@@ -1,0 +1,141 @@
+"""Headline benchmark: publish→match→fan-out throughput on TPU.
+
+Reproduces BASELINE.json config 2/3 (wildcard subscriptions over a
+5-level topic tree, Zipf publish mix): builds a subscription trie of
+``BENCH_SUBS`` filters (60% literal / 25% single-level ``+`` / 15%
+multi-level ``#``), compiles the CSR automaton + fan-out tables to the
+device, and measures steady-state matched publishes/sec through the
+jitted NFA-walk + subscriber-gather pipeline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "msgs/sec", "vs_baseline": N}
+
+vs_baseline is measured against the north-star target of 1M publishes/
+sec (BASELINE.md — the reference publishes no measured numbers, so the
+target is the baseline).
+"""
+
+import json
+import os
+import random
+import time
+
+import numpy as np
+
+
+def build_filters(rng, n_subs, words_per_level, levels=5):
+    filters = set()
+    vocab = [[f"w{lvl}_{i}" for i in range(words_per_level)]
+             for lvl in range(levels)]
+    while len(filters) < n_subs:
+        depth = rng.randint(2, levels)
+        ws = [rng.choice(vocab[i]) for i in range(depth)]
+        r = rng.random()
+        if r < 0.25:  # single-level '+'
+            ws[rng.randrange(depth)] = "+"
+        elif r < 0.40:  # multi-level '#'
+            ws = ws[: rng.randint(1, depth)] + ["#"]
+        filters.add("/".join(ws))
+    return list(filters), vocab
+
+
+def zipf_choice(rng, items, a=1.3):
+    # Zipf-ish publish mix (BASELINE config 2)
+    n = len(items)
+    while True:
+        k = int(rng.paretovariate(a)) - 1
+        if k < n:
+            return items[k]
+
+
+def main():
+    n_subs = int(os.environ.get("BENCH_SUBS", "1000000"))
+    batch = int(os.environ.get("BENCH_BATCH", "8192"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    k = int(os.environ.get("BENCH_K", "48"))
+    m = int(os.environ.get("BENCH_M", "64"))
+    d = int(os.environ.get("BENCH_D", "128"))
+    levels = 5
+
+    import jax
+
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.ops.csr import build_automaton
+    from emqx_tpu.ops.fanout import build_fanout, gather_subscribers
+    from emqx_tpu.ops.match import match_batch
+    from emqx_tpu.ops.tokenize import WordTable, encode_batch
+
+    rng = random.Random(0)
+    t0 = time.time()
+    filters, vocab = build_filters(rng, n_subs, words_per_level=60,
+                                   levels=levels)
+    trie = TrieOracle()
+    table = WordTable()
+    fids = {}
+    for f in filters:
+        trie.insert(f)
+        fids[f] = len(fids)
+        for w in f.split("/"):
+            table.intern(w)
+    auto = build_automaton(trie, fids, table)
+    # one subscriber per subscription (10M-sub scale is sub-id bitmaps
+    # over the same CSR; bench config keeps 1:1)
+    fan = build_fanout({i: [i] for i in range(len(filters))}, len(filters))
+    build_s = time.time() - t0
+
+    auto = jax.device_put(auto)
+    fan = jax.device_put(fan)
+
+    # publish batches: Zipf over the filter tree's own vocabulary
+    n_batches = 8
+    batches = []
+    for _ in range(n_batches):
+        topics = [
+            "/".join(zipf_choice(rng, vocab[i])
+                     for i in range(rng.randint(2, levels)))
+            for _ in range(batch)
+        ]
+        batches.append(encode_batch(table, topics, 16))
+
+    def step(ids, n, sysm):
+        res = match_batch(auto, ids, n, sysm, k=k, m=m)
+        subs, dcount, dovf = gather_subscribers(fan, res.ids, d=d)
+        return res.count, dcount, res.overflow | dovf
+
+    # warmup / compile
+    out = step(*batches[0])
+    jax.block_until_ready(out)
+
+    t1 = time.time()
+    outs = []
+    for i in range(iters):
+        outs.append(step(*batches[i % n_batches]))
+    jax.block_until_ready(outs)
+    dt = time.time() - t1
+
+    total_msgs = batch * iters
+    throughput = total_msgs / dt
+    counts = np.asarray(outs[0][0])
+    deliv = np.asarray(outs[0][1])
+    ovf = sum(int(np.asarray(o[2]).sum()) for o in outs)
+    info = {
+        "subs": len(filters),
+        "batch": batch,
+        "build_s": round(build_s, 1),
+        "avg_matches_per_msg": round(float(counts.mean()), 2),
+        "avg_deliveries_per_msg": round(float(deliv.mean()), 2),
+        "overflow_frac": round(ovf / total_msgs, 6),
+        "device": str(jax.devices()[0]),
+    }
+    import sys
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "publish_match_fanout_throughput",
+        "value": round(throughput, 1),
+        "unit": "msgs/sec",
+        "vs_baseline": round(throughput / 1_000_000, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

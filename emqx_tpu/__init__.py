@@ -1,0 +1,18 @@
+"""emqx_tpu — a TPU-native publish/subscribe message-routing framework.
+
+A ground-up re-design of the EMQ X 4.0 broker core (reference:
+/root/reference, Erlang/OTP) for TPU hardware: the hot publish path —
+wildcard topic matching and subscriber fan-out — runs as a compiled
+JAX/XLA program over publish batches, with the subscription trie
+flattened into a CSR state automaton in HBM and multi-chip operation
+via jax.sharding meshes and XLA collectives.
+
+Public API mirrors the reference's `emqx` facade (src/emqx.erl:26-64):
+subscribe/unsubscribe/publish plus hook management.
+"""
+
+__version__ = "0.1.0"
+
+from emqx_tpu import topic  # noqa: F401
+
+__all__ = ["topic", "__version__"]

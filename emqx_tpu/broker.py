@@ -1,0 +1,196 @@
+"""The PubSub core: subscription tables, publish entry, dispatch.
+
+Mirrors ``src/emqx_broker.erl``: ``subscribe/3`` (127-136),
+``publish/1`` (200-210, incl. the 'message.publish' hook veto at
+204-205), ``dispatch/2`` (283-309) and ``subscriber_down/1``
+(331-348). The route step (aggre/forward, 233-281) goes through the
+:class:`~emqx_tpu.router.Router`, whose match side is the compiled
+TPU automaton; remote destinations are handed to a pluggable
+``forwarder`` (the emqx_rpc seam — kept behind one interface so tests
+and single-node runs exercise the full match/dispatch logic, SURVEY
+§4 "multi-node without a real cluster").
+
+Subscribers are any objects with ``deliver(topic, msg)``; sessions
+(:mod:`emqx_tpu.session`) implement this protocol. For bulk/batched
+publishing, :meth:`Broker.publish_batch` matches a whole batch on
+device in one compiled call — this is the TPU-native throughput path
+(the reference's per-connection processes ingest one message at a
+time; here ingress batches per tick, SURVEY §2.2).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from emqx_tpu import topic as T
+from emqx_tpu.hooks import Hooks
+from emqx_tpu.metrics import Metrics
+from emqx_tpu.router import MatcherConfig, Router
+from emqx_tpu.shared_sub import SharedSub
+from emqx_tpu.types import Message, SubOpts
+
+log = logging.getLogger("emqx_tpu.broker")
+
+
+class Broker:
+    def __init__(
+        self,
+        router: Optional[Router] = None,
+        hooks: Optional[Hooks] = None,
+        metrics: Optional[Metrics] = None,
+        shared: Optional[SharedSub] = None,
+        node: str = "local",
+        config: Optional[MatcherConfig] = None,
+    ) -> None:
+        self.node = node
+        self.router = router or Router(config=config, node=node)
+        self.hooks = hooks or Hooks()
+        self.metrics = metrics or Metrics()
+        self.shared = shared or SharedSub()
+        # filter -> {subscriber: SubOpts}   (emqx_subscriber / emqx_suboption)
+        self._subscribers: Dict[str, Dict[object, SubOpts]] = {}
+        # subscriber -> {filter: SubOpts}   (emqx_subscription)
+        self._subscriptions: Dict[object, Dict[str, SubOpts]] = {}
+        # pluggable cross-node forwarder (emqx_rpc seam); set by cluster
+        self.forwarder = None
+
+    # -- subscribe / unsubscribe (emqx_broker.erl:127-196) ----------------
+
+    def subscribe(self, sub: object, topic_filter: str,
+                  opts: Optional[SubOpts] = None) -> SubOpts:
+        """Subscribe ``sub`` to ``topic_filter`` (may carry a
+        ``$share/<group>/`` prefix). Subscriptions are keyed by the
+        full filter string, so a shared and a plain subscription on
+        the same bare filter coexist independently."""
+        T.validate(topic_filter, "filter")
+        flt, popts = T.parse(topic_filter)
+        opts = opts or SubOpts()
+        if "share" in popts:
+            opts.share = popts["share"]
+        subs = self._subscriptions.setdefault(sub, {})
+        resub = topic_filter in subs
+        subs[topic_filter] = opts
+        if opts.share is not None:
+            if not resub:
+                self.shared.subscribe(opts.share, flt, sub)
+                self.router.add_route(flt, dest=(opts.share, self.node))
+        else:
+            self._subscribers.setdefault(flt, {})[sub] = opts
+            if not resub:
+                self.router.add_route(flt, dest=self.node)
+        return opts
+
+    def unsubscribe(self, sub: object, topic_filter: str) -> bool:
+        flt, popts = T.parse(topic_filter)
+        subs = self._subscriptions.get(sub)
+        if subs is None or topic_filter not in subs:
+            return False
+        opts = subs.pop(topic_filter)
+        if not subs:
+            del self._subscriptions[sub]
+        share = popts.get("share", opts.share)
+        if share is not None:
+            self.shared.unsubscribe(share, flt, sub)
+            self.router.delete_route(flt, dest=(share, self.node))
+        else:
+            ftab = self._subscribers.get(flt)
+            if ftab is not None:
+                ftab.pop(sub, None)
+                if not ftab:
+                    del self._subscribers[flt]
+            self.router.delete_route(flt, dest=self.node)
+        return True
+
+    def subscriber_down(self, sub: object) -> None:
+        """Drop all of a dead subscriber's subscriptions
+        (emqx_broker.erl:331-348)."""
+        for key in list(self._subscriptions.get(sub, {})):
+            self.unsubscribe(sub, key)
+        self.shared.subscriber_down(sub)
+
+    def subscribers(self, topic_filter: str) -> List[object]:
+        return list(self._subscribers.get(topic_filter, ()))
+
+    def subscriptions(self, sub: object) -> Dict[str, SubOpts]:
+        return dict(self._subscriptions.get(sub, {}))
+
+    def suboption(self, sub: object, topic_filter: str) -> Optional[SubOpts]:
+        return self._subscriptions.get(sub, {}).get(topic_filter)
+
+    # -- publish (emqx_broker.erl:200-309) --------------------------------
+
+    def publish(self, msg: Message) -> int:
+        """Publish one message; returns delivery count."""
+        return self.publish_batch([msg])[0]
+
+    def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
+        """Batch publish: one compiled device match for the whole
+        batch, then per-message dispatch. The TPU hot path."""
+        live: List[Tuple[int, Message]] = []
+        results = [0] * len(msgs)
+        for i, msg in enumerate(msgs):
+            self.metrics.inc_msg(msg)
+            out = self.hooks.run_fold("message.publish", (), msg)
+            if out is None or (
+                    out.get_header("allow_publish") is False):
+                self.metrics.inc("messages.dropped")
+                continue
+            live.append((i, out))
+        if not live:
+            return results
+        matched = self.router.match_filters([m.topic for _, m in live])
+        for (i, msg), filters in zip(live, matched):
+            if not filters:
+                self.metrics.inc("messages.dropped")
+                self.metrics.inc("messages.dropped.no_subscribers")
+                continue
+            results[i] = self._route(filters, msg)
+        return results
+
+    def _route(self, filters: List[str], msg: Message) -> int:
+        """Fan a matched message out to local subscribers, shared
+        groups, and remote nodes (route/2 + aggre/1 + forward/4)."""
+        n = 0
+        remote_nodes = set()
+        for flt in filters:
+            for route in self.router.lookup_routes(flt):
+                dest = route.dest
+                if isinstance(dest, tuple):  # (group, node) shared route
+                    group, node = dest
+                    if node == self.node:
+                        n += self.shared.dispatch(group, flt, msg)
+                    else:
+                        remote_nodes.add(node)
+                elif dest == self.node:
+                    n += self.dispatch(flt, msg)
+                else:
+                    remote_nodes.add(dest)
+        for node in remote_nodes:  # one forward per node (aggre dedup)
+            if self.forwarder is not None:
+                self.forwarder(node, msg)
+                self.metrics.inc("messages.forward")
+        return n
+
+    def dispatch(self, topic_filter: str, msg: Message) -> int:
+        """Deliver to every local subscriber of ``topic_filter``
+        (emqx_broker.erl:283-309)."""
+        ftab = self._subscribers.get(topic_filter)
+        if not ftab:
+            return 0
+        n = 0
+        for sub, opts in list(ftab.items()):
+            if opts.nl and getattr(sub, "client_id", None) == msg.from_:
+                self.metrics.inc("delivery.dropped")
+                self.metrics.inc("delivery.dropped.no_local")
+                continue
+            try:
+                # the deliver carries the *subscribed filter* so the
+                # session can resolve its subopts (emqx_broker.erl:298)
+                sub.deliver(topic_filter, msg)
+                n += 1
+            except Exception:
+                log.exception("deliver to %r failed", sub)
+        if n:
+            self.metrics.inc("messages.delivered", n)
+        return n

@@ -1,0 +1,124 @@
+"""Broker metrics: fixed-index counter array + named registry.
+
+Mirrors ``src/emqx_metrics.erl``: a lock-free counters array indexed
+by a name registry (emqx_metrics.erl:230-271) with the standard
+BYTES/PACKETS/MESSAGES/DELIVERY metric names pre-registered
+(emqx_metrics.erl:82-183). Host counters are a numpy int64 array
+(single-writer per-process); the device publish step additionally
+accumulates per-batch counts on-TPU and folds them in with one
+transfer per flush (the reference's pdict-batched counter idea,
+src/emqx_pd.erl).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+MAX_METRICS = 1024
+
+# Pre-registered names (counter kind), reference emqx_metrics.erl:82-183
+BYTES_METRICS = ["bytes.received", "bytes.sent"]
+PACKET_METRICS = [
+    "packets.received", "packets.sent",
+    "packets.connect.received", "packets.connack.sent",
+    "packets.connack.error", "packets.connack.auth_error",
+    "packets.publish.received", "packets.publish.sent",
+    "packets.publish.error", "packets.publish.auth_error",
+    "packets.publish.dropped",
+    "packets.puback.received", "packets.puback.sent",
+    "packets.puback.inuse", "packets.puback.missed",
+    "packets.pubrec.received", "packets.pubrec.sent",
+    "packets.pubrec.inuse", "packets.pubrec.missed",
+    "packets.pubrel.received", "packets.pubrel.sent",
+    "packets.pubrel.missed",
+    "packets.pubcomp.received", "packets.pubcomp.sent",
+    "packets.pubcomp.inuse", "packets.pubcomp.missed",
+    "packets.subscribe.received", "packets.suback.sent",
+    "packets.subscribe.error", "packets.subscribe.auth_error",
+    "packets.unsubscribe.received", "packets.unsuback.sent",
+    "packets.unsubscribe.error",
+    "packets.pingreq.received", "packets.pingresp.sent",
+    "packets.disconnect.received", "packets.disconnect.sent",
+    "packets.auth.received", "packets.auth.sent",
+]
+MESSAGE_METRICS = [
+    "messages.received", "messages.sent",
+    "messages.qos0.received", "messages.qos0.sent",
+    "messages.qos1.received", "messages.qos1.sent",
+    "messages.qos2.received", "messages.qos2.sent",
+    "messages.publish", "messages.dropped",
+    "messages.dropped.expired", "messages.dropped.no_subscribers",
+    "messages.forward", "messages.retained",
+    "messages.delayed", "messages.delivered", "messages.acked",
+]
+DELIVERY_METRICS = [
+    "delivery.dropped", "delivery.dropped.no_local",
+    "delivery.dropped.too_large", "delivery.dropped.qos0_msg",
+    "delivery.dropped.queue_full", "delivery.dropped.expired",
+]
+CLIENT_METRICS = [
+    "client.connect", "client.connack", "client.connected",
+    "client.authenticate", "client.check_acl", "client.subscribe",
+    "client.unsubscribe", "client.disconnected",
+]
+SESSION_METRICS = [
+    "session.created", "session.resumed", "session.takeovered",
+    "session.discarded", "session.terminated",
+]
+AUTH_ACL_METRICS = [
+    "client.auth.anonymous", "client.acl.cache_hit", "client.acl.deny",
+]
+
+ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
+               + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
+               + AUTH_ACL_METRICS)
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._counters = np.zeros((MAX_METRICS,), dtype=np.int64)
+        self._index: Dict[str, int] = {}
+        for name in ALL_METRICS:
+            self.new(name)
+
+    def new(self, name: str) -> int:
+        idx = self._index.get(name)
+        if idx is None:
+            idx = len(self._index)
+            if idx >= MAX_METRICS:
+                raise RuntimeError("metric index overflow")
+            self._index[name] = idx
+        return idx
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self._counters[self._index[name]] += n
+
+    def dec(self, name: str, n: int = 1) -> None:
+        self._counters[self._index[name]] -= n
+
+    def val(self, name: str) -> int:
+        return int(self._counters[self._index[name]])
+
+    def all(self) -> Dict[str, int]:
+        return {n: int(self._counters[i]) for n, i in self._index.items()}
+
+    def names(self) -> List[str]:
+        return list(self._index)
+
+    def inc_msg(self, msg) -> None:
+        """Count an inbound message by QoS (emqx_metrics.erl qos_received)."""
+        self.inc("messages.received")
+        self.inc(f"messages.qos{min(msg.qos, 2)}.received")
+
+    def inc_sent(self, msg) -> None:
+        self.inc("messages.sent")
+        self.inc(f"messages.qos{min(msg.qos, 2)}.sent")
+
+
+_global = Metrics()
+
+
+def global_metrics() -> Metrics:
+    return _global

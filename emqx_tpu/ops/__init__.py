@@ -1,0 +1,2 @@
+"""Device-side compiled ops: tokenization, CSR automaton build, the
+vmapped NFA-walk matcher, and subscriber fan-out."""

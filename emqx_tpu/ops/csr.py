@@ -1,0 +1,146 @@
+"""Flatten the subscription trie into CSR device tables.
+
+The reference stores the trie as two Mnesia tables — edges keyed by
+``{node_id, word}`` and nodes carrying the terminal topic
+(src/emqx_trie.erl:53-74, include/emqx.hrl:96-113). For the TPU the
+trie becomes a static automaton in HBM:
+
+  - literal edges:  CSR ``row_ptr[S+1]`` / ``edge_word[E]`` /
+    ``edge_child[E]`` with words sorted per row (binary-searched by the
+    match kernel);
+  - ``+`` edges:    a dense ``plus_child[S]`` column (-1 = none);
+  - ``#`` edges:    ``hash_filter[S]`` — the filter id terminating at
+    the ``#`` child (``#`` is always a leaf, so the child node is
+    collapsed into its filter id);
+  - terminals:      ``end_filter[S]`` — filter id ending exactly at a
+    state (-1 = none).
+
+State 0 is the root. Arrays are padded to capacity (growth factor 2)
+so that incremental rebuilds keep static shapes and avoid XLA
+recompilation; padded rows are empty and padded edge words are
+INT32_MAX sentinels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import numpy as np
+
+from emqx_tpu import topic as T
+from emqx_tpu.oracle import TrieOracle, _Node
+from emqx_tpu.ops.tokenize import WordTable
+
+_WORD_PAD = np.int32(2**31 - 1)
+
+
+class Automaton(NamedTuple):
+    """CSR topic automaton (numpy or jax arrays; shapes are padded)."""
+
+    row_ptr: np.ndarray      # int32[S_cap + 1]
+    edge_word: np.ndarray    # int32[E_cap], sorted within each row
+    edge_child: np.ndarray   # int32[E_cap]
+    plus_child: np.ndarray   # int32[S_cap]
+    hash_filter: np.ndarray  # int32[S_cap]
+    end_filter: np.ndarray   # int32[S_cap]
+    n_states: int            # live states (root included); static python int
+    n_edges: int             # live literal edges
+
+
+def capacity_for(n: int, cap: int | None = None) -> int:
+    """Next power-of-two capacity ≥ n (min 16), honoring a floor."""
+    c = 16
+    while c < n:
+        c *= 2
+    if cap is not None and cap > c:
+        c = cap
+    return c
+
+
+_capacity = capacity_for
+
+
+def build_automaton(
+    trie: TrieOracle,
+    filter_ids: Dict[str, int],
+    table: WordTable,
+    state_capacity: int | None = None,
+    edge_capacity: int | None = None,
+) -> Automaton:
+    """Flatten ``trie`` into an :class:`Automaton`.
+
+    ``filter_ids`` maps every inserted filter to its dense route id
+    (assigned by the router); ``table`` interns filter words. ``#``
+    child nodes are collapsed (never walked into); ``+`` children are
+    ordinary states.
+    """
+    # BFS assigning dense state ids; root = 0.
+    states: list[_Node] = [trie.root]
+    index: dict[int, int] = {id(trie.root): 0}
+    edges_per_state: list[list[tuple[int, int]]] = []  # (word_id, child_state)
+    plus: list[int] = []
+    hashf: list[int] = []
+    endf: list[int] = []
+
+    i = 0
+    while i < len(states):
+        node = states[i]
+        i += 1
+        lits: list[tuple[int, int]] = []
+        p = -1
+        h = -1
+        for w, child in node.children.items():
+            if w == T.HASH:
+                if child.filter is not None:
+                    h = filter_ids[child.filter]
+                continue
+            sid = index.get(id(child))
+            if sid is None:
+                sid = len(states)
+                index[id(child)] = sid
+                states.append(child)
+            if w == T.PLUS:
+                p = sid
+            else:
+                lits.append((table.intern(w), sid))
+        lits.sort()
+        edges_per_state.append(lits)
+        plus.append(p)
+        hashf.append(h)
+        endf.append(-1 if node.filter is None else filter_ids[node.filter])
+
+    S = len(states)
+    E = sum(len(e) for e in edges_per_state)
+    S_cap = _capacity(S, state_capacity)
+    E_cap = _capacity(E + 1, edge_capacity)  # +1: binary search may read [E]
+
+    row_ptr = np.full((S_cap + 1,), E, dtype=np.int32)
+    edge_word = np.full((E_cap,), _WORD_PAD, dtype=np.int32)
+    edge_child = np.full((E_cap,), -1, dtype=np.int32)
+    plus_child = np.full((S_cap,), -1, dtype=np.int32)
+    hash_filter = np.full((S_cap,), -1, dtype=np.int32)
+    end_filter = np.full((S_cap,), -1, dtype=np.int32)
+
+    pos = 0
+    for s in range(S):
+        row_ptr[s] = pos
+        for wid, child in edges_per_state[s]:
+            edge_word[pos] = wid
+            edge_child[pos] = child
+            pos += 1
+    row_ptr[S:] = pos  # live-end and padded rows all point at E
+
+    plus_child[:S] = plus
+    hash_filter[:S] = hashf
+    end_filter[:S] = endf
+
+    return Automaton(
+        row_ptr=row_ptr,
+        edge_word=edge_word,
+        edge_child=edge_child,
+        plus_child=plus_child,
+        hash_filter=hash_filter,
+        end_filter=end_filter,
+        n_states=S,
+        n_edges=E,
+    )

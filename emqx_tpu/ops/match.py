@@ -1,0 +1,138 @@
+"""The compiled NFA-walk topic matcher — the publish hot loop.
+
+This replaces the reference's per-word ETS trie walk
+(src/emqx_trie.erl:161-186, "HOT LOOP 1" in SURVEY §3.1) with a
+batched, fixed-shape automaton walk under ``jit``:
+
+  - a publish batch ``[B, L]`` of interned word ids is matched
+    against the CSR automaton (:mod:`emqx_tpu.ops.csr`) with one
+    ``lax.scan`` over topic levels;
+  - the NFA active set (≤ K states) advances by literal edges
+    (per-row binary search) and ``+`` edges; ``#`` terminals are
+    collected at every level (including the end-of-topic level — the
+    reference's ``'match_#'`` at match_node/3 :161-186);
+  - topics whose first word starts with ``$`` suppress root-level
+    wildcards (emqx_trie.erl:162-163);
+  - results are the matched filter ids ``[B, M]`` (-1 padded) plus a
+    per-topic overflow flag. Overflowed topics (active set > K or
+    matches > M or levels > L) must be re-matched on the host oracle —
+    parity is preserved by fallback, never silently truncated.
+
+All shapes are static; there is no data-dependent control flow, so XLA
+tiles and fuses the walk. ``vmap`` supplies the batch dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from emqx_tpu.ops.csr import Automaton
+
+
+class MatchResult(NamedTuple):
+    ids: jax.Array       # int32[B, M] matched filter ids, -1 padded
+    count: jax.Array     # int32[B] number of valid ids (clamped to M)
+    overflow: jax.Array  # bool[B] — host-oracle fallback required
+
+
+def _edge_lookup(auto: Automaton, iters: int, state: jax.Array, word: jax.Array) -> jax.Array:
+    """Child state via binary search in the state's CSR row, -1 if none.
+
+    ``state`` may be -1 (inactive); ``word`` may be negative
+    (UNKNOWN/PAD) — both yield -1.
+    """
+    e_cap = auto.edge_word.shape[0]
+    s = jnp.maximum(state, 0)
+    lo = auto.row_ptr[s]
+    hi = auto.row_ptr[s + 1]
+    row_end = hi
+
+    def body(_, lh):
+        lo, hi = lh
+        mid = jnp.minimum((lo + hi) // 2, e_cap - 1)
+        pred = lo < hi
+        less = auto.edge_word[mid] < word
+        new_lo = jnp.where(pred & less, mid + 1, lo)
+        new_hi = jnp.where(pred & ~less, mid, hi)
+        return new_lo, new_hi
+
+    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
+    idx = jnp.minimum(lo, e_cap - 1)
+    found = (state >= 0) & (word >= 0) & (lo < row_end) & (auto.edge_word[idx] == word)
+    return jnp.where(found, auto.edge_child[idx], -1)
+
+
+def _compact(cands: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Compact candidate states [2K] (-1 invalid) into [K]; overflow if >K.
+
+    Trie children are unique (each node has one parent), so no dedup is
+    needed — compaction is pure packing.
+    """
+    count = jnp.sum(cands >= 0)
+    # Descending sort packs valid states to the front; -1s sink.
+    packed = -jnp.sort(-cands)[:k]
+    return packed, count > k
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m"))
+def match_batch(
+    auto: Automaton,
+    word_ids: jax.Array,   # int32[B, L]
+    n_words: jax.Array,    # int32[B] (-1 = too many levels → overflow)
+    sys_mask: jax.Array,   # bool[B]
+    *,
+    k: int = 64,
+    m: int = 128,
+) -> MatchResult:
+    """Match a publish batch against the automaton. See module doc."""
+    L = word_ids.shape[1]
+    iters = max(1, math.ceil(math.log2(auto.edge_word.shape[0] + 1)))
+
+    def one(words: jax.Array, n: jax.Array, is_sys: jax.Array):
+        active0 = jnp.full((k,), -1, dtype=jnp.int32).at[0].set(0)
+        # Pad the level axis: step L sees PAD words only (end-of-topic).
+        words_ext = jnp.concatenate([words, jnp.full((1,), -2, dtype=jnp.int32)])
+
+        def step(carry, xs):
+            active, ovf = carry
+            word, l = xs
+            alive = active >= 0
+            at_root_sys = (l == 0) & is_sys
+            walking = l < n
+            ending = l == n
+
+            # '#'-child terminals at every live level (match_# semantics)
+            emit_h = jnp.where(
+                alive & (walking | ending) & ~at_root_sys,
+                auto.hash_filter[jnp.maximum(active, 0)], -1)
+            # exact terminals at end-of-topic
+            emit_e = jnp.where(
+                alive & ending, auto.end_filter[jnp.maximum(active, 0)], -1)
+
+            lit = jax.vmap(lambda s: _edge_lookup(auto, iters, s, word))(active)
+            plus = jnp.where(
+                alive & ~at_root_sys, auto.plus_child[jnp.maximum(active, 0)], -1)
+            cands = jnp.where(walking, jnp.concatenate([lit, plus]), -1)
+            nxt, over = _compact(cands, k)
+            return (nxt, ovf | over), jnp.concatenate([emit_h, emit_e])
+
+        levels = jnp.arange(L + 1, dtype=jnp.int32)
+        (_, ovf), emits = lax.scan(
+            step, (active0, jnp.asarray(False)), (words_ext, levels))
+        flat = emits.reshape(-1)
+        cnt = jnp.sum(flat >= 0)
+        ids = -jnp.sort(-flat)[:m]
+        too_long = n < 0
+        return MatchResult(
+            ids=jnp.where(too_long, -1, ids),
+            count=jnp.where(too_long, 0, jnp.minimum(cnt, m)).astype(jnp.int32),
+            overflow=ovf | (cnt > m) | too_long,
+        )
+
+    return jax.vmap(one)(word_ids, n_words, sys_mask)

@@ -1,0 +1,3 @@
+"""Multi-chip operation: device meshes, sharded automatons, and the
+collective match step (the reference's cluster routing layer mapped
+onto ICI, SURVEY §2.3)."""

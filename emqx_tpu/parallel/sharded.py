@@ -1,0 +1,202 @@
+"""Sharded automaton + the collective publish step.
+
+Multi-chip design (replaces the reference's replicated-Mnesia reads +
+gen_rpc forwarding, SURVEY §2.3):
+
+  - the filter set is partitioned round-robin into T *trie shards*;
+    each shard is flattened into its own CSR automaton whose tables
+    carry GLOBAL filter ids, padded to common capacities and stacked
+    along a leading shard axis sharded over the mesh's ``trie`` axis;
+  - the publish batch is sharded over the ``data`` axis and
+    *replicated* over ``trie`` (every trie shard sees every topic in
+    its data slice);
+  - inside ``shard_map`` each chip matches its batch slice against its
+    automaton shard, then match ids are all-gathered over ``trie``
+    (ICI collective — the analogue of aggre/forward,
+    src/emqx_broker.erl:243-281) giving every data shard its full
+    route set;
+  - per-batch counters are ``psum``-reduced over the whole mesh (the
+    metrics fold, src/emqx_metrics.erl:230-271).
+
+The walk is identical to the single-chip kernel — sharding composes
+around :func:`emqx_tpu.ops.match.match_batch`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.ops.csr import Automaton, build_automaton
+from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.fanout import FanoutTable, build_fanout, gather_subscribers
+from emqx_tpu.ops.tokenize import WordTable
+
+
+class ShardedAutomaton(NamedTuple):
+    """T stacked automatons; leading axis is the trie-shard axis."""
+
+    row_ptr: jax.Array      # [T, S_cap+1]
+    edge_word: jax.Array    # [T, E_cap]
+    edge_child: jax.Array   # [T, E_cap]
+    plus_child: jax.Array   # [T, S_cap]
+    hash_filter: jax.Array  # [T, S_cap]
+    end_filter: jax.Array   # [T, S_cap]
+
+
+class ShardedFanout(NamedTuple):
+    row_ptr: jax.Array  # [T, F_cap+1] — filter-id -> local sub rows
+    sub_ids: jax.Array  # [T, N_cap]
+
+
+def shard_filters(filters: Sequence[str], n_shards: int) -> List[List[str]]:
+    """Round-robin partition (balances edge counts for uniform load)."""
+    shards: List[List[str]] = [[] for _ in range(n_shards)]
+    for i, f in enumerate(filters):
+        shards[i % n_shards].append(f)
+    return shards
+
+
+def build_sharded(
+    filter_shards: Sequence[Sequence[str]],
+    filter_ids: Dict[str, int],
+    table: WordTable,
+) -> ShardedAutomaton:
+    """Build one automaton per shard (global filter ids), pad to the
+    max capacity, and stack."""
+    autos = []
+    for shard in filter_shards:
+        trie = TrieOracle()
+        for f in shard:
+            trie.insert(f)
+        autos.append(build_automaton(trie, filter_ids, table))
+    s_cap = max(a.row_ptr.shape[0] - 1 for a in autos)
+    e_cap = max(a.edge_word.shape[0] for a in autos)
+    padded = [_pad_automaton(a, s_cap, e_cap) for a in autos]
+    return ShardedAutomaton(
+        row_ptr=np.stack([a.row_ptr for a in padded]),
+        edge_word=np.stack([a.edge_word for a in padded]),
+        edge_child=np.stack([a.edge_child for a in padded]),
+        plus_child=np.stack([a.plus_child for a in padded]),
+        hash_filter=np.stack([a.hash_filter for a in padded]),
+        end_filter=np.stack([a.end_filter for a in padded]),
+    )
+
+
+def _pad_automaton(a: Automaton, s_cap: int, e_cap: int) -> Automaton:
+    """Grow a built automaton's arrays to shared capacities (padded
+    rows are empty; padded edges are out-of-range sentinels)."""
+    from emqx_tpu.ops.csr import _WORD_PAD
+
+    def pad(arr, n, fill):
+        if arr.shape[0] == n:
+            return arr
+        out = np.full((n,), fill, dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    return Automaton(
+        row_ptr=pad(a.row_ptr, s_cap + 1, a.n_edges),
+        edge_word=pad(a.edge_word, e_cap, _WORD_PAD),
+        edge_child=pad(a.edge_child, e_cap, -1),
+        plus_child=pad(a.plus_child, s_cap, -1),
+        hash_filter=pad(a.hash_filter, s_cap, -1),
+        end_filter=pad(a.end_filter, s_cap, -1),
+        n_states=a.n_states,
+        n_edges=a.n_edges,
+    )
+
+
+def build_sharded_fanout(
+    rows_per_shard: Sequence[Dict[int, Sequence[int]]],
+    num_filters: int,
+) -> ShardedFanout:
+    fans = [build_fanout(rows, num_filters) for rows in rows_per_shard]
+    f_cap = max(f.row_ptr.shape[0] - 1 for f in fans)
+    e_cap = max(f.sub_ids.shape[0] for f in fans)
+    fans = [
+        build_fanout(rows, num_filters, filter_capacity=f_cap,
+                     entry_capacity=e_cap)
+        for rows in rows_per_shard
+    ]
+    return ShardedFanout(
+        row_ptr=np.stack([f.row_ptr for f in fans]),
+        sub_ids=np.stack([f.sub_ids for f in fans]),
+    )
+
+
+def place_sharded(mesh: Mesh, sharded: NamedTuple):
+    """Put stacked shard arrays onto the mesh: leading axis on 'trie',
+    replicated over 'data'."""
+    spec = NamedSharding(mesh, P("trie"))
+    return type(sharded)(*[jax.device_put(x, spec) for x in sharded])
+
+
+def place_batch(mesh: Mesh, word_ids, n_words, sys_mask):
+    spec = NamedSharding(mesh, P("data"))
+    return (jax.device_put(word_ids, spec),
+            jax.device_put(n_words, spec),
+            jax.device_put(sys_mask, spec))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "k", "m", "d", "with_fanout"))
+def publish_step(
+    mesh: Mesh,
+    auto: ShardedAutomaton,
+    fan: ShardedFanout,
+    word_ids: jax.Array,   # [B, L] sharded over 'data'
+    n_words: jax.Array,    # [B]
+    sys_mask: jax.Array,   # [B]
+    *,
+    k: int = 64,
+    m: int = 128,
+    d: int = 128,
+    with_fanout: bool = True,
+):
+    """The full multi-chip publish step.
+
+    Returns (match_ids [B, T*m], sub_ids [B, T*d], stats) where stats
+    is a dict of mesh-summed counters (matches, deliveries, overflows)
+    — the device metric accumulator.
+    """
+    T = mesh.shape["trie"]
+
+    def local(auto_t, fan_t, ids, n, sysm):
+        a = Automaton(
+            row_ptr=auto_t.row_ptr[0], edge_word=auto_t.edge_word[0],
+            edge_child=auto_t.edge_child[0], plus_child=auto_t.plus_child[0],
+            hash_filter=auto_t.hash_filter[0], end_filter=auto_t.end_filter[0],
+            n_states=0, n_edges=0)
+        res = match_batch(a, ids, n, sysm, k=k, m=m)
+        if with_fanout:
+            f = FanoutTable(fan_t.row_ptr[0], fan_t.sub_ids[0], 0, 0)
+            subs, dcount, dovf = gather_subscribers(f, res.ids, d=d)
+        else:
+            subs = jnp.zeros((ids.shape[0], d), jnp.int32)
+            dcount = jnp.zeros((ids.shape[0],), jnp.int32)
+            dovf = jnp.zeros((ids.shape[0],), bool)
+        # exchange shard-local matches over ICI: every data shard gets
+        # the union of all trie shards' match ids
+        all_ids = jax.lax.all_gather(res.ids, "trie", axis=1, tiled=True)
+        all_subs = jax.lax.all_gather(subs, "trie", axis=1, tiled=True)
+        stats = {
+            "matches": jax.lax.psum(jnp.sum(res.count), ("data", "trie")),
+            "deliveries": jax.lax.psum(jnp.sum(dcount), ("data", "trie")),
+            "overflows": jax.lax.psum(
+                jnp.sum(res.overflow | dovf), ("data", "trie")),
+        }
+        return all_ids, all_subs, stats
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("trie"), P("trie"), P("data"), P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P()),
+        check_vma=False,  # scan carries start replicated, become varying
+    )(auto, fan, word_ids, n_words, sys_mask)

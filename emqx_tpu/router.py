@@ -1,0 +1,217 @@
+"""The router: authoritative route state + the compiled device matcher.
+
+Replaces the reference's ``emqx_router``/``emqx_trie`` pair
+(src/emqx_router.erl:113-133, src/emqx_trie.erl): routes are a host
+map ``filter → {dest: refcount}`` (the Mnesia ``emqx_route`` bag), and
+the *match* side is a TPU-resident CSR automaton rebuilt incrementally
+from the host trie. Differences by design (SURVEY §7):
+
+  - the reference keeps exact-match routes out of the trie and unions
+    a direct ETS lookup at match time (emqx_router.erl:127-133); here
+    *all* filters live in the automaton, so one device walk returns
+    the full route set — an exact filter is just a literal path;
+  - rebuilds are double-buffered: matching continues against the live
+    automaton while the new one is flattened; the swap is atomic from
+    the caller's perspective (the reference's transactional trie
+    insert, emqx_router.erl:229-234);
+  - topics that exceed the kernel's static bounds fall back to the
+    host oracle (exact parity, never truncation).
+
+Thread-safety follows the reference's serialization model: writes go
+through one writer (the reference hashes topics onto router_pool
+workers, emqx_router.erl:185-186); here a mutex serializes mutations.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from emqx_tpu import topic as T
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.ops.csr import Automaton, build_automaton
+from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.tokenize import WordTable, encode_batch
+from emqx_tpu.types import Route
+
+
+@dataclass
+class MatcherConfig:
+    max_levels: int = 16    # L — deeper topics fall back to the oracle
+    active_k: int = 64      # NFA active-set capacity
+    max_matches: int = 128  # match output capacity
+    min_batch: int = 8      # batch padding bucket floor (pow2 buckets)
+    use_device: bool = True
+
+
+class Router:
+    """Cluster route table + compiled matcher (one per node)."""
+
+    def __init__(self, config: Optional[MatcherConfig] = None,
+                 node: str = "local") -> None:
+        self.config = config or MatcherConfig()
+        self.node = node
+        self._lock = threading.RLock()
+        self._trie = TrieOracle()
+        self._table = WordTable()
+        # filter -> {dest: refcount}; bag semantics (emqx_route)
+        self._routes: Dict[str, Dict[object, int]] = {}
+        self._filter_ids: Dict[str, int] = {}
+        self._id_to_filter: List[Optional[str]] = []
+        self._free_ids: List[int] = []
+        self._auto: Optional[Automaton] = None  # live device automaton
+        # id→filter snapshot taken when _auto was built: translations
+        # of device match ids must use the map the automaton encodes,
+        # not the live one (ids are recycled across rebuilds)
+        self._auto_map: tuple = ()
+        self._dirty = True
+        self._rebuilds = 0
+
+    # -- route table mutation (emqx_router:do_add_route/do_delete_route) --
+
+    def _assign_id(self, filter_: str) -> int:
+        fid = self._filter_ids.get(filter_)
+        if fid is None:
+            if self._free_ids:
+                fid = self._free_ids.pop()
+                self._id_to_filter[fid] = filter_
+            else:
+                fid = len(self._id_to_filter)
+                self._id_to_filter.append(filter_)
+            self._filter_ids[filter_] = fid
+        return fid
+
+    def add_route(self, filter_: str, dest: object = None) -> int:
+        """Add a route; returns the filter's dense id."""
+        dest = self.node if dest is None else dest
+        with self._lock:
+            dests = self._routes.get(filter_)
+            if dests is None:
+                dests = {}
+                self._routes[filter_] = dests
+                self._trie.insert(filter_)
+                self._dirty = True
+            dests[dest] = dests.get(dest, 0) + 1
+            return self._assign_id(filter_)
+
+    def delete_route(self, filter_: str, dest: object = None) -> None:
+        dest = self.node if dest is None else dest
+        with self._lock:
+            dests = self._routes.get(filter_)
+            if dests is None or dest not in dests:
+                return
+            dests[dest] -= 1
+            if dests[dest] <= 0:
+                del dests[dest]
+            if not dests:
+                del self._routes[filter_]
+                self._trie.delete(filter_)
+                fid = self._filter_ids.pop(filter_)
+                self._id_to_filter[fid] = None
+                self._free_ids.append(fid)
+                self._dirty = True
+
+    def has_route(self, filter_: str) -> bool:
+        return filter_ in self._routes
+
+    def topics(self) -> List[str]:
+        return list(self._routes)
+
+    def lookup_routes(self, filter_: str) -> List[Route]:
+        dests = self._routes.get(filter_, {})
+        return [Route(filter_, d) for d in dests]
+
+    def filter_id(self, filter_: str) -> Optional[int]:
+        return self._filter_ids.get(filter_)
+
+    def cleanup_routes(self, node: object) -> None:
+        """Purge all routes pointing at a dead node
+        (emqx_router_helper.erl:173-177)."""
+        with self._lock:
+            for f in [f for f, d in self._routes.items() if node in d]:
+                dests = self._routes[f]
+                del dests[node]
+                if not dests:
+                    del self._routes[f]
+                    self._trie.delete(f)
+                    fid = self._filter_ids.pop(f)
+                    self._id_to_filter[fid] = None
+                    self._free_ids.append(fid)
+                    self._dirty = True
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "routes.count": sum(len(d) for d in self._routes.values()),
+            "topics.count": len(self._routes),
+            "rebuilds": self._rebuilds,
+        }
+
+    # -- automaton lifecycle ---------------------------------------------
+
+    def rebuild(self) -> Automaton:
+        """Flatten the trie to a fresh automaton (double-buffered: the
+        previous one stays live for concurrent matchers until swap)."""
+        with self._lock:
+            prev = self._auto
+            cap_s = prev.row_ptr.shape[0] - 1 if prev is not None else None
+            cap_e = prev.edge_word.shape[0] if prev is not None else None
+            auto = build_automaton(
+                self._trie, self._filter_ids, self._table,
+                state_capacity=cap_s, edge_capacity=cap_e)
+            if self.config.use_device:
+                auto = jax.device_put(auto)
+            self._auto = auto
+            self._auto_map = tuple(self._id_to_filter)
+            self._dirty = False
+            self._rebuilds += 1
+            return auto
+
+    def automaton(self) -> tuple:
+        """(automaton, id→filter snapshot) — a consistent pair."""
+        with self._lock:
+            if self._dirty or self._auto is None:
+                self.rebuild()
+            return self._auto, self._auto_map
+
+    # -- matching (emqx_router:match_routes/1) ----------------------------
+
+    def match_routes(self, topic: str) -> List[Route]:
+        """All routes whose filter matches ``topic``."""
+        [filters] = self.match_filters([topic])
+        out: List[Route] = []
+        for f in filters:
+            out.extend(self.lookup_routes(f))
+        return out
+
+    def match_filters(self, topics: Sequence[str]) -> List[List[str]]:
+        """Batch: matched filter list per topic (device + oracle
+        fallback)."""
+        if not topics:
+            return []
+        if not self.config.use_device or not self._routes:
+            with self._lock:
+                return [self._trie.match(t) for t in topics]
+        cfg = self.config
+        auto, id_map = self.automaton()
+        B = len(topics)
+        bucket = cfg.min_batch
+        while bucket < B:
+            bucket *= 2
+        padded = list(topics) + ["\x00/pad"] * (bucket - B)
+        ids, n, sysm = encode_batch(self._table, padded, cfg.max_levels)
+        res = match_batch(auto, ids, n, sysm, k=cfg.active_k, m=cfg.max_matches)
+        mid = np.asarray(res.ids)
+        ovf = np.asarray(res.overflow)
+        out: List[List[str]] = []
+        for i in range(B):
+            if ovf[i]:
+                with self._lock:
+                    out.append(self._trie.match(topics[i]))
+            else:
+                row = [id_map[j] for j in mid[i] if j >= 0]
+                out.append([f for f in row if f is not None])
+        return out

@@ -1,0 +1,1 @@
+"""Utility substrate (reference: emqx_guid/base62/sequence/batch/misc)."""

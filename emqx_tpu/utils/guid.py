@@ -1,0 +1,32 @@
+"""128-bit time-ordered global unique message ids.
+
+Mirrors the reference's GUID layout (src/emqx_guid.erl:1-150): 64-bit
+microsecond timestamp | node/pid entropy | per-process sequence. Ids
+are monotonically increasing per generator, unique across generators.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_seq = 0
+_node_bits = (os.getpid() & 0xFFFF) << 16 | (
+    int.from_bytes(os.urandom(2), "big"))
+
+
+def new_guid() -> int:
+    """A 128-bit int: ts_us(64) | node+pid entropy(32) | seq(32)."""
+    global _seq
+    ts = int(time.time() * 1_000_000)
+    with _lock:
+        _seq = (_seq + 1) & 0xFFFFFFFF
+        seq = _seq
+    return (ts << 64) | (_node_bits << 32) | seq
+
+
+def guid_timestamp(guid: int) -> float:
+    """Microsecond timestamp embedded in a guid, as seconds."""
+    return (guid >> 64) / 1_000_000

@@ -1,0 +1,31 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's single-node CT strategy (SURVEY §4): the full
+match/dispatch logic runs on one host; multi-chip behaviour is
+exercised on a virtual device mesh (xla_force_host_platform_device_count)
+exactly as the driver's dryrun does.
+
+Env vars must be set before jax initializes a backend; this
+environment also registers a TPU ("axon") PJRT plugin whose
+sitecustomize forces jax_platforms, so we additionally override via
+jax.config (which wins over the env var).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+if jax.config.jax_platforms != "cpu" or len(jax.devices()) < 8:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+

@@ -1,0 +1,184 @@
+"""Broker tests — modeled on reference test/emqx_broker_SUITE.erl:
+subscribe/unsubscribe bookkeeping, publish/dispatch, hook veto,
+shared-group dispatch, subscriber_down cleanup.
+"""
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.hooks import STOP
+from emqx_tpu.types import Message, SubOpts
+
+
+class Q:
+    """Queue subscriber test double (the conn process stand-in)."""
+
+    def __init__(self, client_id="c"):
+        self.client_id = client_id
+        self.inbox = []
+
+    def deliver(self, topic, msg):
+        self.inbox.append((topic, msg))
+
+
+def test_subscribe_unsubscribe():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "topic/a")
+    b.subscribe(s, "topic/+")
+    assert sorted(b.subscriptions(s)) == ["topic/+", "topic/a"]
+    assert b.subscribers("topic/a") == [s]
+    b.unsubscribe(s, "topic/a")
+    assert sorted(b.subscriptions(s)) == ["topic/+"]
+    b.unsubscribe(s, "topic/+")
+    assert b.subscriptions(s) == {}
+    assert not b.router.has_route("topic/a")
+
+
+def test_publish_dispatch():
+    b = Broker()
+    s1, s2, s3 = Q("c1"), Q("c2"), Q("c3")
+    b.subscribe(s1, "a/b/c")
+    b.subscribe(s2, "a/+/c")
+    b.subscribe(s3, "zzz")
+    n = b.publish(Message(topic="a/b/c", payload=b"hi"))
+    assert n == 2
+    assert s1.inbox[0][0] == "a/b/c"
+    assert s2.inbox[0][0] == "a/+/c"  # deliver carries the filter
+    assert s2.inbox[0][1].topic == "a/b/c"
+    assert s3.inbox == []
+
+
+def test_publish_no_subscribers_counts_dropped():
+    b = Broker()
+    assert b.publish(Message(topic="lonely")) == 0
+    assert b.metrics.val("messages.dropped.no_subscribers") == 1
+
+
+def test_hook_veto_stops_publish():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "t")
+
+    def veto(msg):
+        msg.set_header("allow_publish", False)
+        return (STOP, msg)
+
+    b.hooks.add("message.publish", veto)
+    assert b.publish(Message(topic="t")) == 0
+    assert s.inbox == []
+    assert b.metrics.val("messages.dropped") == 1
+
+
+def test_hook_rewrite_topic():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "rewritten")
+
+    def rw(msg):
+        msg.topic = "rewritten"
+        return msg
+
+    b.hooks.add("message.publish", rw)
+    assert b.publish(Message(topic="original")) == 1
+
+
+def test_shared_dispatch_round_robin():
+    b = Broker()
+    s1, s2 = Q("c1"), Q("c2")
+    b.subscribe(s1, "$share/g/t")
+    b.subscribe(s2, "$share/g/t")
+    for _ in range(4):
+        b.publish(Message(topic="t"))
+    assert len(s1.inbox) == 2
+    assert len(s2.inbox) == 2
+
+
+def test_queue_prefix_is_shared():
+    b = Broker()
+    s1, s2 = Q("c1"), Q("c2")
+    b.subscribe(s1, "$queue/t")
+    b.subscribe(s2, "$queue/t")
+    total = sum(b.publish(Message(topic="t")) for _ in range(6))
+    assert total == 6
+    assert len(s1.inbox) + len(s2.inbox) == 6
+
+
+def test_shared_and_plain_both_dispatch():
+    b = Broker()
+    plain, shared = Q("p"), Q("s")
+    b.subscribe(plain, "t/#")
+    b.subscribe(shared, "$share/g/t/1")
+    n = b.publish(Message(topic="t/1"))
+    assert n == 2
+    assert len(plain.inbox) == 1 and len(shared.inbox) == 1
+
+
+def test_no_local():
+    b = Broker()
+    s = Q("me")
+    b.subscribe(s, "t", SubOpts(nl=1))
+    assert b.publish(Message(topic="t", from_="me")) == 0
+    assert b.publish(Message(topic="t", from_="other")) == 1
+    assert b.metrics.val("delivery.dropped.no_local") == 1
+
+
+def test_subscriber_down():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "a/+")
+    b.subscribe(s, "$share/g/b")
+    b.subscriber_down(s)
+    assert b.subscriptions(s) == {}
+    assert b.publish(Message(topic="a/1")) == 0
+    assert b.publish(Message(topic="b")) == 0
+    assert not b.router.has_route("a/+")
+    assert not b.router.has_route("b")
+
+
+def test_forwarder_seam():
+    b = Broker(node="n1")
+    sent = []
+    b.forwarder = lambda node, msg: sent.append((node, msg.topic))
+    b.router.add_route("t/#", dest="n2")
+    b.router.add_route("t/x", dest="n2")
+    b.publish(Message(topic="t/x"))
+    assert sent == [("n2", "t/x")]  # aggre: one forward per node
+
+
+def test_shared_resubscribe_no_crash():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "$share/g/t")
+    b.subscribe(s, "$share/g/t")  # re-subscribe must not KeyError
+    assert b.publish(Message(topic="t")) == 1
+    assert len(s.inbox) == 1
+
+
+def test_shared_and_plain_same_filter_coexist():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "t")
+    b.subscribe(s, "$share/g/t")
+    assert b.publish(Message(topic="t")) == 2
+    assert b.unsubscribe(s, "t")
+    assert b.publish(Message(topic="t")) == 1  # shared leg remains
+    assert b.unsubscribe(s, "$share/g/t")
+    assert b.publish(Message(topic="t")) == 0
+    assert b.subscriptions(s) == {}
+
+
+def test_publish_topic_containing_plus_matches_once():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "a/+")
+    # '+' in a publish name is invalid MQTT, but must not double-match
+    assert b.publish(Message(topic="a/+")) == 1
+
+
+def test_publish_batch():
+    b = Broker()
+    s = Q()
+    b.subscribe(s, "a/+")
+    counts = b.publish_batch([
+        Message(topic="a/1"), Message(topic="b/1"), Message(topic="a/2")])
+    assert counts == [1, 0, 1]
+    assert len(s.inbox) == 2

@@ -1,0 +1,54 @@
+"""Device fan-out gather tests."""
+
+import numpy as np
+
+from emqx_tpu.ops.fanout import build_fanout, gather_subscribers
+
+
+def test_gather_basic():
+    fan = build_fanout({0: [10, 11], 1: [20], 2: [30, 31, 32]}, 3)
+    ids = np.array([[0, 2, -1, -1], [1, -1, -1, -1]], dtype=np.int32)
+    subs, count, ovf = gather_subscribers(fan, ids, d=8)
+    assert count.tolist() == [5, 1]
+    assert not ovf.any()
+    assert sorted(x for x in np.asarray(subs)[0] if x >= 0) == [10, 11, 30, 31, 32]
+    assert [x for x in np.asarray(subs)[1] if x >= 0] == [20]
+
+
+def test_gather_empty_rows_and_no_match():
+    fan = build_fanout({0: [], 1: [5]}, 2)
+    ids = np.array([[-1, -1], [0, 1]], dtype=np.int32)
+    subs, count, ovf = gather_subscribers(fan, ids, d=4)
+    assert count.tolist() == [0, 1]
+    assert [x for x in np.asarray(subs)[1] if x >= 0] == [5]
+
+
+def test_gather_overflow_flagged():
+    fan = build_fanout({0: list(range(100))}, 1)
+    ids = np.array([[0]], dtype=np.int32)
+    subs, count, ovf = gather_subscribers(fan, ids, d=16)
+    assert bool(np.asarray(ovf)[0])
+    assert int(np.asarray(count)[0]) == 100
+    got = [x for x in np.asarray(subs)[0] if x >= 0]
+    assert len(got) == 16 and got == list(range(16))
+
+
+def test_gather_large_random_parity():
+    rng = np.random.default_rng(0)
+    rows = {f: list(rng.integers(0, 10000, size=rng.integers(0, 20)))
+            for f in range(200)}
+    fan = build_fanout(rows, 200)
+    ids = np.full((16, 32), -1, dtype=np.int32)
+    for b in range(16):
+        chosen = rng.choice(200, size=rng.integers(0, 30), replace=False)
+        ids[b, :len(chosen)] = chosen
+    subs, count, ovf = gather_subscribers(fan, ids, d=512)
+    for b in range(16):
+        expect = []
+        for f in ids[b]:
+            if f >= 0:
+                expect.extend(rows[f])
+        assert int(count[b]) == len(expect)
+        if not ovf[b]:
+            got = [x for x in np.asarray(subs)[b] if x >= 0]
+            assert sorted(got) == sorted(int(x) for x in expect)

@@ -1,0 +1,165 @@
+"""Trie oracle tests — ported from reference test/emqx_trie_SUITE.erl
+(t_match/t_match2/t_match3, t_empty, t_delete*) plus randomized
+cross-checks against emqx_tpu.topic.match.
+"""
+
+import random
+
+from emqx_tpu import topic as T
+from emqx_tpu.oracle import TrieOracle
+
+
+def test_match():
+    t = TrieOracle()
+    t.insert("sensor/1/metric/2")
+    t.insert("sensor/+/#")
+    t.insert("sensor/#")
+    assert sorted(t.match("sensor/1")) == sorted(["sensor/+/#", "sensor/#"])
+
+
+def test_match2():
+    t = TrieOracle()
+    t.insert("#")
+    t.insert("+/#")
+    t.insert("+/+/#")
+    assert sorted(t.match("a/b/c")) == sorted(["#", "+/#", "+/+/#"])
+    assert t.match("$SYS/broker/zenmq") == []
+
+
+def test_match3():
+    t = TrieOracle()
+    for f in ["d/#", "a/b/c", "a/b/+", "a/#", "#", "$SYS/#"]:
+        t.insert(f)
+    assert len(t.match("a/b/c")) == 4
+    assert t.match("$SYS/a/b/c") == ["$SYS/#"]
+
+
+def test_match_terminal_and_hash_at_end():
+    t = TrieOracle()
+    t.insert("sensor")
+    t.insert("sensor/#")
+    # '#' matches the parent level too
+    assert sorted(t.match("sensor")) == sorted(["sensor", "sensor/#"])
+    assert t.match("sensor/1") == ["sensor/#"]
+
+
+def test_empty():
+    t = TrieOracle()
+    assert t.is_empty()
+    t.insert("topic/x/#")
+    assert not t.is_empty()
+    t.delete("topic/x/#")
+    assert t.is_empty()
+
+
+def test_delete():
+    t = TrieOracle()
+    t.insert("sensor/1/#")
+    t.insert("sensor/1/metric/2")
+    t.insert("sensor/1/metric/3")
+    t.delete("sensor/1/metric/2")
+    t.delete("sensor/1/metric")  # not present — no-op
+    t.delete("sensor/1/metric")
+    assert t.match("sensor/1/metric/3") == ["sensor/1/metric/3", "sensor/1/#"] or \
+        sorted(t.match("sensor/1/metric/3")) == sorted(["sensor/1/metric/3", "sensor/1/#"])
+    assert "sensor/1/#" in t
+    assert "sensor/1/metric/2" not in t
+
+
+def test_delete2():
+    t = TrieOracle()
+    t.insert("sensor")
+    t.insert("sensor/1/metric/2")
+    t.insert("sensor/+/metric/3")
+    t.delete("sensor")
+    t.delete("sensor/1/metric/2")
+    t.delete("sensor/+/metric/3")
+    t.delete("sensor/+/metric/3")
+    assert t.is_empty()
+    assert t.match("sensor/1/metric/2") == []
+
+
+def test_delete3():
+    t = TrieOracle()
+    t.insert("sensor/+")
+    t.insert("sensor/+/metric/2")
+    t.insert("sensor/+/metric/3")
+    t.delete("sensor/+/metric/2")
+    t.delete("sensor/+/metric/3")
+    t.delete("sensor")
+    t.delete("sensor/+")
+    t.delete("sensor/+/unknown")
+    assert t.is_empty()
+
+
+def test_refcounted_insert():
+    t = TrieOracle()
+    assert t.insert("a/b/#")
+    assert not t.insert("a/b/#")  # second insert refs, not duplicates
+    t.delete("a/b/#")
+    assert "a/b/#" in t
+    t.delete("a/b/#")
+    assert "a/b/#" not in t
+    assert t.is_empty()
+
+
+def _random_word(rng):
+    return rng.choice(["a", "b", "c", "d", "x", "yy", "z0", "$s", ""])
+
+
+def _random_filter(rng):
+    n = rng.randint(1, 6)
+    ws = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.15:
+            ws.append("+")
+        elif r < 0.25 and i == n - 1:
+            ws.append("#")
+        else:
+            ws.append(_random_word(rng))
+    return "/".join(ws)
+
+
+def _random_name(rng):
+    return "/".join(_random_word(rng) for _ in range(rng.randint(1, 6)))
+
+
+def test_random_parity_with_topic_match():
+    """Oracle.match must agree with emqx_topic-style match/2 for every
+    (name, filter) pair — the same invariant the reference relies on
+    between emqx_trie and emqx_topic."""
+    rng = random.Random(42)
+    filters = list({_random_filter(rng) for _ in range(300)})
+    t = TrieOracle()
+    for f in filters:
+        t.insert(f)
+    for _ in range(500):
+        name = _random_name(rng)
+        expect = sorted(f for f in filters if T.match(name, f))
+        got = sorted(t.match(name))
+        assert got == expect, (name, got, expect)
+
+
+def test_random_insert_delete_parity():
+    rng = random.Random(7)
+    t = TrieOracle()
+    refs = {}  # filter -> refcount (insert/delete are refcounted)
+    for _ in range(800):
+        f = _random_filter(rng)
+        if f in refs and rng.random() < 0.5:
+            t.delete(f)
+            refs[f] -= 1
+            if refs[f] == 0:
+                del refs[f]
+        else:
+            t.insert(f)
+            refs[f] = refs.get(f, 0) + 1
+        if rng.random() < 0.2:
+            name = _random_name(rng)
+            expect = sorted(x for x in refs if T.match(name, x))
+            assert sorted(t.match(name)) == expect
+    for f, n in list(refs.items()):
+        for _ in range(n):
+            t.delete(f)
+    assert t.is_empty()

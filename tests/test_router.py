@@ -1,0 +1,89 @@
+"""Router tests — modeled on reference test/emqx_router_SUITE.erl:
+add/delete routes, match_routes, cluster cleanup, plus device/oracle
+agreement through the public API.
+"""
+
+from emqx_tpu.router import MatcherConfig, Router
+from emqx_tpu.types import Route
+
+
+def _mk(use_device=True):
+    return Router(MatcherConfig(use_device=use_device), node="node1")
+
+
+def test_add_delete_route():
+    r = _mk()
+    r.add_route("a/b/c")
+    r.add_route("a/b/c")  # refcounted per (topic, dest)
+    r.add_route("a/+/b", dest="node2")
+    assert r.has_route("a/b/c")
+    r.delete_route("a/b/c")
+    assert r.has_route("a/b/c")  # one ref left
+    r.delete_route("a/b/c")
+    assert not r.has_route("a/b/c")
+    r.delete_route("a/+/b", dest="node2")
+    assert r.topics() == []
+
+
+def test_match_routes():
+    r = _mk()
+    r.add_route("a/b/c")
+    r.add_route("a/+/c", dest="node2")
+    r.add_route("a/#", dest="node3")
+    r.add_route("x/y")
+    got = sorted((rt.topic, rt.dest) for rt in r.match_routes("a/b/c"))
+    assert got == [("a/#", "node3"), ("a/+/c", "node2"), ("a/b/c", "node1")]
+    assert r.match_routes("nope") == []
+
+
+def test_match_after_mutation_rebuilds():
+    r = _mk()
+    r.add_route("s/+")
+    assert [rt.topic for rt in r.match_routes("s/1")] == ["s/+"]
+    r.add_route("s/#")
+    assert sorted(rt.topic for rt in r.match_routes("s/1")) == ["s/#", "s/+"]
+    r.delete_route("s/+")
+    assert [rt.topic for rt in r.match_routes("s/1")] == ["s/#"]
+    assert r.stats()["topics.count"] == 1
+
+
+def test_filter_id_recycling():
+    r = _mk(use_device=False)
+    r.add_route("a")
+    fid = r.filter_id("a")
+    r.delete_route("a")
+    r.add_route("b")
+    assert r.filter_id("b") == fid  # recycled
+    r.add_route("c")
+    assert r.filter_id("c") != fid
+
+
+def test_cleanup_routes_on_nodedown():
+    r = _mk()
+    r.add_route("a/b", dest="dead")
+    r.add_route("a/b")
+    r.add_route("x/#", dest="dead")
+    r.cleanup_routes("dead")
+    assert [rt.dest for rt in r.match_routes("a/b")] == ["node1"]
+    assert r.match_routes("x/1") == []
+
+
+def test_shared_group_dest():
+    r = _mk()
+    r.add_route("t/1", dest=("g1", "node1"))
+    assert r.match_routes("t/1") == [Route("t/1", ("g1", "node1"))]
+
+
+def test_deep_topic_falls_back_to_oracle():
+    r = _mk()
+    r.add_route("a/#")
+    deep = "/".join(["a"] + ["x"] * 64)  # > max_levels
+    assert [rt.topic for rt in r.match_routes(deep)] == ["a/#"]
+
+
+def test_sys_topic_routing():
+    r = _mk()
+    r.add_route("#")
+    r.add_route("$SYS/#")
+    assert [rt.topic for rt in r.match_routes("$SYS/x")] == ["$SYS/#"]
+    assert sorted(rt.topic for rt in r.match_routes("plain")) == ["#"]

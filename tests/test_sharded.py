@@ -1,0 +1,92 @@
+"""Multi-chip publish step on the virtual 8-device CPU mesh:
+parity of the sharded match vs the host oracle, and mesh-summed stats."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.ops.tokenize import WordTable, encode_batch
+from emqx_tpu.parallel.mesh import make_mesh
+from emqx_tpu.parallel.sharded import (
+    build_sharded, build_sharded_fanout, place_batch, place_sharded,
+    publish_step, shard_filters)
+
+
+def _rand_filters(rng, n):
+    words = ["a", "b", "c", "d", "e", "s1", "s2"]
+    out = set()
+    while len(out) < n:
+        depth = rng.randint(1, 5)
+        ws = []
+        for i in range(depth):
+            r = rng.random()
+            if r < 0.2:
+                ws.append("+")
+            elif r < 0.3 and i == depth - 1:
+                ws.append("#")
+            else:
+                ws.append(rng.choice(words))
+        out.add("/".join(ws))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("n_data,n_trie", [(4, 2), (2, 4), (8, 1)])
+def test_sharded_match_parity(n_data, n_trie):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = random.Random(0)
+    filters = _rand_filters(rng, 120)
+    fids = {f: i for i, f in enumerate(filters)}
+    table = WordTable()
+    for f in filters:
+        for w in f.split("/"):
+            table.intern(w)
+    oracle = TrieOracle()
+    for f in filters:
+        oracle.insert(f)
+
+    mesh = make_mesh(n_data, n_trie)
+    shards = shard_filters(filters, n_trie)
+    auto = build_sharded(shards, fids, table)
+    rows = [{fids[f]: [fids[f] * 10, fids[f] * 10 + 1] for f in shard}
+            for shard in shards]
+    fan = build_sharded_fanout(rows, len(filters))
+
+    words = ["a", "b", "c", "d", "e", "s1", "s2", "zz"]
+    B = 8 * n_data
+    topics = ["/".join(rng.choice(words) for _ in range(rng.randint(1, 5)))
+              for _ in range(B)]
+    ids_np, n_np, sys_np = encode_batch(table, topics, 8)
+
+    auto_d = place_sharded(mesh, auto)
+    fan_d = place_sharded(mesh, fan)
+    b = place_batch(mesh, ids_np, n_np, sys_np)
+
+    ids, subs, stats = publish_step(
+        mesh, auto_d, fan_d, *b, k=32, m=32, d=64)
+    ids = np.asarray(ids)
+    subs = np.asarray(subs)
+    inv = {v: k for k, v in fids.items()}
+    total_matches = 0
+    total_deliv = 0
+    for i, t in enumerate(topics):
+        got = sorted(inv[j] for j in ids[i] if j >= 0)
+        expect = sorted(oracle.match(t))
+        assert got == expect, (t, got, expect)
+        total_matches += len(expect)
+        exp_subs = sorted(x for f in expect for x in rows_lookup(rows, fids[f]))
+        assert sorted(x for x in subs[i] if x >= 0) == exp_subs
+        total_deliv += len(exp_subs)
+    assert int(stats["matches"]) == total_matches
+    assert int(stats["deliveries"]) == total_deliv
+    assert int(stats["overflows"]) == 0
+
+
+def rows_lookup(rows, fid):
+    for shard_rows in rows:
+        if fid in shard_rows:
+            return shard_rows[fid]
+    return []

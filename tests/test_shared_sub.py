@@ -1,0 +1,100 @@
+"""Shared-sub strategy tests — modeled on reference
+test/emqx_shared_sub_SUITE.erl (random/round_robin/sticky/hash,
+redispatch on failure)."""
+
+from emqx_tpu.shared_sub import SharedSub
+from emqx_tpu.types import Message
+
+
+class Q:
+    def __init__(self, cid, fail=False):
+        self.client_id = cid
+        self.inbox = []
+        self.fail = fail
+
+    def deliver(self, topic, msg):
+        if self.fail:
+            raise RuntimeError("conn down")
+        self.inbox.append((topic, msg))
+
+
+def _msg(sender="c0"):
+    return Message(topic="t", from_=sender)
+
+
+def test_round_robin():
+    ss = SharedSub("round_robin")
+    a, b = Q("a"), Q("b")
+    ss.subscribe("g", "t", a)
+    ss.subscribe("g", "t", b)
+    for _ in range(4):
+        assert ss.dispatch("g", "t", _msg()) == 1
+    assert len(a.inbox) == 2 and len(b.inbox) == 2
+
+
+def test_sticky():
+    ss = SharedSub("sticky")
+    a, b = Q("a"), Q("b")
+    ss.subscribe("g", "t", a)
+    ss.subscribe("g", "t", b)
+    for _ in range(5):
+        ss.dispatch("g", "t", _msg())
+    assert (len(a.inbox), len(b.inbox)) in [(5, 0), (0, 5)]
+    # sticky target leaves → re-pick the other
+    target = a if a.inbox else b
+    other = b if a.inbox else a
+    ss.unsubscribe("g", "t", target)
+    ss.dispatch("g", "t", _msg())
+    assert len(other.inbox) == 1
+
+
+def test_hash_is_per_sender_stable():
+    ss = SharedSub("hash")
+    a, b = Q("a"), Q("b")
+    ss.subscribe("g", "t", a)
+    ss.subscribe("g", "t", b)
+    for _ in range(5):
+        ss.dispatch("g", "t", _msg("client-x"))
+    assert (len(a.inbox), len(b.inbox)) in [(5, 0), (0, 5)]
+
+
+def test_random_delivers():
+    ss = SharedSub("random")
+    a, b = Q("a"), Q("b")
+    ss.subscribe("g", "t", a)
+    ss.subscribe("g", "t", b)
+    for _ in range(20):
+        assert ss.dispatch("g", "t", _msg()) == 1
+    assert len(a.inbox) + len(b.inbox) == 20
+
+
+def test_redispatch_on_failure():
+    ss = SharedSub("round_robin")
+    bad, good = Q("bad", fail=True), Q("good")
+    ss.subscribe("g", "t", bad)
+    ss.subscribe("g", "t", good)
+    for _ in range(3):
+        assert ss.dispatch("g", "t", _msg()) == 1
+    assert len(good.inbox) == 3
+
+
+def test_no_subscribers():
+    ss = SharedSub()
+    assert ss.dispatch("g", "t", _msg()) == 0
+
+
+def test_all_failed():
+    ss = SharedSub()
+    bad = Q("bad", fail=True)
+    ss.subscribe("g", "t", bad)
+    assert ss.dispatch("g", "t", _msg()) == 0
+
+
+def test_subscriber_down_cleans_groups():
+    ss = SharedSub()
+    a = Q("a")
+    ss.subscribe("g1", "t1", a)
+    ss.subscribe("g2", "t2", a)
+    ss.subscriber_down(a)
+    assert ss.subscribers("g1", "t1") == []
+    assert ss.subscribers("g2", "t2") == []

@@ -1,0 +1,164 @@
+"""Topic algebra tests — ported from reference test/emqx_topic_SUITE.erl."""
+
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.topic import TopicError
+
+
+def test_wildcard():
+    assert T.wildcard("a/b/#")
+    assert T.wildcard("a/+/#")
+    assert not T.wildcard("")
+    assert not T.wildcard("a/b/c")
+
+
+def test_match1():
+    assert T.match("a/b/c", "a/b/+")
+    assert T.match("a/b/c", "a/#")
+    assert T.match("abcd/ef/g", "#")
+    assert T.match("abc/de/f", "abc/de/f")
+    assert T.match("abc", "+")
+    assert T.match("a/b/c", "a/b/c")
+    assert not T.match("a/b/c", "a/c/d")
+    assert not T.match("$share/x/y", "+")
+    assert not T.match("$share/x/y", "+/x/y")
+    assert not T.match("$share/x/y", "#")
+    assert not T.match("$share/x/y", "+/+/#")
+    assert not T.match("house/1/sensor/0", "house/+")
+    assert not T.match("house", "house/+")
+
+
+def test_match2():
+    assert T.match("sport/tennis/player1", "sport/tennis/player1/#")
+    assert T.match("sport/tennis/player1/ranking", "sport/tennis/player1/#")
+    assert T.match("sport/tennis/player1/score/wimbledon", "sport/tennis/player1/#")
+    assert T.match("sport", "sport/#")
+    assert T.match("sport", "#")
+    assert T.match("/sport/football/score/1", "#")
+    assert T.match("Topic/C", "+/+")
+    assert T.match("TopicA/B", "+/+")
+
+
+def test_match3():
+    assert T.match("device/60019423a83c/fw", "device/60019423a83c/#")
+    assert T.match("device/60019423a83c/$fw", "device/60019423a83c/#")
+    assert T.match("device/60019423a83c/$fw/fw", "device/60019423a83c/$fw/#")
+    assert T.match("device/60019423a83c/fw/checksum", "device/60019423a83c/#")
+    assert T.match("device/60019423a83c/dust/type", "device/60019423a83c/#")
+
+
+def test_single_level_match():
+    assert T.match("sport/tennis/player1", "sport/tennis/+")
+    assert not T.match("sport/tennis/player1/ranking", "sport/tennis/+")
+    assert not T.match("sport", "sport/+")
+    assert T.match("sport/", "sport/+")
+    assert T.match("/finance", "+/+")
+    assert T.match("/finance", "/+")
+    assert not T.match("/finance", "+")
+    assert T.match("/devices/$dev1", "/devices/+")
+    assert T.match("/devices/$dev1/online", "/devices/+/online")
+
+
+def test_sys_match():
+    assert T.match("$SYS/broker/clients/testclient", "$SYS/#")
+    assert T.match("$SYS/broker", "$SYS/+")
+    assert not T.match("$SYS/broker", "+/+")
+    assert not T.match("$SYS/broker", "#")
+
+
+def test_hash_match():
+    assert T.match("a/b/c", "#")
+    assert T.match("a/b/c", "+/#")
+    assert not T.match("$SYS/brokers", "#")
+    assert T.match("a/b/$c", "a/b/#")
+    assert T.match("a/b/$c", "a/#")
+
+
+def test_validate():
+    assert T.validate("a/+/#")
+    assert T.validate("a/b/c/d")
+    assert T.validate("abc/de/f", "name")
+    assert T.validate("abc/+/f", "filter")
+    assert T.validate("abc/#", "filter")
+    assert T.validate("x", "filter")
+    assert T.validate("x//y", "name")
+    assert T.validate("sport/tennis/#", "filter")
+    with pytest.raises(TopicError, match="empty_topic"):
+        T.validate("", "name")
+    with pytest.raises(TopicError, match="topic_name_error"):
+        T.validate("abc/#", "name")
+    with pytest.raises(TopicError, match="topic_too_long"):
+        T.validate("/".join(str(i) for i in range(10001)), "name")
+    with pytest.raises(TopicError, match="topic_invalid_#"):
+        T.validate("abc/#/1", "filter")
+    with pytest.raises(TopicError, match="topic_invalid_char"):
+        T.validate("abc/#xzy/+", "filter")
+    with pytest.raises(TopicError, match="topic_invalid_char"):
+        T.validate("abc/xzy/+9827", "filter")
+    with pytest.raises(TopicError, match="topic_invalid_char"):
+        T.validate("sport/tennis#", "filter")
+    with pytest.raises(TopicError, match="topic_invalid_#"):
+        T.validate("sport/tennis/#/ranking", "filter")
+
+
+def test_single_level_validate():
+    assert T.validate("+", "filter")
+    assert T.validate("+/tennis/#", "filter")
+    assert T.validate("sport/+/player1", "filter")
+    with pytest.raises(TopicError, match="topic_invalid_char"):
+        T.validate("sport+", "filter")
+
+
+def test_prepend():
+    assert T.prepend(None, "ab") == "ab"
+    assert T.prepend("", "a/b") == "a/b"
+    assert T.prepend("x/", "a/b") == "x/a/b"
+    assert T.prepend("x/y", "a/b") == "x/y/a/b"
+    assert T.prepend("+", "a/b") == "+/a/b"
+
+
+def test_levels_tokens_words():
+    assert T.levels("a/+/#") == 3
+    assert T.levels("a/b/c/d") == 4
+    assert T.tokens("a/b/+/#") == ["a", "b", "+", "#"]
+    assert T.words("/a/+/#") == ["", "a", "+", "#"]
+    assert T.words("/abkc/19383/+/akakdkkdkak/#") == [
+        "", "abkc", "19383", "+", "akakdkkdkak", "#"]
+
+
+def test_join():
+    assert T.join([]) == ""
+    assert T.join(["x"]) == "x"
+    assert T.join(["#"]) == "#"
+    assert T.join(["+", "", "#"]) == "+//#"
+    assert T.join(["x", "y", "z", "+"]) == "x/y/z/+"
+    assert T.join(T.words("/ab/cd/ef/")) == "/ab/cd/ef/"
+    assert T.join(T.words("ab/+/#")) == "ab/+/#"
+
+
+def test_systop():
+    assert T.systop("xyz", node="n1@host") == "$SYS/brokers/n1@host/xyz"
+
+
+def test_feed_var():
+    assert T.feed_var("$c", "clientId", "$queue/client/$c") == "$queue/client/clientId"
+    assert T.feed_var("%u", "test", "username/%u/client/x") == "username/test/client/x"
+    assert T.feed_var("%c", "clientId", "username/test/client/%c") == \
+        "username/test/client/clientId"
+
+
+def test_parse():
+    with pytest.raises(TopicError):
+        T.parse("$queue/t", {"share": "g"})
+    with pytest.raises(TopicError):
+        T.parse("$share/g/t", {"share": "g"})
+    with pytest.raises(TopicError):
+        T.parse("$share/t")
+    assert T.parse("a/b/+/#") == ("a/b/+/#", {})
+    assert T.parse("$queue/a/b/+/#") == ("a/b/+/#", {"share": "$queue"})
+    assert T.parse("$share/g/a/b/+/#") == ("a/b/+/#", {"share": "g"})
+    with pytest.raises(TopicError):
+        T.parse("$share/g+/t")
+    with pytest.raises(TopicError):
+        T.parse("$share/g#/t")
