@@ -1,0 +1,621 @@
+"""The MQTT protocol state machine — sans-IO.
+
+Mirrors ``src/emqx_channel.erl`` (the reference's largest module):
+a pure-ish FSM over connection state; the transport
+(:mod:`emqx_tpu.connection`) feeds parsed packets into
+:meth:`Channel.handle_in` and writes whatever packets come back.
+
+Pipelines follow the reference:
+  - CONNECT: enrich conninfo → 'client.connect' hook → check proto →
+    banned check → authenticate → open session (clean/resume via CM)
+    → CONNACK (+v5 props) → 'client.connected' (:237-261, 433-450)
+  - PUBLISH: topic-alias resolve → ACL → caps → session.publish →
+    PUBACK/PUBREC (:293-298, 456-543)
+  - SUBSCRIBE: 'client.subscribe' hook → per-filter ACL + caps →
+    session/broker subscribe → SUBACK (:362-383)
+  - deliver: session outbox → PUBLISH/PUBREL packets (:657-680)
+  - timers: keepalive, retry, awaiting-rel expiry (:936-989)
+  - will message published on abnormal close (:1539-1551)
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from emqx_tpu import topic as T
+from emqx_tpu.access_control import (ALLOW, DENY, PUB, SUB, AccessControl,
+                                     ClientInfo)
+from emqx_tpu.acl_cache import AclCache
+from emqx_tpu.keepalive import Keepalive
+from emqx_tpu.mountpoint import mount, replvar, unmount
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqtt.packet import (Auth, Connack, Connect, Disconnect,
+                                  PacketError, Packet, PubAck, Publish,
+                                  Pingreq, Pingresp, Suback, Subscribe,
+                                  Unsuback, Unsubscribe, check, to_message,
+                                  from_message, will_msg)
+from emqx_tpu.session import (PUBREL_MARKER, Session, SessionError)
+from emqx_tpu.types import Message, SubOpts
+from emqx_tpu.utils.base62 import encode as b62encode
+from emqx_tpu.utils.guid import new_guid
+from emqx_tpu.zone import Zone, get_zone
+
+log = logging.getLogger("emqx_tpu.channel")
+
+# channel states
+IDLE = "idle"
+CONNECTING = "connecting"
+CONNECTED = "connected"
+DISCONNECTED = "disconnected"
+
+
+class Channel:
+    def __init__(self, broker, cm, zone: Optional[Zone] = None,
+                 peername: Tuple[str, int] = ("127.0.0.1", 0),
+                 listener: str = "tcp:default") -> None:
+        self.broker = broker
+        self.cm = cm
+        self.zone = zone or get_zone()
+        self.peername = peername
+        self.listener = listener
+        self.state = IDLE
+        self.proto_ver = C.MQTT_V4
+        self.client_id = ""
+        self.username: Optional[str] = None
+        self.clientinfo = ClientInfo()
+        self.session: Optional[Session] = None
+        self.keepalive: Optional[Keepalive] = None
+        self.will: Optional[Message] = None
+        self.acl_cache = AclCache()
+        self.access = AccessControl(broker.hooks, self.zone)
+        self.alias_in: Dict[int, str] = {}   # v5 inbound topic aliases
+        self.mountpoint: Optional[str] = None
+        self.connected_at: Optional[float] = None
+        self.disconnect_reason: Optional[str] = None
+        self.expiry_interval = 0.0
+        self.closed = False
+        # set when the FSM wants the transport closed *after* the
+        # packets it just returned are flushed (error CONNACK, v5
+        # DISCONNECT with reason code)
+        self.close_after_send = False
+        # transport hooks: set by connection
+        self.on_close = None          # force-close the socket
+        self.on_deliver = None        # new outbox items are ready
+
+    # -- helpers ----------------------------------------------------------
+
+    def _ack(self, ptype: int, pid: int, rc: int = RC.SUCCESS) -> PubAck:
+        return PubAck(type=ptype, packet_id=pid, reason_code=rc)
+
+    def _connack_error(self, rc5: int) -> List[Packet]:
+        rc = rc5 if self.proto_ver == C.MQTT_V5 else RC.compat("connack", rc5)
+        self.broker.metrics.inc("packets.connack.error")
+        if rc5 in (RC.BAD_USERNAME_OR_PASSWORD, RC.NOT_AUTHORIZED):
+            self.broker.metrics.inc("packets.connack.auth_error")
+        # MQTT: the server MUST close the connection after an error
+        # CONNACK — but the CONNACK has to reach the wire first
+        self.disconnect_reason = RC.name(rc5)
+        self._shutdown(close_transport=False)
+        self.close_after_send = True
+        return [Connack(reason_code=rc)]
+
+    # -- inbound ----------------------------------------------------------
+
+    def handle_in(self, pkt: Packet) -> List[Packet]:
+        """Feed one parsed packet; returns packets to send."""
+        if self.closed:
+            return []
+        if self.state == IDLE and not isinstance(pkt, Connect):
+            self.disconnect_reason = "protocol_error"
+            self._shutdown()
+            return []
+        try:
+            if isinstance(pkt, Connect):
+                return self._in_connect(pkt)
+            if isinstance(pkt, Publish):
+                return self._in_publish(pkt)
+            if isinstance(pkt, PubAck):
+                return self._in_puback(pkt)
+            if isinstance(pkt, Subscribe):
+                return self._in_subscribe(pkt)
+            if isinstance(pkt, Unsubscribe):
+                return self._in_unsubscribe(pkt)
+            if isinstance(pkt, Pingreq):
+                self.broker.metrics.inc("packets.pingreq.received")
+                self.broker.metrics.inc("packets.pingresp.sent")
+                return [Pingresp()]
+            if isinstance(pkt, Disconnect):
+                return self._in_disconnect(pkt)
+            if isinstance(pkt, Auth):
+                # enhanced auth is negotiated by hook; no built-in method
+                return []
+        except SessionError as e:
+            log.warning("session error: %s", e)
+            return []
+        return []
+
+    # CONNECT ------------------------------------------------------------
+
+    def _in_connect(self, pkt: Connect) -> List[Packet]:
+        self.broker.metrics.inc("packets.connect.received")
+        self.broker.metrics.inc("client.connect")
+        if self.state != IDLE:
+            # duplicate CONNECT is a protocol error
+            self.disconnect_reason = "protocol_error"
+            self._shutdown()
+            return []
+        self.state = CONNECTING
+        self.proto_ver = pkt.proto_ver
+        client_id = pkt.client_id
+        if client_id == "":
+            if not pkt.clean_start and pkt.proto_ver != C.MQTT_V5:
+                return self._connack_error(RC.CLIENT_IDENTIFIER_NOT_VALID)
+            client_id = "emqx_tpu_" + b62encode(new_guid())[:20]
+            assigned = True
+        else:
+            assigned = False
+        if len(client_id) > self.zone.max_clientid_len:
+            return self._connack_error(RC.CLIENT_IDENTIFIER_NOT_VALID)
+        self.client_id = client_id
+        self.username = pkt.username
+        self.clientinfo = ClientInfo(
+            clientid=client_id, username=pkt.username,
+            peerhost=self.peername[0], zone=self.zone.name,
+            proto_ver=pkt.proto_ver, keepalive=pkt.keepalive,
+            clean_start=pkt.clean_start, listener=self.listener,
+            mountpoint=self.zone.mountpoint,
+        )
+        self.broker.hooks.run("client.connect", (dict(self.clientinfo),))
+        # banned?
+        banned = getattr(self.broker, "banned", None)
+        if self.zone.enable_ban and banned is not None and banned.check(
+                clientid=client_id, username=pkt.username,
+                peerhost=self.peername[0]):
+            return self._connack_error(RC.BANNED)
+        # flapping
+        flapping = getattr(self.broker, "flapping", None)
+        if flapping is not None and self.zone.enable_flapping_detect:
+            flapping.connected(client_id, self.peername[0])
+        # auth
+        auth = self.access.authenticate(self.clientinfo)
+        if auth.get("auth_result") != "success":
+            self.broker.hooks.run(
+                "client.connack",
+                (dict(self.clientinfo), "not_authorized"))
+            return self._connack_error(RC.NOT_AUTHORIZED)
+        if auth.get("anonymous"):
+            self.broker.metrics.inc("client.auth.anonymous")
+        self.clientinfo["is_superuser"] = auth.get("is_superuser", False)
+        self.mountpoint = replvar(self.zone.mountpoint, client_id,
+                                  pkt.username or "")
+        # will message (kept until disconnect decides its fate)
+        self.will = will_msg(pkt)
+        if self.will is not None and self.mountpoint:
+            self.will.topic = mount(self.mountpoint, self.will.topic)
+        # session expiry (v5 property or zone default for v3 persistent)
+        if pkt.proto_ver == C.MQTT_V5:
+            self.expiry_interval = pkt.properties.get(
+                "Session-Expiry-Interval", 0)
+        else:
+            self.expiry_interval = (0 if pkt.clean_start
+                                    else self.zone.session_expiry_interval)
+        # open session
+        sess_opts = {
+            "max_subscriptions": self.zone.max_subscriptions,
+            "upgrade_qos": self.zone.upgrade_qos,
+            "max_inflight": self.zone.max_inflight,
+            "retry_interval": self.zone.retry_interval,
+            "max_awaiting_rel": self.zone.max_awaiting_rel,
+            "await_rel_timeout": self.zone.await_rel_timeout,
+            "max_mqueue_len": self.zone.max_mqueue_len,
+            "mqueue_store_qos0": self.zone.mqueue_store_qos0,
+            "mqueue_priorities": self.zone.mqueue_priorities,
+        }
+        receive_max = None
+        if pkt.proto_ver == C.MQTT_V5:
+            receive_max = pkt.properties.get("Receive-Maximum")
+            if receive_max:
+                sess_opts["max_inflight"] = min(
+                    sess_opts["max_inflight"] or receive_max, receive_max)
+        self.session, session_present = self.cm.open_session(
+            client_id, pkt.clean_start, self, sess_opts)
+        self.session.broker = self.broker
+        self.session.notify = self._notify_deliver
+        # keepalive (server may override via zone)
+        interval = pkt.keepalive
+        props: Dict[str, Any] = {}
+        if self.zone.server_keepalive is not None \
+                and pkt.proto_ver == C.MQTT_V5:
+            interval = self.zone.server_keepalive
+            props["Server-Keep-Alive"] = interval
+        self.keepalive = Keepalive(interval) if interval else None
+        self.state = CONNECTED
+        self.connected_at = time.time()
+        self.broker.metrics.inc("client.connected")
+        self.broker.hooks.run(
+            "client.connected",
+            (dict(self.clientinfo), {"connected_at": self.connected_at}))
+        if pkt.proto_ver == C.MQTT_V5:
+            if assigned:
+                props["Assigned-Client-Identifier"] = client_id
+            props["Topic-Alias-Maximum"] = self.zone.max_topic_alias
+            if not self.zone.retain_available:
+                props["Retain-Available"] = 0
+            if self.zone.max_qos_allowed < 2:
+                props["Maximum-QoS"] = self.zone.max_qos_allowed
+            if not self.zone.wildcard_subscription:
+                props["Wildcard-Subscription-Available"] = 0
+            if not self.zone.shared_subscription:
+                props["Shared-Subscription-Available"] = 0
+            if self.zone.max_packet_size:
+                props["Maximum-Packet-Size"] = self.zone.max_packet_size
+        self.broker.metrics.inc("packets.connack.sent")
+        out: List[Packet] = [Connack(session_present=session_present,
+                                     reason_code=RC.SUCCESS,
+                                     properties=props)]
+        # replay pending state on resumed sessions
+        if session_present:
+            self.session.replay()
+            out.extend(self.handle_deliver())
+        return out
+
+    # PUBLISH ------------------------------------------------------------
+
+    def _in_publish(self, pkt: Publish) -> List[Packet]:
+        self.broker.metrics.inc("packets.publish.received")
+        # v5 topic alias (inbound)
+        if self.proto_ver == C.MQTT_V5:
+            alias = pkt.properties.get("Topic-Alias")
+            if alias is not None:
+                if alias == 0 or alias > self.zone.max_topic_alias:
+                    return self._disconnect_with(RC.TOPIC_ALIAS_INVALID)
+                if pkt.topic:
+                    self.alias_in[alias] = pkt.topic
+                else:
+                    topic = self.alias_in.get(alias)
+                    if topic is None:
+                        return self._disconnect_with(
+                            RC.PROTOCOL_ERROR)
+                    pkt.topic = topic
+        try:
+            check(pkt)
+        except PacketError:
+            self.broker.metrics.inc("packets.publish.error")
+            return self._puback_for(pkt, RC.TOPIC_NAME_INVALID)
+        # caps
+        if pkt.qos > self.zone.max_qos_allowed:
+            self.broker.metrics.inc("packets.publish.dropped")
+            return self._puback_for(pkt, RC.QOS_NOT_SUPPORTED)
+        if pkt.retain and not self.zone.retain_available:
+            self.broker.metrics.inc("packets.publish.dropped")
+            return self._puback_for(pkt, RC.RETAIN_NOT_SUPPORTED)
+        if self.zone.max_topic_levels and \
+                T.levels(pkt.topic) > self.zone.max_topic_levels:
+            return self._puback_for(pkt, RC.TOPIC_NAME_INVALID)
+        # acl
+        if self.zone.enable_acl and not self.clientinfo.get("is_superuser"):
+            if self.access.check_acl(self.clientinfo, PUB, pkt.topic,
+                                     self.acl_cache) == DENY:
+                self.broker.metrics.inc("packets.publish.auth_error")
+                self.broker.metrics.inc("client.acl.deny")
+                return self._puback_for(pkt, RC.NOT_AUTHORIZED)
+        msg = to_message(pkt, self.client_id,
+                         headers={"proto_ver": self.proto_ver,
+                                  "peerhost": self.peername[0],
+                                  "username": self.username})
+        if self.mountpoint:
+            msg.topic = mount(self.mountpoint, msg.topic)
+        try:
+            if pkt.qos == C.QOS_2:
+                n = self.session.publish(pkt.packet_id, msg)
+                rc = RC.SUCCESS if n else RC.NO_MATCHING_SUBSCRIBERS
+                self.broker.metrics.inc("packets.pubrec.sent")
+                return [self._ack(C.PUBREC, pkt.packet_id,
+                                  rc if self.proto_ver == C.MQTT_V5 else 0)]
+            n = self.session.publish(pkt.packet_id, msg)
+        except SessionError as e:
+            if pkt.qos == C.QOS_2:
+                self.broker.metrics.inc("packets.pubrec.sent")
+                return [self._ack(C.PUBREC, pkt.packet_id,
+                                  e.rc if self.proto_ver == C.MQTT_V5 else 0)]
+            return self._puback_for(pkt, e.rc)
+        if pkt.qos == C.QOS_1:
+            rc = RC.SUCCESS if n else RC.NO_MATCHING_SUBSCRIBERS
+            self.broker.metrics.inc("packets.puback.sent")
+            return [self._ack(C.PUBACK, pkt.packet_id,
+                              rc if self.proto_ver == C.MQTT_V5 else 0)]
+        return []
+
+    def _puback_for(self, pkt: Publish, rc: int) -> List[Packet]:
+        if pkt.qos == C.QOS_1:
+            return [self._ack(C.PUBACK, pkt.packet_id,
+                              rc if self.proto_ver == C.MQTT_V5 else 0)]
+        if pkt.qos == C.QOS_2:
+            return [self._ack(C.PUBREC, pkt.packet_id,
+                              rc if self.proto_ver == C.MQTT_V5 else 0)]
+        return []
+
+    # PUBACK family ------------------------------------------------------
+
+    def _in_puback(self, pkt: PubAck) -> List[Packet]:
+        t = pkt.type
+        out: List[Packet] = []
+        try:
+            if t == C.PUBACK:
+                self.broker.metrics.inc("packets.puback.received")
+                self.session.puback(pkt.packet_id)
+                self.broker.metrics.inc("messages.acked")
+            elif t == C.PUBREC:
+                self.broker.metrics.inc("packets.pubrec.received")
+                try:
+                    self.session.pubrec(pkt.packet_id)
+                    rc = RC.SUCCESS
+                except SessionError as e:
+                    self.broker.metrics.inc("packets.pubrec.missed")
+                    rc = e.rc
+                self.broker.metrics.inc("packets.pubrel.sent")
+                return [self._ack(C.PUBREL, pkt.packet_id,
+                                  rc if self.proto_ver == C.MQTT_V5 else 0)]
+            elif t == C.PUBREL:
+                self.broker.metrics.inc("packets.pubrel.received")
+                try:
+                    self.session.pubrel(pkt.packet_id)
+                    rc = RC.SUCCESS
+                except SessionError as e:
+                    self.broker.metrics.inc("packets.pubrel.missed")
+                    rc = e.rc
+                self.broker.metrics.inc("packets.pubcomp.sent")
+                return [self._ack(C.PUBCOMP, pkt.packet_id,
+                                  rc if self.proto_ver == C.MQTT_V5 else 0)]
+            elif t == C.PUBCOMP:
+                self.broker.metrics.inc("packets.pubcomp.received")
+                self.session.pubcomp(pkt.packet_id)
+                self.broker.metrics.inc("messages.acked")
+        except SessionError as e:
+            if t == C.PUBACK:
+                self.broker.metrics.inc("packets.puback.missed")
+            elif t == C.PUBCOMP:
+                self.broker.metrics.inc("packets.pubcomp.missed")
+            log.debug("ack error: %s", e)
+        out.extend(self.handle_deliver())
+        return out
+
+    # SUBSCRIBE / UNSUBSCRIBE -------------------------------------------
+
+    def _in_subscribe(self, pkt: Subscribe) -> List[Packet]:
+        self.broker.metrics.inc("packets.subscribe.received")
+        self.broker.metrics.inc("client.subscribe")
+        tf = self.broker.hooks.run_fold(
+            "client.subscribe",
+            (dict(self.clientinfo), pkt.properties),
+            pkt.topic_filters)
+        rcs: List[int] = []
+        subid = pkt.properties.get("Subscription-Identifier") \
+            if self.proto_ver == C.MQTT_V5 else None
+        for flt, opts in tf:
+            rcs.append(self._do_subscribe(flt, opts, subid))
+        self.broker.metrics.inc("packets.suback.sent")
+        if self.proto_ver != C.MQTT_V5:
+            rcs = [RC.compat("suback", rc) for rc in rcs]
+        out: List[Packet] = [Suback(packet_id=pkt.packet_id,
+                                    reason_codes=rcs)]
+        out.extend(self.handle_deliver())
+        return out
+
+    def _do_subscribe(self, flt: str, opts: Dict[str, int],
+                      subid) -> int:
+        try:
+            bare, popts = T.parse(flt)
+            T.validate(bare, "filter")
+        except T.TopicError:
+            self.broker.metrics.inc("packets.subscribe.error")
+            return RC.TOPIC_FILTER_INVALID
+        # caps
+        if "share" in popts and not self.zone.shared_subscription:
+            return RC.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
+        if T.wildcard(bare) and not self.zone.wildcard_subscription:
+            return RC.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
+        if self.zone.max_topic_levels and \
+                T.levels(bare) > self.zone.max_topic_levels:
+            return RC.TOPIC_FILTER_INVALID
+        # acl on the bare filter
+        if self.zone.enable_acl and not self.clientinfo.get("is_superuser"):
+            if self.access.check_acl(self.clientinfo, SUB, bare,
+                                     self.acl_cache) == DENY:
+                self.broker.metrics.inc("packets.subscribe.auth_error")
+                self.broker.metrics.inc("client.acl.deny")
+                return RC.NOT_AUTHORIZED
+        qos = min(opts.get("qos", 0), self.zone.max_qos_allowed)
+        subopts = SubOpts(qos=qos, nl=opts.get("nl", 0),
+                          rap=opts.get("rap", 0), rh=opts.get("rh", 0),
+                          subid=subid)
+        mflt = self._mount_filter(flt, bare, popts)
+        try:
+            self.session.subscribe(mflt, subopts)
+        except SessionError as e:
+            return e.rc
+        self.broker.hooks.run(
+            "session.subscribed",
+            (dict(self.clientinfo), mflt, subopts.to_dict()))
+        return qos  # granted qos == RC 0/1/2
+
+    def _mount_filter(self, flt: str, bare: str, popts: dict) -> str:
+        """Apply the mountpoint under the share prefix: ``$queue/``
+        keeps a 1-segment prefix, ``$share/<g>/`` a 2-segment one."""
+        if not self.mountpoint:
+            return flt
+        mounted = mount(self.mountpoint, bare)
+        share = popts.get("share")
+        if share == "$queue":
+            return "$queue/" + mounted
+        if share is not None:
+            return f"$share/{share}/{mounted}"
+        return mounted
+
+    def _in_unsubscribe(self, pkt: Unsubscribe) -> List[Packet]:
+        self.broker.metrics.inc("packets.unsubscribe.received")
+        self.broker.metrics.inc("client.unsubscribe")
+        tf = self.broker.hooks.run_fold(
+            "client.unsubscribe",
+            (dict(self.clientinfo), pkt.properties),
+            pkt.topic_filters)
+        rcs = []
+        for flt in tf:
+            try:
+                bare, popts = T.parse(flt)
+            except T.TopicError:
+                rcs.append(RC.TOPIC_FILTER_INVALID)
+                continue
+            mflt = self._mount_filter(flt, bare, popts)
+            try:
+                opts = self.session.unsubscribe(mflt)
+                self.broker.hooks.run(
+                    "session.unsubscribed",
+                    (dict(self.clientinfo), mflt, opts.to_dict()))
+                rcs.append(RC.SUCCESS)
+            except SessionError as e:
+                self.broker.metrics.inc("packets.unsubscribe.error")
+                rcs.append(e.rc)
+        self.broker.metrics.inc("packets.unsuback.sent")
+        return [Unsuback(packet_id=pkt.packet_id, reason_codes=rcs)]
+
+    # DISCONNECT ---------------------------------------------------------
+
+    def _in_disconnect(self, pkt: Disconnect) -> List[Packet]:
+        self.broker.metrics.inc("packets.disconnect.received")
+        if pkt.reason_code == RC.NORMAL_DISCONNECTION:
+            self.will = None  # clean close: discard will
+        # v5: client may update session expiry on disconnect
+        if self.proto_ver == C.MQTT_V5:
+            exp = pkt.properties.get("Session-Expiry-Interval")
+            if exp is not None:
+                self.expiry_interval = exp
+        self.disconnect_reason = "normal"
+        self._shutdown()
+        return []
+
+    def _disconnect_with(self, rc: int) -> List[Packet]:
+        self.disconnect_reason = RC.name(rc)
+        self._shutdown(close_transport=False)
+        self.close_after_send = True
+        if self.proto_ver == C.MQTT_V5:
+            self.broker.metrics.inc("packets.disconnect.sent")
+            return [Disconnect(reason_code=rc)]
+        return []
+
+    # -- outbound delivery ------------------------------------------------
+
+    def _notify_deliver(self) -> None:
+        if self.on_deliver is not None and not self.closed:
+            self.on_deliver()
+
+    def handle_deliver(self) -> List[Packet]:
+        """Drain the session outbox into PUBLISH/PUBREL packets."""
+        if self.session is None:
+            return []
+        out: List[Packet] = []
+        for pid, item in self.session.drain_outbox():
+            if pid == PUBREL_MARKER:
+                out.append(self._ack(C.PUBREL, item))
+                continue
+            msg = item
+            if msg.is_expired():
+                self.broker.metrics.inc("delivery.dropped")
+                self.broker.metrics.inc("delivery.dropped.expired")
+                continue
+            # copy before wire-mutation: the same object stays in the
+            # inflight window for retry/replay
+            msg = msg.copy()
+            if self.mountpoint:
+                msg.topic = unmount(self.mountpoint, msg.topic)
+            msg.update_expiry()
+            pub = from_message(pid, msg)
+            if self.proto_ver != C.MQTT_V5:
+                pub.properties = {}
+            self.broker.metrics.inc("packets.publish.sent")
+            self.broker.metrics.inc_sent(msg)
+            out.append(pub)
+        return out
+
+    # -- timers -----------------------------------------------------------
+
+    def handle_timeout(self, name: str, recv_bytes: int = 0) -> List[Packet]:
+        if name == "keepalive":
+            if self.keepalive is not None and \
+                    not self.keepalive.check(recv_bytes):
+                self.disconnect_reason = "keepalive_timeout"
+                self._shutdown(publish_will=True, close_transport=False)
+                self.close_after_send = True
+                if self.proto_ver == C.MQTT_V5:
+                    return [Disconnect(reason_code=RC.KEEPALIVE_TIMEOUT)]
+            return []
+        if name == "retry" and self.session is not None:
+            self.session.retry()
+            return self.handle_deliver()
+        if name == "expire_awaiting_rel" and self.session is not None:
+            self.session.expire_awaiting_rel()
+            return []
+        return []
+
+    # -- takeover / kick (called by CM) -----------------------------------
+
+    def takeover_begin(self) -> Optional[Session]:
+        sess = self.session
+        if sess is not None:
+            sess.takeover()
+        return sess
+
+    def takeover_end(self, rc: int) -> None:
+        self.session = None  # handed off — don't tear it down on close
+        self.disconnect_reason = "takeovered"
+        self.will = None
+        self._shutdown(rc=rc)
+
+    def kick(self, discard: bool = False) -> None:
+        self.disconnect_reason = "discarded" if discard else "kicked"
+        self._shutdown(rc=RC.SESSION_TAKEN_OVER)
+
+    # -- teardown ----------------------------------------------------------
+
+    def _shutdown(self, publish_will: Optional[bool] = None,
+                  rc: Optional[int] = None,
+                  close_transport: bool = True) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        was_connected = self.state == CONNECTED
+        self.state = DISCONNECTED
+        if publish_will is None:
+            publish_will = self.disconnect_reason not in (
+                "normal", "takeovered", "discarded")
+        if publish_will and self.will is not None:
+            delay = (self.will.get_header("properties") or {}).get(
+                "Will-Delay-Interval", 0)
+            delayed = getattr(self.broker, "delayed", None)
+            if delay and delayed is not None:
+                delayed.delay(self.will, delay)
+            else:
+                self.broker.publish(self.will)
+            self.will = None
+        if was_connected:
+            self.broker.metrics.inc("client.disconnected")
+            self.broker.hooks.run(
+                "client.disconnected",
+                (dict(self.clientinfo), self.disconnect_reason or "normal"))
+            flapping = getattr(self.broker, "flapping", None)
+            if flapping is not None and self.zone.enable_flapping_detect:
+                flapping.disconnected(self.client_id, self.peername[0])
+        if self.client_id and self.session is not None:
+            self.cm.connection_closed(
+                self.client_id, self, self.session, self.expiry_interval)
+            self.session = None
+        elif self.client_id:
+            self.cm.unregister_channel(self.client_id, self)
+        if close_transport and self.on_close is not None:
+            try:
+                self.on_close()
+            except Exception:
+                pass

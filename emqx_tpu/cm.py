@@ -1,0 +1,170 @@
+"""Connection/session manager: clientid registry, session open with
+clean-start/resume, takeover, discard, kick.
+
+Mirrors ``src/emqx_cm.erl``: ``open_session/3`` under a per-clientid
+lock (:209-236 — here a per-clientid mutex; the reference's cluster
+lock arrives with the cluster layer), takeover protocol
+(:244-272), discard/kick (:274-326), and the clientid→channel
+registry (emqx_cm_registry). Detached persistent sessions are kept
+for ``session_expiry_interval`` and swept by :meth:`expire_sessions`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from emqx_tpu.session import Session
+
+TAKEOVER_RC = 0x8E  # session taken over
+
+
+class ConnectionManager:
+    def __init__(self, broker=None) -> None:
+        self.broker = broker
+        self._lock = threading.Lock()
+        self._locks: Dict[str, threading.Lock] = {}
+        self._channels: Dict[str, object] = {}   # clientid -> live channel
+        # clientid -> (detached Session, detach_ts, expiry_interval)
+        self._detached: Dict[str, Tuple[Session, float, float]] = {}
+
+    def _client_lock(self, client_id: str) -> threading.Lock:
+        with self._lock:
+            lk = self._locks.get(client_id)
+            if lk is None:
+                lk = threading.Lock()
+                self._locks[client_id] = lk
+            return lk
+
+    # -- registry ---------------------------------------------------------
+
+    def register_channel(self, client_id: str, channel) -> None:
+        self._channels[client_id] = channel
+
+    def unregister_channel(self, client_id: str, channel=None) -> None:
+        cur = self._channels.get(client_id)
+        if channel is None or cur is channel:
+            self._channels.pop(client_id, None)
+
+    def lookup_channel(self, client_id: str):
+        return self._channels.get(client_id)
+
+    def connection_count(self) -> int:
+        return len(self._channels)
+
+    # -- session lifecycle (emqx_cm:open_session) -------------------------
+
+    def open_session(self, client_id: str, clean_start: bool,
+                     channel, session_opts: Optional[dict] = None,
+                     expiry_interval: float = 0.0
+                     ) -> Tuple[Session, bool]:
+        """Returns (session, session_present)."""
+        with self._client_lock(client_id):
+            old_chan = self._channels.get(client_id)
+            if clean_start:
+                if old_chan is not None and old_chan is not channel:
+                    self._kick(old_chan, discard=True)
+                stale = self._detached.pop(client_id, None)
+                if stale is not None and self.broker is not None:
+                    self.broker.subscriber_down(stale[0])
+                sess = self._new_session(client_id, True, session_opts)
+                if self.broker is not None:
+                    self.broker.metrics.inc("session.created")
+                    self.broker.hooks.run(
+                        "session.created", (client_id, sess.info()))
+                self._channels[client_id] = channel
+                return sess, False
+            # resume path
+            sess: Optional[Session] = None
+            if old_chan is not None and old_chan is not channel:
+                sess = self._takeover(old_chan)
+            elif client_id in self._detached:
+                sess, _ts, _exp = self._detached.pop(client_id)
+            if sess is not None:
+                self._channels[client_id] = channel
+                if self.broker is not None:
+                    sess.resume(self.broker)
+                return sess, True
+            sess = self._new_session(client_id, False, session_opts)
+            if self.broker is not None:
+                self.broker.metrics.inc("session.created")
+                self.broker.hooks.run(
+                    "session.created", (client_id, sess.info()))
+            self._channels[client_id] = channel
+            return sess, False
+
+    def _new_session(self, client_id: str, clean_start: bool,
+                     opts: Optional[dict]) -> Session:
+        return Session(client_id, broker=self.broker,
+                       clean_start=clean_start, **(opts or {}))
+
+    def _takeover(self, old_chan) -> Optional[Session]:
+        """{takeover, begin/end} protocol against the old channel."""
+        sess = old_chan.takeover_begin()
+        old_chan.takeover_end(TAKEOVER_RC)
+        if self.broker is not None:
+            self.broker.metrics.inc("session.takeovered")
+        return sess
+
+    def _kick(self, chan, discard: bool) -> None:
+        try:
+            chan.kick(discard=discard)
+        except Exception:
+            pass
+        self.unregister_channel(getattr(chan, "client_id", ""), chan)
+
+    def discard_session(self, client_id: str) -> None:
+        chan = self._channels.get(client_id)
+        if chan is not None:
+            self._kick(chan, discard=True)
+        stale = self._detached.pop(client_id, None)
+        if stale is not None and self.broker is not None:
+            self.broker.subscriber_down(stale[0])
+        if self.broker is not None:
+            self.broker.metrics.inc("session.discarded")
+
+    def kick_session(self, client_id: str) -> bool:
+        chan = self._channels.get(client_id)
+        if chan is None:
+            return False
+        self._kick(chan, discard=True)
+        return True
+
+    # -- disconnect bookkeeping ------------------------------------------
+
+    def connection_closed(self, client_id: str, channel,
+                          session: Optional[Session],
+                          expiry_interval: float) -> None:
+        """Keep a persistent session around; drop a clean one."""
+        self.unregister_channel(client_id, channel)
+        if session is None:
+            return
+        if expiry_interval > 0:
+            # stay subscribed: deliveries enqueue to the mqueue while
+            # the owner is away (reference `disconnected` state)
+            session.connected = False
+            session.notify = None
+            self._detached[client_id] = (
+                session, time.time(), expiry_interval)
+        else:
+            if self.broker is not None:
+                session.broker = self.broker
+                self.broker.subscriber_down(session)
+                self.broker.metrics.inc("session.terminated")
+
+    def expire_sessions(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        dead = [cid for cid, (_s, ts, exp) in self._detached.items()
+                if now - ts >= exp]
+        for cid in dead:
+            sess, _, _ = self._detached.pop(cid)
+            if self.broker is not None:
+                self.broker.subscriber_down(sess)
+                self.broker.metrics.inc("session.terminated")
+                self.broker.hooks.run(
+                    "session.terminated", (cid, "expired", sess.info()))
+        return len(dead)
+
+    def session_count(self) -> int:
+        return len(self._channels) + len(self._detached)

@@ -1,0 +1,240 @@
+"""TCP/WS transport: one asyncio task per connection feeding the
+channel FSM.
+
+Replaces the reference's process-per-connection loop
+(src/emqx_connection.erl:254-271): asyncio tasks play the role of
+BEAM processes; the esockd acceptor pool becomes
+``asyncio.start_server``. Flow control mirrors `{active, N}` +
+rate-limit pause (:363-373, 633-645) via a token-bucket limiter pause;
+per-connection GC policy has no analogue (no per-task heaps).
+
+The broker's batching tick lives here too: publishes arriving within
+one event-loop iteration across connections can be matched as one
+device batch (`Listener.batch_window`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional, Tuple
+
+from emqx_tpu.channel import Channel
+from emqx_tpu.limiter import TokenBucket
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import FrameError, FrameTooLarge, Parser, serialize
+from emqx_tpu.zone import Zone, get_zone
+
+log = logging.getLogger("emqx_tpu.connection")
+
+
+class Connection:
+    """One client socket <-> one Channel."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter,
+                 broker, cm, zone: Optional[Zone] = None,
+                 listener: str = "tcp:default") -> None:
+        self.reader = reader
+        self.writer = writer
+        self.zone = zone or get_zone()
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        self.channel = Channel(broker, cm, zone=self.zone,
+                               peername=(str(peer[0]), int(peer[1])),
+                               listener=listener)
+        self.channel.on_close = self._close_transport
+        self.channel.on_deliver = self._schedule_flush
+        self.parser = Parser(max_size=self.zone.max_packet_size)
+        self.broker = broker
+        self.recv_bytes = 0
+        self.send_bytes = 0
+        self.recv_pkts = 0
+        self.send_pkts = 0
+        self._closing = False
+        self._limiter = (TokenBucket(*self.zone.ratelimit_bytes_in)
+                         if self.zone.ratelimit_bytes_in else None)
+        self._timers: list = []
+
+    # -- IO ----------------------------------------------------------------
+
+    def _send_packets(self, pkts) -> None:
+        for pkt in pkts:
+            data = serialize(pkt, self.channel.proto_ver)
+            self.send_bytes += len(data)
+            self.send_pkts += 1
+            self.broker.metrics.inc("packets.sent")
+            self.broker.metrics.inc("bytes.sent", len(data))
+            if not self._closing:
+                self.writer.write(data)
+
+    def _schedule_flush(self) -> None:
+        """Wake the writer when the broker delivered into our session
+        from another connection's task."""
+        try:
+            asyncio.get_running_loop().call_soon(self._flush_deliver)
+        except RuntimeError:
+            self._flush_deliver()  # no loop (sync tests): flush inline
+
+    def _flush_deliver(self) -> None:
+        if self._closing:
+            return
+        self._send_packets(self.channel.handle_deliver())
+
+    def _close_transport(self) -> None:
+        self._closing = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    async def _drain_and_close(self) -> None:
+        """Flush pending bytes (error CONNACK / reason-coded
+        DISCONNECT), then close the socket."""
+        try:
+            await self.writer.drain()
+        except Exception:
+            pass
+        self._close_transport()
+
+    async def run(self) -> None:
+        """The connection loop: read → parse → channel → write."""
+        idle_deadline = time.time() + self.zone.idle_timeout
+        try:
+            while not self._closing:
+                timeout = None
+                if self.channel.state == "idle":
+                    timeout = max(0.1, idle_deadline - time.time())
+                try:
+                    data = await asyncio.wait_for(
+                        self.reader.read(65536), timeout) \
+                        if timeout else await self.reader.read(65536)
+                except asyncio.TimeoutError:
+                    break  # no CONNECT within idle_timeout
+                if not data:
+                    break
+                self.recv_bytes += len(data)
+                self.broker.metrics.inc("bytes.received", len(data))
+                if self._limiter is not None:
+                    wait = self._limiter.consume(len(data))
+                    if wait > 0:
+                        await asyncio.sleep(wait)  # backpressure pause
+                try:
+                    pkts = self.parser.feed(data)
+                except FrameTooLarge:
+                    self.broker.metrics.inc("delivery.dropped.too_large")
+                    break
+                except FrameError as e:
+                    log.debug("frame error from %s: %s",
+                              self.channel.peername, e)
+                    break
+                for pkt in pkts:
+                    self.recv_pkts += 1
+                    self.broker.metrics.inc("packets.received")
+                    first_connect = self.channel.state == "idle"
+                    out = self.channel.handle_in(pkt)
+                    self._send_packets(out)
+                    out2 = self.channel.handle_deliver()
+                    self._send_packets(out2)
+                    if first_connect and self.channel.state == "connected":
+                        self._start_timers()
+                    if self.channel.close_after_send:
+                        await self._drain_and_close()
+                        return
+                if not self._closing:
+                    await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            for t in self._timers:
+                t.cancel()
+            if not self.channel.closed:
+                if self.channel.disconnect_reason is None:
+                    self.channel.disconnect_reason = "sock_closed"
+                self.channel._shutdown()
+            self._close_transport()
+
+    def _start_timers(self) -> None:
+        loop = asyncio.get_event_loop()
+        self._timers.append(loop.create_task(self._keepalive_loop()))
+        self._timers.append(loop.create_task(self._retry_loop()))
+
+    async def _keepalive_loop(self) -> None:
+        ka = self.channel.keepalive
+        if ka is None:
+            return
+        while not self._closing:
+            await asyncio.sleep(ka.check_interval())
+            out = self.channel.handle_timeout("keepalive", self.recv_bytes)
+            self._send_packets(out)
+            if self.channel.close_after_send:
+                await self._drain_and_close()
+                return
+            if self.channel.closed:
+                return
+
+    async def _retry_loop(self) -> None:
+        while not self._closing and self.channel.session is not None:
+            await asyncio.sleep(
+                max(1.0, self.channel.session.retry_interval))
+            out = self.channel.handle_timeout("retry")
+            self._send_packets(out)
+            out = self.channel.handle_timeout("expire_awaiting_rel")
+            self._send_packets(out)
+            try:
+                await self.writer.drain()
+            except Exception:
+                return
+
+
+class Listener:
+    """TCP listener: accepts sockets, spawns Connections
+    (reference: src/emqx_listeners.erl + esockd acceptors)."""
+
+    def __init__(self, broker, cm, host: str = "127.0.0.1",
+                 port: int = 1883, zone: Optional[Zone] = None,
+                 name: str = "tcp:default",
+                 max_connections: int = 1024000) -> None:
+        self.broker = broker
+        self.cm = cm
+        self.host = host
+        self.port = port
+        self.zone = zone or get_zone()
+        self.name = name
+        self.max_connections = max_connections
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    async def _on_client(self, reader, writer) -> None:
+        if len(self._conns) >= self.max_connections:
+            writer.close()
+            return
+        conn = Connection(reader, writer, self.broker, self.cm,
+                          zone=self.zone, listener=self.name)
+        self._conns.add(conn)
+        try:
+            await conn.run()
+        finally:
+            self._conns.discard(conn)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]
+        log.info("listener %s on %s:%s", self.name, self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # force-close live connections: wait_closed() (3.12+)
+            # blocks until every client handler returns
+            for conn in list(self._conns):
+                if not conn.channel.closed:
+                    conn.channel.disconnect_reason = "server_shutdown"
+                    conn.channel._shutdown()
+                conn._close_transport()
+            await self._server.wait_closed()
+
+    def current_connections(self) -> int:
+        return len(self._conns)

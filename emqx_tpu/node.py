@@ -1,0 +1,88 @@
+"""Broker node assembly + lifecycle — the ``emqx_app``/``emqx_sup``
+analogue (src/emqx_app.erl:31-44, src/emqx_sup.erl:64-80).
+
+Order mirrors the reference boot: kernel services (hooks, metrics) →
+router/broker → connection manager → modules → listeners. asyncio
+supervision replaces OTP supervisors: crashed connection tasks die
+alone; the listener and node survive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.cm import ConnectionManager
+from emqx_tpu.connection import Listener
+from emqx_tpu.hooks import Hooks
+from emqx_tpu.metrics import Metrics
+from emqx_tpu.router import MatcherConfig, Router
+from emqx_tpu.zone import Zone, get_zone
+
+log = logging.getLogger("emqx_tpu.node")
+
+
+class Node:
+    def __init__(self, name: str = "emqx_tpu@127.0.0.1",
+                 zone: Optional[Zone] = None,
+                 matcher: Optional[MatcherConfig] = None,
+                 boot_listeners: bool = True) -> None:
+        self.name = name
+        self.zone = zone or get_zone()
+        self.hooks = Hooks()
+        self.metrics = Metrics()
+        self.router = Router(config=matcher, node=name)
+        self.broker = Broker(router=self.router, hooks=self.hooks,
+                             metrics=self.metrics, node=name)
+        self.cm = ConnectionManager(broker=self.broker)
+        self.listeners: List[Listener] = []
+        self.boot_listeners = boot_listeners
+        self.modules: Dict[str, object] = {}
+        self._started = False
+        self._bg_tasks: list = []
+
+    def add_listener(self, host: str = "127.0.0.1", port: int = 1883,
+                     zone: Optional[Zone] = None,
+                     name: str = "tcp:default") -> Listener:
+        lst = Listener(self.broker, self.cm, host=host, port=port,
+                       zone=zone or self.zone, name=name)
+        self.listeners.append(lst)
+        return lst
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        if self.boot_listeners and not self.listeners:
+            self.add_listener()
+        for lst in self.listeners:
+            await lst.start()
+        loop = asyncio.get_event_loop()
+        self._bg_tasks.append(loop.create_task(self._session_sweeper()))
+        self._started = True
+        log.info("node %s started", self.name)
+
+    async def stop(self) -> None:
+        for t in self._bg_tasks:
+            t.cancel()
+        self._bg_tasks.clear()
+        for lst in self.listeners:
+            await lst.stop()
+        self._started = False
+
+    async def _session_sweeper(self) -> None:
+        while True:
+            await asyncio.sleep(5.0)
+            self.cm.expire_sessions()
+
+    # -- facade (src/emqx.erl:26-64) --------------------------------------
+
+    def subscribe(self, sub, topic_filter: str, **kw):
+        return self.broker.subscribe(sub, topic_filter, **kw)
+
+    def unsubscribe(self, sub, topic_filter: str):
+        return self.broker.unsubscribe(sub, topic_filter)
+
+    def publish(self, msg):
+        return self.broker.publish(msg)

@@ -1,0 +1,340 @@
+"""Per-client session state machine: subscriptions, QoS flows,
+delivery window, message queue.
+
+Mirrors ``src/emqx_session.erl`` (#session record :96-124): the
+session is the per-client, inherently-sequential half of the broker
+(SURVEY §7 step 4 — kept host-side by design; the batched device path
+ends at the broker's dispatch into sessions). Covers:
+
+  - subscribe/unsubscribe with max_subscriptions quota (:238-276)
+  - inbound publish with QoS2 awaiting_rel two-phase flow (:281-301)
+  - outbound delivery: subopts enrichment (qos min/upgrade, nl, rap,
+    subid :505-530), packet-id assignment, inflight window with
+    mqueue overflow (:419-457)
+  - puback/pubrec/pubrel/pubcomp (:314-376) with dequeue-on-ack
+  - retry with dup flag + delivery expiry (:543-577)
+  - awaiting_rel expiry (:582-599)
+  - takeover/resume/replay (:606-629)
+
+A Session is also a broker subscriber: ``deliver(filter, msg)``
+enriches + windows the message and appends ready-to-send publishes to
+``outbox`` for the channel/connection to drain.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from emqx_tpu.inflight import Inflight
+from emqx_tpu.mqueue import MQueue
+from emqx_tpu.types import Message, QOS_0, QOS_1, QOS_2, SubOpts
+
+# reason codes used at the session boundary (mqtt/reason_codes has
+# the full table)
+RC_SUCCESS = 0x00
+RC_NO_SUBSCRIPTION_EXISTED = 0x11
+RC_PACKET_IDENTIFIER_IN_USE = 0x91
+RC_PACKET_IDENTIFIER_NOT_FOUND = 0x92
+RC_RECEIVE_MAXIMUM_EXCEEDED = 0x93
+RC_QUOTA_EXCEEDED = 0x97
+
+PUBREL_MARKER = "pubrel"
+
+
+class SessionError(Exception):
+    def __init__(self, rc: int):
+        super().__init__(hex(rc))
+        self.rc = rc
+
+
+class Session:
+    def __init__(
+        self,
+        client_id: str,
+        broker=None,
+        clean_start: bool = True,
+        max_subscriptions: int = 0,
+        max_inflight: int = 32,
+        max_mqueue_len: int = 1000,
+        mqueue_store_qos0: bool = False,
+        mqueue_priorities: Optional[Dict[str, int]] = None,
+        mqueue_default_priority: float = 0,
+        upgrade_qos: bool = False,
+        retry_interval: float = 30.0,
+        max_awaiting_rel: int = 100,
+        await_rel_timeout: float = 300.0,
+        expiry_interval: float = 0.0,
+    ) -> None:
+        self.client_id = client_id
+        self.broker = broker
+        self.clean_start = clean_start
+        self.created_at = time.time()
+        self.subscriptions: Dict[str, SubOpts] = {}
+        self.max_subscriptions = max_subscriptions
+        self.upgrade_qos = upgrade_qos
+        self.inflight = Inflight(max_inflight)
+        self.mqueue = MQueue(max_mqueue_len, mqueue_store_qos0,
+                             mqueue_priorities, mqueue_default_priority)
+        self.next_pkt_id = 1
+        self.retry_interval = retry_interval
+        self.awaiting_rel: Dict[int, float] = {}
+        self.max_awaiting_rel = max_awaiting_rel
+        self.await_rel_timeout = await_rel_timeout
+        self.expiry_interval = expiry_interval
+        # (packet_id | None, Message) or (PUBREL_MARKER, packet_id)
+        self.outbox: List[Tuple[Any, Any]] = []
+        # wakeup hook: the owning connection sets this so broker-driven
+        # deliveries flush to the socket (the BEAM's message-send wakeup
+        # has no implicit analogue in asyncio)
+        self.notify = None
+        # False while the owner is disconnected (persistent session):
+        # deliveries then enqueue instead of entering the send window
+        # (the reference channel's `disconnected` state)
+        self.connected = True
+
+    # -- info --------------------------------------------------------------
+
+    def info(self) -> dict:
+        return {
+            "clientid": self.client_id,
+            "clean_start": self.clean_start,
+            "subscriptions_cnt": len(self.subscriptions),
+            "inflight_cnt": len(self.inflight),
+            "mqueue_len": len(self.mqueue),
+            "mqueue_dropped": self.mqueue.dropped,
+            "awaiting_rel_cnt": len(self.awaiting_rel),
+            "next_pkt_id": self.next_pkt_id,
+            "created_at": self.created_at,
+        }
+
+    stats = info
+
+    # -- SUBSCRIBE / UNSUBSCRIBE ------------------------------------------
+
+    def subscribe(self, topic_filter: str,
+                  opts: Optional[SubOpts] = None) -> None:
+        is_new = topic_filter not in self.subscriptions
+        if (is_new and self.max_subscriptions
+                and len(self.subscriptions) >= self.max_subscriptions):
+            raise SessionError(RC_QUOTA_EXCEEDED)
+        opts = opts or SubOpts()
+        if self.broker is not None:
+            self.broker.subscribe(self, topic_filter, opts)
+        self.subscriptions[topic_filter] = opts
+
+    def unsubscribe(self, topic_filter: str) -> SubOpts:
+        if topic_filter not in self.subscriptions:
+            raise SessionError(RC_NO_SUBSCRIPTION_EXISTED)
+        if self.broker is not None:
+            self.broker.unsubscribe(self, topic_filter)
+        return self.subscriptions.pop(topic_filter)
+
+    # -- inbound PUBLISH (client -> broker) -------------------------------
+
+    def publish(self, packet_id: Optional[int], msg: Message) -> int:
+        """Returns the delivery count from the broker."""
+        if msg.qos == QOS_2:
+            if (self.max_awaiting_rel
+                    and len(self.awaiting_rel) >= self.max_awaiting_rel):
+                raise SessionError(RC_RECEIVE_MAXIMUM_EXCEEDED)
+            if packet_id in self.awaiting_rel:
+                raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
+            n = self.broker.publish(msg) if self.broker else 0
+            self.awaiting_rel[packet_id] = time.time()
+            return n
+        return self.broker.publish(msg) if self.broker else 0
+
+    def pubrel(self, packet_id: int) -> None:
+        if packet_id not in self.awaiting_rel:
+            raise SessionError(RC_PACKET_IDENTIFIER_NOT_FOUND)
+        del self.awaiting_rel[packet_id]
+
+    # -- outbound acks (client acks our deliveries) -----------------------
+
+    def puback(self, packet_id: int) -> Message:
+        val = self.inflight.lookup(packet_id)
+        if val is None:
+            raise SessionError(RC_PACKET_IDENTIFIER_NOT_FOUND)
+        msg, _ts = val
+        if msg == PUBREL_MARKER:
+            raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
+        self.inflight.delete(packet_id)
+        self.dequeue()
+        return msg
+
+    def pubrec(self, packet_id: int) -> Message:
+        val = self.inflight.lookup(packet_id)
+        if val is None:
+            raise SessionError(RC_PACKET_IDENTIFIER_NOT_FOUND)
+        msg, _ts = val
+        if msg == PUBREL_MARKER:
+            raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
+        self.inflight.update(packet_id, (PUBREL_MARKER, time.time()))
+        return msg
+
+    def pubcomp(self, packet_id: int) -> None:
+        val = self.inflight.lookup(packet_id)
+        if val is None:
+            raise SessionError(RC_PACKET_IDENTIFIER_NOT_FOUND)
+        if val[0] != PUBREL_MARKER:
+            raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
+        self.inflight.delete(packet_id)
+        self.dequeue()
+
+    # -- outbound delivery (broker -> client) -----------------------------
+
+    def deliver(self, topic_filter: str, msg: Message) -> None:
+        """Broker subscriber protocol: enrich, window, queue."""
+        m = self._enrich(topic_filter, msg)
+        if not self.connected:
+            self.enqueue(m)
+            return
+        self._deliver_msg(m)
+        if self.outbox and self.notify is not None:
+            self.notify()
+
+    def _enrich(self, topic_filter: str, msg: Message) -> Message:
+        opts = self.subscriptions.get(topic_filter)
+        # look up shared form too: session keys by full filter string
+        if opts is None:
+            for key, o in self.subscriptions.items():
+                if o.share and key.endswith("/" + topic_filter):
+                    opts = o
+                    break
+        m = Message(
+            topic=msg.topic, payload=msg.payload, qos=msg.qos,
+            from_=msg.from_, flags=dict(msg.flags),
+            headers=dict(msg.headers), id=msg.id, timestamp=msg.timestamp)
+        if opts is None:
+            return m
+        if self.upgrade_qos:
+            m.qos = max(opts.qos, m.qos)
+        else:
+            m.qos = min(opts.qos, m.qos)
+        if opts.nl:
+            m.set_flag("nl")
+        if not opts.rap and not m.get_header("retained", False):
+            m.set_flag("retain", False)
+        if opts.subid is not None:
+            props = dict(m.get_header("properties") or {})
+            props["Subscription-Identifier"] = opts.subid
+            m.set_header("properties", props)
+        return m
+
+    def _deliver_msg(self, msg: Message) -> None:
+        if msg.qos == QOS_0:
+            self.outbox.append((None, msg))
+            return
+        if self.inflight.is_full():
+            self.enqueue(msg)
+            return
+        pid = self._next_pkt_id()
+        self.inflight.insert(pid, (msg, time.time()))
+        self.outbox.append((pid, msg))
+
+    def enqueue(self, msg: Message) -> None:
+        dropped = self.mqueue.push(msg)
+        if dropped is not None and self.broker is not None:
+            self.broker.metrics.inc("delivery.dropped")
+            if msg.qos == QOS_0 and not self.mqueue.store_qos0:
+                self.broker.metrics.inc("delivery.dropped.qos0_msg")
+            else:
+                self.broker.metrics.inc("delivery.dropped.queue_full")
+
+    def dequeue(self) -> None:
+        """Move queued messages into the freed inflight window
+        (emqx_session:dequeue/1 :389-409)."""
+        while not self.mqueue.is_empty() and not self.inflight.is_full():
+            msg = self.mqueue.pop()
+            if msg is None:
+                break
+            if msg.is_expired():
+                if self.broker is not None:
+                    self.broker.metrics.inc("delivery.dropped")
+                    self.broker.metrics.inc("delivery.dropped.expired")
+                continue
+            self._deliver_msg(msg)
+
+    def _next_pkt_id(self) -> int:
+        # skip ids still awaited (wrap-around safety; reference wraps
+        # at 0xFFFF and relies on window < 65535)
+        for _ in range(0x10000):
+            pid = self.next_pkt_id
+            self.next_pkt_id = 1 if pid == 0xFFFF else pid + 1
+            if pid not in self.inflight:
+                return pid
+        raise SessionError(RC_QUOTA_EXCEEDED)
+
+    # -- timers -----------------------------------------------------------
+
+    def retry(self, now: Optional[float] = None) -> float:
+        """Re-send timed-out inflight entries (dup=true) / pubrels.
+        Returns the next retry delay in seconds."""
+        now = time.time() if now is None else now
+        if self.inflight.is_empty():
+            return self.retry_interval
+        items = self.inflight.to_list(sort_key=lambda kv: kv[1][1])
+        next_delay = self.retry_interval
+        for pid, (msg, ts) in items:
+            age = now - ts
+            if age < self.retry_interval:
+                next_delay = self.retry_interval - age
+                break
+            if msg == PUBREL_MARKER:
+                self.inflight.update(pid, (PUBREL_MARKER, now))
+                self.outbox.append((PUBREL_MARKER, pid))
+            elif msg.is_expired():
+                self.inflight.delete(pid)
+                if self.broker is not None:
+                    self.broker.metrics.inc("delivery.dropped")
+                    self.broker.metrics.inc("delivery.dropped.expired")
+            else:
+                msg.set_flag("dup", True)
+                self.inflight.update(pid, (msg, now))
+                self.outbox.append((pid, msg))
+        return next_delay
+
+    def expire_awaiting_rel(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        expired = [pid for pid, ts in self.awaiting_rel.items()
+                   if now - ts >= self.await_rel_timeout]
+        for pid in expired:
+            del self.awaiting_rel[pid]
+        if expired and self.broker is not None:
+            self.broker.metrics.inc("messages.dropped", len(expired))
+            self.broker.metrics.inc("messages.dropped.expired", len(expired))
+
+    # -- takeover / resume / replay (emqx_session:606-629) ----------------
+
+    def takeover(self) -> None:
+        """Old owner: detach from the broker, keep state for handoff."""
+        if self.broker is not None:
+            for topic_filter in self.subscriptions:
+                self.broker.unsubscribe(self, topic_filter)
+
+    def resume(self, broker) -> None:
+        """New owner: reattach subscriptions to the (possibly new)
+        broker."""
+        self.broker = broker
+        self.connected = True
+        for topic_filter, opts in self.subscriptions.items():
+            broker.subscribe(self, topic_filter, opts)
+        if broker is not None:
+            broker.metrics.inc("session.resumed")
+            broker.hooks.run("session.resumed", (self.client_id, self.info()))
+
+    def replay(self) -> None:
+        """Re-emit all inflight entries (dup) then drain the queue."""
+        for pid, (msg, _ts) in self.inflight.to_list(
+                sort_key=lambda kv: kv[0]):
+            if msg == PUBREL_MARKER:
+                self.outbox.append((PUBREL_MARKER, pid))
+            else:
+                msg.set_flag("dup", True)
+                self.outbox.append((pid, msg))
+        self.dequeue()
+
+    def drain_outbox(self) -> List[Tuple[Any, Any]]:
+        out, self.outbox = self.outbox, []
+        return out

@@ -31,6 +31,17 @@ class Message:
     id: int = field(default_factory=new_guid)
     timestamp: float = field(default_factory=time.time)
 
+    def copy(self) -> "Message":
+        """Shallow copy with independent flags/headers dicts — for per-wire
+        mutation (unmount, expiry rewrite) without corrupting the
+        inflight/mqueue-retained original."""
+        return Message(
+            topic=self.topic, payload=self.payload, qos=self.qos,
+            from_=self.from_, flags=dict(self.flags),
+            headers={k: (dict(v) if isinstance(v, dict) else v)
+                     for k, v in self.headers.items()},
+            id=self.id, timestamp=self.timestamp)
+
     def get_flag(self, name: str, default: bool = False) -> bool:
         return self.flags.get(name, default)
 

@@ -1,0 +1,73 @@
+"""Config zones: named bundles of per-listener/per-connection settings.
+
+Mirrors ``src/emqx_zone.erl`` + the zone sections of etc/emqx.conf:
+a zone snapshot is read lock-free by every connection (here: a frozen
+dataclass). Defaults follow etc/emqx.conf:698-907.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class Zone:
+    name: str = "default"
+    # connection
+    idle_timeout: float = 15.0
+    max_packet_size: int = 1024 * 1024
+    max_clientid_len: int = 65535
+    max_topic_levels: int = 0          # 0 = unlimited
+    max_topic_alias: int = 65535
+    max_qos_allowed: int = 2
+    retain_available: bool = True
+    wildcard_subscription: bool = True
+    shared_subscription: bool = True
+    server_keepalive: Optional[int] = None
+    keepalive_backoff: float = 0.75
+    # session
+    max_subscriptions: int = 0
+    upgrade_qos: bool = False
+    max_inflight: int = 32
+    retry_interval: float = 30.0
+    max_awaiting_rel: int = 100
+    await_rel_timeout: float = 300.0
+    session_expiry_interval: float = 7200.0
+    max_mqueue_len: int = 1000
+    mqueue_priorities: Optional[Dict[str, int]] = None
+    mqueue_default_priority: float = 0
+    mqueue_store_qos0: bool = True
+    # auth/acl
+    allow_anonymous: bool = True
+    acl_nomatch: str = "allow"          # allow | deny
+    enable_acl: bool = True
+    enable_ban: bool = True
+    # flapping
+    enable_flapping_detect: bool = False
+    # stats
+    enable_stats: bool = True
+    mountpoint: Optional[str] = None
+    # rate limits (None = unlimited): (rate/sec, burst)
+    ratelimit_msg_in: Optional[tuple] = None
+    ratelimit_bytes_in: Optional[tuple] = None
+    quota_conn_messages: Optional[tuple] = None
+
+
+_zones: Dict[str, Zone] = {}
+
+
+def get_zone(name: str = "default") -> Zone:
+    z = _zones.get(name)
+    if z is None:
+        z = Zone(name=name)
+        _zones[name] = z
+    return z
+
+
+def set_zone(zone: Zone) -> None:
+    _zones[zone.name] = zone
+
+
+def force_reload() -> None:
+    _zones.clear()

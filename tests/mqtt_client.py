@@ -1,0 +1,140 @@
+"""Minimal asyncio MQTT client for integration tests — the role
+emqtt plays in the reference's CT suites (rebar.config:40-45)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import Parser, serialize
+from emqx_tpu.mqtt.packet import (Connack, Connect, Disconnect, PubAck,
+                                  Publish, Pingreq, Pingresp, Suback,
+                                  Subscribe, Unsuback, Unsubscribe)
+
+
+class TestClient:
+    __test__ = False  # not a pytest class
+
+    def __init__(self, client_id: str, version: int = C.MQTT_V4,
+                 clean_start: bool = True, **connect_kw) -> None:
+        self.client_id = client_id
+        self.version = version
+        self.clean_start = clean_start
+        self.connect_kw = connect_kw
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.parser = Parser(version=version)
+        self.inbox: asyncio.Queue = asyncio.Queue()   # inbound PUBLISHes
+        self.acks: asyncio.Queue = asyncio.Queue()    # everything else
+        self.connack: Optional[Connack] = None
+        self._task: Optional[asyncio.Task] = None
+        self._pkt_id = 0
+
+    def next_pkt_id(self) -> int:
+        self._pkt_id = (self._pkt_id % 0xFFFF) + 1
+        return self._pkt_id
+
+    async def connect(self, host="127.0.0.1", port=1883,
+                      timeout=5.0) -> Connack:
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        self._task = asyncio.get_event_loop().create_task(self._read_loop())
+        await self.send(Connect(
+            proto_ver=self.version,
+            proto_name=C.PROTOCOL_NAMES[self.version],
+            client_id=self.client_id, clean_start=self.clean_start,
+            **self.connect_kw))
+        self.connack = await asyncio.wait_for(self.acks.get(), timeout)
+        assert isinstance(self.connack, Connack), self.connack
+        return self.connack
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    return
+                for pkt in self.parser.feed(data):
+                    if isinstance(pkt, Publish):
+                        await self.inbox.put(pkt)
+                        # auto-ack inbound QoS1/2
+                        if pkt.qos == 1:
+                            await self.send(PubAck(type=C.PUBACK,
+                                                   packet_id=pkt.packet_id))
+                        elif pkt.qos == 2:
+                            await self.send(PubAck(type=C.PUBREC,
+                                                   packet_id=pkt.packet_id))
+                    elif isinstance(pkt, PubAck) and pkt.type == C.PUBREL:
+                        await self.send(PubAck(type=C.PUBCOMP,
+                                               packet_id=pkt.packet_id))
+                        await self.acks.put(pkt)
+                    else:
+                        await self.acks.put(pkt)
+        except (ConnectionResetError, asyncio.CancelledError):
+            return
+
+    async def send(self, pkt) -> None:
+        self.writer.write(serialize(pkt, self.version))
+        await self.writer.drain()
+
+    async def subscribe(self, *filters, qos=0, timeout=5.0) -> Suback:
+        pid = self.next_pkt_id()
+        tf = [(f, {"qos": qos, "nl": 0, "rap": 0, "rh": 0})
+              if isinstance(f, str) else f for f in filters]
+        await self.send(Subscribe(packet_id=pid, topic_filters=tf))
+        ack = await asyncio.wait_for(self.acks.get(), timeout)
+        assert isinstance(ack, Suback), ack
+        return ack
+
+    async def unsubscribe(self, *filters, timeout=5.0) -> Unsuback:
+        pid = self.next_pkt_id()
+        await self.send(Unsubscribe(packet_id=pid,
+                                    topic_filters=list(filters)))
+        ack = await asyncio.wait_for(self.acks.get(), timeout)
+        assert isinstance(ack, Unsuback), ack
+        return ack
+
+    async def publish(self, topic: str, payload: bytes = b"", qos: int = 0,
+                      retain: bool = False, props: Optional[dict] = None,
+                      timeout=5.0):
+        pid = self.next_pkt_id() if qos else None
+        await self.send(Publish(topic=topic, payload=payload, qos=qos,
+                                retain=retain, packet_id=pid,
+                                properties=props or {}))
+        if qos == 1:
+            ack = await asyncio.wait_for(self.acks.get(), timeout)
+            assert isinstance(ack, PubAck) and ack.type == C.PUBACK, ack
+            return ack
+        if qos == 2:
+            rec = await asyncio.wait_for(self.acks.get(), timeout)
+            assert isinstance(rec, PubAck) and rec.type == C.PUBREC, rec
+            await self.send(PubAck(type=C.PUBREL, packet_id=pid))
+            comp = await asyncio.wait_for(self.acks.get(), timeout)
+            assert isinstance(comp, PubAck) and comp.type == C.PUBCOMP, comp
+            return comp
+        return None
+
+    async def recv(self, timeout=5.0) -> Publish:
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+    async def ping(self, timeout=5.0) -> None:
+        await self.send(Pingreq())
+        ack = await asyncio.wait_for(self.acks.get(), timeout)
+        assert isinstance(ack, Pingresp), ack
+
+    async def disconnect(self, rc: int = 0) -> None:
+        try:
+            await self.send(Disconnect(reason_code=rc))
+        except Exception:
+            pass
+        await self.close()
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self.writer:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except Exception:
+                pass

@@ -1,0 +1,240 @@
+"""Wire codec tests — ported from reference emqx_frame_SUITE and
+prop_emqx_frame (serialize∘parse roundtrip across versions)."""
+
+import random
+
+import pytest
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import (
+    FrameError, FrameTooLarge, Parser, serialize)
+from emqx_tpu.mqtt.packet import (
+    Auth, Connack, Connect, Disconnect, PubAck, Publish, Pingreq,
+    Pingresp, Suback, Subscribe, Unsuback, Unsubscribe, check,
+    to_message, will_msg, PacketError)
+
+
+def roundtrip(pkt, version):
+    data = serialize(pkt, version)
+    p = Parser(version=version)
+    out = p.feed(data)
+    assert len(out) == 1, (pkt, out)
+    return out[0]
+
+
+def test_connect_roundtrip_v4():
+    pkt = Connect(proto_ver=C.MQTT_V4, client_id="c1", keepalive=30,
+                  clean_start=True, username="u", password=b"p")
+    got = roundtrip(pkt, C.MQTT_V4)
+    assert got == pkt
+
+
+def test_connect_roundtrip_v5_with_will_and_props():
+    pkt = Connect(
+        proto_ver=C.MQTT_V5, client_id="c2", clean_start=False,
+        keepalive=120,
+        will_flag=True, will_qos=1, will_retain=True,
+        will_topic="will/t", will_payload=b"bye",
+        will_props={"Will-Delay-Interval": 5},
+        properties={"Session-Expiry-Interval": 3600,
+                    "Receive-Maximum": 10,
+                    "User-Property": [("a", "b"), ("a", "c")]})
+    got = roundtrip(pkt, C.MQTT_V5)
+    assert got == pkt
+
+
+def test_connect_v3():
+    pkt = Connect(proto_ver=C.MQTT_V3, proto_name="MQIsdp", client_id="x")
+    got = roundtrip(pkt, C.MQTT_V3)
+    assert got.proto_ver == 3 and got.proto_name == "MQIsdp"
+
+
+def test_bad_protocol_name():
+    pkt = Connect(proto_ver=C.MQTT_V4, client_id="c")
+    data = bytearray(serialize(pkt, C.MQTT_V4))
+    data[4] = ord("X")  # corrupt protocol name
+    with pytest.raises(FrameError):
+        Parser().feed(bytes(data))
+
+
+def test_publish_roundtrip_all_qos():
+    for v in (C.MQTT_V3, C.MQTT_V4, C.MQTT_V5):
+        for qos in (0, 1, 2):
+            pkt = Publish(topic="a/b", qos=qos,
+                          packet_id=None if qos == 0 else 7,
+                          payload=b"\x00\xffhello", retain=qos == 1,
+                          dup=qos == 2)
+            if v == C.MQTT_V5 and qos:
+                pkt.properties = {"Topic-Alias": 3,
+                                  "Message-Expiry-Interval": 60}
+            assert roundtrip(pkt, v) == pkt
+
+
+def test_puback_family_roundtrip():
+    for v in (C.MQTT_V4, C.MQTT_V5):
+        for t in (C.PUBACK, C.PUBREC, C.PUBREL, C.PUBCOMP):
+            pkt = PubAck(type=t, packet_id=99)
+            if v == C.MQTT_V5:
+                pkt.reason_code = 0x10
+                pkt.properties = {"Reason-String": "meh"}
+            assert roundtrip(pkt, v) == pkt
+
+
+def test_subscribe_roundtrip():
+    pkt = Subscribe(packet_id=5, topic_filters=[
+        ("a/+", {"qos": 1, "nl": 0, "rap": 0, "rh": 0}),
+        ("b/#", {"qos": 2, "nl": 1, "rap": 1, "rh": 2})])
+    assert roundtrip(pkt, C.MQTT_V5) == pkt
+    # v4 loses nl/rap/rh on the wire (they're v5 sub options)
+    got = roundtrip(pkt, C.MQTT_V4)
+    assert [f for f, _ in got.topic_filters] == ["a/+", "b/#"]
+
+
+def test_suback_unsub_roundtrip():
+    assert roundtrip(Suback(packet_id=5, reason_codes=[0, 1, 0x80]),
+                     C.MQTT_V5).reason_codes == [0, 1, 0x80]
+    pkt = Unsubscribe(packet_id=6, topic_filters=["a", "b/c"])
+    assert roundtrip(pkt, C.MQTT_V4) == pkt
+    assert roundtrip(Unsuback(packet_id=6, reason_codes=[0, 17]),
+                     C.MQTT_V5).reason_codes == [0, 17]
+
+
+def test_ping_disconnect_auth():
+    assert isinstance(roundtrip(Pingreq(), C.MQTT_V4), Pingreq)
+    assert isinstance(roundtrip(Pingresp(), C.MQTT_V4), Pingresp)
+    assert roundtrip(Disconnect(), C.MQTT_V4) == Disconnect()
+    d5 = Disconnect(reason_code=0x8E,
+                    properties={"Reason-String": "takeover"})
+    assert roundtrip(d5, C.MQTT_V5) == d5
+    a = Auth(reason_code=0x18,
+             properties={"Authentication-Method": "SCRAM"})
+    assert roundtrip(a, C.MQTT_V5) == a
+
+
+def test_incremental_feed_byte_by_byte():
+    pkt = Publish(topic="x/y", qos=1, packet_id=3, payload=b"data")
+    data = serialize(pkt, C.MQTT_V4)
+    p = Parser()
+    got = []
+    for i in range(len(data)):
+        got += p.feed(data[i:i + 1])
+    assert got == [pkt]
+
+
+def test_multiple_packets_in_one_feed():
+    a = serialize(Publish(topic="a", qos=0, payload=b"1"), C.MQTT_V4)
+    b = serialize(Pingreq(), C.MQTT_V4)
+    got = Parser().feed(a + b)
+    assert len(got) == 2 and isinstance(got[1], Pingreq)
+
+
+def test_parser_version_switches_on_connect():
+    p = Parser(version=C.MQTT_V4)
+    con = serialize(Connect(proto_ver=C.MQTT_V5, client_id="c"), C.MQTT_V5)
+    pub5 = serialize(Publish(topic="t", qos=0, payload=b"",
+                             properties={"Content-Type": "x"}), C.MQTT_V5)
+    out = p.feed(con + pub5)
+    assert out[1].properties == {"Content-Type": "x"}
+
+
+def test_frame_too_large():
+    p = Parser(max_size=64)
+    big = serialize(Publish(topic="t", qos=0, payload=b"x" * 1000),
+                    C.MQTT_V4)
+    with pytest.raises(FrameTooLarge):
+        p.feed(big)
+
+
+def test_bad_qos_rejected():
+    data = bytes([0x30 | 0x06, 2, 0, 0])  # qos=3
+    with pytest.raises(FrameError):
+        Parser().feed(data)
+
+
+def test_reserved_pubrel_flags_strict():
+    data = bytearray(serialize(PubAck(type=C.PUBREL, packet_id=1),
+                               C.MQTT_V4))
+    data[0] = (C.PUBREL << 4) | 0x00  # must be 0x02
+    with pytest.raises(FrameError):
+        Parser().feed(bytes(data))
+    Parser(strict=False).feed(bytes(data))  # lenient mode ok
+
+
+def test_packet_check_and_conversion():
+    with pytest.raises(PacketError):
+        check(Publish(topic="a/#", qos=0))  # wildcard in name
+    with pytest.raises(PacketError):
+        check(Publish(topic="t", qos=1, packet_id=None))
+    with pytest.raises(PacketError):
+        check(Subscribe(packet_id=1, topic_filters=[]))
+    msg = to_message(Publish(topic="t", qos=1, packet_id=1,
+                             retain=True, payload=b"p"), "cid")
+    assert msg.from_ == "cid" and msg.get_flag("retain")
+    w = will_msg(Connect(client_id="c", will_flag=True, will_qos=1,
+                         will_topic="w", will_payload=b"bye"))
+    assert w.topic == "w" and w.qos == 1
+
+
+def _rand_packet(rng):
+    t = rng.choice(["pub", "sub", "unsub", "ack", "con", "disc"])
+    if t == "pub":
+        qos = rng.randint(0, 2)
+        return Publish(
+            topic="/".join("abcdef"[rng.randint(0, 5)]
+                           for _ in range(rng.randint(1, 5))),
+            qos=qos, packet_id=rng.randint(1, 0xFFFF) if qos else None,
+            dup=bool(rng.randint(0, 1)) if qos else False,
+            retain=bool(rng.randint(0, 1)),
+            payload=bytes(rng.randrange(256)
+                          for _ in range(rng.randint(0, 64))))
+    if t == "sub":
+        return Subscribe(
+            packet_id=rng.randint(1, 0xFFFF),
+            topic_filters=[("t/%d" % i, {"qos": rng.randint(0, 2),
+                                         "nl": rng.randint(0, 1),
+                                         "rap": rng.randint(0, 1),
+                                         "rh": rng.randint(0, 2)})
+                           for i in range(rng.randint(1, 4))])
+    if t == "unsub":
+        return Unsubscribe(packet_id=rng.randint(1, 0xFFFF),
+                           topic_filters=["x/%d" % i
+                                          for i in range(rng.randint(1, 4))])
+    if t == "ack":
+        return PubAck(type=rng.choice([C.PUBACK, C.PUBREC, C.PUBCOMP]),
+                      packet_id=rng.randint(1, 0xFFFF))
+    if t == "con":
+        return Connect(proto_ver=C.MQTT_V5 if rng.random() < 0.5 else C.MQTT_V4,
+                       client_id="c%d" % rng.randint(0, 99),
+                       clean_start=bool(rng.randint(0, 1)),
+                       keepalive=rng.randint(0, 0xFFFF))
+    return Disconnect()
+
+
+def test_random_roundtrip_property():
+    """prop_emqx_frame analogue: serialize∘parse == id."""
+    rng = random.Random(99)
+    for _ in range(300):
+        pkt = _rand_packet(rng)
+        v = pkt.proto_ver if isinstance(pkt, Connect) else (
+            C.MQTT_V5 if rng.random() < 0.5 else C.MQTT_V4)
+        got = roundtrip(pkt, v)
+        if isinstance(pkt, Subscribe) and v != C.MQTT_V5:
+            assert [f for f, _ in got.topic_filters] == \
+                [f for f, _ in pkt.topic_filters]
+        else:
+            assert got == pkt, (v, pkt, got)
+
+
+def test_fragmented_stream_of_many_packets():
+    rng = random.Random(1)
+    pkts = [_rand_packet(rng) for _ in range(50)]
+    pkts = [p for p in pkts if not isinstance(p, Connect)]
+    stream = b"".join(serialize(p, C.MQTT_V4) for p in pkts)
+    parser = Parser()
+    got = []
+    i = 0
+    while i < len(stream):
+        n = rng.randint(1, 17)
+        got += parser.feed(stream[i:i + n])
+        i += n
+    assert len(got) == len(pkts)

@@ -1,0 +1,250 @@
+"""Session-layer tests — modeled on reference emqx_session_SUITE,
+emqx_mqueue_SUITE, emqx_inflight_SUITE, emqx_pqueue_SUITE."""
+
+import pytest
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.inflight import Inflight, KeyExists
+from emqx_tpu.mqueue import MQueue
+from emqx_tpu.pqueue import PQueue
+from emqx_tpu.session import (
+    PUBREL_MARKER, RC_PACKET_IDENTIFIER_IN_USE,
+    RC_PACKET_IDENTIFIER_NOT_FOUND, RC_RECEIVE_MAXIMUM_EXCEEDED,
+    RC_QUOTA_EXCEEDED, Session, SessionError)
+from emqx_tpu.types import Message, SubOpts
+
+
+def _m(topic="t", qos=1, **kw):
+    return Message(topic=topic, qos=qos, **kw)
+
+
+# -- pqueue ----------------------------------------------------------------
+
+def test_pqueue_fifo_and_priority():
+    q = PQueue()
+    q.push("a")
+    q.push("b")
+    q.push("hi", priority=10)
+    assert q.pop() == (True, "hi")
+    assert q.pop() == (True, "a")
+    assert q.pop() == (True, "b")
+    assert q.pop() == (False, None)
+
+
+def test_pqueue_plen():
+    q = PQueue()
+    q.push("a", 1)
+    q.push("b", 1)
+    q.push("c", 2)
+    assert q.plen(1) == 2 and q.plen(2) == 1 and q.plen(3) == 0
+    assert len(q) == 3
+
+
+# -- inflight --------------------------------------------------------------
+
+def test_inflight_basic():
+    inf = Inflight(max_size=2)
+    inf.insert(1, "a")
+    with pytest.raises(KeyExists):
+        inf.insert(1, "dup")
+    inf.insert(2, "b")
+    assert inf.is_full()
+    inf.update(2, "b2")
+    assert inf.lookup(2) == "b2"
+    inf.delete(1)
+    assert not inf.is_full()
+    assert inf.keys() == [2]
+
+
+# -- mqueue ----------------------------------------------------------------
+
+def test_mqueue_qos0_dropped_unless_stored():
+    q = MQueue(max_len=10, store_qos0=False)
+    dropped = q.push(_m(qos=0))
+    assert dropped is not None and len(q) == 0
+    q2 = MQueue(max_len=10, store_qos0=True)
+    assert q2.push(_m(qos=0)) is None and len(q2) == 1
+
+
+def test_mqueue_drop_oldest_when_full():
+    q = MQueue(max_len=2)
+    m1, m2, m3 = _m(payload=b"1"), _m(payload=b"2"), _m(payload=b"3")
+    assert q.push(m1) is None
+    assert q.push(m2) is None
+    dropped = q.push(m3)
+    assert dropped is m1  # oldest of the class dropped
+    assert q.dropped == 1
+    assert q.pop() is m2
+    assert q.pop() is m3
+
+
+def test_mqueue_priorities():
+    q = MQueue(max_len=10, priorities={"hi": 5}, default_priority=0)
+    q.push(_m(topic="lo"))
+    q.push(_m(topic="hi"))
+    assert q.pop().topic == "hi"
+    assert q.pop().topic == "lo"
+
+
+def test_mqueue_unbounded():
+    q = MQueue(max_len=0)
+    for i in range(5000):
+        assert q.push(_m()) is None
+    assert len(q) == 5000
+
+
+# -- session QoS flows -----------------------------------------------------
+
+def test_qos1_flow():
+    b = Broker()
+    s = Session("c1", broker=b)
+    s.subscribe("t", SubOpts(qos=1))
+    b.publish(_m(qos=1))
+    [(pid, msg)] = s.drain_outbox()
+    assert pid == 1 and msg.qos == 1
+    assert s.puback(pid).id == msg.id
+    assert len(s.inflight) == 0
+    with pytest.raises(SessionError) as e:
+        s.puback(pid)
+    assert e.value.rc == RC_PACKET_IDENTIFIER_NOT_FOUND
+
+
+def test_qos2_outbound_flow():
+    b = Broker()
+    s = Session("c1", broker=b)
+    s.subscribe("t", SubOpts(qos=2))
+    b.publish(_m(qos=2))
+    [(pid, _msg)] = s.drain_outbox()
+    s.pubrec(pid)
+    with pytest.raises(SessionError) as e:
+        s.pubrec(pid)  # second pubrec: already pubrel state
+    assert e.value.rc == RC_PACKET_IDENTIFIER_IN_USE
+    with pytest.raises(SessionError):
+        s.puback(pid)
+    s.pubcomp(pid)
+    assert len(s.inflight) == 0
+
+
+def test_qos2_inbound_awaiting_rel():
+    b = Broker()
+    s = Session("c1", broker=b, max_awaiting_rel=2)
+    s.publish(10, _m(qos=2))
+    with pytest.raises(SessionError) as e:
+        s.publish(10, _m(qos=2))  # duplicate packet id
+    assert e.value.rc == RC_PACKET_IDENTIFIER_IN_USE
+    s.publish(11, _m(qos=2))
+    with pytest.raises(SessionError) as e:
+        s.publish(12, _m(qos=2))  # window full
+    assert e.value.rc == RC_RECEIVE_MAXIMUM_EXCEEDED
+    s.pubrel(10)
+    with pytest.raises(SessionError):
+        s.pubrel(10)
+    s.publish(12, _m(qos=2))
+
+
+def test_qos_downgrade_and_upgrade():
+    b = Broker()
+    s = Session("c1", broker=b)
+    s.subscribe("t", SubOpts(qos=0))
+    b.publish(_m(qos=2))
+    [(pid, msg)] = s.drain_outbox()
+    assert pid is None and msg.qos == 0  # min(sub 0, pub 2)
+    up = Session("c2", broker=b, upgrade_qos=True)
+    up.subscribe("t", SubOpts(qos=2))
+    b.publish(_m(qos=0))
+    [(pid2, msg2)] = up.drain_outbox()
+    assert msg2.qos == 2 and pid2 == 1
+
+
+def test_inflight_full_overflows_to_mqueue_then_dequeues():
+    b = Broker()
+    s = Session("c1", broker=b, max_inflight=2, max_mqueue_len=10)
+    s.subscribe("t", SubOpts(qos=1))
+    for _ in range(5):
+        b.publish(_m(qos=1))
+    sent = s.drain_outbox()
+    assert len(sent) == 2
+    assert len(s.mqueue) == 3
+    s.puback(sent[0][0])
+    [(pid3, _)] = s.drain_outbox()  # dequeue refills the window
+    assert len(s.mqueue) == 2
+    assert pid3 == 3
+
+
+def test_retry_sets_dup_and_reemits():
+    b = Broker()
+    s = Session("c1", broker=b, retry_interval=0.0)
+    s.subscribe("t", SubOpts(qos=1))
+    b.publish(_m(qos=1))
+    [(pid, msg)] = s.drain_outbox()
+    s.retry()
+    [(pid2, msg2)] = s.drain_outbox()
+    assert pid2 == pid and msg2.get_flag("dup")
+
+
+def test_retry_pubrel():
+    b = Broker()
+    s = Session("c1", broker=b, retry_interval=0.0)
+    s.subscribe("t", SubOpts(qos=2))
+    b.publish(_m(qos=2))
+    [(pid, _)] = s.drain_outbox()
+    s.pubrec(pid)
+    s.retry()
+    assert s.drain_outbox() == [(PUBREL_MARKER, pid)]
+
+
+def test_awaiting_rel_expiry():
+    b = Broker()
+    s = Session("c1", broker=b, await_rel_timeout=0.0)
+    s.publish(5, _m(qos=2))
+    s.expire_awaiting_rel()
+    assert s.awaiting_rel == {}
+    assert b.metrics.val("messages.dropped.expired") == 1
+
+
+def test_max_subscriptions_quota():
+    b = Broker()
+    s = Session("c1", broker=b, max_subscriptions=1)
+    s.subscribe("a")
+    with pytest.raises(SessionError) as e:
+        s.subscribe("b")
+    assert e.value.rc == RC_QUOTA_EXCEEDED
+    s.subscribe("a", SubOpts(qos=1))  # resubscribe ok
+
+
+def test_takeover_resume_replay():
+    b = Broker()
+    s = Session("c1", broker=b, max_inflight=4)
+    s.subscribe("t", SubOpts(qos=1))
+    b.publish(_m(qos=1, payload=b"x"))
+    [(pid, _)] = s.drain_outbox()
+    # old connection dies; session taken over
+    s.takeover()
+    assert b.publish(_m(qos=1)) == 0  # detached
+    s.resume(b)
+    assert b.publish(_m(qos=1, payload=b"y")) == 1
+    s.drain_outbox()
+    s.replay()
+    replayed = s.drain_outbox()
+    assert any(p == pid and m.get_flag("dup") for p, m in replayed
+               if p != PUBREL_MARKER)
+
+
+def test_packet_id_wraps_and_skips_live():
+    b = Broker()
+    s = Session("c1", broker=b, max_inflight=3)
+    s.next_pkt_id = 0xFFFF
+    s.subscribe("t", SubOpts(qos=1))
+    b.publish(_m(qos=1))
+    b.publish(_m(qos=1))
+    pids = [p for p, _ in s.drain_outbox()]
+    assert pids == [0xFFFF, 1]
+
+
+def test_shared_delivery_enriched():
+    b = Broker()
+    s = Session("c1", broker=b)
+    s.subscribe("$share/g/t", SubOpts(qos=1))
+    b.publish(_m(qos=1))
+    [(pid, msg)] = s.drain_outbox()
+    assert pid == 1 and msg.qos == 1
