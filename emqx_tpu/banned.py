@@ -1,0 +1,62 @@
+"""Ban list: clientid / username / peerhost with expiry
+(reference: src/emqx_banned.erl — Mnesia table + expiry timer)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass
+class BanRule:
+    who: Tuple[str, str]           # ("clientid"|"username"|"peerhost", value)
+    by: str = "admin"
+    reason: str = ""
+    at: float = field(default_factory=time.time)
+    until: Optional[float] = None  # None = forever
+
+
+class Banned:
+    def __init__(self) -> None:
+        self._rules: Dict[Tuple[str, str], BanRule] = {}
+
+    def create(self, kind: str, value: str, by: str = "admin",
+               reason: str = "", duration: Optional[float] = None) -> BanRule:
+        if kind not in ("clientid", "username", "peerhost"):
+            raise ValueError(f"bad ban kind: {kind}")
+        until = time.time() + duration if duration is not None else None
+        rule = BanRule(who=(kind, value), by=by, reason=reason, until=until)
+        self._rules[rule.who] = rule
+        return rule
+
+    def delete(self, kind: str, value: str) -> None:
+        self._rules.pop((kind, value), None)
+
+    def look_up(self, kind: str, value: str) -> Optional[BanRule]:
+        return self._rules.get((kind, value))
+
+    def check(self, clientid: str = "", username: Optional[str] = None,
+              peerhost: str = "") -> bool:
+        """True if any identity facet is banned (emqx_banned:check/1)."""
+        now = time.time()
+        for who in (("clientid", clientid), ("username", username or ""),
+                    ("peerhost", peerhost)):
+            rule = self._rules.get(who)
+            if rule is not None:
+                if rule.until is not None and now > rule.until:
+                    del self._rules[who]  # lazy expiry
+                    continue
+                return True
+        return False
+
+    def expire(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        dead = [w for w, r in self._rules.items()
+                if r.until is not None and now > r.until]
+        for w in dead:
+            del self._rules[w]
+        return len(dead)
+
+    def info(self) -> list:
+        return list(self._rules.values())

@@ -21,7 +21,7 @@ time; here ingress batches per tick, SURVEY §2.2).
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from emqx_tpu import topic as T
 from emqx_tpu.hooks import Hooks
@@ -54,6 +54,14 @@ class Broker:
         self._subscriptions: Dict[object, Dict[str, SubOpts]] = {}
         # pluggable cross-node forwarder (emqx_rpc seam); set by cluster
         self.forwarder = None
+        # cluster-wide shared-group router: (group, flt, nodes, msg)
+        # -> local delivery count; None = single-node (local pick)
+        self.shared_router = None
+        # optional subsystems wired by Node (channel consults them)
+        self.banned = None
+        self.flapping = None
+        self.delayed = None
+        self.tracer = None
 
     # -- subscribe / unsubscribe (emqx_broker.erl:127-196) ----------------
 
@@ -131,10 +139,14 @@ class Broker:
         results = [0] * len(msgs)
         for i, msg in enumerate(msgs):
             self.metrics.inc_msg(msg)
+            if self.tracer is not None:
+                self.tracer.trace_publish(msg)
             out = self.hooks.run_fold("message.publish", (), msg)
             if out is None or (
                     out.get_header("allow_publish") is False):
                 self.metrics.inc("messages.dropped")
+                self.hooks.run("message.dropped",
+                               (out if out is not None else msg, "vetoed"))
                 continue
             live.append((i, out))
         if not live:
@@ -144,6 +156,7 @@ class Broker:
             if not filters:
                 self.metrics.inc("messages.dropped")
                 self.metrics.inc("messages.dropped.no_subscribers")
+                self.hooks.run("message.dropped", (msg, "no_subscribers"))
                 continue
             results[i] = self._route(filters, msg)
         return results
@@ -152,23 +165,29 @@ class Broker:
         """Fan a matched message out to local subscribers, shared
         groups, and remote nodes (route/2 + aggre/1 + forward/4)."""
         n = 0
-        remote_nodes = set()
+        remote: set = set()  # (node, filter) — aggre/1 dedup
+        shared: Dict[Tuple[str, str], List[str]] = {}  # (group,flt)->nodes
         for flt in filters:
             for route in self.router.lookup_routes(flt):
                 dest = route.dest
                 if isinstance(dest, tuple):  # (group, node) shared route
                     group, node = dest
-                    if node == self.node:
-                        n += self.shared.dispatch(group, flt, msg)
-                    else:
-                        remote_nodes.add(node)
+                    shared.setdefault((group, flt), []).append(node)
                 elif dest == self.node:
                     n += self.dispatch(flt, msg)
                 else:
-                    remote_nodes.add(dest)
-        for node in remote_nodes:  # one forward per node (aggre dedup)
+                    remote.add((dest, flt))
+        for (group, flt), nodes in shared.items():
+            if self.shared_router is not None:
+                # cluster: ONE delivery per group across all nodes
+                n += self.shared_router(group, flt, nodes, msg)
+            elif self.node in nodes:
+                n += self.shared.dispatch(group, flt, msg)
+        for node, flt in remote:
             if self.forwarder is not None:
-                self.forwarder(node, msg)
+                # remote node dispatches by the matched filter — no
+                # re-match there (emqx_broker:forward/4 :266-281)
+                self.forwarder(node, flt, msg)
                 self.metrics.inc("messages.forward")
         return n
 
@@ -193,4 +212,5 @@ class Broker:
                 log.exception("deliver to %r failed", sub)
         if n:
             self.metrics.inc("messages.delivered", n)
+            self.hooks.run("message.delivered", (msg, n))
         return n

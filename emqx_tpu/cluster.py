@@ -1,0 +1,250 @@
+"""Cluster layer: membership, replicated routes, cross-node
+forwarding, node-down cleanup — behind one RPC seam.
+
+Maps the reference's distribution stack (SURVEY §2.3):
+  - ekka membership           → :class:`Cluster` join/leave/nodedown,
+    transitive (membership is a set agreed by all members)
+  - Mnesia route replication  → one logical route per (filter, dest)
+    replicated to every member (bag semantics; local refcounts stay
+    node-private and only edge transitions broadcast), reads stay
+    node-local like replicated ram_copies (src/emqx_router.erl:77-86)
+  - gen_rpc data plane        → :class:`Transport` — in-process
+    :class:`LocalTransport` for tests/single-host multi-node; a real
+    socket transport plugs in the same seam (the reference isolates
+    RPC behind emqx_rpc for the same reason, SURVEY §4)
+  - node-down route purge     → :meth:`Cluster.handle_nodedown`
+    (emqx_router_helper:135-144, emqx_cm_registry:123-128)
+  - shared groups             → one delivery per group cluster-wide:
+    the publishing node picks ONE member node per (group, filter)
+    (round-robin over nodes) and forwards; the picked node runs its
+    local strategy (the reference picks over a replicated global
+    member table, src/emqx_shared_sub.erl:229-244 — node-level
+    round-robin then local pick approximates it without replicating
+    member pids)
+
+The TPU angle: each member keeps its own device automaton; route
+replication means every chip's automaton covers the full cluster
+filter set, so any node matches locally in one device call and only
+*deliveries* cross nodes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.types import Message
+
+log = logging.getLogger("emqx_tpu.cluster")
+
+
+class Transport:
+    """RPC seam (emqx_rpc): deliver opaque calls to peer nodes."""
+
+    def cast(self, node: str, op: str, *args) -> None:
+        raise NotImplementedError
+
+    def call(self, node: str, op: str, *args):
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """In-process transport: peers are Cluster objects in this
+    process (the reference tests fake remote nodes the same way,
+    test/emqx_broker_SUITE)."""
+
+    def __init__(self) -> None:
+        self._peers: Dict[str, "Cluster"] = {}
+
+    def register(self, node: str, cluster: "Cluster") -> None:
+        self._peers[node] = cluster
+
+    def unregister(self, node: str) -> None:
+        self._peers.pop(node, None)
+
+    def cast(self, node: str, op: str, *args) -> None:
+        peer = self._peers.get(node)
+        if peer is None:
+            raise ConnectionError(f"node down: {node}")
+        peer.handle_rpc(op, *args)
+
+    def call(self, node: str, op: str, *args):
+        peer = self._peers.get(node)
+        if peer is None:
+            raise ConnectionError(f"node down: {node}")
+        return peer.handle_rpc(op, *args)
+
+
+class Cluster:
+    """Per-node cluster agent: wires a Node's broker/router into the
+    membership + replication + forwarding protocol."""
+
+    def __init__(self, node, transport: Optional[Transport] = None) -> None:
+        self.node = node            # emqx_tpu.node.Node
+        self.name = node.name
+        self.transport = transport or LocalTransport()
+        self.members: List[str] = [self.name]
+        self._lock = threading.Lock()
+        self._shared_rr: Dict[Tuple[str, str], int] = {}
+        # intercept local route mutations for replication
+        self._orig_add = node.router.add_route
+        self._orig_del = node.router.delete_route
+        node.router.add_route = self._add_route_replicated
+        node.router.delete_route = self._del_route_replicated
+        node.broker.forwarder = self._forward
+        node.broker.shared_router = self._route_shared
+        if isinstance(self.transport, LocalTransport):
+            self.transport.register(self.name, self)
+
+    # -- membership (ekka) ------------------------------------------------
+
+    def join(self, other: "Cluster") -> None:
+        """Merge the two membership sets cluster-wide and sync routes
+        to/from every member (transitive: all members of both sides
+        learn the union)."""
+        union = sorted(set(self.members) | set(other.members))
+        for m in union:
+            if m == self.name:
+                self._set_members(union)
+            else:
+                self.transport.call(m, "set_members", union)
+        # every member pushes its owned routes to every other member
+        for m in union:
+            if m == self.name:
+                self._push_owned_routes()
+            else:
+                self.transport.call(m, "push_routes")
+
+    def _set_members(self, members: List[str]) -> None:
+        with self._lock:
+            self.members = list(members)
+
+    def _push_owned_routes(self) -> None:
+        for flt in self.node.router.topics():
+            for r in self.node.router.lookup_routes(flt):
+                if self._owned(r.dest, self.name):
+                    self._broadcast("route_add", flt, r.dest)
+
+    @staticmethod
+    def _owned(dest, name: str) -> bool:
+        return dest == name or (isinstance(dest, tuple) and dest[1] == name)
+
+    def leave(self) -> None:
+        """Leave the cluster: tell everyone, purge every ex-member's
+        routes locally (the symmetric half of nodedown)."""
+        ex = [m for m in self.members if m != self.name]
+        for m in ex:
+            try:
+                self.transport.cast(m, "nodedown", self.name)
+            except ConnectionError:
+                pass
+        self.members = [self.name]
+        for m in ex:
+            self._purge_node_routes(m)
+
+    def handle_nodedown(self, name: str) -> None:
+        """Purge a dead member's routes + registry entries
+        (emqx_router_helper cleanup, §3.5)."""
+        with self._lock:
+            if name in self.members:
+                self.members.remove(name)
+        self._purge_node_routes(name)
+
+    def _purge_node_routes(self, name: str) -> None:
+        self.node.router.cleanup_routes(name)
+        # shared-group routes carry (group, node) dests
+        for flt in list(self.node.router.topics()):
+            for r in self.node.router.lookup_routes(flt):
+                if isinstance(r.dest, tuple) and r.dest[1] == name:
+                    self._orig_del(flt, dest=r.dest)
+
+    # -- route replication (mnesia ram_copies analogue) -------------------
+
+    def _add_route_replicated(self, flt: str, dest=None):
+        dest = self.name if dest is None else dest
+        fresh = not self.node.router.has_dest(flt, dest)
+        fid = self._orig_add(flt, dest=dest)
+        if fresh:  # only edge transitions replicate (bag semantics)
+            self._broadcast("route_add", flt, dest)
+        return fid
+
+    def _del_route_replicated(self, flt: str, dest=None) -> None:
+        dest = self.name if dest is None else dest
+        self._orig_del(flt, dest=dest)
+        if not self.node.router.has_dest(flt, dest):
+            self._broadcast("route_del", flt, dest)
+
+    def _broadcast(self, op: str, *args) -> None:
+        for m in list(self.members):
+            if m == self.name:
+                continue
+            try:
+                self.transport.cast(m, op, *args)
+            except ConnectionError:
+                self.handle_nodedown(m)
+
+    def _apply_route(self, op: str, flt: str, dest) -> None:
+        """Idempotent remote apply — always through the ORIGINAL
+        router methods (a replicated apply must never re-broadcast)."""
+        if op == "add":
+            if not self.node.router.has_dest(flt, dest):
+                self._orig_add(flt, dest=dest)
+        else:
+            dests = self.node.router._routes.get(flt)
+            if dests is not None and dest in dests:
+                dests[dest] = 1
+                self._orig_del(flt, dest=dest)
+
+    # -- data plane (gen_rpc analogue) ------------------------------------
+
+    def _forward(self, node: str, flt: str, msg: Message) -> None:
+        try:
+            self.transport.cast(node, "forward", flt, msg)
+        except ConnectionError:
+            self.handle_nodedown(node)
+
+    def _route_shared(self, group: str, flt: str, nodes: List[str],
+                      msg: Message) -> int:
+        """One delivery per (group, filter) cluster-wide: round-robin
+        over the member nodes, then the picked node's local strategy
+        chooses the subscriber."""
+        if not nodes:
+            return 0
+        key = (group, flt)
+        n = self._shared_rr.get(key, -1)
+        n = (n + 1) % len(nodes)
+        self._shared_rr[key] = n
+        target = sorted(nodes)[n]
+        if target == self.name:
+            return self.node.broker.shared.dispatch(group, flt, msg)
+        try:
+            self.transport.cast(target, "forward_shared", group, flt, msg)
+            return 0  # remote delivery, not counted locally
+        except ConnectionError:
+            self.handle_nodedown(target)
+            rest = [x for x in nodes if x != target]
+            return self._route_shared(group, flt, rest, msg)
+
+    def handle_rpc(self, op: str, *args):
+        if op == "route_add":
+            return self._apply_route("add", args[0], args[1])
+        if op == "route_del":
+            return self._apply_route("del", args[0], args[1])
+        if op == "forward":
+            flt, msg = args
+            b = self.node.broker
+            b.metrics.inc("messages.received")
+            # dispatch by the already-matched filter (no re-match,
+            # no shared dispatch — shared goes via forward_shared)
+            return b.dispatch(flt, msg)
+        if op == "forward_shared":
+            group, flt, msg = args
+            return self.node.broker.shared.dispatch(group, flt, msg)
+        if op == "set_members":
+            return self._set_members(args[0])
+        if op == "push_routes":
+            return self._push_owned_routes()
+        if op == "nodedown":
+            return self.handle_nodedown(args[0])
+        raise ValueError(f"bad rpc op: {op}")

@@ -1,0 +1,166 @@
+"""CLI command registry + the built-in management commands
+(reference: src/emqx_ctl.erl + the ctl hooks in broker/cm/plugins).
+
+Commands operate on a live :class:`~emqx_tpu.node.Node`; the registry
+is extensible the same way the reference's `emqx_ctl:register_command`
+is."""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+
+class Ctl:
+    def __init__(self, node) -> None:
+        self.node = node
+        self._commands: Dict[str, Callable] = {}
+        self._usage: Dict[str, str] = {}
+        self._register_builtins()
+
+    def register_command(self, name: str, fn: Callable,
+                         usage: str = "") -> None:
+        self._commands[name] = fn
+        self._usage[name] = usage
+
+    def unregister_command(self, name: str) -> None:
+        self._commands.pop(name, None)
+        self._usage.pop(name, None)
+
+    def run(self, argv: List[str]) -> str:
+        if not argv or argv[0] in ("help", "--help"):
+            return self.usage()
+        cmd = self._commands.get(argv[0])
+        if cmd is None:
+            return f"unknown command: {argv[0]}\n" + self.usage()
+        try:
+            return cmd(argv[1:])
+        except Exception as e:  # operator input errors become text
+            usage = self._usage.get(argv[0], "")
+            return f"error: {e}\nusage: {argv[0]} {usage}"
+
+    def usage(self) -> str:
+        lines = ["commands:"]
+        for name in sorted(self._commands):
+            lines.append(f"  {name:<14} {self._usage.get(name, '')}")
+        return "\n".join(lines)
+
+    # -- built-ins --------------------------------------------------------
+
+    def _register_builtins(self) -> None:
+        self.register_command("status", self._status, "broker status")
+        self.register_command("broker", self._broker, "broker info")
+        self.register_command("clients", self._clients,
+                              "list | show <clientid> | kick <clientid>")
+        self.register_command("sessions", self._sessions, "session count")
+        self.register_command("topics", self._topics, "list routed topics")
+        self.register_command("subscriptions", self._subs,
+                              "show <clientid>")
+        self.register_command("metrics", self._metrics, "all counters")
+        self.register_command("stats", self._stats, "all gauges")
+        self.register_command("routes", self._routes, "list routes")
+        self.register_command("plugins", self._plugins,
+                              "list | load <name> | unload <name>")
+        self.register_command("banned", self._banned,
+                              "list | add <kind> <value> [secs] | del <kind> <value>")
+        self.register_command("trace", self._trace,
+                              "list | start client|topic <v> | stop client|topic <v>")
+
+    def _status(self, args) -> str:
+        n = self.node
+        return (f"node: {n.name}\n"
+                f"connections: {n.cm.connection_count()}\n"
+                f"sessions: {n.cm.session_count()}\n"
+                f"topics: {len(n.router.topics())}")
+
+    def _broker(self, args) -> str:
+        from emqx_tpu import __version__
+        from emqx_tpu.sys_topics import SYSDESCR
+        return f"{self.node.name} {__version__} — {SYSDESCR}"
+
+    def _clients(self, args) -> str:
+        cm = self.node.cm
+        if not args or args[0] == "list":
+            return "\n".join(cm._channels) or "(none)"
+        if args[0] == "show" and len(args) > 1:
+            chan = cm.lookup_channel(args[1])
+            if chan is None:
+                return "not found"
+            return json.dumps(dict(chan.clientinfo), default=str)
+        if args[0] == "kick" and len(args) > 1:
+            return "ok" if cm.kick_session(args[1]) else "not found"
+        return "usage: clients list | show <id> | kick <id>"
+
+    def _sessions(self, args) -> str:
+        return str(self.node.cm.session_count())
+
+    def _topics(self, args) -> str:
+        return "\n".join(sorted(self.node.router.topics())) or "(none)"
+
+    def _subs(self, args) -> str:
+        if args and args[0] == "show" and len(args) > 1:
+            chan = self.node.cm.lookup_channel(args[1])
+            if chan is None or chan.session is None:
+                return "not found"
+            return json.dumps({f: o.to_dict()
+                               for f, o in chan.session.subscriptions.items()})
+        out = []
+        for cid, chan in self.node.cm._channels.items():
+            if getattr(chan, "session", None):
+                for f in chan.session.subscriptions:
+                    out.append(f"{cid} -> {f}")
+        return "\n".join(out) or "(none)"
+
+    def _metrics(self, args) -> str:
+        return "\n".join(f"{k:<40} {v}"
+                         for k, v in self.node.metrics.all().items() if v)
+
+    def _stats(self, args) -> str:
+        return "\n".join(f"{k:<30} {v}"
+                         for k, v in self.node.stats.all().items())
+
+    def _routes(self, args) -> str:
+        out = []
+        for t in self.node.router.topics():
+            for r in self.node.router.lookup_routes(t):
+                out.append(f"{r.topic} -> {r.dest}")
+        return "\n".join(out) or "(none)"
+
+    def _plugins(self, args) -> str:
+        p = self.node.plugins
+        if not args or args[0] == "list":
+            return "\n".join(f"{d['name']} ({'active' if d['active'] else 'inactive'})"
+                             for d in p.list()) or "(none)"
+        if args[0] == "load" and len(args) > 1:
+            return "ok" if p.load(args[1]) else "already loaded"
+        if args[0] == "unload" and len(args) > 1:
+            return "ok" if p.unload(args[1]) else "not loaded"
+        return "usage: plugins list | load <name> | unload <name>"
+
+    def _banned(self, args) -> str:
+        b = self.node.broker.banned
+        if not args or args[0] == "list":
+            return "\n".join(f"{r.who[0]}:{r.who[1]} until={r.until}"
+                             for r in b.info()) or "(none)"
+        if args[0] == "add" and len(args) >= 3:
+            dur = float(args[3]) if len(args) > 3 else None
+            b.create(args[1], args[2], duration=dur)
+            return "ok"
+        if args[0] == "del" and len(args) >= 3:
+            b.delete(args[1], args[2])
+            return "ok"
+        return "usage: banned list | add <kind> <value> [secs] | del <kind> <value>"
+
+    def _trace(self, args) -> str:
+        tr = self.node.tracer
+        if not args or args[0] == "list":
+            return "\n".join(f"{k}:{v}" for k, v in tr.lookup_traces()) \
+                or "(none)"
+        if args[0] == "start" and len(args) >= 3:
+            kind = "clientid" if args[1] == "client" else "topic"
+            tr.start_trace(kind, args[2])
+            return "ok"
+        if args[0] == "stop" and len(args) >= 3:
+            kind = "clientid" if args[1] == "client" else "topic"
+            return "ok" if tr.stop_trace(kind, args[2]) else "not found"
+        return "usage: trace list | start client|topic <v> | stop client|topic <v>"

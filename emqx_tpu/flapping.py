@@ -1,0 +1,58 @@
+"""Connect/disconnect flap detection → auto-ban
+(reference: src/emqx_flapping.erl: threshold of state changes within
+a window bans the clientid for ban_time)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from emqx_tpu.banned import Banned
+
+
+@dataclass
+class FlappingConfig:
+    max_count: int = 15          # disconnects within window
+    window: float = 60.0         # seconds (detect_window)
+    ban_time: float = 300.0      # seconds
+
+
+@dataclass
+class _Track:
+    started: float = field(default_factory=time.time)
+    count: int = 0
+
+
+class Flapping:
+    def __init__(self, banned: Optional[Banned] = None,
+                 config: Optional[FlappingConfig] = None,
+                 metrics=None) -> None:
+        self.banned = banned
+        self.config = config or FlappingConfig()
+        self.metrics = metrics
+        self._tracks: Dict[str, _Track] = {}
+
+    def connected(self, clientid: str, peerhost: str = "") -> None:
+        pass  # tracked on disconnect (reference counts state changes)
+
+    def disconnected(self, clientid: str, peerhost: str = "") -> None:
+        now = time.time()
+        t = self._tracks.get(clientid)
+        if t is None or now - t.started > self.config.window:
+            t = _Track(started=now)
+            self._tracks[clientid] = t
+        t.count += 1
+        if t.count >= self.config.max_count:
+            del self._tracks[clientid]
+            if self.banned is not None:
+                self.banned.create(
+                    "clientid", clientid, by="flapping",
+                    reason=f"flapping: {t.count} in {self.config.window}s",
+                    duration=self.config.ban_time)
+
+    def gc(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for cid in [c for c, t in self._tracks.items()
+                    if now - t.started > self.config.window]:
+            del self._tracks[cid]

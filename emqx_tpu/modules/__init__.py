@@ -1,0 +1,46 @@
+"""Built-in modules — lightweight plugins with load/unload
+(reference: src/emqx_modules.erl + emqx_gen_mod.erl behaviour)."""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+
+class Module:
+    """Behaviour: subclasses implement load/unload
+    (emqx_gen_mod callbacks)."""
+
+    name = "module"
+
+    def __init__(self, node) -> None:
+        self.node = node
+
+    def load(self, env: dict) -> None:
+        raise NotImplementedError
+
+    def unload(self) -> None:
+        raise NotImplementedError
+
+
+class ModuleRegistry:
+    def __init__(self, node) -> None:
+        self.node = node
+        self._loaded: Dict[str, Module] = {}
+
+    def load(self, cls: Type[Module], env: dict | None = None) -> Module:
+        if cls.name in self._loaded:
+            return self._loaded[cls.name]
+        mod = cls(self.node)
+        mod.load(env or {})
+        self._loaded[cls.name] = mod
+        return mod
+
+    def unload(self, name: str) -> bool:
+        mod = self._loaded.pop(name, None)
+        if mod is None:
+            return False
+        mod.unload()
+        return True
+
+    def loaded(self):
+        return list(self._loaded)

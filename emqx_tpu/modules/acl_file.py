@@ -1,0 +1,107 @@
+"""File/rule-based ACL — the internal ACL backend.
+
+Mirrors ``src/emqx_mod_acl_internal.erl`` + ``src/emqx_access_rule.erl``
+(etc/acl.conf): ordered rules of
+
+    (allow|deny, who, access, topics)
+
+who:    "all" | ("user", Name) | ("client", Id) | ("ipaddr", CIDR)
+access: "subscribe" | "publish" | "pubsub"
+topics: list of topic filters; ("eq", topic) pins a literal match
+        (no wildcard expansion); %c/%u placeholders substitute the
+        client's id/username.
+
+First matching rule wins; no match falls through to the zone's
+acl_nomatch default (handled by AccessControl).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import List, Optional, Tuple, Union
+
+from emqx_tpu import topic as T
+from emqx_tpu.access_control import ALLOW, DENY
+from emqx_tpu.hooks import STOP
+from emqx_tpu.modules import Module
+
+Who = Union[str, Tuple[str, str]]
+TopicSpec = Union[str, Tuple[str, str]]
+
+
+DEFAULT_RULES: List[tuple] = [
+    # mirror etc/acl.conf defaults: dashboard user, localhost full
+    # access, deny $SYS+eq(#) sub for others, allow rest
+    ("allow", ("user", "dashboard"), "subscribe", ["$SYS/#"]),
+    ("allow", ("ipaddr", "127.0.0.1"), "pubsub", ["$SYS/#", "#"]),
+    ("deny", "all", "subscribe", ["$SYS/#", ("eq", "#")]),
+    ("allow", "all", "pubsub", ["#"]),
+]
+
+
+class AclFileModule(Module):
+    name = "acl_internal"
+
+    def __init__(self, node) -> None:
+        super().__init__(node)
+        self.rules: List[tuple] = []
+
+    def load(self, env: dict) -> None:
+        self.rules = list(env.get("rules", DEFAULT_RULES))
+        self.node.hooks.add("client.check_acl", self.check_acl,
+                            priority=-10)
+
+    def unload(self) -> None:
+        self.node.hooks.delete("client.check_acl", self.check_acl)
+
+    # -- rule evaluation (emqx_access_rule:match/3) -----------------------
+
+    def check_acl(self, clientinfo: dict, pubsub: str, topic: str, acc):
+        for rule in self.rules:
+            verdict, who, access, topics = rule
+            if not self._match_access(access, pubsub):
+                continue
+            if not self._match_who(who, clientinfo):
+                continue
+            if not self._match_topics(topics, topic, clientinfo):
+                continue
+            return (STOP, ALLOW if verdict == "allow" else DENY)
+        return None  # fall through to default
+
+    @staticmethod
+    def _match_access(access: str, pubsub: str) -> bool:
+        return access == "pubsub" or access == pubsub
+
+    @staticmethod
+    def _match_who(who: Who, clientinfo: dict) -> bool:
+        if who == "all":
+            return True
+        kind, value = who
+        if kind == "user":
+            return clientinfo.get("username") == value
+        if kind == "client":
+            return clientinfo.get("clientid") == value
+        if kind == "ipaddr":
+            try:
+                host = clientinfo.get("peerhost", "")
+                return ipaddress.ip_address(host) in ipaddress.ip_network(
+                    value, strict=False)
+            except ValueError:
+                return False
+        return False
+
+    @staticmethod
+    def _match_topics(topics: List[TopicSpec], topic: str,
+                      clientinfo: dict) -> bool:
+        from emqx_tpu.mountpoint import replvar
+
+        for spec in topics:
+            if isinstance(spec, tuple):  # ("eq", literal)
+                if spec[1] == topic:
+                    return True
+                continue
+            flt = replvar(spec, clientinfo.get("clientid", ""),
+                          clientinfo.get("username"))
+            if T.match(topic, flt):
+                return True
+        return False

@@ -1,0 +1,89 @@
+"""``$delayed/<secs>/<topic>`` delayed publish
+(reference: src/emqx_mod_delayed.erl — intercepts 'message.publish',
+stores, republishes after the delay)."""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import List, Optional, Tuple
+
+from emqx_tpu.hooks import STOP
+from emqx_tpu.modules import Module
+from emqx_tpu.types import Message
+
+PREFIX = "$delayed/"
+MAX_DELAY = 4294967  # seconds (reference caps at 0xFFFFFFFF ms)
+
+
+class DelayedModule(Module):
+    name = "delayed"
+
+    def __init__(self, node) -> None:
+        super().__init__(node)
+        self._heap: List[Tuple[float, int, Message]] = []
+        self._seq = 0
+        self._task: Optional[asyncio.Task] = None
+
+    def load(self, env: dict) -> None:
+        self.node.hooks.add("message.publish", self.on_publish,
+                            priority=100)
+        try:
+            loop = asyncio.get_running_loop()
+            self._task = loop.create_task(self._timer_loop())
+        except RuntimeError:
+            self._task = None  # sync context: call tick() manually
+
+    def unload(self) -> None:
+        self.node.hooks.delete("message.publish", self.on_publish)
+        if self._task is not None:
+            self._task.cancel()
+
+    # -- hook -------------------------------------------------------------
+
+    def on_publish(self, msg: Message):
+        if not msg.topic.startswith(PREFIX):
+            return None
+        rest = msg.topic[len(PREFIX):]
+        if "/" not in rest:
+            return None
+        secs_s, real_topic = rest.split("/", 1)
+        try:
+            secs = min(int(secs_s), MAX_DELAY)
+        except ValueError:
+            return None
+        self.delay(msg, secs, real_topic)
+        # veto the immediate publish
+        msg.set_header("allow_publish", False)
+        if self.node.broker is not None:
+            self.node.broker.metrics.inc("messages.delayed")
+        return (STOP, msg)
+
+    def delay(self, msg: Message, secs: float,
+              real_topic: Optional[str] = None) -> None:
+        m = msg.copy()
+        if real_topic is not None:
+            m.topic = real_topic
+        m.headers.pop("allow_publish", None)
+        self._seq += 1
+        heapq.heappush(self._heap, (time.time() + secs, self._seq, m))
+
+    # -- delivery ---------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        n = 0
+        while self._heap and self._heap[0][0] <= now:
+            _, _, msg = heapq.heappop(self._heap)
+            self.node.broker.publish(msg)
+            n += 1
+        return n
+
+    async def _timer_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            self.tick()
+
+    def __len__(self) -> int:
+        return len(self._heap)

@@ -1,0 +1,35 @@
+"""Auto-subscribe on connect with %c/%u templated topics
+(reference: src/emqx_mod_subscription.erl)."""
+
+from __future__ import annotations
+
+from emqx_tpu.modules import Module
+from emqx_tpu.mountpoint import replvar
+from emqx_tpu.types import SubOpts
+
+
+class SubscriptionModule(Module):
+    name = "subscription"
+
+    def __init__(self, node) -> None:
+        super().__init__(node)
+        self._topics = []  # [(template, qos)]
+
+    def load(self, env: dict) -> None:
+        self._topics = list(env.get("topics", []))
+        self.node.hooks.add("client.connected", self.on_connected)
+
+    def unload(self) -> None:
+        self.node.hooks.delete("client.connected", self.on_connected)
+
+    def on_connected(self, clientinfo: dict, conninfo: dict):
+        cid = clientinfo.get("clientid", "")
+        chan = self.node.cm.lookup_channel(cid)
+        if chan is None or chan.session is None:
+            return
+        for template, qos in self._topics:
+            flt = replvar(template, cid, clientinfo.get("username"))
+            try:
+                chan.session.subscribe(flt, SubOpts(qos=qos))
+            except Exception:
+                pass

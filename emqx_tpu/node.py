@@ -1,10 +1,10 @@
 """Broker node assembly + lifecycle — the ``emqx_app``/``emqx_sup``
 analogue (src/emqx_app.erl:31-44, src/emqx_sup.erl:64-80).
 
-Order mirrors the reference boot: kernel services (hooks, metrics) →
-router/broker → connection manager → modules → listeners. asyncio
-supervision replaces OTP supervisors: crashed connection tasks die
-alone; the listener and node survive.
+Order mirrors the reference boot: kernel services (hooks, metrics,
+stats, alarms) → router/broker → connection manager → modules/plugins
+→ listeners. asyncio supervision replaces OTP supervisors: crashed
+connection tasks die alone; the listener and node survive.
 """
 
 from __future__ import annotations
@@ -13,12 +13,23 @@ import asyncio
 import logging
 from typing import Dict, List, Optional
 
+from emqx_tpu.alarm import AlarmManager
+from emqx_tpu.banned import Banned
 from emqx_tpu.broker import Broker
 from emqx_tpu.cm import ConnectionManager
 from emqx_tpu.connection import Listener
+from emqx_tpu.ctl import Ctl
+from emqx_tpu.flapping import Flapping, FlappingConfig
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
+from emqx_tpu.modules import ModuleRegistry
+from emqx_tpu.modules.acl_file import AclFileModule
+from emqx_tpu.modules.delayed import DelayedModule
+from emqx_tpu.plugins import Plugins
 from emqx_tpu.router import MatcherConfig, Router
+from emqx_tpu.stats import Stats
+from emqx_tpu.sys_topics import SysTopics
+from emqx_tpu.tracer import Tracer
 from emqx_tpu.zone import Zone, get_zone
 
 log = logging.getLogger("emqx_tpu.node")
@@ -28,20 +39,49 @@ class Node:
     def __init__(self, name: str = "emqx_tpu@127.0.0.1",
                  zone: Optional[Zone] = None,
                  matcher: Optional[MatcherConfig] = None,
-                 boot_listeners: bool = True) -> None:
+                 boot_listeners: bool = True,
+                 sys_interval: float = 60.0,
+                 load_default_modules: bool = False) -> None:
         self.name = name
         self.zone = zone or get_zone()
+        # kernel services (emqx_kernel_sup)
         self.hooks = Hooks()
         self.metrics = Metrics()
+        self.stats = Stats()
+        self.tracer = Tracer()
+        # routing + pubsub core
         self.router = Router(config=matcher, node=name)
         self.broker = Broker(router=self.router, hooks=self.hooks,
                              metrics=self.metrics, node=name)
+        self.broker.tracer = self.tracer
+        # connection/session management (emqx_cm_sup)
         self.cm = ConnectionManager(broker=self.broker)
+        self.broker.banned = Banned()
+        self.broker.flapping = Flapping(
+            banned=self.broker.banned, metrics=self.metrics)
+        # ops (emqx_sys_sup)
+        self.alarms = AlarmManager(broker=self.broker, node=name)
+        self.sys = SysTopics(self.broker, node=name, stats=self.stats,
+                             interval=sys_interval)
+        # extension system
+        self.modules = ModuleRegistry(self)
+        self.plugins = Plugins(self)
+        self.ctl = Ctl(self)
         self.listeners: List[Listener] = []
         self.boot_listeners = boot_listeners
-        self.modules: Dict[str, object] = {}
+        self._load_default_modules = load_default_modules
         self._started = False
         self._bg_tasks: list = []
+        self.stats.register_update(self._update_stats)
+
+    # convenience accessors
+    @property
+    def banned(self) -> Banned:
+        return self.broker.banned
+
+    @property
+    def flapping(self) -> Flapping:
+        return self.broker.flapping
 
     def add_listener(self, host: str = "127.0.0.1", port: int = 1883,
                      zone: Optional[Zone] = None,
@@ -54,14 +94,24 @@ class Node:
     async def start(self) -> None:
         if self._started:
             return
+        if self._load_default_modules:
+            self.load_default_modules()
         if self.boot_listeners and not self.listeners:
             self.add_listener()
         for lst in self.listeners:
             await lst.start()
         loop = asyncio.get_event_loop()
-        self._bg_tasks.append(loop.create_task(self._session_sweeper()))
+        self._bg_tasks.append(loop.create_task(self._housekeeping()))
+        self._bg_tasks.append(loop.create_task(self._sys_loop()))
         self._started = True
         log.info("node %s started", self.name)
+
+    def load_default_modules(self) -> None:
+        """The reference's default loaded modules
+        (data/loaded_modules): delayed + internal ACL."""
+        self.modules.load(DelayedModule)
+        self.broker.delayed = self.modules._loaded["delayed"]
+        self.modules.load(AclFileModule)
 
     async def stop(self) -> None:
         for t in self._bg_tasks:
@@ -71,10 +121,37 @@ class Node:
             await lst.stop()
         self._started = False
 
-    async def _session_sweeper(self) -> None:
+    async def _housekeeping(self) -> None:
         while True:
             await asyncio.sleep(5.0)
             self.cm.expire_sessions()
+            self.broker.banned.expire()
+            self.broker.flapping.gc()
+
+    async def _sys_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.sys.interval)
+            try:
+                self.sys.heartbeat()
+            except Exception:
+                log.exception("sys heartbeat failed")
+
+    def _update_stats(self, stats: Stats) -> None:
+        stats.setstat("connections.count", self.cm.connection_count(),
+                      "connections.max")
+        stats.setstat("sessions.count", self.cm.session_count(),
+                      "sessions.max")
+        rstats = self.router.stats()
+        stats.setstat("topics.count", rstats["topics.count"], "topics.max")
+        stats.setstat("routes.count", rstats["routes.count"], "routes.max")
+        nsubs = sum(len(s) for s in self.broker._subscriptions.values())
+        stats.setstat("subscriptions.count", nsubs, "subscriptions.max")
+        nshared = sum(len(m) for m in self.broker.shared._subs.values())
+        stats.setstat("subscriptions.shared.count", nshared,
+                      "subscriptions.shared.max")
+        stats.setstat("subscribers.count",
+                      sum(len(v) for v in self.broker._subscribers.values()),
+                      "subscribers.max")
 
     # -- facade (src/emqx.erl:26-64) --------------------------------------
 

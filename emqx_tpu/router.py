@@ -118,6 +118,24 @@ class Router:
     def has_route(self, filter_: str) -> bool:
         return filter_ in self._routes
 
+    def has_dest(self, filter_: str, dest: object) -> bool:
+        return dest in self._routes.get(filter_, ())
+
+    def ensure_route(self, filter_: str, dest: object) -> None:
+        """Idempotent add — one logical route per (filter, dest), used
+        by replication (Mnesia-bag semantics, no refcount)."""
+        with self._lock:
+            if not self.has_dest(filter_, dest):
+                self.add_route(filter_, dest=dest)
+
+    def drop_route(self, filter_: str, dest: object) -> None:
+        """Remove a (filter, dest) route regardless of refcount."""
+        with self._lock:
+            dests = self._routes.get(filter_)
+            if dests is not None and dest in dests:
+                dests[dest] = 1
+                self.delete_route(filter_, dest=dest)
+
     def topics(self) -> List[str]:
         return list(self._routes)
 

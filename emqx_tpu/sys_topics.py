@@ -1,0 +1,53 @@
+"""``$SYS`` broker heartbeat: periodic publication of uptime/version/
+stats/metrics under ``$SYS/brokers/<node>/...``
+(reference: src/emqx_sys.erl:154-163)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from emqx_tpu import __version__
+from emqx_tpu.types import Message
+
+SYSDESCR = "emqx_tpu — TPU-native MQTT broker"
+
+
+class SysTopics:
+    def __init__(self, broker, node: str = "emqx_tpu@127.0.0.1",
+                 stats=None, interval: float = 60.0) -> None:
+        self.broker = broker
+        self.node = node
+        self.stats = stats
+        self.interval = interval
+        self.started_at = time.time()
+
+    def uptime(self) -> float:
+        return time.time() - self.started_at
+
+    def _pub(self, suffix: str, payload) -> None:
+        if isinstance(payload, (dict, list)):
+            payload = json.dumps(payload)
+        if isinstance(payload, str):
+            payload = payload.encode()
+        self.broker.publish(Message(
+            topic=f"$SYS/brokers/{self.node}/{suffix}",
+            payload=payload, flags={"sys": True}))
+
+    def heartbeat(self) -> None:
+        """One tick: info + stats + metrics (emqx_sys timer loop)."""
+        self.broker.publish(Message(topic="$SYS/brokers",
+                                    payload=self.node.encode(),
+                                    flags={"sys": True}))
+        self._pub("version", __version__)
+        self._pub("uptime", str(int(self.uptime())))
+        self._pub("datetime", time.strftime("%Y-%m-%d %H:%M:%S"))
+        self._pub("sysdescr", SYSDESCR)
+        if self.stats is not None:
+            self.stats.tick()
+            for k, v in self.stats.all().items():
+                self._pub(f"stats/{k}", str(v))
+        for k, v in self.broker.metrics.all().items():
+            if v:
+                self._pub(f"metrics/{k}", str(v))

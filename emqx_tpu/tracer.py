@@ -1,0 +1,87 @@
+"""Per-clientid / per-topic tracing via logging handlers
+(reference: src/emqx_tracer.erl:102-151 — OTP logger handlers with
+metadata/topic filters; here: logging.Handler instances filtered on
+record attributes, plus an in-memory tap for tests/CLI).
+
+Each Tracer owns a private, non-propagating logger so traces on one
+broker node never capture another node's traffic in multi-node
+processes."""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu import topic as T
+
+_ids = itertools.count()
+
+
+class _TraceHandler(logging.Handler):
+    def __init__(self, kind: str, value: str, sink) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.kind = kind      # "clientid" | "topic"
+        self.value = value
+        self.sink = sink      # list or file-like
+
+    def match(self, record: logging.LogRecord) -> bool:
+        if self.kind == "clientid":
+            return getattr(record, "clientid", None) == self.value
+        topic = getattr(record, "topic", None)
+        return topic is not None and T.match(topic, self.value)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if not self.match(record):
+            return
+        line = self.format(record)
+        if hasattr(self.sink, "write"):
+            self.sink.write(line + "\n")
+        else:
+            self.sink.append(line)
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self._log = logging.getLogger(
+            f"emqx_tpu.trace.{next(_ids)}")
+        self._log.setLevel(logging.DEBUG)
+        self._log.propagate = False
+        self._traces: Dict[Tuple[str, str], _TraceHandler] = {}
+
+    def start_trace(self, kind: str, value: str, sink=None):
+        """sink: a list (in-memory) or open file; returns the sink."""
+        assert kind in ("clientid", "topic")
+        key = (kind, value)
+        if key in self._traces:
+            raise ValueError("already_traced")
+        sink = [] if sink is None else sink
+        h = _TraceHandler(kind, value, sink)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s [%(levelname)s] %(message)s"))
+        self._log.addHandler(h)
+        self._traces[key] = h
+        return sink
+
+    def stop_trace(self, kind: str, value: str) -> bool:
+        h = self._traces.pop((kind, value), None)
+        if h is None:
+            return False
+        self._log.removeHandler(h)
+        return True
+
+    def lookup_traces(self) -> List[Tuple[str, str]]:
+        return list(self._traces)
+
+    def trace_publish(self, msg) -> None:
+        """Tee a publish into the trace log (emqx_broker.erl:202)."""
+        if self._traces:
+            self._log.debug("PUBLISH to %s: %r", msg.topic,
+                            msg.payload[:64],
+                            extra={"topic": msg.topic,
+                                   "clientid": msg.from_})
+
+    def trace_packet(self, direction: str, clientid: str, pkt) -> None:
+        if self._traces:
+            self._log.debug("%s %s", direction, pkt,
+                            extra={"clientid": clientid})

@@ -137,11 +137,12 @@ def test_subscriber_down():
 def test_forwarder_seam():
     b = Broker(node="n1")
     sent = []
-    b.forwarder = lambda node, msg: sent.append((node, msg.topic))
+    b.forwarder = lambda node, flt, msg: sent.append((node, flt))
     b.router.add_route("t/#", dest="n2")
     b.router.add_route("t/x", dest="n2")
     b.publish(Message(topic="t/x"))
-    assert sent == [("n2", "t/x")]  # aggre: one forward per node
+    # one forward per matched (node, filter) route — aggre dedup
+    assert sorted(sent) == [("n2", "t/#"), ("n2", "t/x")]
 
 
 def test_shared_resubscribe_no_crash():

@@ -1,0 +1,187 @@
+"""Cluster tests: route replication, cross-node forwarding,
+node-down cleanup — single-process multi-node over the LocalTransport
+seam (the reference's fake-remote-node strategy, SURVEY §4)."""
+
+from emqx_tpu.cluster import Cluster, LocalTransport
+from emqx_tpu.node import Node
+from emqx_tpu.types import Message
+
+
+class Q:
+    def __init__(self, cid="q"):
+        self.client_id = cid
+        self.inbox = []
+
+    def deliver(self, t, m):
+        self.inbox.append((t, m))
+
+
+def _mk_cluster(n=2):
+    transport = LocalTransport()
+    nodes = [Node(name=f"n{i}", boot_listeners=False) for i in range(n)]
+    clusters = [Cluster(node, transport) for node in nodes]
+    for c in clusters[1:]:
+        clusters[0].join(c)
+        for other in clusters[1:]:
+            if other is not c:
+                c.join(other)
+    return nodes, clusters
+
+
+def test_route_replication():
+    (n0, n1), _ = _mk_cluster(2)
+    s = Q()
+    n0.broker.subscribe(s, "rep/+")
+    # the route is visible from both nodes
+    assert n0.router.has_route("rep/+")
+    assert n1.router.has_route("rep/+")
+    assert [r.dest for r in n1.router.match_routes("rep/x")] == ["n0"]
+    n0.broker.unsubscribe(s, "rep/+")
+    assert not n1.router.has_route("rep/+")
+
+
+def test_cross_node_forwarding():
+    (n0, n1), _ = _mk_cluster(2)
+    s0, s1 = Q("on0"), Q("on1")
+    n0.broker.subscribe(s0, "t/#")
+    n1.broker.subscribe(s1, "t/#")
+    # publish at n1: local dispatch + one forward to n0
+    delivered = n1.broker.publish(Message(topic="t/1", payload=b"x"))
+    assert delivered == 1  # local count (remote async)
+    assert len(s1.inbox) == 1
+    assert len(s0.inbox) == 1
+    assert s0.inbox[0][1].payload == b"x"
+
+
+def test_forward_count_is_per_filter_node():
+    (n0, n1), _ = _mk_cluster(2)
+    s0 = Q()
+    n0.broker.subscribe(s0, "a/#")
+    n0.broker.subscribe(s0, "a/b")
+    n1.broker.publish(Message(topic="a/b"))
+    # two matched filters, both routed to n0 → two dispatches
+    assert len(s0.inbox) == 2
+    assert n1.metrics.val("messages.forward") == 2
+
+
+def test_shared_sub_across_nodes():
+    (n0, n1), _ = _mk_cluster(2)
+    s0 = Q("w0")
+    n0.broker.subscribe(s0, "$share/g/jobs")
+    # publish on the other node: group route forwards to n0
+    n1.broker.publish(Message(topic="jobs", payload=b"j"))
+    assert len(s0.inbox) == 1
+
+
+def test_shared_group_spanning_nodes_delivers_once():
+    """One delivery per group cluster-wide, even with members on
+    multiple nodes (the reference's shared-dispatch contract)."""
+    (n0, n1), _ = _mk_cluster(2)
+    s0, s1 = Q("w0"), Q("w1")
+    n0.broker.subscribe(s0, "$share/g/jobs")
+    n1.broker.subscribe(s1, "$share/g/jobs")
+    for _ in range(6):
+        n1.broker.publish(Message(topic="jobs"))
+    total = len(s0.inbox) + len(s1.inbox)
+    assert total == 6
+    # round-robin over nodes: both sides got some
+    assert len(s0.inbox) == 3 and len(s1.inbox) == 3
+
+
+def test_join_is_transitive():
+    transport = LocalTransport()
+    a, b, c = (Node(name=x, boot_listeners=False) for x in "abc")
+    ca, cb, cc = (Cluster(n, transport) for n in (a, b, c))
+    cb.join(cc)                     # {b, c}
+    s = Q()
+    c.broker.subscribe(s, "t/2")    # route exists on b and c
+    ca.join(cb)                     # a joins {b, c} via b
+    assert sorted(cc.members) == ["a", "b", "c"]
+    assert sorted(ca.members) == ["a", "b", "c"]
+    assert a.router.has_route("t/2")  # pre-existing route synced to a
+    a.broker.publish(Message(topic="t/2"))
+    assert len(s.inbox) == 1
+    # and future routes reach a too
+    s2 = Q()
+    c.broker.subscribe(s2, "t/3")
+    assert a.router.has_route("t/3")
+
+
+def test_leave_purges_both_directions():
+    (n0, n1), (c0, c1) = _mk_cluster(2)
+    s0, s1 = Q(), Q()
+    n0.broker.subscribe(s0, "mine/#")
+    n1.broker.subscribe(s1, "theirs/#")
+    c0.leave()
+    # leaver's routes purged on the remaining node
+    assert not n1.router.has_route("mine/#")
+    # remaining node's routes purged on the leaver
+    assert not n0.router.has_route("theirs/#")
+    n0.broker.publish(Message(topic="theirs/x"))
+    assert s1.inbox == []
+
+
+def test_refcounted_local_subs_replicate_once():
+    """Two local subscribers on one filter = one replicated route;
+    unsubscribing one must NOT delete the peer's copy."""
+    (n0, n1), _ = _mk_cluster(2)
+    s1, s2 = Q("a"), Q("b")
+    n0.broker.subscribe(s1, "rc/t")
+    n0.broker.subscribe(s2, "rc/t")
+    assert n1.router.has_route("rc/t")
+    n0.broker.unsubscribe(s1, "rc/t")
+    assert n1.router.has_route("rc/t")  # still one local subscriber
+    n0.broker.unsubscribe(s2, "rc/t")
+    assert not n1.router.has_route("rc/t")
+
+
+def test_tracer_isolated_between_nodes():
+    (n0, n1), _ = _mk_cluster(2)
+    sink = n0.tracer.start_trace("topic", "x/#")
+    n1.broker.publish(Message(topic="x/1"))
+    assert sink == []  # other node's traffic must not bleed in
+    n0.broker.publish(Message(topic="x/1"))
+    assert len(sink) == 1
+    n0.tracer.stop_trace("topic", "x/#")
+
+
+def test_nodedown_cleanup():
+    (n0, n1), (c0, c1) = _mk_cluster(2)
+    s0 = Q()
+    n0.broker.subscribe(s0, "gone/+")
+    assert n1.router.has_route("gone/+")
+    c1.handle_nodedown("n0")
+    assert not n1.router.has_route("gone/+")
+    assert n1.broker.publish(Message(topic="gone/x")) == 0
+    assert "n0" not in c1.members
+
+
+def test_leave_broadcasts_nodedown():
+    (n0, n1), (c0, c1) = _mk_cluster(2)
+    s0 = Q()
+    n0.broker.subscribe(s0, "bye/#")
+    c0.leave()
+    assert not n1.router.has_route("bye/#")
+
+
+def test_three_node_replication():
+    (n0, n1, n2), _ = _mk_cluster(3)
+    s = Q()
+    n2.broker.subscribe(s, "three/+")
+    assert n0.router.has_route("three/+")
+    assert n1.router.has_route("three/+")
+    n0.broker.publish(Message(topic="three/x"))
+    assert len(s.inbox) == 1
+
+
+def test_join_syncs_existing_routes():
+    transport = LocalTransport()
+    a = Node(name="a", boot_listeners=False)
+    b = Node(name="b", boot_listeners=False)
+    ca, cb = Cluster(a, transport), Cluster(b, transport)
+    s = Q()
+    a.broker.subscribe(s, "pre/existing")  # before join
+    ca.join(cb)
+    assert b.router.has_route("pre/existing")
+    b.broker.publish(Message(topic="pre/existing"))
+    assert len(s.inbox) == 1

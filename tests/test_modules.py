@@ -1,0 +1,329 @@
+"""Extension-layer tests: hooks, banned, flapping, modules (delayed,
+presence, rewrite, subscription, topic_metrics, acl), alarms, tracer,
+stats, ctl — modeled on the corresponding reference SUITEs."""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu.access_control import ALLOW, DENY, AccessControl, ClientInfo
+from emqx_tpu.acl_cache import AclCache
+from emqx_tpu.banned import Banned
+from emqx_tpu.flapping import Flapping, FlappingConfig
+from emqx_tpu.hooks import Hooks, STOP
+from emqx_tpu.modules.acl_file import AclFileModule, DEFAULT_RULES
+from emqx_tpu.modules.delayed import DelayedModule
+from emqx_tpu.modules.presence import PresenceModule
+from emqx_tpu.modules.rewrite import RewriteModule
+from emqx_tpu.modules.topic_metrics import TopicMetricsModule
+from emqx_tpu.node import Node
+from emqx_tpu.types import Message
+from emqx_tpu.zone import Zone
+
+
+class Q:
+    def __init__(self, cid="q"):
+        self.client_id = cid
+        self.inbox = []
+
+    def deliver(self, t, m):
+        self.inbox.append((t, m))
+
+
+# -- hooks -----------------------------------------------------------------
+
+def test_hooks_priority_and_stop():
+    h = Hooks()
+    order = []
+    h.add("t", lambda: order.append("lo"), priority=0)
+    h.add("t", lambda: order.append("hi"), priority=10)
+    h.run("t")
+    assert order == ["hi", "lo"]
+    h2 = Hooks()
+    h2.add("t", lambda: STOP, priority=10)
+    h2.add("t", lambda: order.append("never"))
+    h2.run("t")
+    assert "never" not in order
+
+
+def test_hooks_fold_and_crash_isolation():
+    h = Hooks()
+    h.add("f", lambda acc: acc + 1)
+    h.add("f", lambda acc: 1 / 0)      # crashes, chain continues
+    h.add("f", lambda acc: (STOP, acc + 10))
+    h.add("f", lambda acc: acc + 100)  # never runs after STOP
+    assert h.run_fold("f", (), 0) == 11
+
+
+def test_hooks_delete_and_dup():
+    h = Hooks()
+    fn = lambda: None  # noqa: E731
+    h.add("x", fn)
+    h.add("x", fn)  # dup ignored
+    assert len(h.lookup("x")) == 1
+    h.delete("x", fn)
+    assert h.lookup("x") == []
+
+
+# -- banned / flapping ------------------------------------------------------
+
+def test_banned_check_and_expiry():
+    b = Banned()
+    b.create("clientid", "evil")
+    b.create("peerhost", "10.0.0.1", duration=0.0)
+    assert b.check(clientid="evil")
+    assert not b.check(clientid="good")
+    time.sleep(0.01)
+    assert not b.check(peerhost="10.0.0.1")  # expired lazily
+    b.delete("clientid", "evil")
+    assert not b.check(clientid="evil")
+
+
+def test_flapping_bans_after_threshold():
+    b = Banned()
+    f = Flapping(banned=b, config=FlappingConfig(max_count=3, window=10,
+                                                 ban_time=100))
+    for _ in range(3):
+        f.disconnected("flappy", "1.2.3.4")
+    assert b.check(clientid="flappy")
+
+
+# -- delayed ----------------------------------------------------------------
+
+def test_delayed_module_intercepts_and_republishes():
+    n = Node(boot_listeners=False)
+    n.modules.load(DelayedModule)
+    dm = n.modules._loaded["delayed"]
+    s = Q()
+    n.broker.subscribe(s, "real/topic")
+    assert n.publish(Message(topic="$delayed/1/real/topic",
+                             payload=b"later")) == 0
+    assert s.inbox == [] and len(dm) == 1
+    assert n.metrics.val("messages.delayed") == 1
+    dm.tick(now=time.time() + 2)
+    assert len(s.inbox) == 1
+    assert s.inbox[0][1].topic == "real/topic"
+
+
+def test_delayed_bad_prefix_passes_through():
+    n = Node(boot_listeners=False)
+    n.modules.load(DelayedModule)
+    s = Q()
+    n.broker.subscribe(s, "$delayed/nope")
+    assert n.publish(Message(topic="$delayed/nope")) == 1
+
+
+# -- presence ---------------------------------------------------------------
+
+def test_presence_publishes_sys_events():
+    n = Node(boot_listeners=False)
+    n.modules.load(PresenceModule)
+    s = Q()
+    n.broker.subscribe(s, f"$SYS/brokers/{n.name}/clients/#")
+    n.hooks.run("client.connected",
+                ({"clientid": "c1", "peerhost": "127.0.0.1"},
+                 {"connected_at": time.time()}))
+    n.hooks.run("client.disconnected", ({"clientid": "c1"}, "bye"))
+    assert len(s.inbox) == 2
+    assert s.inbox[0][1].topic.endswith("c1/connected")
+    assert s.inbox[1][1].topic.endswith("c1/disconnected")
+
+
+# -- rewrite ----------------------------------------------------------------
+
+def test_rewrite_pub_and_sub():
+    n = Node(boot_listeners=False)
+    n.modules.load(RewriteModule, {
+        "rules": [("all", "x/#", r"^x/y/(.+)$", r"z/y/$1")]})
+    s = Q()
+    n.broker.subscribe(s, "z/y/1")
+    assert n.publish(Message(topic="x/y/1")) == 1
+    tf = n.hooks.run_fold("client.subscribe", ({}, {}),
+                          [("x/y/2", {"qos": 0})])
+    assert tf == [("z/y/2", {"qos": 0})]
+
+
+# -- topic metrics ----------------------------------------------------------
+
+def test_topic_metrics_counts():
+    n = Node(boot_listeners=False)
+    n.modules.load(TopicMetricsModule, {"topics": ["m/t"]})
+    tm = n.modules._loaded["topic_metrics"]
+    with pytest.raises(ValueError):
+        tm.register("bad/#")
+    n.publish(Message(topic="m/t", qos=1))
+    n.publish(Message(topic="m/t"))
+    n.publish(Message(topic="other"))
+    m = tm.metrics("m/t")
+    assert m["messages.in"] == 2 and m["messages.qos1.in"] == 1
+    assert tm.metrics("other") is None
+
+
+# -- acl file ---------------------------------------------------------------
+
+def test_acl_rules():
+    n = Node(boot_listeners=False)
+    n.modules.load(AclFileModule, {"rules": [
+        ("allow", ("user", "dash"), "subscribe", ["$SYS/#"]),
+        ("deny", "all", "subscribe", ["$SYS/#", ("eq", "#")]),
+        ("deny", ("client", "bad"), "pubsub", ["#"]),
+        ("allow", "all", "pubsub", ["#"]),
+    ]})
+    ac = AccessControl(n.hooks, Zone())
+    dash = ClientInfo(clientid="d", username="dash", peerhost="9.9.9.9")
+    anon = ClientInfo(clientid="a", peerhost="9.9.9.9")
+    bad = ClientInfo(clientid="bad", peerhost="9.9.9.9")
+    assert ac.check_acl(dash, "subscribe", "$SYS/x") == ALLOW
+    assert ac.check_acl(anon, "subscribe", "$SYS/x") == DENY
+    assert ac.check_acl(anon, "subscribe", "#") == DENY   # eq(#)
+    assert ac.check_acl(anon, "subscribe", "a/b") == ALLOW
+    assert ac.check_acl(bad, "publish", "a") == DENY
+    assert ac.check_acl(anon, "publish", "a") == ALLOW
+
+
+def test_acl_cache():
+    c = AclCache(max_size=2, ttl=100)
+    c.put("publish", "a", ALLOW)
+    c.put("publish", "b", DENY)
+    assert c.get("publish", "a") == ALLOW
+    c.put("publish", "c", ALLOW)  # evicts LRU ("b")
+    assert c.get("publish", "b") is None
+    c2 = AclCache(ttl=0.0)
+    c2.put("publish", "x", ALLOW)
+    time.sleep(0.01)
+    assert c2.get("publish", "x") == ALLOW  # ttl=0 disables expiry
+
+
+# -- alarms / sys / stats / ctl --------------------------------------------
+
+def test_alarms_publish_to_sys():
+    n = Node(boot_listeners=False)
+    s = Q()
+    n.broker.subscribe(s, f"$SYS/brokers/{n.name}/alarms/#")
+    assert n.alarms.activate("high_mem", {"usage": 0.9}, "memory high")
+    assert not n.alarms.activate("high_mem")
+    assert n.alarms.deactivate("high_mem")
+    assert not n.alarms.deactivate("high_mem")
+    kinds = [m.topic.rsplit("/", 1)[1] for _, m in s.inbox]
+    assert kinds == ["alert", "clear"]
+    assert len(n.alarms.get_alarms("deactivated")) == 1
+
+
+def test_sys_heartbeat():
+    n = Node(boot_listeners=False)
+    s = Q()
+    n.broker.subscribe(s, "$SYS/brokers/+/uptime")
+    n.sys.heartbeat()
+    assert any(m.topic.endswith("/uptime") for _, m in s.inbox)
+
+
+def test_stats_tick_updates_gauges():
+    n = Node(boot_listeners=False)
+    s = Q()
+    n.broker.subscribe(s, "a/b")
+    n.stats.tick()
+    assert n.stats.getstat("subscriptions.count") == 1
+    assert n.stats.getstat("topics.count") == 1
+    n.broker.unsubscribe(s, "a/b")
+    n.stats.tick()
+    assert n.stats.getstat("subscriptions.count") == 0
+    assert n.stats.getstat("topics.max") == 1  # watermark
+
+
+def test_tracer_topic_and_client():
+    n = Node(boot_listeners=False)
+    sink = n.tracer.start_trace("topic", "tr/#")
+    n.publish(Message(topic="tr/x", payload=b"p", from_="c9"))
+    n.publish(Message(topic="other", payload=b"q"))
+    assert len(sink) == 1 and "tr/x" in sink[0]
+    assert n.tracer.stop_trace("topic", "tr/#")
+    sink2 = n.tracer.start_trace("clientid", "c9")
+    n.publish(Message(topic="zzz", from_="c9"))
+    assert len(sink2) == 1
+    n.tracer.stop_trace("clientid", "c9")
+
+
+def test_topic_metrics_dropped_and_out():
+    n = Node(boot_listeners=False)
+    n.modules.load(TopicMetricsModule, {"topics": ["d/t"]})
+    tm = n.modules._loaded["topic_metrics"]
+    n.publish(Message(topic="d/t"))  # no subscribers -> dropped
+    assert tm.metrics("d/t")["messages.dropped"] == 1
+    s = Q()
+    n.broker.subscribe(s, "d/t")
+    n.publish(Message(topic="d/t"))
+    assert tm.metrics("d/t")["messages.out"] == 1
+
+
+def test_ctl_bad_input_returns_error_text():
+    n = Node(boot_listeners=False)
+    out = n.ctl.run(["banned", "add", "bogus-kind", "v"])
+    assert out.startswith("error:")
+    out = n.ctl.run(["banned", "add", "clientid", "v", "notanum"])
+    assert out.startswith("error:")
+    n.ctl.run(["trace", "start", "client", "c"])
+    out = n.ctl.run(["trace", "start", "client", "c"])
+    assert out.startswith("error:")
+
+
+def test_ctl_commands():
+    n = Node(boot_listeners=False)
+    s = Q()
+    n.broker.subscribe(s, "ctl/t")
+    out = n.ctl.run(["status"])
+    assert "connections: 0" in out
+    assert "ctl/t" in n.ctl.run(["topics"])
+    n.ctl.run(["banned", "add", "clientid", "evil", "60"])
+    assert "evil" in n.ctl.run(["banned", "list"])
+    n.ctl.run(["banned", "del", "clientid", "evil"])
+    assert "(none)" in n.ctl.run(["banned", "list"])
+    assert "unknown command" in n.ctl.run(["bogus"])
+    assert "commands:" in n.ctl.run(["help"])
+
+
+def test_module_registry_load_unload():
+    n = Node(boot_listeners=False)
+    n.modules.load(PresenceModule)
+    assert "presence" in n.modules.loaded()
+    assert n.modules.unload("presence")
+    assert not n.modules.unload("presence")
+    # unloaded module no longer hooks
+    s = Q()
+    n.broker.subscribe(s, "$SYS/#")
+    n.hooks.run("client.connected", ({"clientid": "x"}, {}))
+    assert s.inbox == []
+
+
+def test_plugins_lifecycle(tmp_path):
+    from emqx_tpu.plugins import Plugin
+
+    class P(Plugin):
+        name = "demo"
+
+        def __init__(self):
+            self.loads = 0
+
+        def load(self, node, env):
+            self.loads += 1
+
+        def unload(self, node):
+            self.loads -= 1
+
+    n = Node(boot_listeners=False)
+    n.plugins.state_file = str(tmp_path / "loaded.json")
+    p = P()
+    n.plugins.register(p)
+    assert n.plugins.load("demo")
+    assert not n.plugins.load("demo")
+    assert p.loads == 1
+    assert n.plugins.unload("demo")
+    assert p.loads == 0
+    n.plugins.load("demo")
+    # persisted list reloads
+    n2 = Node(boot_listeners=False)
+    n2.plugins.state_file = n.plugins.state_file
+    p2 = P()
+    n2.plugins.register(p2)
+    n2.plugins.load_all()
+    assert p2.loads == 1
