@@ -59,25 +59,35 @@ def main():
 
     import jax
 
-    from emqx_tpu.oracle import TrieOracle
-    from emqx_tpu.ops.csr import build_automaton
+    from emqx_tpu.ops import native
     from emqx_tpu.ops.fanout import build_fanout, gather_subscribers
     from emqx_tpu.ops.match import match_batch
-    from emqx_tpu.ops.tokenize import WordTable, encode_batch
 
     rng = random.Random(0)
     t0 = time.time()
     filters, vocab = build_filters(rng, n_subs, words_per_level=60,
                                    levels=levels)
-    trie = TrieOracle()
-    table = WordTable()
-    fids = {}
-    for f in filters:
-        trie.insert(f)
-        fids[f] = len(fids)
-        for w in f.split("/"):
-            table.intern(w)
-    auto = build_automaton(trie, fids, table)
+    use_native = native.available()
+    if use_native:
+        eng = native.NativeEngine()
+        for i, f in enumerate(filters):
+            eng.insert(f, i)
+        auto = eng.flatten()
+        encode = eng.encode_batch
+    else:
+        from emqx_tpu.oracle import TrieOracle
+        from emqx_tpu.ops.csr import build_automaton
+        from emqx_tpu.ops.tokenize import WordTable, encode_batch as _eb
+        trie = TrieOracle()
+        table = WordTable()
+        fids = {}
+        for f in filters:
+            trie.insert(f)
+            fids[f] = len(fids)
+            for w in f.split("/"):
+                table.intern(w)
+        auto = build_automaton(trie, fids, table)
+        encode = lambda ts, L: _eb(table, ts, L)  # noqa: E731
     # one subscriber per subscription (10M-sub scale is sub-id bitmaps
     # over the same CSR; bench config keeps 1:1)
     fan = build_fanout({i: [i] for i in range(len(filters))}, len(filters))
@@ -95,7 +105,7 @@ def main():
                      for i in range(rng.randint(2, levels)))
             for _ in range(batch)
         ]
-        batches.append(encode_batch(table, topics, 16))
+        batches.append(encode(topics, 16))
 
     def step(ids, n, sysm):
         res = match_batch(auto, ids, n, sysm, k=k, m=m)
@@ -121,6 +131,7 @@ def main():
     info = {
         "subs": len(filters),
         "batch": batch,
+        "native": use_native,
         "build_s": round(build_s, 1),
         "avg_matches_per_msg": round(float(counts.mean()), 2),
         "avg_deliveries_per_msg": round(float(deliv.mean()), 2),

@@ -1,0 +1,206 @@
+"""ctypes binding for the native C++ runtime (word table, batch
+encoder, trie + CSR flattener, host-side oracle match).
+
+The library is built on demand from ``native/emqx_native.cpp`` with
+g++ (no pybind11 in this image — the C API + ctypes keeps the binding
+dependency-free). When the toolchain or .so is unavailable every
+caller falls back to the pure-Python implementations, so the native
+path is a strict accelerator, not a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger("emqx_tpu.native")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO, "native")
+_SO = os.path.join(_SRC_DIR, "libemqx_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    src = os.path.join(_SRC_DIR, "emqx_native.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except Exception as e:
+        log.warning("native build failed: %s", e)
+        return False
+
+
+def load_library():
+    """The shared library, building it if needed; None on failure."""
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_SO) and not _build():
+            _build_failed = True
+            return None
+        lib = C.CDLL(_SO)
+        lib.wt_new.restype = C.c_void_p
+        lib.wt_free.argtypes = [C.c_void_p]
+        lib.wt_size.argtypes = [C.c_void_p]
+        lib.wt_size.restype = C.c_int32
+        lib.wt_intern.argtypes = [C.c_void_p, C.c_char_p, C.c_int32]
+        lib.wt_intern.restype = C.c_int32
+        lib.wt_lookup.argtypes = [C.c_void_p, C.c_char_p, C.c_int32]
+        lib.wt_lookup.restype = C.c_int32
+        lib.encode_topics.argtypes = [
+            C.c_void_p, C.c_char_p, _i64p, C.c_int32, C.c_int32,
+            _i32p, _i32p, _u8p]
+        lib.trie_new.argtypes = [C.c_void_p]
+        lib.trie_new.restype = C.c_void_p
+        lib.trie_free.argtypes = [C.c_void_p]
+        lib.trie_num_filters.argtypes = [C.c_void_p]
+        lib.trie_num_filters.restype = C.c_int32
+        lib.trie_insert.argtypes = [C.c_void_p, C.c_char_p, C.c_int32,
+                                    C.c_int32]
+        lib.trie_insert.restype = C.c_int32
+        lib.trie_delete.argtypes = [C.c_void_p, C.c_char_p, C.c_int32]
+        lib.trie_delete.restype = C.c_int32
+        lib.trie_counts.argtypes = [C.c_void_p,
+                                    C.POINTER(C.c_int64),
+                                    C.POINTER(C.c_int64)]
+        lib.trie_flatten.argtypes = [
+            C.c_void_p, C.c_int64, C.c_int64, _i32p, _i32p, _i32p,
+            _i32p, _i32p, _i32p]
+        lib.trie_flatten.restype = C.c_int64
+        lib.trie_match.argtypes = [C.c_void_p, C.c_char_p, C.c_int32,
+                                   _i32p, C.c_int32]
+        lib.trie_match.restype = C.c_int32
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+class NativeEngine:
+    """Owns a native word table + trie; produces Automaton arrays.
+
+    Drop-in replacement for the WordTable + TrieOracle + CSR-flatten
+    trio on the router's hot path. The Python TrieOracle remains the
+    cross-checked oracle; parity is pinned by tests/test_native.py.
+    """
+
+    def __init__(self) -> None:
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._wt = lib.wt_new()
+        self._trie = lib.trie_new(self._wt)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            if getattr(self, "_trie", None):
+                lib.trie_free(self._trie)
+            if getattr(self, "_wt", None):
+                lib.wt_free(self._wt)
+
+    # -- word table -------------------------------------------------------
+
+    def intern(self, word: str) -> int:
+        b = word.encode()
+        return self._lib.wt_intern(self._wt, b, len(b))
+
+    def lookup(self, word: str) -> int:
+        b = word.encode()
+        return self._lib.wt_lookup(self._wt, b, len(b))
+
+    def vocab_size(self) -> int:
+        return self._lib.wt_size(self._wt)
+
+    # -- trie -------------------------------------------------------------
+
+    def insert(self, filter_: str, filter_id: int) -> bool:
+        b = filter_.encode()
+        return bool(self._lib.trie_insert(self._trie, b, len(b),
+                                          filter_id))
+
+    def delete(self, filter_: str) -> bool:
+        b = filter_.encode()
+        return bool(self._lib.trie_delete(self._trie, b, len(b)))
+
+    def num_filters(self) -> int:
+        return self._lib.trie_num_filters(self._trie)
+
+    def counts(self) -> Tuple[int, int]:
+        s, e = C.c_int64(), C.c_int64()
+        self._lib.trie_counts(self._trie, C.byref(s), C.byref(e))
+        return s.value, e.value
+
+    def match(self, topic: str, cap: int = 4096) -> np.ndarray:
+        """All matching filter ids — grows the buffer until complete
+        (the fallback path must be exact, never truncated)."""
+        b = topic.encode()
+        while True:
+            out = np.empty((cap,), dtype=np.int32)
+            n = self._lib.trie_match(self._trie, b, len(b), out, cap)
+            if n < cap:
+                return out[:n].copy()
+            cap *= 4
+
+    # -- flatten ----------------------------------------------------------
+
+    def flatten(self, state_capacity: Optional[int] = None,
+                edge_capacity: Optional[int] = None):
+        from emqx_tpu.ops.csr import Automaton, capacity_for
+
+        S, E = self.counts()
+        s_cap = capacity_for(S, state_capacity)
+        e_cap = capacity_for(E + 1, edge_capacity)
+        row_ptr = np.empty((s_cap + 1,), dtype=np.int32)
+        edge_word = np.empty((e_cap,), dtype=np.int32)
+        edge_child = np.empty((e_cap,), dtype=np.int32)
+        plus_child = np.empty((s_cap,), dtype=np.int32)
+        hash_filter = np.empty((s_cap,), dtype=np.int32)
+        end_filter = np.empty((s_cap,), dtype=np.int32)
+        n_states = self._lib.trie_flatten(
+            self._trie, s_cap, e_cap, row_ptr, edge_word, edge_child,
+            plus_child, hash_filter, end_filter)
+        if n_states < 0:
+            raise RuntimeError("flatten capacity underestimated")
+        return Automaton(
+            row_ptr=row_ptr, edge_word=edge_word, edge_child=edge_child,
+            plus_child=plus_child, hash_filter=hash_filter,
+            end_filter=end_filter, n_states=int(n_states), n_edges=E)
+
+    # -- batch encode -----------------------------------------------------
+
+    def encode_batch(self, topics: Sequence[str], max_levels: int):
+        n = len(topics)
+        blobs = [t.encode() for t in topics]
+        offsets = np.zeros((n + 1,), dtype=np.int64)
+        for i, b in enumerate(blobs):
+            offsets[i + 1] = offsets[i] + len(b)
+        blob = b"".join(blobs)
+        ids = np.empty((n, max_levels), dtype=np.int32)
+        out_n = np.empty((n,), dtype=np.int32)
+        sysm = np.empty((n,), dtype=np.uint8)
+        self._lib.encode_topics(self._wt, blob, offsets, n, max_levels,
+                                ids.reshape(-1), out_n, sysm)
+        return ids, out_n, sysm.astype(bool)

@@ -46,6 +46,7 @@ class MatcherConfig:
     max_matches: int = 128  # match output capacity
     min_batch: int = 8      # batch padding bucket floor (pow2 buckets)
     use_device: bool = True
+    use_native: bool = True  # C++ trie/encoder when the .so is present
 
 
 class Router:
@@ -56,8 +57,18 @@ class Router:
         self.config = config or MatcherConfig()
         self.node = node
         self._lock = threading.RLock()
-        self._trie = TrieOracle()
-        self._table = WordTable()
+        self._native = None
+        if self.config.use_native:
+            try:
+                from emqx_tpu.ops import native as _native_mod
+                if _native_mod.available():
+                    self._native = _native_mod.NativeEngine()
+            except Exception:
+                self._native = None
+        # pure-Python structures double as the fallback path when the
+        # native engine is absent (parity pinned in tests/test_native)
+        self._trie = TrieOracle() if self._native is None else None
+        self._table = WordTable() if self._native is None else None
         # filter -> {dest: refcount}; bag semantics (emqx_route)
         self._routes: Dict[str, Dict[object, int]] = {}
         self._filter_ids: Dict[str, int] = {}
@@ -70,6 +81,37 @@ class Router:
         self._auto_map: tuple = ()
         self._dirty = True
         self._rebuilds = 0
+
+    # -- engine dispatch (native C++ or pure Python) ----------------------
+
+    def _t_insert(self, filter_: str, fid: int) -> None:
+        if self._native is not None:
+            self._native.insert(filter_, fid)
+        else:
+            self._trie.insert(filter_)
+
+    def _t_delete(self, filter_: str) -> None:
+        if self._native is not None:
+            self._native.delete(filter_)
+        else:
+            self._trie.delete(filter_)
+
+    def _t_match(self, topic: str) -> List[str]:
+        """Host-side exact match (fallback path); call under lock."""
+        if self._native is not None:
+            out = []
+            for fid in self._native.match(topic):
+                f = self._id_to_filter[fid] \
+                    if fid < len(self._id_to_filter) else None
+                if f is not None:
+                    out.append(f)
+            return out
+        return self._trie.match(topic)
+
+    def _encode(self, topics: Sequence[str], max_levels: int):
+        if self._native is not None:
+            return self._native.encode_batch(topics, max_levels)
+        return encode_batch(self._table, topics, max_levels)
 
     # -- route table mutation (emqx_router:do_add_route/do_delete_route) --
 
@@ -90,13 +132,14 @@ class Router:
         dest = self.node if dest is None else dest
         with self._lock:
             dests = self._routes.get(filter_)
+            fid = self._assign_id(filter_)
             if dests is None:
                 dests = {}
                 self._routes[filter_] = dests
-                self._trie.insert(filter_)
+                self._t_insert(filter_, fid)
                 self._dirty = True
             dests[dest] = dests.get(dest, 0) + 1
-            return self._assign_id(filter_)
+            return fid
 
     def delete_route(self, filter_: str, dest: object = None) -> None:
         dest = self.node if dest is None else dest
@@ -109,7 +152,7 @@ class Router:
                 del dests[dest]
             if not dests:
                 del self._routes[filter_]
-                self._trie.delete(filter_)
+                self._t_delete(filter_)
                 fid = self._filter_ids.pop(filter_)
                 self._id_to_filter[fid] = None
                 self._free_ids.append(fid)
@@ -155,7 +198,7 @@ class Router:
                 del dests[node]
                 if not dests:
                     del self._routes[f]
-                    self._trie.delete(f)
+                    self._t_delete(f)
                     fid = self._filter_ids.pop(f)
                     self._id_to_filter[fid] = None
                     self._free_ids.append(fid)
@@ -177,9 +220,13 @@ class Router:
             prev = self._auto
             cap_s = prev.row_ptr.shape[0] - 1 if prev is not None else None
             cap_e = prev.edge_word.shape[0] if prev is not None else None
-            auto = build_automaton(
-                self._trie, self._filter_ids, self._table,
-                state_capacity=cap_s, edge_capacity=cap_e)
+            if self._native is not None:
+                auto = self._native.flatten(
+                    state_capacity=cap_s, edge_capacity=cap_e)
+            else:
+                auto = build_automaton(
+                    self._trie, self._filter_ids, self._table,
+                    state_capacity=cap_s, edge_capacity=cap_e)
             if self.config.use_device:
                 auto = jax.device_put(auto)
             self._auto = auto
@@ -212,7 +259,7 @@ class Router:
             return []
         if not self.config.use_device or not self._routes:
             with self._lock:
-                return [self._trie.match(t) for t in topics]
+                return [self._t_match(t) for t in topics]
         cfg = self.config
         auto, id_map = self.automaton()
         B = len(topics)
@@ -220,7 +267,11 @@ class Router:
         while bucket < B:
             bucket *= 2
         padded = list(topics) + ["\x00/pad"] * (bucket - B)
-        ids, n, sysm = encode_batch(self._table, padded, cfg.max_levels)
+        # under the lock: the native word table must not be read
+        # (wt_lookup) while a concurrent add_route interns into it —
+        # ctypes calls drop the GIL, so the map can rehash mid-read
+        with self._lock:
+            ids, n, sysm = self._encode(padded, cfg.max_levels)
         res = match_batch(auto, ids, n, sysm, k=cfg.active_k, m=cfg.max_matches)
         mid = np.asarray(res.ids)
         ovf = np.asarray(res.overflow)
@@ -228,7 +279,7 @@ class Router:
         for i in range(B):
             if ovf[i]:
                 with self._lock:
-                    out.append(self._trie.match(topics[i]))
+                    out.append(self._t_match(topics[i]))
             else:
                 row = [id_map[j] for j in mid[i] if j >= 0]
                 out.append([f for f in row if f is not None])

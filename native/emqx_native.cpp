@@ -1,0 +1,403 @@
+// emqx_tpu native runtime: word interning, batch topic encoding, and
+// CSR automaton flattening — the host hot path feeding the TPU
+// matcher.
+//
+// Role in the framework (cf. SURVEY §2): the reference keeps its trie
+// in ETS inside the BEAM (C); here the authoritative trie lives in
+// this library and is flattened straight into the caller-provided
+// numpy buffers that jax.device_put ships to HBM. The Python layer
+// (emqx_tpu/ops/native.py) binds via ctypes and falls back to the
+// pure-Python builder when the shared object is unavailable.
+//
+// Semantics mirror emqx_tpu/oracle.py + ops/csr.py exactly (parity
+// tested in tests/test_native.py): '#' children collapse into
+// hash_filter, '+' children are ordinary states, literal edges are
+// CSR rows sorted by word id, state 0 is the root.
+//
+// Build: make -C native   (g++ -O2 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Word table: string -> dense int32 id (append-only interning)
+// ---------------------------------------------------------------------------
+
+struct WordTable {
+    std::unordered_map<std::string, int32_t> ids;
+    std::vector<std::string> words;
+};
+
+WordTable* wt_new() { return new WordTable(); }
+void wt_free(WordTable* wt) { delete wt; }
+int32_t wt_size(WordTable* wt) { return (int32_t)wt->words.size(); }
+
+int32_t wt_intern(WordTable* wt, const char* word, int32_t len) {
+    std::string w(word, len);
+    auto it = wt->ids.find(w);
+    if (it != wt->ids.end()) return it->second;
+    int32_t id = (int32_t)wt->words.size();
+    wt->ids.emplace(std::move(w), id);
+    wt->words.push_back(std::string(word, len));
+    return id;
+}
+
+int32_t wt_lookup(WordTable* wt, const char* word, int32_t len) {
+    auto it = wt->ids.find(std::string(word, len));
+    return it == wt->ids.end() ? -1 : it->second;
+}
+
+// copy word i into buf (caller sized via wt_word_len)
+int32_t wt_word_len(WordTable* wt, int32_t id) {
+    if (id < 0 || id >= (int32_t)wt->words.size()) return -1;
+    return (int32_t)wt->words[id].size();
+}
+void wt_word_copy(WordTable* wt, int32_t id, char* buf) {
+    const std::string& w = wt->words[id];
+    memcpy(buf, w.data(), w.size());
+}
+
+// ---------------------------------------------------------------------------
+// Batch topic encoder (emqx_tpu/ops/tokenize.encode_batch)
+// topics: concatenated utf-8 blob; offsets[n+1] delimit each topic.
+// out_ids[n*max_levels] filled with PAD(-2)/UNKNOWN(-1)/word ids;
+// out_n[n] = word count or -1 when levels exceed max_levels;
+// out_sys[n] = 1 when the first word starts with '$'.
+// ---------------------------------------------------------------------------
+
+void encode_topics(WordTable* wt, const char* blob, const int64_t* offsets,
+                   int32_t n, int32_t max_levels, int32_t* out_ids,
+                   int32_t* out_n, uint8_t* out_sys) {
+    for (int32_t i = 0; i < n; i++) {
+        const char* t = blob + offsets[i];
+        int64_t len = offsets[i + 1] - offsets[i];
+        int32_t* row = out_ids + (int64_t)i * max_levels;
+        for (int32_t j = 0; j < max_levels; j++) row[j] = -2;  // PAD
+        int32_t nw = 0;
+        int64_t start = 0;
+        bool overflow = false;
+        for (int64_t p = 0; p <= len; p++) {
+            if (p == len || t[p] == '/') {
+                if (nw >= max_levels) { overflow = true; break; }
+                row[nw++] = wt_lookup(wt, t + start, (int32_t)(p - start));
+                start = p + 1;
+            }
+        }
+        if (overflow)  // too many levels: leave the row fully padded
+            for (int32_t j = 0; j < max_levels; j++) row[j] = -2;
+        out_n[i] = overflow ? -1 : nw;
+        // parity with Python encode_batch: over-level rows keep
+        // sys_mask False (they never reach the kernel anyway)
+        out_sys[i] = (!overflow && len > 0 && t[0] == '$') ? 1 : 0;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Trie + CSR flattening (emqx_tpu/oracle.TrieOracle + ops/csr.py)
+// ---------------------------------------------------------------------------
+
+struct TrieNode {
+    // word id -> child node index; '#'/'+' tracked separately
+    std::unordered_map<int32_t, int32_t> lits;
+    int32_t plus = -1;        // node index of '+' child
+    int32_t hash_filter = -1; // filter id of '#'-child terminal
+    int32_t filter = -1;      // filter id terminating here
+    int32_t refcount = 0;     // live filters through this node
+};
+
+struct Trie {
+    WordTable* wt;           // shared, not owned
+    std::vector<TrieNode> nodes;
+    std::vector<int32_t> free_nodes;  // pruned slots for reuse
+    int32_t plus_id;         // interned ids of "+" and "#"
+    int32_t hash_id;
+    std::unordered_map<std::string, int32_t> filter_refs;
+
+    explicit Trie(WordTable* w) : wt(w) {
+        nodes.emplace_back();  // root = 0
+        plus_id = wt_intern(w, "+", 1);
+        hash_id = wt_intern(w, "#", 1);
+    }
+
+    int32_t alloc_node() {
+        if (!free_nodes.empty()) {
+            int32_t i = free_nodes.back();
+            free_nodes.pop_back();
+            return i;
+        }
+        nodes.emplace_back();
+        return (int32_t)nodes.size() - 1;
+    }
+
+    void release_node(int32_t i) {
+        nodes[i].lits.clear();
+        nodes[i].plus = -1;
+        nodes[i].hash_filter = -1;
+        nodes[i].filter = -1;
+        nodes[i].refcount = 0;
+        free_nodes.push_back(i);
+    }
+};
+
+Trie* trie_new(WordTable* wt) { return new Trie(wt); }
+void trie_free(Trie* t) { delete t; }
+int32_t trie_num_filters(Trie* t) { return (int32_t)t->filter_refs.size(); }
+
+// split filter into interned word ids
+static void split_intern(Trie* t, const char* f, int32_t len,
+                         std::vector<int32_t>& out) {
+    int32_t start = 0;
+    for (int32_t p = 0; p <= len; p++) {
+        if (p == len || f[p] == '/') {
+            out.push_back(wt_intern(t->wt, f + start, p - start));
+            start = p + 1;
+        }
+    }
+}
+
+// insert filter with dense id; returns 1 if newly added
+int32_t trie_insert(Trie* t, const char* filter, int32_t len,
+                    int32_t filter_id) {
+    std::string key(filter, len);
+    auto it = t->filter_refs.find(key);
+    if (it != t->filter_refs.end()) { it->second++; return 0; }
+    t->filter_refs.emplace(std::move(key), 1);
+    std::vector<int32_t> ws;
+    split_intern(t, filter, len, ws);
+    int32_t node = 0;
+    for (size_t i = 0; i < ws.size(); i++) {
+        int32_t w = ws[i];
+        t->nodes[node].refcount++;
+        if (w == t->hash_id) {
+            // '#' must be last word: collapse into hash_filter
+            t->nodes[node].hash_filter = filter_id;
+            return 1;
+        }
+        int32_t child;
+        if (w == t->plus_id) {
+            child = t->nodes[node].plus;
+            if (child < 0) {
+                child = t->alloc_node();
+                t->nodes[node].plus = child;
+            }
+        } else {
+            auto e = t->nodes[node].lits.find(w);
+            if (e == t->nodes[node].lits.end()) {
+                child = t->alloc_node();
+                t->nodes[node].lits.emplace(w, child);
+            } else {
+                child = e->second;
+            }
+        }
+        node = child;
+    }
+    t->nodes[node].refcount++;
+    t->nodes[node].filter = filter_id;
+    return 1;
+}
+
+// delete filter; returns 1 when fully removed (refcount reached 0).
+// Dead path nodes are physically pruned into a free list (a node at
+// refcount 0 had exactly one filter through it, so its subtree is the
+// remaining path suffix — unwound leaf-to-root below).
+int32_t trie_delete(Trie* t, const char* filter, int32_t len) {
+    std::string key(filter, len);
+    auto it = t->filter_refs.find(key);
+    if (it == t->filter_refs.end()) return 0;
+    if (--it->second > 0) return 0;
+    t->filter_refs.erase(it);
+    std::vector<int32_t> ws;
+    split_intern(t, filter, len, ws);
+    int32_t node = 0;
+    std::vector<std::pair<int32_t, int32_t>> edges;  // (parent, word)
+    for (size_t i = 0; i < ws.size(); i++) {
+        int32_t w = ws[i];
+        t->nodes[node].refcount--;
+        if (w == t->hash_id) {
+            t->nodes[node].hash_filter = -1;
+            node = -1;
+            break;
+        }
+        edges.emplace_back(node, w);
+        node = (w == t->plus_id) ? t->nodes[node].plus
+                                 : t->nodes[node].lits[w];
+    }
+    if (node >= 0) {
+        t->nodes[node].refcount--;
+        t->nodes[node].filter = -1;
+    }
+    // prune dead suffix (emqx_trie delete_path / oracle.py prune loop)
+    for (size_t i = edges.size(); i-- > 0;) {
+        int32_t parent = edges[i].first;
+        int32_t w = edges[i].second;
+        int32_t child = (w == t->plus_id) ? t->nodes[parent].plus
+                                          : t->nodes[parent].lits[w];
+        if (t->nodes[child].refcount > 0) break;
+        if (w == t->plus_id)
+            t->nodes[parent].plus = -1;
+        else
+            t->nodes[parent].lits.erase(w);
+        t->release_node(child);
+    }
+    return 1;
+}
+
+// live state/edge counts for capacity sizing (dead subtrees excluded)
+struct FlattenCounts { int64_t states; int64_t edges; };
+
+static void count_live(Trie* t, int32_t ni, int64_t& states,
+                       int64_t& edges) {
+    // iterative DFS
+    std::vector<int32_t> stack{ni};
+    while (!stack.empty()) {
+        int32_t cur = stack.back(); stack.pop_back();
+        states++;
+        TrieNode& nd = t->nodes[cur];
+        for (auto& kv : nd.lits) {
+            if (t->nodes[kv.second].refcount > 0) {
+                edges++;
+                stack.push_back(kv.second);
+            }
+        }
+        if (nd.plus >= 0 && t->nodes[nd.plus].refcount > 0)
+            stack.push_back(nd.plus);
+    }
+}
+
+void trie_counts(Trie* t, int64_t* out_states, int64_t* out_edges) {
+    int64_t s = 0, e = 0;
+    count_live(t, 0, s, e);
+    *out_states = s;
+    *out_edges = e;
+}
+
+// Flatten into caller buffers (capacities pre-sized via trie_counts):
+//   row_ptr[s_cap+1], edge_word[e_cap], edge_child[e_cap],
+//   plus_child[s_cap], hash_filter[s_cap], end_filter[s_cap]
+// Returns number of live states, or -1 if capacities are too small.
+int64_t trie_flatten(Trie* t, int64_t s_cap, int64_t e_cap,
+                     int32_t* row_ptr, int32_t* edge_word,
+                     int32_t* edge_child, int32_t* plus_child,
+                     int32_t* hash_filter, int32_t* end_filter) {
+    const int32_t WORD_PAD = INT32_MAX;
+    // BFS assigning dense ids (root first — matches csr.py)
+    std::vector<int32_t> order;            // trie node index per state
+    std::vector<int32_t> state_of(t->nodes.size(), -1);
+    order.push_back(0);
+    state_of[0] = 0;
+    for (size_t qi = 0; qi < order.size(); qi++) {
+        TrieNode& nd = t->nodes[order[qi]];
+        // deterministic order: sort lit edges by word id
+        for (auto& kv : nd.lits) {
+            if (t->nodes[kv.second].refcount <= 0) continue;
+            if (state_of[kv.second] < 0) {
+                state_of[kv.second] = (int32_t)order.size();
+                order.push_back(kv.second);
+            }
+        }
+        if (nd.plus >= 0 && t->nodes[nd.plus].refcount > 0 &&
+            state_of[nd.plus] < 0) {
+            state_of[nd.plus] = (int32_t)order.size();
+            order.push_back(nd.plus);
+        }
+    }
+    int64_t S = (int64_t)order.size();
+    if (S > s_cap) return -1;
+
+    int64_t pos = 0;
+    std::vector<std::pair<int32_t, int32_t>> row;
+    for (int64_t s = 0; s < S; s++) {
+        TrieNode& nd = t->nodes[order[s]];
+        row_ptr[s] = (int32_t)pos;
+        row.clear();
+        for (auto& kv : nd.lits)
+            if (t->nodes[kv.second].refcount > 0)
+                row.emplace_back(kv.first, state_of[kv.second]);
+        std::sort(row.begin(), row.end());
+        if (pos + (int64_t)row.size() > e_cap) return -1;
+        for (auto& e : row) {
+            edge_word[pos] = e.first;
+            edge_child[pos] = e.second;
+            pos++;
+        }
+        plus_child[s] = (nd.plus >= 0 && t->nodes[nd.plus].refcount > 0)
+                            ? state_of[nd.plus] : -1;
+        hash_filter[s] = nd.hash_filter;
+        end_filter[s] = nd.filter;
+    }
+    for (int64_t s = S; s <= s_cap; s++) row_ptr[s] = (int32_t)pos;
+    for (int64_t e = pos; e < e_cap; e++) {
+        edge_word[e] = WORD_PAD;
+        edge_child[e] = -1;
+    }
+    for (int64_t s = S; s < s_cap; s++) {
+        plus_child[s] = -1;
+        hash_filter[s] = -1;
+        end_filter[s] = -1;
+    }
+    return S;
+}
+
+// ---------------------------------------------------------------------------
+// Host-side oracle match (fallback path, emqx_tpu/oracle.py semantics)
+// Returns count of matched filter ids written to out (max out_cap).
+// ---------------------------------------------------------------------------
+
+static void match_node(Trie* t, int32_t node, const int32_t* ws,
+                       int32_t n, int32_t i, int32_t* out,
+                       int32_t out_cap, int32_t* cnt) {
+    TrieNode& nd = t->nodes[node];
+    if (nd.hash_filter >= 0 && *cnt < out_cap)
+        out[(*cnt)++] = nd.hash_filter;
+    if (i == n) {
+        if (nd.filter >= 0 && *cnt < out_cap) out[(*cnt)++] = nd.filter;
+        return;
+    }
+    int32_t w = ws[i];
+    // lits never hold '+'/'#' keys (insert routes them to plus/
+    // hash_filter), so wildcard words in publish names can't descend
+    // here — matching oracle.py's guards
+    if (w >= 0) {
+        auto it = nd.lits.find(w);
+        if (it != nd.lits.end() && t->nodes[it->second].refcount > 0)
+            match_node(t, it->second, ws, n, i + 1, out, out_cap, cnt);
+    }
+    if (nd.plus >= 0 && t->nodes[nd.plus].refcount > 0)
+        match_node(t, nd.plus, ws, n, i + 1, out, out_cap, cnt);
+}
+
+int32_t trie_match(Trie* t, const char* topic, int32_t len, int32_t* out,
+                   int32_t out_cap) {
+    // tokenize (lookup only — unknown words can still match wildcards)
+    std::vector<int32_t> ws;
+    int32_t start = 0;
+    for (int32_t p = 0; p <= len; p++) {
+        if (p == len || topic[p] == '/') {
+            ws.push_back(wt_lookup(t->wt, topic + start, p - start));
+            start = p + 1;
+        }
+    }
+    int32_t cnt = 0;
+    bool sys = len > 0 && topic[0] == '$';
+    if (sys) {
+        if (ws[0] >= 0) {
+            auto it = t->nodes[0].lits.find(ws[0]);
+            if (it != t->nodes[0].lits.end() &&
+                t->nodes[it->second].refcount > 0)
+                match_node(t, it->second, ws.data(), (int32_t)ws.size(),
+                           1, out, out_cap, &cnt);
+        }
+    } else {
+        match_node(t, 0, ws.data(), (int32_t)ws.size(), 0, out, out_cap,
+                   &cnt);
+    }
+    return cnt;
+}
+
+}  // extern "C"

@@ -1,0 +1,176 @@
+"""Native engine parity vs the Python oracle + CSR builder."""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.ops import native
+from emqx_tpu.ops.csr import build_automaton
+from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.tokenize import WordTable, encode_batch
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def _random_filter(rng, maxlen=6):
+    words = ["a", "b", "c", "d", "e", "x", "yy", "z0", "$s", ""]
+    n = rng.randint(1, maxlen)
+    ws = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.2:
+            ws.append("+")
+        elif r < 0.3 and i == n - 1:
+            ws.append("#")
+        else:
+            ws.append(rng.choice(words))
+    return "/".join(ws)
+
+
+def _random_name(rng):
+    words = ["a", "b", "c", "d", "e", "x", "yy", "z0", "$s", "", "new"]
+    return "/".join(rng.choice(words) for _ in range(rng.randint(1, 6)))
+
+
+def test_native_match_parity_random():
+    rng = random.Random(3)
+    eng = native.NativeEngine()
+    oracle = TrieOracle()
+    filters = sorted({_random_filter(rng) for _ in range(500)})
+    fids = {f: i for i, f in enumerate(filters)}
+    for f in filters:
+        eng.insert(f, fids[f])
+        oracle.insert(f)
+    inv = {v: k for k, v in fids.items()}
+    for _ in range(600):
+        name = _random_name(rng)
+        got = sorted(inv[i] for i in eng.match(name))
+        expect = sorted(oracle.match(name))
+        assert got == expect, (name, got, expect)
+
+
+def test_native_insert_delete_parity():
+    rng = random.Random(5)
+    eng = native.NativeEngine()
+    oracle = TrieOracle()
+    refs = {}
+    next_id = [0]
+
+    def fid(f):
+        if f not in refs:
+            refs[f] = next_id[0]
+            next_id[0] += 1
+        return refs[f]
+
+    live = {}
+    for _ in range(600):
+        f = _random_filter(rng)
+        if f in live and rng.random() < 0.5:
+            eng.delete(f)
+            oracle.delete(f)
+            live[f] -= 1
+            if live[f] == 0:
+                del live[f]
+        else:
+            eng.insert(f, fid(f))
+            oracle.insert(f)
+            live[f] = live.get(f, 0) + 1
+        if rng.random() < 0.25:
+            name = _random_name(rng)
+            inv = {v: k for k, v in refs.items()}
+            got = sorted(inv[i] for i in eng.match(name))
+            assert got == sorted(oracle.match(name)), name
+    assert eng.num_filters() == len(live)
+
+
+def test_native_flatten_device_parity():
+    """Native CSR arrays drive the device kernel identically to the
+    Python-built ones."""
+    rng = random.Random(11)
+    filters = sorted({_random_filter(rng) for _ in range(300)})
+    fids = {f: i for i, f in enumerate(filters)}
+    # python build
+    table = WordTable()
+    oracle = TrieOracle()
+    for f in filters:
+        oracle.insert(f)
+        for w in T.words(f):
+            table.intern(w)
+    # native build
+    eng = native.NativeEngine()
+    for f in filters:
+        eng.insert(f, fids[f])
+    auto_n = eng.flatten()
+
+    topics = [_random_name(rng) for _ in range(64)]
+    ids_n, n_n, sys_n = eng.encode_batch(topics, 8)
+    res = match_batch(auto_n, ids_n, n_n, sys_n, k=64, m=128)
+    inv = {v: k for k, v in fids.items()}
+    mid = np.asarray(res.ids)
+    ovf = np.asarray(res.overflow)
+    for i, t in enumerate(topics):
+        if ovf[i]:
+            continue
+        got = sorted(inv[j] for j in mid[i] if j >= 0)
+        assert got == sorted(oracle.match(t)), t
+
+
+def test_native_encode_matches_python():
+    eng = native.NativeEngine()
+    table = WordTable()
+    # the native engine pre-interns '+'/'#' at trie construction
+    table.intern("+")
+    table.intern("#")
+    for f in ["a/b/c", "x//y", "$SYS/z"]:
+        for w in f.split("/"):
+            eng.intern(w)
+            table.intern(w)
+    topics = ["a/b/c", "x//y", "$SYS/z", "unknown/word", "a",
+              "/".join(["d"] * 40), "$SYS/" + "/".join(["d"] * 40)]
+    ids_n, n_n, sys_n = eng.encode_batch(topics, 16)
+    ids_p, n_p, sys_p = encode_batch(table, topics, 16)
+    assert (ids_n == ids_p).all()
+    assert (n_n == n_p).all()
+    assert (sys_n == sys_p).all()
+
+
+def test_native_match_grows_past_cap():
+    """The fallback matcher must return ALL matches even when the
+    initial output buffer is smaller than the match count."""
+    eng = native.NativeEngine()
+    eng.insert("m/1", 0)
+    eng.insert("m/+", 1)
+    eng.insert("m/#", 2)
+    eng.insert("#", 3)
+    got = eng.match("m/1", cap=2)  # cap < 4 matches
+    assert sorted(got) == [0, 1, 2, 3]
+
+
+def test_native_churn_prunes_nodes():
+    """Unique-filter churn must not grow the trie without bound."""
+    eng = native.NativeEngine()
+    eng.insert("keep/#", 0)
+    s0, e0 = eng.counts()
+    for i in range(2000):
+        f = f"reply/client-{i}/inbox"
+        eng.insert(f, 1)
+        eng.delete(f)
+    s1, e1 = eng.counts()
+    assert (s1, e1) == (s0, e0)
+    # matching still exact after churn
+    assert list(eng.match("keep/x")) == [0]
+    assert list(eng.match("reply/client-5/inbox")) == []
+
+
+def test_native_flatten_capacity_growth():
+    eng = native.NativeEngine()
+    eng.insert("a/b", 0)
+    a1 = eng.flatten()
+    eng.insert("a/+/c", 1)
+    a2 = eng.flatten(state_capacity=a1.row_ptr.shape[0] - 1,
+                     edge_capacity=a1.edge_word.shape[0])
+    assert a2.n_states >= a1.n_states
