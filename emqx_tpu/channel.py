@@ -32,6 +32,7 @@ from emqx_tpu.keepalive import Keepalive
 from emqx_tpu.mountpoint import mount, replvar, unmount
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqtt_caps import PUB_DROP_CODES, check_pub, check_sub
 from emqx_tpu.mqtt.packet import (Auth, Connack, Connect, Disconnect,
                                   PacketError, Packet, PubAck, Publish,
                                   Pingreq, Pingresp, Suback, Subscribe,
@@ -286,15 +287,11 @@ class Channel:
             self.broker.metrics.inc("packets.publish.error")
             return self._puback_for(pkt, RC.TOPIC_NAME_INVALID)
         # caps
-        if pkt.qos > self.zone.max_qos_allowed:
-            self.broker.metrics.inc("packets.publish.dropped")
-            return self._puback_for(pkt, RC.QOS_NOT_SUPPORTED)
-        if pkt.retain and not self.zone.retain_available:
-            self.broker.metrics.inc("packets.publish.dropped")
-            return self._puback_for(pkt, RC.RETAIN_NOT_SUPPORTED)
-        if self.zone.max_topic_levels and \
-                T.levels(pkt.topic) > self.zone.max_topic_levels:
-            return self._puback_for(pkt, RC.TOPIC_NAME_INVALID)
+        cap_rc = check_pub(self.zone, pkt.qos, pkt.retain, pkt.topic)
+        if cap_rc is not None:
+            if cap_rc in PUB_DROP_CODES:
+                self.broker.metrics.inc("packets.publish.dropped")
+            return self._puback_for(pkt, cap_rc)
         # acl
         if self.zone.enable_acl and not self.clientinfo.get("is_superuser"):
             if self.access.check_acl(self.clientinfo, PUB, pkt.topic,
@@ -414,13 +411,9 @@ class Channel:
             self.broker.metrics.inc("packets.subscribe.error")
             return RC.TOPIC_FILTER_INVALID
         # caps
-        if "share" in popts and not self.zone.shared_subscription:
-            return RC.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
-        if T.wildcard(bare) and not self.zone.wildcard_subscription:
-            return RC.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
-        if self.zone.max_topic_levels and \
-                T.levels(bare) > self.zone.max_topic_levels:
-            return RC.TOPIC_FILTER_INVALID
+        cap_rc = check_sub(self.zone, bare, popts)
+        if cap_rc is not None:
+            return cap_rc
         # acl on the bare filter
         if self.zone.enable_acl and not self.clientinfo.get("is_superuser"):
             if self.access.check_acl(self.clientinfo, SUB, bare,

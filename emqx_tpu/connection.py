@@ -52,11 +52,19 @@ class Connection:
         self.recv_pkts = 0
         self.send_pkts = 0
         self._closing = False
+        # set by _decode: finish the loop after processing the packets
+        # it returned (e.g. a WS CLOSE frame behind an MQTT DISCONNECT)
+        self._finish_after_batch = False
         self._limiter = (TokenBucket(*self.zone.ratelimit_bytes_in)
                          if self.zone.ratelimit_bytes_in else None)
         self._timers: list = []
 
     # -- IO ----------------------------------------------------------------
+
+    def _wrap_out(self, data: bytes) -> bytes:
+        """Outbound framing seam: WS wraps MQTT bytes in a binary
+        frame; plain TCP is the identity."""
+        return data
 
     def _send_packets(self, pkts) -> None:
         for pkt in pkts:
@@ -66,7 +74,7 @@ class Connection:
             self.broker.metrics.inc("packets.sent")
             self.broker.metrics.inc("bytes.sent", len(data))
             if not self._closing:
-                self.writer.write(data)
+                self.writer.write(self._wrap_out(data))
 
     def _schedule_flush(self) -> None:
         """Wake the writer when the broker delivered into our session
@@ -119,28 +127,14 @@ class Connection:
                     wait = self._limiter.consume(len(data))
                     if wait > 0:
                         await asyncio.sleep(wait)  # backpressure pause
-                try:
-                    pkts = self.parser.feed(data)
-                except FrameTooLarge:
-                    self.broker.metrics.inc("delivery.dropped.too_large")
-                    break
-                except FrameError as e:
-                    log.debug("frame error from %s: %s",
-                              self.channel.peername, e)
-                    break
-                for pkt in pkts:
-                    self.recv_pkts += 1
-                    self.broker.metrics.inc("packets.received")
-                    first_connect = self.channel.state == "idle"
-                    out = self.channel.handle_in(pkt)
-                    self._send_packets(out)
-                    out2 = self.channel.handle_deliver()
-                    self._send_packets(out2)
-                    if first_connect and self.channel.state == "connected":
-                        self._start_timers()
-                    if self.channel.close_after_send:
-                        await self._drain_and_close()
+                pkts = await self._decode(data)
+                for pkt in (pkts or []):
+                    if not await self._process(pkt):
                         return
+                if pkts is None or self._finish_after_batch:
+                    # framing violation / transport-level close: any
+                    # packets decoded before it were processed above
+                    break
                 if not self._closing:
                     await self.writer.drain()
         except (ConnectionResetError, BrokenPipeError):
@@ -153,6 +147,33 @@ class Connection:
                     self.channel.disconnect_reason = "sock_closed"
                 self.channel._shutdown()
             self._close_transport()
+
+    async def _decode(self, data: bytes):
+        """Inbound framing seam: bytes → MQTT packets, or ``None`` to
+        finish the connection (framing violation)."""
+        try:
+            return self.parser.feed(data)
+        except FrameTooLarge:
+            self.broker.metrics.inc("delivery.dropped.too_large")
+            return None
+        except FrameError as e:
+            log.debug("frame error from %s: %s", self.channel.peername, e)
+            return None
+
+    async def _process(self, pkt) -> bool:
+        """Run one parsed packet through the channel; ``False`` ends
+        the connection loop (the FSM asked for a close)."""
+        self.recv_pkts += 1
+        self.broker.metrics.inc("packets.received")
+        first_connect = self.channel.state == "idle"
+        self._send_packets(self.channel.handle_in(pkt))
+        self._send_packets(self.channel.handle_deliver())
+        if first_connect and self.channel.state == "connected":
+            self._start_timers()
+        if self.channel.close_after_send:
+            await self._drain_and_close()
+            return False
+        return True
 
     def _start_timers(self) -> None:
         loop = asyncio.get_event_loop()
@@ -189,7 +210,12 @@ class Connection:
 
 class Listener:
     """TCP listener: accepts sockets, spawns Connections
-    (reference: src/emqx_listeners.erl + esockd acceptors)."""
+    (reference: src/emqx_listeners.erl + esockd acceptors).
+
+    Subclasses override :attr:`connection_class` and
+    :meth:`_handshake` (e.g. the WS listener's HTTP upgrade)."""
+
+    connection_class = Connection
 
     def __init__(self, broker, cm, host: str = "127.0.0.1",
                  port: int = 1883, zone: Optional[Zone] = None,
@@ -204,18 +230,39 @@ class Listener:
         self.max_connections = max_connections
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        self._handshaking: set = set()
+
+    async def _handshake(self, reader, writer) -> bool:
+        """Pre-MQTT negotiation; False rejects the socket (the
+        override is responsible for any error response)."""
+        return True
 
     async def _on_client(self, reader, writer) -> None:
-        if len(self._conns) >= self.max_connections:
+        if len(self._conns) + len(self._handshaking) >= \
+                self.max_connections:
             writer.close()
             return
-        conn = Connection(reader, writer, self.broker, self.cm,
-                          zone=self.zone, listener=self.name)
-        self._conns.add(conn)
+        conn = None
+        self._handshaking.add(writer)
         try:
+            if not await self._handshake(reader, writer):
+                return
+            conn = self.connection_class(
+                reader, writer, self.broker, self.cm,
+                zone=self.zone, listener=self.name)
+            self._conns.add(conn)
+            self._handshaking.discard(writer)
             await conn.run()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
         finally:
-            self._conns.discard(conn)
+            self._handshaking.discard(writer)
+            if conn is not None:
+                self._conns.discard(conn)
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -229,6 +276,11 @@ class Listener:
             self._server.close()
             # force-close live connections: wait_closed() (3.12+)
             # blocks until every client handler returns
+            for w in list(self._handshaking):
+                try:
+                    w.close()
+                except Exception:
+                    pass
             for conn in list(self._conns):
                 if not conn.channel.closed:
                     conn.channel.disconnect_reason = "server_shutdown"

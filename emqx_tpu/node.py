@@ -91,6 +91,15 @@ class Node:
         self.listeners.append(lst)
         return lst
 
+    def add_ws_listener(self, host: str = "127.0.0.1", port: int = 8083,
+                        path: str = "/mqtt", zone: Optional[Zone] = None,
+                        name: str = "ws:default"):
+        from emqx_tpu.ws_connection import WsListener
+        lst = WsListener(self.broker, self.cm, host=host, port=port,
+                         path=path, zone=zone or self.zone, name=name)
+        self.listeners.append(lst)
+        return lst
+
     async def start(self) -> None:
         if self._started:
             return

@@ -1,0 +1,57 @@
+"""Zone capability checks (emqx_mqtt_caps parity)."""
+
+from emqx_tpu import mqtt_caps
+from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.zone import Zone
+
+
+def test_check_pub_within_caps():
+    z = Zone()
+    assert mqtt_caps.check_pub(z, 2, True, "a/b/c") is None
+
+
+def test_check_pub_qos():
+    z = Zone(max_qos_allowed=1)
+    assert mqtt_caps.check_pub(z, 2, False, "t") == RC.QOS_NOT_SUPPORTED
+    assert mqtt_caps.check_pub(z, 1, False, "t") is None
+
+
+def test_check_pub_retain():
+    z = Zone(retain_available=False)
+    assert mqtt_caps.check_pub(z, 0, True, "t") == RC.RETAIN_NOT_SUPPORTED
+    assert mqtt_caps.check_pub(z, 0, False, "t") is None
+
+
+def test_check_pub_levels():
+    z = Zone(max_topic_levels=2)
+    assert mqtt_caps.check_pub(z, 0, False, "a/b/c") == RC.TOPIC_NAME_INVALID
+    assert mqtt_caps.check_pub(z, 0, False, "a/b") is None
+
+
+def test_check_sub_shared():
+    z = Zone(shared_subscription=False)
+    assert mqtt_caps.check_sub(z, "t", {"share": "g"}) == \
+        RC.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
+    assert mqtt_caps.check_sub(z, "t", {}) is None
+
+
+def test_check_sub_wildcard():
+    z = Zone(wildcard_subscription=False)
+    assert mqtt_caps.check_sub(z, "a/+", {}) == \
+        RC.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
+    assert mqtt_caps.check_sub(z, "a/#", {}) == \
+        RC.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
+    assert mqtt_caps.check_sub(z, "a/b", {}) is None
+
+
+def test_check_sub_levels():
+    z = Zone(max_topic_levels=3)
+    assert mqtt_caps.check_sub(z, "a/b/c/d", {}) == RC.TOPIC_FILTER_INVALID
+    assert mqtt_caps.check_sub(z, "a/b/c", {}) is None
+
+
+def test_get_caps():
+    caps = mqtt_caps.get_caps(Zone(max_qos_allowed=1))
+    assert caps["max_qos_allowed"] == 1
+    assert caps["retain_available"] is True
+    assert "wildcard_subscription" in caps

@@ -1,0 +1,232 @@
+"""MQTT-over-WebSocket transport (emqx_ws_connection parity)."""
+
+import asyncio
+import base64
+import contextlib
+import os
+
+import pytest
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import Parser, serialize
+from emqx_tpu.mqtt.packet import (Connack, Connect, Publish, Suback,
+                                  Subscribe)
+from emqx_tpu.node import Node
+from emqx_tpu.ws_connection import (OP_BINARY, OP_CLOSE, OP_PING, OP_PONG,
+                                    WsFrameParser, WsParseError, accept_key,
+                                    encode_frame)
+
+
+def mask_frame(opcode: int, payload: bytes, fin: bool = True,
+               mask: bytes = b"\x01\x02\x03\x04") -> bytes:
+    """Client→server frame (masked)."""
+    head = bytearray([(0x80 if fin else 0) | opcode])
+    n = len(payload)
+    if n < 126:
+        head.append(0x80 | n)
+    elif n < 65536:
+        head.append(0x80 | 126)
+        head += n.to_bytes(2, "big")
+    else:
+        head.append(0x80 | 127)
+        head += n.to_bytes(8, "big")
+    body = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    return bytes(head) + mask + body
+
+
+# -- frame codec unit tests -------------------------------------------------
+
+def test_accept_key_rfc_example():
+    # the worked example from RFC 6455 §1.3
+    assert accept_key("dGhlIHNhbXBsZSBub25jZQ==") == \
+        "s3pPLMBiTxaQ9kYGzzhZRbK+xOo="
+
+
+def test_frame_roundtrip_sizes():
+    p = WsFrameParser()
+    for n in (0, 1, 125, 126, 65535, 65536, 100_000):
+        payload = bytes(i % 251 for i in range(n))
+        out = p.feed(mask_frame(OP_BINARY, payload))
+        assert out == [(OP_BINARY, payload)]
+
+
+def test_frame_incremental_and_fragmented():
+    p = WsFrameParser()
+    data = mask_frame(OP_BINARY, b"hello", fin=False) + \
+        mask_frame(0x0, b" world")  # continuation
+    for i in range(0, len(data), 3):
+        chunks = p.feed(data[i:i + 3])
+        if chunks:
+            assert chunks == [(OP_BINARY, b"hello world")]
+
+
+def test_frame_rejects_unmasked():
+    p = WsFrameParser()
+    with pytest.raises(WsParseError):
+        p.feed(encode_frame(OP_BINARY, b"x"))  # server-style, no mask
+
+
+def test_frame_rejects_bad_continuation():
+    with pytest.raises(WsParseError):
+        WsFrameParser().feed(mask_frame(0x0, b"orphan"))
+
+
+# -- end-to-end over a real WS socket ---------------------------------------
+
+class WsTestClient:
+    """Raw WebSocket MQTT client (handshake + masked binary frames)."""
+
+    def __init__(self, client_id: str):
+        self.client_id = client_id
+        self.parser = Parser()
+        self.reader = None
+        self.writer = None
+        self.inbox = asyncio.Queue()
+        self.acks = asyncio.Queue()
+
+    async def connect(self, port: int, path: str = "/mqtt",
+                      subprotocol: str = "mqtt"):
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (f"GET {path} HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+               "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\n"
+               "Sec-WebSocket-Version: 13\r\n"
+               f"Sec-WebSocket-Protocol: {subprotocol}\r\n\r\n")
+        self.writer.write(req.encode())
+        head = await self.reader.readuntil(b"\r\n\r\n")
+        status = head.split(b"\r\n")[0].decode()
+        if "101" not in status:
+            return status
+        assert accept_key(key).encode() in head
+        self._task = asyncio.get_event_loop().create_task(self._read_loop())
+        await self.send_mqtt(Connect(
+            proto_ver=C.MQTT_V4, proto_name=C.PROTOCOL_NAMES[C.MQTT_V4],
+            client_id=self.client_id, clean_start=True))
+        ack = await asyncio.wait_for(self.acks.get(), 5.0)
+        assert isinstance(ack, Connack)
+        return ack
+
+    async def _read_loop(self):
+        # server frames are unmasked: parse by hand
+        buf = bytearray()
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    return
+                buf += data
+                while len(buf) >= 2:
+                    opcode = buf[0] & 0x0F
+                    n = buf[1] & 0x7F
+                    pos = 2
+                    if n == 126:
+                        if len(buf) < 4:
+                            break
+                        n = int.from_bytes(buf[2:4], "big")
+                        pos = 4
+                    elif n == 127:
+                        if len(buf) < 10:
+                            break
+                        n = int.from_bytes(buf[2:10], "big")
+                        pos = 10
+                    if len(buf) < pos + n:
+                        break
+                    payload = bytes(buf[pos:pos + n])
+                    del buf[:pos + n]
+                    if opcode == OP_PONG:
+                        await self.acks.put(("pong", payload))
+                    elif opcode == OP_CLOSE:
+                        await self.acks.put(("close", payload))
+                    elif opcode == OP_BINARY:
+                        for pkt in self.parser.feed(payload):
+                            if isinstance(pkt, Publish):
+                                await self.inbox.put(pkt)
+                            else:
+                                await self.acks.put(pkt)
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+
+    async def send_mqtt(self, pkt):
+        self.writer.write(
+            mask_frame(OP_BINARY, serialize(pkt, C.MQTT_V4),
+                       mask=os.urandom(4)))
+        await self.writer.drain()
+
+    async def send_raw(self, frame: bytes):
+        self.writer.write(frame)
+        await self.writer.drain()
+
+    async def close(self):
+        self.writer.close()
+
+
+@contextlib.asynccontextmanager
+async def ws_node():
+    n = Node(boot_listeners=False)
+    n.add_ws_listener(port=0)
+    await n.start()
+    try:
+        yield n
+    finally:
+        await n.stop()
+
+
+async def test_ws_connect_pub_sub():
+    async with ws_node() as node:
+        port = node.listeners[0].port
+        sub, pub = WsTestClient("wsub"), WsTestClient("wpub")
+        ack = await sub.connect(port)
+        assert ack.reason_code == 0
+        await pub.connect(port)
+        await sub.send_mqtt(Subscribe(packet_id=1,
+                                      topic_filters=[("t/#", {"qos": 0})]))
+        sa = await asyncio.wait_for(sub.acks.get(), 5.0)
+        assert isinstance(sa, Suback) and sa.reason_codes == [0]
+        await pub.send_mqtt(Publish(topic="t/x", payload=b"over-ws"))
+        msg = await asyncio.wait_for(sub.inbox.get(), 5.0)
+        assert msg.topic == "t/x" and msg.payload == b"over-ws"
+        assert node.metrics.val("client.connected") == 2
+        await sub.close()
+        await pub.close()
+
+
+async def test_ws_ping_pong_and_close():
+    async with ws_node() as node:
+        port = node.listeners[0].port
+        c = WsTestClient("wping")
+        await c.connect(port)
+        await c.send_raw(mask_frame(OP_PING, b"hi"))
+        kind, payload = await asyncio.wait_for(c.acks.get(), 5.0)
+        assert (kind, payload) == ("pong", b"hi")
+        await c.send_raw(mask_frame(OP_CLOSE, b"\x03\xe8"))
+        kind, _ = await asyncio.wait_for(c.acks.get(), 5.0)
+        assert kind == "close"
+        await c.close()
+
+
+async def test_ws_bad_handshake_rejected():
+    async with ws_node() as node:
+        port = node.listeners[0].port
+        # wrong path
+        c = WsTestClient("wbad")
+        status = await c.connect(port, path="/nope")
+        assert "400" in status
+        await c.close()
+        # missing mqtt subprotocol
+        c2 = WsTestClient("wbad2")
+        status = await c2.connect(port, subprotocol="chat")
+        assert "400" in status
+        await c2.close()
+
+
+async def test_ws_text_frame_disconnects():
+    async with ws_node() as node:
+        port = node.listeners[0].port
+        c = WsTestClient("wtext")
+        await c.connect(port)
+        await c.send_raw(mask_frame(0x1, b"not-binary"))
+        kind, _ = await asyncio.wait_for(c.acks.get(), 5.0)
+        assert kind == "close"
+        await c.close()
