@@ -29,6 +29,7 @@ from emqx_tpu.access_control import (ALLOW, DENY, PUB, SUB, AccessControl,
                                      ClientInfo)
 from emqx_tpu.acl_cache import AclCache
 from emqx_tpu.keepalive import Keepalive
+from emqx_tpu.logger import set_metadata_clientid, set_metadata_peername
 from emqx_tpu.mountpoint import mount, replvar, unmount
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt import reason_codes as RC
@@ -162,6 +163,10 @@ class Channel:
             return self._connack_error(RC.CLIENT_IDENTIFIER_NOT_VALID)
         self.client_id = client_id
         self.username = pkt.username
+        # every later log line from this task carries the client
+        # context (src/emqx_channel.erl:1161-1162)
+        set_metadata_clientid(client_id)
+        set_metadata_peername(self.peername)
         self.clientinfo = ClientInfo(
             clientid=client_id, username=pkt.username,
             peerhost=self.peername[0], zone=self.zone.name,

@@ -21,6 +21,7 @@ import time
 from typing import Optional, Tuple
 
 from emqx_tpu.channel import Channel
+from emqx_tpu.gc import GcPolicy
 from emqx_tpu.limiter import TokenBucket
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.frame import FrameError, FrameTooLarge, Parser, serialize
@@ -57,6 +58,8 @@ class Connection:
         self._finish_after_batch = False
         self._limiter = (TokenBucket(*self.zone.ratelimit_bytes_in)
                          if self.zone.ratelimit_bytes_in else None)
+        self._gc = (GcPolicy(*self.zone.force_gc_policy)
+                    if self.zone.force_gc_policy else None)
         self._timers: list = []
 
     # -- IO ----------------------------------------------------------------
@@ -127,6 +130,8 @@ class Connection:
                     wait = self._limiter.consume(len(data))
                     if wait > 0:
                         await asyncio.sleep(wait)  # backpressure pause
+                if self._gc is not None:
+                    self._gc.inc(1, len(data))
                 pkts = await self._decode(data)
                 for pkt in (pkts or []):
                     if not await self._process(pkt):

@@ -20,7 +20,9 @@ from emqx_tpu.cm import ConnectionManager
 from emqx_tpu.connection import Listener
 from emqx_tpu.ctl import Ctl
 from emqx_tpu.flapping import Flapping, FlappingConfig
+from emqx_tpu.gc import GlobalGc
 from emqx_tpu.hooks import Hooks
+from emqx_tpu.monitors import OsMon, SysMon, VmMon
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.modules import ModuleRegistry
 from emqx_tpu.modules.acl_file import AclFileModule
@@ -63,6 +65,12 @@ class Node:
         self.alarms = AlarmManager(broker=self.broker, node=name)
         self.sys = SysTopics(self.broker, node=name, stats=self.stats,
                              interval=sys_interval)
+        # host monitors (emqx_os_mon / emqx_vm_mon / emqx_sys_mon)
+        self.os_mon = OsMon(self.alarms)
+        self.vm_mon = VmMon(self.alarms, self.cm.connection_count,
+                            max_count=1024000)
+        self.sys_mon = SysMon(metrics=self.metrics, hooks=self.hooks)
+        self.global_gc = GlobalGc()
         # extension system
         self.modules = ModuleRegistry(self)
         self.plugins = Plugins(self)
@@ -109,9 +117,17 @@ class Node:
             self.add_listener()
         for lst in self.listeners:
             await lst.start()
+        # vm_mon watches the node-wide connection count, so the
+        # watermark denominator is the summed listener capacity
+        total_cap = sum(lst.max_connections for lst in self.listeners)
+        if total_cap > 0:
+            self.vm_mon.max_count = total_cap
         loop = asyncio.get_event_loop()
         self._bg_tasks.append(loop.create_task(self._housekeeping()))
         self._bg_tasks.append(loop.create_task(self._sys_loop()))
+        for mon in (self.os_mon, self.vm_mon, self.sys_mon,
+                    self.global_gc):
+            self._bg_tasks.append(loop.create_task(mon.run()))
         self._started = True
         log.info("node %s started", self.name)
 

@@ -80,31 +80,45 @@ class WsFrameParser:
         self.max_size = max_size
         self._frag_op: Optional[int] = None
         self._frag_data = bytearray()
+        # set instead of raised mid-batch so messages parsed before a
+        # malformed frame still reach the caller (a clean DISCONNECT
+        # ahead of garbage must not be dropped)
+        self.error: Optional[WsParseError] = None
 
     def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        if self.error is not None:
+            raise self.error
         self.buf += data
         out: List[Tuple[int, bytes]] = []
         while True:
-            frame = self._next_frame()
+            try:
+                frame = self._next_frame()
+            except WsParseError as e:
+                self.error = e
+                return out
             if frame is None:
                 return out
             fin, opcode, payload = frame
             if opcode in (OP_CLOSE, OP_PING, OP_PONG):
                 if not fin:
-                    raise WsParseError("fragmented control frame")
+                    self.error = WsParseError("fragmented control frame")
+                    return out
                 out.append((opcode, payload))
                 continue
             if opcode == OP_CONT:
                 if self._frag_op is None:
-                    raise WsParseError("continuation without start")
+                    self.error = WsParseError("continuation without start")
+                    return out
                 self._frag_data += payload
             else:
                 if self._frag_op is not None:
-                    raise WsParseError("interleaved data message")
+                    self.error = WsParseError("interleaved data message")
+                    return out
                 self._frag_op = opcode
                 self._frag_data = bytearray(payload)
             if len(self._frag_data) > self.max_size:
-                raise WsParseError("message too large")
+                self.error = WsParseError("message too large")
+                return out
             if fin:
                 out.append((self._frag_op, bytes(self._frag_data)))
                 self._frag_op = None
@@ -123,6 +137,9 @@ class WsFrameParser:
         if not masked:
             raise WsParseError("client frame not masked")
         n = b1 & 0x7F
+        if opcode >= 0x8 and n > 125:
+            # RFC 6455 §5.5: control frames MUST be ≤125 bytes
+            raise WsParseError("control frame too large")
         pos = 2
         if n == 126:
             if len(buf) < 4:
@@ -210,6 +227,13 @@ class WsConnection(Connection):
             log.debug("ws error from %s: %s", self.channel.peername, e)
             await self._drain_and_close()
             return None
+        if self.ws_parser.error is not None:
+            # malformed frame behind valid ones: process what parsed
+            # cleanly, then finish (feed() raises from here on)
+            log.debug("ws error from %s: %s", self.channel.peername,
+                      self.ws_parser.error)
+            await self._drain_and_close()
+            self._finish_after_batch = True
         pkts = []
         for opcode, payload in msgs:
             if opcode == OP_PING:

@@ -52,6 +52,9 @@ class Zone:
     ratelimit_msg_in: Optional[tuple] = None
     ratelimit_bytes_in: Optional[tuple] = None
     quota_conn_messages: Optional[tuple] = None
+    # forced-GC trigger (count, bytes), None disables
+    # (etc/emqx.conf force_gc_policy, src/emqx_gc.erl)
+    force_gc_policy: Optional[tuple] = (16000, 16 * 1024 * 1024)
 
 
 _zones: Dict[str, Zone] = {}

@@ -1,0 +1,162 @@
+"""Host monitors, PSK lookup, GC policies, logger metadata
+(emqx_os_mon / emqx_vm_mon / emqx_sys_mon / emqx_psk / emqx_gc /
+emqx_logger parity)."""
+
+import logging
+
+from emqx_tpu import logger as elog
+from emqx_tpu.alarm import AlarmManager
+from emqx_tpu.gc import GcPolicy, GlobalGc
+from emqx_tpu.hooks import Hooks
+from emqx_tpu.monitors import (OsMon, SysMon, VmMon, read_cpu_times,
+                               read_mem_usage)
+from emqx_tpu.psk import PskAuth
+
+
+# -- os_mon -----------------------------------------------------------------
+
+def test_os_mon_cpu_watermarks():
+    alarms = AlarmManager()
+    mon = OsMon(alarms, cpu_high=0.8, cpu_low=0.6)
+    mon.check(0.9, None)
+    assert any(a.name == "high_cpu_usage"
+               for a in alarms.get_alarms("activated"))
+    mon.check(0.7, None)  # between: hysteresis, stays active
+    assert any(a.name == "high_cpu_usage"
+               for a in alarms.get_alarms("activated"))
+    mon.check(0.5, None)
+    assert not alarms.get_alarms("activated")
+
+
+def test_os_mon_mem_watermarks():
+    alarms = AlarmManager()
+    mon = OsMon(alarms, mem_high=0.8, mem_low=0.6)
+    mon.check(None, 0.95)
+    assert any(a.name == "high_memory_usage"
+               for a in alarms.get_alarms("activated"))
+    mon.check(None, 0.3)
+    assert not alarms.get_alarms("activated")
+
+
+def test_os_mon_proc_readers():
+    # live /proc readings on Linux: sane ranges
+    cpu = read_cpu_times()
+    assert cpu is None or (cpu[1] >= cpu[0] >= 0)
+    mem = read_mem_usage()
+    assert mem is None or 0.0 <= mem <= 1.0
+    # a second CPU sample yields a usage fraction
+    mon = OsMon(AlarmManager())
+    mon.sample_cpu()
+    u = mon.sample_cpu()
+    assert u is None or 0.0 <= u <= 1.0
+
+
+# -- vm_mon -----------------------------------------------------------------
+
+def test_vm_mon_count_watermark():
+    alarms = AlarmManager()
+    mon = VmMon(alarms, count_fn=lambda: 0, max_count=100,
+                high=0.8, low=0.6)
+    mon.check(90)
+    assert any(a.name == "too_many_processes"
+               for a in alarms.get_alarms("activated"))
+    mon.check(50)
+    assert not alarms.get_alarms("activated")
+
+
+# -- sys_mon ----------------------------------------------------------------
+
+def test_sys_mon_long_schedule_and_gc():
+    hooks = Hooks()
+    events = []
+    hooks.add("sysmon.long_schedule", lambda ms: events.append(ms))
+    mon = SysMon(hooks=hooks, long_schedule_ms=100.0)
+    mon.check_lag(1.0, 1.05)   # 50ms lag: fine
+    assert mon.long_schedule_count == 0
+    mon.check_lag(1.0, 1.5)    # 500ms lag
+    assert mon.long_schedule_count == 1 and events == [500.0]
+    mon.on_long_gc(150.0)
+    assert mon.long_gc_count == 1
+
+
+def test_sys_mon_gc_hook_install_remove():
+    import gc
+    mon = SysMon()
+    mon.install_gc_hook()
+    assert mon._on_gc in gc.callbacks
+    gc.collect()  # must not raise through the callback
+    mon.remove_gc_hook()
+    assert mon._on_gc not in gc.callbacks
+
+
+# -- psk --------------------------------------------------------------------
+
+def test_psk_lookup_and_chain():
+    hooks = Hooks()
+    auth = PskAuth(hooks, {"dev1": b"secret1"})
+    assert auth.lookup("dev1") == b"secret1"
+    assert auth.lookup("ghost") is None
+    auth.add("dev2", b"k2")
+    assert auth.lookup("dev2") == b"k2"
+    auth.remove("dev2")
+    assert auth.lookup("dev2") is None
+    # a second resolver fills misses; the first keeps priority
+    PskAuth(hooks, {"dev1": b"shadowed", "dev3": b"k3"})
+    assert auth.lookup("dev1") == b"secret1"
+    assert auth.lookup("dev3") == b"k3"
+
+
+# -- gc ---------------------------------------------------------------------
+
+def test_gc_policy_triggers():
+    p = GcPolicy(count=10, bytes_=1000)
+    for _ in range(9):
+        assert not p.inc(1, 10)
+    assert p.inc(1, 10)          # count trigger
+    assert p.collections == 1
+    assert p.inc(1, 2000)        # bytes trigger
+    assert p.collections == 2
+
+
+def test_global_gc_runs():
+    g = GlobalGc(interval=None)
+    freed = g.run_gc()
+    assert g.runs == 1 and freed >= 0
+
+
+# -- logger -----------------------------------------------------------------
+
+def test_logger_metadata_and_formatter():
+    elog.clear_metadata()
+    elog.set_metadata_clientid("c1")
+    elog.set_metadata_peername(("10.0.0.1", 4321))
+    assert elog.get_metadata() == {"clientid": "c1",
+                                   "peername": "10.0.0.1:4321"}
+    rec = logging.LogRecord("emqx_tpu.x", logging.INFO, "f", 1,
+                            "hello %s", ("world",), None)
+    assert elog.MetadataFilter().filter(rec)
+    line = elog.BrokerFormatter().format(rec)
+    assert "c1@10.0.0.1:4321 hello world" in line
+    elog.clear_metadata()
+    rec2 = logging.LogRecord("emqx_tpu.x", logging.INFO, "f", 1,
+                             "plain", (), None)
+    elog.MetadataFilter().filter(rec2)
+    line2 = elog.BrokerFormatter().format(rec2)
+    assert line2.endswith("plain") and "@" not in line2
+
+
+def test_logger_setup_attaches_handler():
+    sink = []
+
+    class ListHandler(logging.Handler):
+        def emit(self, record):
+            sink.append(self.format(record))
+
+    h = elog.setup(level=logging.DEBUG, handler=ListHandler())
+    try:
+        elog.set_metadata_clientid("cX")
+        logging.getLogger("emqx_tpu.test").info("msg")
+        assert any("cX" in line and "msg" in line for line in sink)
+    finally:
+        logging.getLogger("emqx_tpu").removeHandler(h)
+        elog.clear_metadata()

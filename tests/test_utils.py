@@ -1,0 +1,115 @@
+"""Utility substrate: batch, sequence, pmon, misc pipeline
+(emqx_batch / emqx_sequence / emqx_pmon / emqx_misc parity)."""
+
+import asyncio
+
+from emqx_tpu.utils.batch import AsyncBatcher, Batch
+from emqx_tpu.utils.misc import ERROR, OK, pipeline, run_fold
+from emqx_tpu.utils.pmon import PMon
+from emqx_tpu.utils.sequence import Sequence
+
+
+# -- batch ------------------------------------------------------------------
+
+def test_batch_size_trigger():
+    committed = []
+    b = Batch(batch_size=3, commit_fun=committed.append)
+    assert b.push(1) is None and b.push(2) is None
+    b.push(3)
+    assert committed == [[1, 2, 3]] and len(b) == 0
+
+
+def test_batch_flush_and_due():
+    b = Batch(batch_size=100, linger_ms=0.0)
+    assert b.flush() is None
+    b.push("x")
+    assert b.due()  # linger 0: due immediately
+    assert b.flush() == ["x"]
+    assert not b.due()
+
+
+async def test_async_batcher_linger():
+    committed = []
+    ab = AsyncBatcher(committed.append, batch_size=100, linger_ms=5.0)
+    ab.start()
+    ab.push(1)
+    ab.push(2)
+    await asyncio.sleep(0.1)
+    assert committed == [[1, 2]]
+    ab.push(3)
+    ab.stop()
+    assert committed == [[1, 2], [3]]  # stop flushes the remainder
+
+
+# -- sequence ---------------------------------------------------------------
+
+def test_sequence_nextval_reclaim():
+    s = Sequence()
+    assert s.nextval("t") == 1
+    assert s.nextval("t") == 2
+    assert s.nextval("u") == 1
+    assert s.currval("t") == 2
+    assert s.reclaim("t") == 1
+    assert s.reclaim("t") == 0
+    assert s.currval("t") == 0          # deleted at zero
+    assert s.reclaim("ghost") == 0
+
+
+# -- pmon -------------------------------------------------------------------
+
+def test_pmon_explicit_down_batch_erase():
+    pm = PMon()
+    pm.monitor("a", {"x": 1})
+    pm.monitor("b", {"x": 2})
+    assert pm.count() == 2 and pm.find("a") == {"x": 1} and "a" in pm
+    pm.notify_down("a")
+    pm.notify_down("ghost")  # unknown: ignored
+    assert pm.erase_all() == [("a", {"x": 1})]
+    assert pm.count() == 1 and "a" not in pm
+    pm.demonitor("b")
+    assert pm.count() == 0
+
+
+async def test_pmon_task_completion():
+    pm = PMon()
+
+    async def short():
+        return 42
+
+    t = asyncio.get_event_loop().create_task(short())
+    pm.monitor("conn1", "val", task=t)
+    await t
+    await asyncio.sleep(0)  # let the done callback run
+    assert pm.erase_all() == [("conn1", "val")]
+
+
+# -- misc pipeline ----------------------------------------------------------
+
+def test_pipeline_ok_chain():
+    funs = [
+        lambda p, s: None,                       # keep
+        lambda p, s: (OK, p + 1),                # new packet
+        lambda p, s: (OK, p * 2, s + "b"),       # both
+    ]
+    assert pipeline(funs, 1, "a") == (OK, 4, "ab")
+
+
+def test_pipeline_error_halts():
+    calls = []
+    funs = [
+        lambda p, s: (OK, p + 1),
+        lambda p, s: (ERROR, "denied"),
+        lambda p, s: calls.append(1),
+    ]
+    assert pipeline(funs, 0, "s") == (ERROR, "denied", "s")
+    assert calls == []
+
+
+def test_pipeline_error_with_state():
+    funs = [lambda p, s: (ERROR, "bad", "new_state")]
+    assert pipeline(funs, 0, "old") == (ERROR, "bad", "new_state")
+
+
+def test_run_fold():
+    funs = [lambda acc, s: acc + s, lambda acc, s: acc * 2]
+    assert run_fold(funs, 1, 3) == 8

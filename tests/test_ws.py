@@ -62,13 +62,31 @@ def test_frame_incremental_and_fragmented():
 
 def test_frame_rejects_unmasked():
     p = WsFrameParser()
+    assert p.feed(encode_frame(OP_BINARY, b"x")) == []  # no mask
+    assert p.error is not None
     with pytest.raises(WsParseError):
-        p.feed(encode_frame(OP_BINARY, b"x"))  # server-style, no mask
+        p.feed(b"")  # poisoned: every later feed raises
 
 
 def test_frame_rejects_bad_continuation():
-    with pytest.raises(WsParseError):
-        WsFrameParser().feed(mask_frame(0x0, b"orphan"))
+    p = WsFrameParser()
+    assert p.feed(mask_frame(0x0, b"orphan")) == []
+    assert p.error is not None
+
+
+def test_frame_rejects_oversized_control():
+    p = WsFrameParser()
+    assert p.feed(mask_frame(OP_PING, b"p" * 126)) == []
+    assert p.error is not None
+    assert p.feed is not None and "control" in str(p.error)
+
+
+def test_frame_error_preserves_earlier_messages():
+    # a valid message ahead of garbage must still come out
+    p = WsFrameParser()
+    data = mask_frame(OP_BINARY, b"keep-me") + encode_frame(OP_BINARY, b"bad")
+    assert p.feed(data) == [(OP_BINARY, b"keep-me")]
+    assert p.error is not None
 
 
 # -- end-to-end over a real WS socket ---------------------------------------
