@@ -116,15 +116,22 @@ def main():
     out = step(*batches[0])
     jax.block_until_ready(out)
 
-    t1 = time.time()
-    outs = []
-    for i in range(iters):
-        outs.append(step(*batches[i % n_batches]))
-    jax.block_until_ready(outs)
-    dt = time.time() - t1
-
+    # The chip is reached through a shared tunnel with transient
+    # stalls, so one long timing window is unstable (observed 5x
+    # swings run-to-run). Time several independent windows and report
+    # the median window throughput.
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", "5")))
+    rates = []
+    outs = None
+    for w in range(windows):
+        t1 = time.time()
+        outs = []
+        for i in range(iters):
+            outs.append(step(*batches[i % n_batches]))
+        jax.block_until_ready(outs)
+        rates.append(batch * iters / (time.time() - t1))
+    throughput = float(np.median(rates))
     total_msgs = batch * iters
-    throughput = total_msgs / dt
     counts = np.asarray(outs[0][0])
     deliv = np.asarray(outs[0][1])
     ovf = sum(int(np.asarray(o[2]).sum()) for o in outs)
@@ -137,6 +144,7 @@ def main():
         "avg_deliveries_per_msg": round(float(deliv.mean()), 2),
         "overflow_frac": round(ovf / total_msgs, 6),
         "device": str(jax.devices()[0]),
+        "window_mmsgs": [round(r / 1e6, 2) for r in rates],
     }
     import sys
     print(json.dumps(info), file=sys.stderr, flush=True)

@@ -138,7 +138,9 @@ class Connection:
                         return
                 if pkts is None or self._finish_after_batch:
                     # framing violation / transport-level close: any
-                    # packets decoded before it were processed above
+                    # packets decoded before it were processed above,
+                    # and their responses flushed before the close
+                    await self._drain_and_close()
                     break
                 if not self._closing:
                     await self.writer.drain()

@@ -82,6 +82,12 @@ def setup(level: int = logging.INFO,
     root = logging.getLogger("emqx_tpu")
     root.setLevel(level)
     if handler is None:
+        # idempotent: a second setup() reuses the existing default
+        # handler instead of stacking one (duplicate log lines)
+        for h in root.handlers:
+            if isinstance(h.formatter, BrokerFormatter):
+                h.setLevel(level)
+                return h
         handler = logging.StreamHandler()
     handler.addFilter(MetadataFilter())
     handler.setFormatter(BrokerFormatter())

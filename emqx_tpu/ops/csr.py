@@ -35,7 +35,18 @@ _WORD_PAD = np.int32(2**31 - 1)
 
 
 class Automaton(NamedTuple):
-    """CSR topic automaton (numpy or jax arrays; shapes are padded)."""
+    """CSR topic automaton (numpy or jax arrays; shapes are padded).
+
+    Literal-edge lookup has two device encodings:
+      - CSR rows (``row_ptr``/``edge_word``/``edge_child``), walked by
+        per-row binary search (~2·log2 E gathers per step);
+      - a bucketed 2-choice hash table (``ht_*``, 4 slots per bucket)
+        keyed by (state, word) — the hot-path encoding: a lookup is two
+        4-wide row gathers per table (6 gathers total), independent of
+        automaton size.
+    The hash bucket count derives from the *edge capacity*, so
+    incremental rebuilds keep every shape static (no recompiles).
+    """
 
     row_ptr: np.ndarray      # int32[S_cap + 1]
     edge_word: np.ndarray    # int32[E_cap], sorted within each row
@@ -45,6 +56,10 @@ class Automaton(NamedTuple):
     end_filter: np.ndarray   # int32[S_cap]
     n_states: int            # live states (root included); static python int
     n_edges: int             # live literal edges
+    ht_state: np.ndarray | None = None  # int32[NB, 4] (-1 = empty slot)
+    ht_word: np.ndarray | None = None   # int32[NB, 4]
+    ht_child: np.ndarray | None = None  # int32[NB, 4]
+    ht_seed: np.ndarray | None = None   # uint32[1] — the mix seed used
 
 
 def capacity_for(n: int, cap: int | None = None) -> int:
@@ -66,6 +81,7 @@ def build_automaton(
     table: WordTable,
     state_capacity: int | None = None,
     edge_capacity: int | None = None,
+    skip_hash: bool = False,
 ) -> Automaton:
     """Flatten ``trie`` into an :class:`Automaton`.
 
@@ -134,7 +150,7 @@ def build_automaton(
     hash_filter[:S] = hashf
     end_filter[:S] = endf
 
-    return Automaton(
+    auto = Automaton(
         row_ptr=row_ptr,
         edge_word=edge_word,
         edge_child=edge_child,
@@ -144,3 +160,144 @@ def build_automaton(
         n_states=S,
         n_edges=E,
     )
+    # skip_hash: sharded builds pad first, then attach with a bucket
+    # count shared across shards (parallel/sharded.py:build_sharded)
+    return auto if skip_hash else attach_edge_hash(auto)
+
+
+# -- bucketed 2-choice edge hash ------------------------------------------
+
+_BUCKET = 4
+
+
+def hash_mix(state, word, seed):
+    """The (state, word) → (h1, h2) mix — uint32 wraparound arithmetic,
+    written so numpy (build) and jnp (match kernel) agree bit-for-bit."""
+    s = state.astype("uint32")
+    w = word.astype("uint32")
+    h = s * np.uint32(0x9E3779B9) + w * np.uint32(0x85EBCA6B) + seed
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x7FEB352D)
+    h = h ^ (h >> np.uint32(15))
+    h2 = h * np.uint32(0x846CA68B)
+    h2 = h2 ^ (h2 >> np.uint32(16))
+    return h, h2
+
+
+def buckets_for_capacity(edge_capacity: int) -> int:
+    """Bucket count giving ≤0.5 fill at full edge capacity (pow2)."""
+    nb = 4
+    while nb * _BUCKET < 2 * edge_capacity:
+        nb *= 2
+    return nb
+
+
+def _greedy_place(b, avail, fill, order_keys):
+    """Vectorized capacity-bounded placement of keys into buckets ``b``
+    (one pass). Returns (placed_key_idx, bucket, slot, leftover_idx)."""
+    order = np.argsort(b, kind="stable")
+    bs = b[order]
+    rank = np.arange(len(bs)) - np.searchsorted(bs, bs)
+    slot = fill[bs] + rank
+    ok = slot < avail
+    return order_keys[order[ok]], bs[ok], slot[ok], order_keys[order[~ok]]
+
+
+def build_edge_hash(
+    row_ptr: np.ndarray,
+    edge_word: np.ndarray,
+    edge_child: np.ndarray,
+    n_states: int,
+    n_edges: int,
+    n_buckets: int,
+    max_seeds: int = 32,
+):
+    """(ht_state, ht_word, ht_child, ht_seed) for the live edges.
+
+    Two vectorized greedy passes (first-choice bucket, then
+    second-choice) place ~all keys; the tail is fixed up with bounded
+    cuckoo evictions. On pathological seeds the whole build retries
+    with the next seed (keys are unique, so success at ≤50% fill is
+    essentially certain).
+    """
+    E = int(n_edges)
+    lens = np.diff(row_ptr[: n_states + 1].astype(np.int64))
+    states = np.repeat(np.arange(n_states, dtype=np.int32), lens)[:E]
+    words = np.asarray(edge_word[:E], dtype=np.int32)
+    children = np.asarray(edge_child[:E], dtype=np.int32)
+    mask = np.uint32(n_buckets - 1)
+
+    for seed_i in range(max_seeds):
+        seed = np.uint32(0xA5A5A5A5 + 0x9E37 * seed_i)
+        ht_s = np.full((n_buckets, _BUCKET), -1, dtype=np.int32)
+        ht_w = np.full((n_buckets, _BUCKET), -1, dtype=np.int32)
+        ht_c = np.full((n_buckets, _BUCKET), -1, dtype=np.int32)
+        if E == 0:
+            return ht_s, ht_w, ht_c, np.array([seed], dtype=np.uint32)
+        h1, h2 = hash_mix(states, words, seed)
+        b1 = (h1 & mask).astype(np.int64)
+        b2 = (h2 & mask).astype(np.int64)
+        fill = np.zeros((n_buckets,), dtype=np.int64)
+
+        keys = np.arange(E, dtype=np.int64)
+        placed_k, placed_b, placed_s, left = _greedy_place(
+            b1, _BUCKET, fill, keys)
+        np.add.at(fill, placed_b, 1)
+        ht_s[placed_b, placed_s] = states[placed_k]
+        ht_w[placed_b, placed_s] = words[placed_k]
+        ht_c[placed_b, placed_s] = children[placed_k]
+        if len(left):
+            placed_k, placed_b, placed_s, left = _greedy_place(
+                b2[left], _BUCKET, fill, left)
+            np.add.at(fill, placed_b, 1)
+            ht_s[placed_b, placed_s] = states[placed_k]
+            ht_w[placed_b, placed_s] = words[placed_k]
+            ht_c[placed_b, placed_s] = children[placed_k]
+
+        # cuckoo-eviction fixup for keys whose both buckets were full
+        ok = True
+        for k in left:
+            cs, cw, cc = int(states[k]), int(words[k]), int(children[k])
+            cb = int(b1[k])
+            for step in range(500):
+                row = ht_s[cb]
+                free = np.nonzero(row < 0)[0]
+                if len(free):
+                    ht_s[cb, free[0]] = cs
+                    ht_w[cb, free[0]] = cw
+                    ht_c[cb, free[0]] = cc
+                    break
+                # evict the slot this key's path rotates through
+                victim = step % _BUCKET
+                vs, vw, vc = (int(ht_s[cb, victim]), int(ht_w[cb, victim]),
+                              int(ht_c[cb, victim]))
+                ht_s[cb, victim] = cs
+                ht_w[cb, victim] = cw
+                ht_c[cb, victim] = cc
+                cs, cw, cc = vs, vw, vc
+                with np.errstate(over="ignore"):
+                    # uint32 wraparound is the point of the mix
+                    a1, a2 = hash_mix(np.array(cs, np.int32),
+                                      np.array(cw, np.int32), seed)
+                alt1, alt2 = int(a1 & mask), int(a2 & mask)
+                cb = alt2 if cb == alt1 else alt1
+            else:
+                ok = False
+                break
+        if ok:
+            return ht_s, ht_w, ht_c, np.array([seed], dtype=np.uint32)
+    raise RuntimeError("edge-hash build failed for all seeds")
+
+
+def attach_edge_hash(auto: Automaton, n_buckets: int | None = None) -> Automaton:
+    """Return ``auto`` with hash tables built (bucket count derived
+    from edge capacity unless given — sharded builds pass a shared
+    count so stacked shards agree on shapes)."""
+    if n_buckets is None:
+        n_buckets = buckets_for_capacity(auto.edge_word.shape[0])
+    ht_s, ht_w, ht_c, seed = build_edge_hash(
+        np.asarray(auto.row_ptr), np.asarray(auto.edge_word),
+        np.asarray(auto.edge_child), auto.n_states, auto.n_edges,
+        n_buckets)
+    return auto._replace(ht_state=ht_s, ht_word=ht_w, ht_child=ht_c,
+                         ht_seed=seed)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import NamedTuple
 
 import jax
@@ -68,15 +69,56 @@ def _edge_lookup(auto: Automaton, iters: int, state: jax.Array, word: jax.Array)
     return jnp.where(found, auto.edge_child[idx], -1)
 
 
+def _edge_lookup_hash(auto: Automaton, states: jax.Array, word: jax.Array) -> jax.Array:
+    """Child states for the whole active set via the bucketed 2-choice
+    hash table: two 4-wide row gathers per table (size-independent),
+    vs ~2·log2(E) scalar gathers for the CSR binary search.
+
+    ``states`` is the active set [K] (-1 = inactive); ``word`` a scalar
+    (may be UNKNOWN/PAD < 0). Returns [K] child ids, -1 = no edge.
+    """
+    from emqx_tpu.ops.csr import hash_mix
+
+    nb = auto.ht_state.shape[0]
+    seed = auto.ht_seed[0]
+    h1, h2 = hash_mix(states, jnp.broadcast_to(word, states.shape), seed)
+    b1 = (h1 & jnp.uint32(nb - 1)).astype(jnp.int32)
+    b2 = (h2 & jnp.uint32(nb - 1)).astype(jnp.int32)
+
+    def probe(b):
+        rs = auto.ht_state[b]          # [K, 4]
+        rw = auto.ht_word[b]
+        hit = (rs == states[:, None]) & (rw == word)
+        child = jnp.max(jnp.where(hit, auto.ht_child[b], -1), axis=1)
+        return child
+
+    child = jnp.maximum(probe(b1), probe(b2))
+    live = (states >= 0) & (word >= 0)
+    return jnp.where(live, child, -1)
+
+
+# Active-set compaction strategy, read once at import. The scatter
+# path (cumsum + drop-mode scatter) measured ~60% faster than the
+# bitonic sort on v5e for the per-level compaction; EMQX_COMPACT=sort
+# keeps the sort variant selectable for A/B on other hardware.
+_COMPACT_SCATTER = os.environ.get("EMQX_COMPACT", "scatter") == "scatter"
+
+
 def _compact(cands: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     """Compact candidate states [2K] (-1 invalid) into [K]; overflow if >K.
 
     Trie children are unique (each node has one parent), so no dedup is
     needed — compaction is pure packing.
     """
-    count = jnp.sum(cands >= 0)
-    # Descending sort packs valid states to the front; -1s sink.
-    packed = -jnp.sort(-cands)[:k]
+    valid = cands >= 0
+    count = jnp.sum(valid)
+    if _COMPACT_SCATTER:
+        pos = jnp.cumsum(valid) - 1
+        packed = jnp.full((k,), -1, dtype=cands.dtype).at[
+            jnp.where(valid, pos, k)].set(cands, mode="drop")
+    else:
+        # Descending sort packs valid states to the front; -1s sink.
+        packed = -jnp.sort(-cands)[:k]
     return packed, count > k
 
 
@@ -115,7 +157,11 @@ def match_batch(
             emit_e = jnp.where(
                 alive & ending, auto.end_filter[jnp.maximum(active, 0)], -1)
 
-            lit = jax.vmap(lambda s: _edge_lookup(auto, iters, s, word))(active)
+            if auto.ht_state is not None:
+                lit = _edge_lookup_hash(auto, active, word)
+            else:
+                lit = jax.vmap(
+                    lambda s: _edge_lookup(auto, iters, s, word))(active)
             plus = jnp.where(
                 alive & ~at_root_sys, auto.plus_child[jnp.maximum(active, 0)], -1)
             cands = jnp.where(walking, jnp.concatenate([lit, plus]), -1)
@@ -127,6 +173,10 @@ def match_batch(
             step, (active0, jnp.asarray(False)), (words_ext, levels))
         flat = emits.reshape(-1)
         cnt = jnp.sum(flat >= 0)
+        # Final emit-packing stays a sort: one descending sort of the
+        # [(L+1)·2K] emit buffer beats a same-size scatter here
+        # (measured on v5e; the per-level scatter in _compact wins
+        # because it runs L+1 times on a hotter loop).
         ids = -jnp.sort(-flat)[:m]
         too_long = n < 0
         return MatchResult(

@@ -168,7 +168,8 @@ class NativeEngine:
 
     def flatten(self, state_capacity: Optional[int] = None,
                 edge_capacity: Optional[int] = None):
-        from emqx_tpu.ops.csr import Automaton, capacity_for
+        from emqx_tpu.ops.csr import (Automaton, attach_edge_hash,
+                                      capacity_for)
 
         S, E = self.counts()
         s_cap = capacity_for(S, state_capacity)
@@ -184,10 +185,10 @@ class NativeEngine:
             plus_child, hash_filter, end_filter)
         if n_states < 0:
             raise RuntimeError("flatten capacity underestimated")
-        return Automaton(
+        return attach_edge_hash(Automaton(
             row_ptr=row_ptr, edge_word=edge_word, edge_child=edge_child,
             plus_child=plus_child, hash_filter=hash_filter,
-            end_filter=end_filter, n_states=int(n_states), n_edges=E)
+            end_filter=end_filter, n_states=int(n_states), n_edges=E))
 
     # -- batch encode -----------------------------------------------------
 

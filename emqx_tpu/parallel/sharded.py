@@ -48,6 +48,10 @@ class ShardedAutomaton(NamedTuple):
     plus_child: jax.Array   # [T, S_cap]
     hash_filter: jax.Array  # [T, S_cap]
     end_filter: jax.Array   # [T, S_cap]
+    ht_state: jax.Array     # [T, NB, 4] — shared bucket count NB
+    ht_word: jax.Array      # [T, NB, 4]
+    ht_child: jax.Array     # [T, NB, 4]
+    ht_seed: jax.Array      # [T, 1]
 
 
 class ShardedFanout(NamedTuple):
@@ -70,15 +74,21 @@ def build_sharded(
 ) -> ShardedAutomaton:
     """Build one automaton per shard (global filter ids), pad to the
     max capacity, and stack."""
+    from emqx_tpu.ops.csr import attach_edge_hash, buckets_for_capacity
+
     autos = []
     for shard in filter_shards:
         trie = TrieOracle()
         for f in shard:
             trie.insert(f)
-        autos.append(build_automaton(trie, filter_ids, table))
+        autos.append(build_automaton(trie, filter_ids, table, skip_hash=True))
     s_cap = max(a.row_ptr.shape[0] - 1 for a in autos)
     e_cap = max(a.edge_word.shape[0] for a in autos)
-    padded = [_pad_automaton(a, s_cap, e_cap) for a in autos]
+    nb = buckets_for_capacity(e_cap)
+    padded = [
+        attach_edge_hash(_pad_automaton(a, s_cap, e_cap), n_buckets=nb)
+        for a in autos
+    ]
     return ShardedAutomaton(
         row_ptr=np.stack([a.row_ptr for a in padded]),
         edge_word=np.stack([a.edge_word for a in padded]),
@@ -86,6 +96,10 @@ def build_sharded(
         plus_child=np.stack([a.plus_child for a in padded]),
         hash_filter=np.stack([a.hash_filter for a in padded]),
         end_filter=np.stack([a.end_filter for a in padded]),
+        ht_state=np.stack([a.ht_state for a in padded]),
+        ht_word=np.stack([a.ht_word for a in padded]),
+        ht_child=np.stack([a.ht_child for a in padded]),
+        ht_seed=np.stack([a.ht_seed for a in padded]),
     )
 
 
@@ -173,7 +187,9 @@ def publish_step(
             row_ptr=auto_t.row_ptr[0], edge_word=auto_t.edge_word[0],
             edge_child=auto_t.edge_child[0], plus_child=auto_t.plus_child[0],
             hash_filter=auto_t.hash_filter[0], end_filter=auto_t.end_filter[0],
-            n_states=0, n_edges=0)
+            n_states=0, n_edges=0, ht_state=auto_t.ht_state[0],
+            ht_word=auto_t.ht_word[0], ht_child=auto_t.ht_child[0],
+            ht_seed=auto_t.ht_seed[0])
         res = match_batch(a, ids, n, sysm, k=k, m=m)
         if with_fanout:
             f = FanoutTable(fan_t.row_ptr[0], fan_t.sub_ids[0], 0, 0)

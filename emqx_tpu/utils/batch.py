@@ -11,6 +11,7 @@ mapping).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Any, Callable, List, Optional
 
@@ -84,5 +85,11 @@ class AsyncBatcher:
         interval = max(self.batch.linger_ms / 1000.0, 0.001)
         while True:
             await asyncio.sleep(interval)
-            if self.batch.due():
-                self.batch.flush()
+            try:
+                if self.batch.due():
+                    self.batch.flush()
+            except Exception:
+                # a transient commit failure must not kill the linger
+                # task — that would silently stall partial batches
+                logging.getLogger("emqx_tpu.batch").exception(
+                    "batch commit failed")

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
+_MISSING = object()
+
 
 class PMon:
     def __init__(self) -> None:
@@ -25,11 +27,18 @@ class PMon:
         the key as down."""
         self._items[key] = val
         if task is not None:
-            task.add_done_callback(lambda _t, k=key: self._mark_down(k))
+            # bind the monitored generation: a stale task's callback
+            # must not queue a key that was re-registered since (e.g.
+            # client reconnected between old-task death and callback)
+            task.add_done_callback(
+                lambda _t, k=key, v=val: self._mark_down(k, v))
 
-    def _mark_down(self, key: Hashable) -> None:
-        if key in self._items:
-            self._down.append(key)
+    def _mark_down(self, key: Hashable, val: Any = _MISSING) -> None:
+        if key not in self._items:
+            return
+        if val is not _MISSING and self._items[key] is not val:
+            return  # entry was re-registered; the down is stale
+        self._down.append(key)
 
     def notify_down(self, key: Hashable) -> None:
         """Explicit down signal (no task attached)."""

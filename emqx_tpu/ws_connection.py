@@ -225,14 +225,13 @@ class WsConnection(Connection):
             msgs = self.ws_parser.feed(data)
         except WsParseError as e:
             log.debug("ws error from %s: %s", self.channel.peername, e)
-            await self._drain_and_close()
             return None
         if self.ws_parser.error is not None:
             # malformed frame behind valid ones: process what parsed
-            # cleanly, then finish (feed() raises from here on)
+            # cleanly, then finish (feed() raises from here on); the
+            # run loop drains responses and closes after the batch
             log.debug("ws error from %s: %s", self.channel.peername,
                       self.ws_parser.error)
-            await self._drain_and_close()
             self._finish_after_batch = True
         pkts = []
         for opcode, payload in msgs:
@@ -255,12 +254,10 @@ class WsConnection(Connection):
                 return pkts
             if opcode != OP_BINARY:
                 # MQTT over WS MUST use binary frames
-                await self._drain_and_close()
                 self._finish_after_batch = True
                 return pkts
             mqtt_pkts = await super()._decode(payload)
             if mqtt_pkts is None:
-                await self._drain_and_close()
                 self._finish_after_batch = True
                 return pkts
             pkts.extend(mqtt_pkts)

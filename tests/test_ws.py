@@ -78,7 +78,7 @@ def test_frame_rejects_oversized_control():
     p = WsFrameParser()
     assert p.feed(mask_frame(OP_PING, b"p" * 126)) == []
     assert p.error is not None
-    assert p.feed is not None and "control" in str(p.error)
+    assert "control" in str(p.error)
 
 
 def test_frame_error_preserves_earlier_messages():
@@ -247,4 +247,35 @@ async def test_ws_text_frame_disconnects():
         await c.send_raw(mask_frame(0x1, b"not-binary"))
         kind, _ = await asyncio.wait_for(c.acks.get(), 5.0)
         assert kind == "close"
+        await c.close()
+
+
+async def test_ws_error_after_valid_packet_still_answered():
+    # regression: a malformed WS frame arriving in the same TCP read as
+    # a valid MQTT packet must not swallow the valid packet's response —
+    # the connection answers, drains, THEN closes (with a WS CLOSE)
+    async with ws_node() as node:
+        port = node.listeners[0].port
+        c = WsTestClient("werr")
+        ack = await c.connect(port)
+        assert ack.reason_code == 0
+        good = mask_frame(OP_BINARY, serialize(
+            Subscribe(packet_id=7, topic_filters=[("t/err", {"qos": 0})]),
+            C.MQTT_V4))
+        bad = encode_frame(OP_BINARY, b"junk")  # unmasked = protocol error
+        await c.send_raw(good + bad)
+        got_suback = False
+        got_close = False
+        for _ in range(3):
+            try:
+                item = await asyncio.wait_for(c.acks.get(), 5.0)
+            except asyncio.TimeoutError:
+                break
+            if isinstance(item, Suback):
+                got_suback = True
+            elif isinstance(item, tuple) and item[0] == "close":
+                got_close = True
+                break
+        assert got_suback, "response to pre-error packet was dropped"
+        assert got_close, "server did not send a WS CLOSE frame"
         await c.close()
