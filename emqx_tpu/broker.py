@@ -112,10 +112,28 @@ class Broker:
 
     def subscriber_down(self, sub: object) -> None:
         """Drop all of a dead subscriber's subscriptions
-        (emqx_broker.erl:331-348)."""
+        (emqx_broker.erl:331-348); unacked shared-group messages are
+        redispatched to the surviving members (the reference's
+        shared-sub nack/redispatch, emqx_shared_sub.erl:131-227)."""
         for key in list(self._subscriptions.get(sub, {})):
             self.unsubscribe(sub, key)
         self.shared.subscriber_down(sub)
+        pending = getattr(sub, "take_shared_pending", None)
+        if pending is not None:
+            for group, flt, msg, was_sent in pending():
+                if was_sent and msg.qos > 0:
+                    # retransmission of a possibly-seen message; never
+                    # DUP-flag untransmitted or QoS0 ones (MQTT-3.3.1)
+                    msg.set_flag("dup", True)
+                nodes = [r.dest[1] for r in self.router.lookup_routes(flt)
+                         if isinstance(r.dest, tuple) and r.dest[0] == group]
+                if self.shared_router is not None and nodes:
+                    # surviving members may live on other nodes
+                    n = self.shared_router(group, flt, nodes, msg)
+                else:
+                    n = self.shared.dispatch(group, flt, msg)
+                if n:
+                    self.metrics.inc("messages.redispatched")
 
     def subscribers(self, topic_filter: str) -> List[object]:
         return list(self._subscribers.get(topic_filter, ()))

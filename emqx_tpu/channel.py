@@ -86,6 +86,7 @@ class Channel:
         # transport hooks: set by connection
         self.on_close = None          # force-close the socket
         self.on_deliver = None        # new outbox items are ready
+        self.send_oob = None          # out-of-band packet send (kick)
 
     # -- helpers ----------------------------------------------------------
 
@@ -289,8 +290,10 @@ class Channel:
         try:
             check(pkt)
         except PacketError:
+            # wildcard/empty topic in PUBLISH is a protocol violation:
+            # disconnect, as the reference does (t_publish_wildtopic)
             self.broker.metrics.inc("packets.publish.error")
-            return self._puback_for(pkt, RC.TOPIC_NAME_INVALID)
+            return self._disconnect_with(RC.TOPIC_NAME_INVALID)
         # caps
         cap_rc = check_pub(self.zone, pkt.qos, pkt.retain, pkt.topic)
         if cap_rc is not None:
@@ -586,15 +589,29 @@ class Channel:
         self.closed = True
         was_connected = self.state == CONNECTED
         self.state = DISCONNECTED
+        if (rc is not None and was_connected
+                and self.proto_ver == C.MQTT_V5
+                and self.send_oob is not None):
+            # tell the victim why before closing (e.g. DISCONNECT
+            # 0x8E session-taken-over on kick/takeover — the
+            # reference's handle_call({takeover,...}) reply path)
+            try:
+                self.send_oob([Disconnect(reason_code=rc)])
+            except Exception:
+                pass
         if publish_will is None:
             publish_will = self.disconnect_reason not in (
                 "normal", "takeovered", "discarded")
         if publish_will and self.will is not None:
             delay = (self.will.get_header("properties") or {}).get(
                 "Will-Delay-Interval", 0)
-            delayed = getattr(self.broker, "delayed", None)
-            if delay and delayed is not None:
-                delayed.delay(self.will, delay)
+            if delay and self.expiry_interval > 0 and self.client_id:
+                # held back until the delay elapses or the session
+                # ends, whichever first; cancelled on reconnect
+                # (MQTT5 3.1.3.2.2)
+                self.cm.schedule_will(
+                    self.client_id, self.will,
+                    min(delay, self.expiry_interval))
             else:
                 self.broker.publish(self.will)
             self.will = None

@@ -11,6 +11,7 @@ for ``session_expiry_interval`` and swept by :meth:`expire_sessions`.
 
 from __future__ import annotations
 
+import asyncio
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -28,6 +29,10 @@ class ConnectionManager:
         self._channels: Dict[str, object] = {}   # clientid -> live channel
         # clientid -> (detached Session, detach_ts, expiry_interval)
         self._detached: Dict[str, Tuple[Session, float, float]] = {}
+        # clientid -> (timer handle | None, will Message) — wills held
+        # back by Will-Delay-Interval (MQTT5 3.1.3.2.2; the reference's
+        # will_message timer, emqx_channel ?TIMER_TABLE)
+        self._pending_wills: Dict[str, Tuple[object, object]] = {}
 
     def _client_lock(self, client_id: str) -> threading.Lock:
         with self._lock:
@@ -53,6 +58,41 @@ class ConnectionManager:
     def connection_count(self) -> int:
         return len(self._channels)
 
+    # -- delayed wills (MQTT5 Will-Delay-Interval) ------------------------
+
+    def schedule_will(self, client_id: str, msg, delay: float) -> None:
+        """Hold the will back for ``delay`` seconds; a reconnect
+        cancels it (spec: MUST NOT send if the connection is
+        re-established first)."""
+        self.cancel_will(client_id)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no event loop (sync drivers): no way to time the delay,
+            # publish now rather than silently dropping the will
+            if self.broker is not None:
+                self.broker.publish(msg)
+            return
+        handle = loop.call_later(delay, self._fire_will, client_id)
+        self._pending_wills[client_id] = (handle, msg)
+
+    def _fire_will(self, client_id: str) -> None:
+        ent = self._pending_wills.pop(client_id, None)
+        if ent is not None and self.broker is not None:
+            self.broker.publish(ent[1])
+
+    def cancel_will(self, client_id: str, fire: bool = False) -> None:
+        """Drop a pending will; ``fire=True`` publishes it instead
+        (session ended before the delay elapsed)."""
+        ent = self._pending_wills.pop(client_id, None)
+        if ent is None:
+            return
+        handle, msg = ent
+        if handle is not None:
+            handle.cancel()
+        if fire and self.broker is not None:
+            self.broker.publish(msg)
+
     # -- session lifecycle (emqx_cm:open_session) -------------------------
 
     def open_session(self, client_id: str, clean_start: bool,
@@ -63,6 +103,8 @@ class ConnectionManager:
         with self._client_lock(client_id):
             old_chan = self._channels.get(client_id)
             if clean_start:
+                # old session ends now → a delay-held will fires now
+                self.cancel_will(client_id, fire=True)
                 if old_chan is not None and old_chan is not channel:
                     self._kick(old_chan, discard=True)
                 stale = self._detached.pop(client_id, None)
@@ -75,7 +117,9 @@ class ConnectionManager:
                         "session.created", (client_id, sess.info()))
                 self._channels[client_id] = channel
                 return sess, False
-            # resume path
+            # resume path: connection re-established → pending will
+            # MUST NOT be sent (MQTT5 3.1.3.2.2)
+            self.cancel_will(client_id)
             sess: Optional[Session] = None
             if old_chan is not None and old_chan is not channel:
                 sess = self._takeover(old_chan)
@@ -115,6 +159,7 @@ class ConnectionManager:
         self.unregister_channel(getattr(chan, "client_id", ""), chan)
 
     def discard_session(self, client_id: str) -> None:
+        self.cancel_will(client_id, fire=True)  # session ends now
         chan = self._channels.get(client_id)
         if chan is not None:
             self._kick(chan, discard=True)
@@ -125,6 +170,7 @@ class ConnectionManager:
             self.broker.metrics.inc("session.discarded")
 
     def kick_session(self, client_id: str) -> bool:
+        self.cancel_will(client_id, fire=True)  # session ends now
         chan = self._channels.get(client_id)
         if chan is None:
             return False
@@ -159,6 +205,7 @@ class ConnectionManager:
                 if now - ts >= exp]
         for cid in dead:
             sess, _, _ = self._detached.pop(cid)
+            self.cancel_will(cid, fire=True)  # session end publishes it
             if self.broker is not None:
                 self.broker.subscriber_down(sess)
                 self.broker.metrics.inc("session.terminated")

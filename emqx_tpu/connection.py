@@ -46,6 +46,7 @@ class Connection:
                                listener=listener)
         self.channel.on_close = self._close_transport
         self.channel.on_deliver = self._schedule_flush
+        self.channel.send_oob = self._send_packets
         self.parser = Parser(max_size=self.zone.max_packet_size)
         self.broker = broker
         self.recv_bytes = 0

@@ -220,6 +220,13 @@ class Session:
             props = dict(m.get_header("properties") or {})
             props["Subscription-Identifier"] = opts.subid
             m.set_header("properties", props)
+        if opts.share:
+            # mark for group redispatch if this session dies before
+            # acking (emqx_shared_sub redispatch protocol). The
+            # *pre-enrichment* message rides along: redispatch must
+            # hand the survivor the original, not this copy with our
+            # subid/downgraded qos baked in
+            m.set_header("shared", (opts.share, topic_filter, msg))
         return m
 
     def _deliver_msg(self, msg: Message) -> None:
@@ -306,6 +313,29 @@ class Session:
             self.broker.metrics.inc("messages.dropped.expired", len(expired))
 
     # -- takeover / resume / replay (emqx_session:606-629) ----------------
+
+    def take_shared_pending(self) -> List[Tuple[str, str, Message, bool]]:
+        """Drain unacked/queued shared-group messages for redispatch
+        when this session terminates: [(group, topic, original_msg,
+        was_transmitted)]. QoS2 messages already PUBREC'd
+        (PUBREL_MARKER) are past the point of redispatch, matching the
+        reference's ack protocol."""
+        out: List[Tuple[str, str, Message, bool]] = []
+        for _pid, val in self.inflight.to_list():
+            msg = val[0]
+            if msg == PUBREL_MARKER or not isinstance(msg, Message):
+                continue
+            sh = msg.get_header("shared")
+            if sh and not msg.is_expired():
+                out.append((sh[0], sh[1], sh[2], True))
+        while not self.mqueue.is_empty():
+            msg = self.mqueue.pop()
+            if msg is None:
+                break
+            sh = msg.get_header("shared")
+            if sh and not msg.is_expired():
+                out.append((sh[0], sh[1], sh[2], False))
+        return out
 
     def takeover(self) -> None:
         """Old owner: detach from the broker, keep state for handoff."""

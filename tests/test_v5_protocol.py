@@ -1,0 +1,263 @@
+"""MQTT v5 spec-conformance over live loopback TCP.
+
+Mirrors the reference's ``test/mqtt_protocol_v5_SUITE.erl`` (756 LoC)
+case by case where the behaviour is observable through a real client:
+session expiry, will delay, topic aliases, RAP/no-local subscription
+options, batch subscribe reason codes, wildcard-publish rejection,
+duplicate clientid takeover, overlapping subscriptions.
+"""
+
+import asyncio
+import contextlib
+import time
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.packet import Disconnect, Publish, Subscribe
+from emqx_tpu.node import Node
+from tests.mqtt_client import TestClient
+
+
+@contextlib.asynccontextmanager
+async def broker_node(**kw):
+    n = Node(**kw)
+    n.add_listener(port=0)
+    await n.start()
+    try:
+        yield n
+    finally:
+        await n.stop()
+
+
+def _port(node):
+    return node.listeners[0].port
+
+
+# -- session expiry (t_connect_session_expiry_interval) ---------------------
+
+async def test_session_expiry_interval_queues_offline():
+    async with broker_node() as node:
+        c1 = TestClient("sei1", version=C.MQTT_V5,
+                        properties={"Session-Expiry-Interval": 7200})
+        await c1.connect(port=_port(node))
+        await c1.subscribe("sei/t", qos=2)
+        await c1.disconnect()  # normal disconnect, session kept
+
+        pub = TestClient("seipub", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("sei/t", b"while-away", qos=2, timeout=60)
+
+        c2 = TestClient("sei1", version=C.MQTT_V5, clean_start=False,
+                        properties={"Session-Expiry-Interval": 7200})
+        ack = await c2.connect(port=_port(node))
+        assert ack.session_present
+        m = await c2.recv(10)
+        assert m.payload == b"while-away" and m.qos == 2
+        await c2.close()
+        await pub.close()
+
+
+async def test_disconnect_with_zero_expiry_drops_session():
+    async with broker_node() as node:
+        c1 = TestClient("sei0", version=C.MQTT_V5,
+                        properties={"Session-Expiry-Interval": 7200})
+        await c1.connect(port=_port(node))
+        await c1.subscribe("sei0/t", qos=1)
+        # DISCONNECT overriding expiry to 0 → session dropped now
+        await c1.send(Disconnect(
+            reason_code=0, properties={"Session-Expiry-Interval": 0}))
+        await c1.close()
+        await asyncio.sleep(0.1)
+
+        c2 = TestClient("sei0", version=C.MQTT_V5, clean_start=False)
+        ack = await c2.connect(port=_port(node))
+        assert not ack.session_present
+        await c2.close()
+
+
+# -- will delay (t_connect_will_delay_interval) -----------------------------
+
+async def test_will_delay_interval():
+    async with broker_node() as node:
+        watcher = TestClient("wdwatch", version=C.MQTT_V5)
+        await watcher.connect(port=_port(node))
+        await watcher.subscribe("wd/t")
+
+        c = TestClient("wdc", version=C.MQTT_V5,
+                       will_flag=True, will_topic="wd/t",
+                       will_payload=b"gone",
+                       will_props={"Will-Delay-Interval": 1},
+                       properties={"Session-Expiry-Interval": 60})
+        await c.connect(port=_port(node))
+        c.writer.close()  # abnormal loss, no DISCONNECT
+        t0 = time.time()
+        with contextlib.suppress(asyncio.TimeoutError):
+            await watcher.recv(0.4)
+            raise AssertionError("will published before the delay")
+        m = await watcher.recv(15)
+        assert m.payload == b"gone"
+        assert time.time() - t0 >= 0.8
+        await watcher.close()
+
+
+async def test_will_delay_cancelled_by_reconnect():
+    async with broker_node() as node:
+        watcher = TestClient("wdw2", version=C.MQTT_V5)
+        await watcher.connect(port=_port(node))
+        await watcher.subscribe("wd2/t")
+
+        c = TestClient("wdc2", version=C.MQTT_V5,
+                       will_flag=True, will_topic="wd2/t",
+                       will_payload=b"gone",
+                       will_props={"Will-Delay-Interval": 2},
+                       properties={"Session-Expiry-Interval": 60})
+        await c.connect(port=_port(node))
+        c.writer.close()
+        await asyncio.sleep(0.2)
+        # reconnect before the delay elapses → will must not fire
+        c2 = TestClient("wdc2", version=C.MQTT_V5, clean_start=False,
+                        properties={"Session-Expiry-Interval": 60})
+        await c2.connect(port=_port(node))
+        with contextlib.suppress(asyncio.TimeoutError):
+            m = await watcher.recv(3.0)
+            raise AssertionError(f"will fired despite reconnect: {m}")
+        await c2.close()
+        await watcher.close()
+
+
+# -- topic alias (t_publish_topic_alias) ------------------------------------
+
+async def test_topic_alias_zero_is_protocol_error():
+    async with broker_node() as node:
+        c = TestClient("alias0", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.send(Publish(topic="t", payload=b"x", qos=0,
+                             properties={"Topic-Alias": 0}))
+        # server must DISCONNECT (0x94 topic alias invalid) and close
+        pkt = await asyncio.wait_for(c.acks.get(), 5)
+        assert isinstance(pkt, Disconnect)
+        assert pkt.reason_code == 0x94
+        await c.close()
+
+
+async def test_topic_alias_reuse_across_publishes():
+    async with broker_node() as node:
+        sub = TestClient("aliassub", version=C.MQTT_V5)
+        await sub.connect(port=_port(node))
+        await sub.subscribe("al/t", qos=0)
+        c = TestClient("aliasc", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.send(Publish(topic="al/t", payload=b"first", qos=0,
+                             properties={"Topic-Alias": 3}))
+        # empty topic + alias resolves to the registered one
+        await c.send(Publish(topic="", payload=b"second", qos=0,
+                             properties={"Topic-Alias": 3}))
+        m1 = await sub.recv(60)
+        m2 = await sub.recv(10)
+        assert (m1.payload, m2.payload) == (b"first", b"second")
+        assert m1.topic == m2.topic == "al/t"
+        await c.close()
+        await sub.close()
+
+
+# -- subscription options (t_publish_rap, t_subscribe_no_local) -------------
+
+async def test_retain_as_published():
+    async with broker_node() as node:
+        rap1 = TestClient("rap1", version=C.MQTT_V5)
+        await rap1.connect(port=_port(node))
+        await rap1.subscribe(("rap/t", {"qos": 0, "nl": 0, "rap": 1,
+                                        "rh": 0}))
+        rap0 = TestClient("rap0", version=C.MQTT_V5)
+        await rap0.connect(port=_port(node))
+        await rap0.subscribe("rap/t")
+        pub = TestClient("rappub", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("rap/t", b"r", retain=True)
+        m1 = await rap1.recv(60)
+        m0 = await rap0.recv(10)
+        assert m1.retain is True      # rap=1 keeps the flag
+        assert m0.retain is False     # rap=0 clears it on routed pubs
+        for c in (rap1, rap0, pub):
+            await c.close()
+
+
+async def test_no_local_over_wire():
+    async with broker_node() as node:
+        c = TestClient("nloc", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.subscribe(("nl/t", {"qos": 0, "nl": 1, "rap": 0, "rh": 0}))
+        await c.publish("nl/t", b"self", timeout=60)
+        other = TestClient("nloc2", version=C.MQTT_V5)
+        await other.connect(port=_port(node))
+        await other.publish("nl/t", b"peer", timeout=60)
+        m = await c.recv(10)
+        assert m.payload == b"peer"
+        assert c.inbox.empty()
+        await c.close()
+        await other.close()
+
+
+# -- batch subscribe (t_batch_subscribe) ------------------------------------
+
+async def test_batch_subscribe_mixed_reason_codes():
+    async with broker_node() as node:
+        c = TestClient("batch", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        pid = c.next_pkt_id()
+        await c.send(Subscribe(packet_id=pid, topic_filters=[
+            ("ok/a", {"qos": 2, "nl": 0, "rap": 0, "rh": 0}),
+            ("bad/#/mid", {"qos": 1, "nl": 0, "rap": 0, "rh": 0}),
+            ("ok/b", {"qos": 1, "nl": 0, "rap": 0, "rh": 0}),
+        ]))
+        ack = await asyncio.wait_for(c.acks.get(), 5)
+        assert ack.reason_codes == [2, 0x8F, 1]  # granted, invalid, granted
+        await c.close()
+
+
+# -- wildcard publish (t_publish_wildtopic) ---------------------------------
+
+async def test_publish_to_wildcard_topic_rejected():
+    async with broker_node() as node:
+        c = TestClient("wildpub", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.send(Publish(topic="oops/#", payload=b"x", qos=0))
+        pkt = await asyncio.wait_for(c.acks.get(), 5)
+        assert isinstance(pkt, Disconnect)
+        data = await asyncio.wait_for(c.reader.read(64), 5)
+        assert data == b""  # server closed the socket
+        await c.close()
+
+
+# -- duplicate clientid (t_connect_duplicate_clientid) ----------------------
+
+async def test_duplicate_clientid_kicks_old_connection():
+    async with broker_node() as node:
+        a = TestClient("dup", version=C.MQTT_V5)
+        await a.connect(port=_port(node))
+        b = TestClient("dup", version=C.MQTT_V5)
+        await b.connect(port=_port(node))
+        # old connection receives DISCONNECT 0x8E (session taken over)
+        pkt = await asyncio.wait_for(a.acks.get(), 5)
+        assert isinstance(pkt, Disconnect)
+        assert pkt.reason_code == 0x8E
+        assert await b.ping() is None
+        await a.close()
+        await b.close()
+
+
+# -- overlapping subscriptions (t_publish_overlapping_subscriptions) --------
+
+async def test_overlapping_subscriptions_deliver_per_subscription():
+    async with broker_node() as node:
+        c = TestClient("overlap", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.subscribe(("ov/+", {"qos": 2, "nl": 0, "rap": 0, "rh": 0}))
+        await c.subscribe(("ov/#", {"qos": 1, "nl": 0, "rap": 0, "rh": 0}))
+        pub = TestClient("ovpub", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("ov/x", b"m", qos=0)
+        m1 = await c.recv(60)
+        m2 = await c.recv(10)
+        assert {m1.payload, m2.payload} == {b"m"}
+        await c.close()
+        await pub.close()
