@@ -120,11 +120,14 @@ class Broker:
         self.shared.subscriber_down(sub)
         pending = getattr(sub, "take_shared_pending", None)
         if pending is not None:
-            for group, flt, msg, was_sent in pending():
-                if was_sent and msg.qos > 0:
-                    # retransmission of a possibly-seen message; never
-                    # DUP-flag untransmitted or QoS0 ones (MQTT-3.3.1)
-                    msg.set_flag("dup", True)
+            for group, flt, orig, was_sent in pending():
+                # never mutate the shared original (other sessions'
+                # copies reference its state); DUP is decided per
+                # delivery in Session._enrich AFTER the survivor's QoS
+                # downgrade, so a QoS0 member never sees DUP=1
+                msg = orig.copy()
+                if was_sent:
+                    msg.set_header("redispatch", True)
                 nodes = [r.dest[1] for r in self.router.lookup_routes(flt)
                          if isinstance(r.dest, tuple) and r.dest[0] == group]
                 if self.shared_router is not None and nodes:

@@ -170,10 +170,10 @@ class ConnectionManager:
             self.broker.metrics.inc("session.discarded")
 
     def kick_session(self, client_id: str) -> bool:
-        self.cancel_will(client_id, fire=True)  # session ends now
         chan = self._channels.get(client_id)
         if chan is None:
             return False
+        self.cancel_will(client_id, fire=True)  # session ends now
         self._kick(chan, discard=True)
         return True
 

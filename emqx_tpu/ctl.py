@@ -65,6 +65,12 @@ class Ctl:
                               "list | add <kind> <value> [secs] | del <kind> <value>")
         self.register_command("trace", self._trace,
                               "list | start client|topic <v> | stop client|topic <v>")
+        self.register_command("vm", self._vm,
+                              "host/runtime introspection (emqx_vm)")
+
+    def _vm(self, args) -> str:
+        from emqx_tpu import vm
+        return json.dumps(vm.get_system_info(), indent=2, default=str)
 
     def _status(self, args) -> str:
         n = self.node

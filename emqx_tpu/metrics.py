@@ -50,7 +50,7 @@ MESSAGE_METRICS = [
     "messages.qos2.received", "messages.qos2.sent",
     "messages.publish", "messages.dropped",
     "messages.dropped.expired", "messages.dropped.no_subscribers",
-    "messages.forward", "messages.retained",
+    "messages.forward", "messages.retained", "messages.redispatched",
     "messages.delayed", "messages.delivered", "messages.acked",
 ]
 DELIVERY_METRICS = [

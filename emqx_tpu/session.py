@@ -227,6 +227,10 @@ class Session:
             # hand the survivor the original, not this copy with our
             # subid/downgraded qos baked in
             m.set_header("shared", (opts.share, topic_filter, msg))
+            if m.get_header("redispatch") and m.qos > 0:
+                # retransmission of a possibly-seen message — DUP only
+                # at QoS>0 after OUR downgrade (MQTT-3.3.1-2)
+                m.set_flag("dup", True)
         return m
 
     def _deliver_msg(self, msg: Message) -> None:

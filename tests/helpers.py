@@ -1,0 +1,20 @@
+"""Shared live-broker fixtures for the integration-tier suites."""
+
+import contextlib
+
+from emqx_tpu.node import Node
+
+
+@contextlib.asynccontextmanager
+async def broker_node(**kw):
+    n = Node(**kw)
+    n.add_listener(port=0)  # ephemeral port
+    await n.start()
+    try:
+        yield n
+    finally:
+        await n.stop()
+
+
+def node_port(node):
+    return node.listeners[0].port

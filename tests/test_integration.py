@@ -10,22 +10,10 @@ import pytest
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.node import Node
 from emqx_tpu.types import Message
+from tests.helpers import broker_node, node_port as _port
 from tests.mqtt_client import TestClient
 
 
-@contextlib.asynccontextmanager
-async def broker_node(**kw):
-    n = Node(**kw)
-    n.add_listener(port=0)  # ephemeral port
-    await n.start()
-    try:
-        yield n
-    finally:
-        await n.stop()
-
-
-def _port(node):
-    return node.listeners[0].port
 
 
 async def test_connect_and_ping():

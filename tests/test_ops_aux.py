@@ -160,3 +160,20 @@ def test_logger_setup_attaches_handler():
     finally:
         logging.getLogger("emqx_tpu").removeHandler(h)
         elog.clear_metadata()
+
+
+def test_vm_introspection():
+    from emqx_tpu import vm
+    info = vm.get_system_info()
+    assert info["cpu_count"] >= 1
+    assert info["memory"]["rss"] > 0
+    assert info["process"]["threads"] >= 1
+    assert len(info["load"]) == 3
+    assert isinstance(info["devices"], list)
+
+
+def test_ctl_vm_command():
+    from emqx_tpu.node import Node
+    n = Node(boot_listeners=False)
+    out = n.ctl.run(["vm"])
+    assert '"cpu_count"' in out and '"rss"' in out

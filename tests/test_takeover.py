@@ -8,22 +8,10 @@ import contextlib
 
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.node import Node
+from tests.helpers import broker_node, node_port as _port
 from tests.mqtt_client import TestClient
 
 
-@contextlib.asynccontextmanager
-async def broker_node(**kw):
-    n = Node(**kw)
-    n.add_listener(port=0)
-    await n.start()
-    try:
-        yield n
-    finally:
-        await n.stop()
-
-
-def _port(node):
-    return node.listeners[0].port
 
 
 async def test_takeover_mid_stream_no_qos1_loss():
