@@ -124,6 +124,90 @@ def bigfan():
     }), flush=True)
 
 
+def shared():
+    """BENCH_MODE=shared — BASELINE config 4: $share/<group>
+    load-balanced dispatch at 1M shared subscribers. Match on device,
+    then the device-side hash-strategy group pick
+    (ops.fanout.pick_shared)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops import native
+    from emqx_tpu.ops.fanout import build_fanout, pick_shared
+    from emqx_tpu.ops.match import depth_bucket, match_batch
+
+    n_subs = int(os.environ.get("BENCH_SUBS", "1000000"))
+    n_groups = int(os.environ.get("BENCH_GROUPS", "1000"))
+    batch = int(os.environ.get("BENCH_BATCH", "8192"))
+    iters = int(os.environ.get("BENCH_ITERS", "100"))
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", "5")))
+    k = int(os.environ.get("BENCH_K", "48"))
+    m = int(os.environ.get("BENCH_M", "64"))
+    levels = 5
+
+    rng = random.Random(0)
+    t0 = time.time()
+    # one shared filter per group; members spread evenly (the
+    # reference stores {group, topic} -> member rows the same way)
+    filters, vocab = build_filters(rng, n_groups, words_per_level=60,
+                                   levels=levels)
+    assert native.available(), "shared bench expects the native engine"
+    eng = native.NativeEngine()
+    rows = {}
+    per = n_subs // n_groups
+    for i, f in enumerate(filters):
+        eng.insert(f, i)
+        rows[i] = range(i * per, (i + 1) * per)
+    auto = eng.flatten()
+    fan = build_fanout(rows, len(filters))
+    build_s = time.time() - t0
+
+    auto = jax.device_put(auto)
+    fan = jax.device_put(fan)
+    batches = []
+    for _ in range(8):
+        topics = ["/".join(zipf_choice(rng, vocab[i])
+                           for i in range(rng.randint(2, levels)))
+                  for _ in range(batch)]
+        ids_, n_, sysm_ = eng.encode_batch(topics, 16)
+        ids_, n_ = depth_bucket(ids_, n_)
+        seeds = np.random.default_rng(1).integers(
+            0, 2**31 - 1, size=batch, dtype=np.int32)
+        batches.append(jax.device_put((ids_, n_, sysm_, seeds)))
+
+    def step(ids, n, sysm, seeds):
+        res = match_batch(auto, ids, n, sysm, k=k, m=m)
+        picks = pick_shared(fan, res.ids, seeds)
+        return jnp.sum(picks >= 0, dtype=jnp.int32), res.overflow
+
+    jax.block_until_ready(step(*batches[0]))
+    rates = []
+    picked = int(step(*batches[0])[0])
+    for _ in range(windows):
+        t1 = _t.time()
+        outs = [step(*batches[i % len(batches)]) for i in range(iters)]
+        jax.block_until_ready(outs)
+        np.asarray(outs[-1][0])
+        rates.append(batch * iters / (_t.time() - t1))
+    throughput = float(np.median(rates))
+    import sys
+    print(json.dumps({
+        "mode": "shared", "subs": n_subs, "groups": n_groups,
+        "batch": batch, "build_s": round(build_s, 1),
+        "picks_per_batch": picked,
+        "device": str(jax.devices()[0]),
+        "window_mmsgs": [round(r / 1e6, 2) for r in rates],
+    }), file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "shared_dispatch_throughput",
+        "value": round(throughput, 1),
+        "unit": "msgs/sec",
+        "vs_baseline": round(throughput / 1_000_000, 3),
+    }), flush=True)
+
+
 def main():
     n_subs = int(os.environ.get("BENCH_SUBS", "1000000"))
     batch = int(os.environ.get("BENCH_BATCH", "8192"))
@@ -176,6 +260,8 @@ def main():
     # device_put once — the steady-state path matches device-resident
     # arrays produced by the ingress batcher, and re-shipping numpy
     # per step would time the host link, not the kernel
+    from emqx_tpu.ops.match import depth_bucket
+
     n_batches = 8
     batches = []
     for _ in range(n_batches):
@@ -184,7 +270,9 @@ def main():
                      for i in range(rng.randint(2, levels)))
             for _ in range(batch)
         ]
-        batches.append(jax.device_put(encode(topics, 16)))
+        ids_, n_, sysm_ = encode(topics, 16)
+        ids_, n_ = depth_bucket(ids_, n_)
+        batches.append(jax.device_put((ids_, n_, sysm_)))
 
     def step(ids, n, sysm):
         res = match_batch(auto, ids, n, sysm, k=k, m=m)
@@ -236,7 +324,10 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_MODE") == "bigfan":
+    _mode = os.environ.get("BENCH_MODE")
+    if _mode == "bigfan":
         bigfan()
+    elif _mode == "shared":
+        shared()
     else:
         main()

@@ -138,6 +138,14 @@ class Broker:
                 if n:
                     self.metrics.inc("messages.redispatched")
 
+    def detach_subscriber(self, sub: object) -> None:
+        """Remove a subscriber's table entries WITHOUT the death-path
+        side effects (no shared redispatch): the session is being
+        handed to another node's broker, which resubscribes it."""
+        for key in list(self._subscriptions.get(sub, {})):
+            self.unsubscribe(sub, key)
+        self.shared.subscriber_down(sub)
+
     def subscribers(self, topic_filter: str) -> List[object]:
         return list(self._subscribers.get(topic_filter, ()))
 

@@ -87,6 +87,11 @@ class Cluster:
         self.members: List[str] = [self.name]
         self._lock = threading.Lock()
         self._shared_rr: Dict[Tuple[str, str], int] = {}
+        # replicated clientid -> node registry (emqx_cm_registry:
+        # Mnesia bag emqx_channel_registry); covers live and detached
+        # sessions so cross-node takeover can find the owner
+        self._registry: Dict[str, str] = {}
+        node.cm.cluster = self
         # intercept local route mutations for replication
         self._orig_add = node.router.add_route
         self._orig_del = node.router.delete_route
@@ -145,11 +150,63 @@ class Cluster:
 
     def handle_nodedown(self, name: str) -> None:
         """Purge a dead member's routes + registry entries
-        (emqx_router_helper cleanup, §3.5)."""
+        (emqx_router_helper cleanup + emqx_cm_registry
+        cleanup_channels, §3.5)."""
         with self._lock:
             if name in self.members:
                 self.members.remove(name)
+            dead = [c for c, n in self._registry.items() if n == name]
+            for c in dead:
+                del self._registry[c]
         self._purge_node_routes(name)
+
+    # -- clientid registry + cross-node takeover (emqx_cm_registry) -------
+
+    def client_up(self, client_id: str) -> None:
+        with self._lock:
+            self._registry[client_id] = self.name
+        self._broadcast("client_up", client_id, self.name)
+
+    def client_down(self, client_id: str) -> None:
+        with self._lock:
+            if self._registry.get(client_id) == self.name:
+                self._registry.pop(client_id, None)
+        self._broadcast("client_down", client_id, self.name)
+
+    def locate_client(self, client_id: str) -> Optional[str]:
+        return self._registry.get(client_id)
+
+    def remote_discard(self, client_id: str, node: str) -> None:
+        """Old session on another node must die (clean start)."""
+        try:
+            self.transport.call(node, "discard_client", client_id)
+        except ConnectionError:
+            self.handle_nodedown(node)
+
+    def remote_takeover(self, client_id: str, node: str):
+        """Pull the session from its current owner node
+        (emqx_cm:takeover_session RPC, src/emqx_cm.erl:263-272)."""
+        try:
+            return self.transport.call(node, "takeover_client", client_id)
+        except ConnectionError:
+            self.handle_nodedown(node)
+            return None
+
+    def _local_takeover(self, client_id: str):
+        cm = self.node.cm
+        chan = cm.lookup_channel(client_id)
+        sess = None
+        if chan is not None:
+            sess = cm._takeover(chan)
+        elif client_id in cm._detached:
+            sess, _ts, _exp = cm._detached.pop(client_id)
+        cm.cancel_will(client_id)  # connection re-established elsewhere
+        if sess is not None:
+            # hand-off: drop table entries here without death-path
+            # side effects; the new node's resume() resubscribes
+            self.node.broker.detach_subscriber(sess)
+            sess.notify = None
+        return sess
 
     def _purge_node_routes(self, name: str) -> None:
         self.node.router.cleanup_routes(name)
@@ -241,6 +298,21 @@ class Cluster:
         if op == "forward_shared":
             group, flt, msg = args
             return self.node.broker.shared.dispatch(group, flt, msg)
+        if op == "client_up":
+            cid, name = args
+            with self._lock:
+                self._registry[cid] = name
+            return None
+        if op == "client_down":
+            cid, name = args
+            with self._lock:
+                if self._registry.get(cid) == name:
+                    self._registry.pop(cid, None)
+            return None
+        if op == "discard_client":
+            return self.node.cm.discard_session(args[0])
+        if op == "takeover_client":
+            return self._local_takeover(args[0])
         if op == "set_members":
             return self._set_members(args[0])
         if op == "push_routes":

@@ -24,6 +24,9 @@ TAKEOVER_RC = 0x8E  # session taken over
 class ConnectionManager:
     def __init__(self, broker=None) -> None:
         self.broker = broker
+        # set by Cluster: replicated clientid registry + remote
+        # takeover/discard (emqx_cm_registry + emqx_rpc seam)
+        self.cluster = None
         self._lock = threading.Lock()
         self._locks: Dict[str, threading.Lock] = {}
         self._channels: Dict[str, object] = {}   # clientid -> live channel
@@ -107,6 +110,10 @@ class ConnectionManager:
                 self.cancel_will(client_id, fire=True)
                 if old_chan is not None and old_chan is not channel:
                     self._kick(old_chan, discard=True)
+                elif self.cluster is not None:
+                    loc = self.cluster.locate_client(client_id)
+                    if loc is not None and loc != self.cluster.name:
+                        self.cluster.remote_discard(client_id, loc)
                 stale = self._detached.pop(client_id, None)
                 if stale is not None and self.broker is not None:
                     self.broker.subscriber_down(stale[0])
@@ -115,7 +122,7 @@ class ConnectionManager:
                     self.broker.metrics.inc("session.created")
                     self.broker.hooks.run(
                         "session.created", (client_id, sess.info()))
-                self._channels[client_id] = channel
+                self._register(client_id, channel)
                 return sess, False
             # resume path: connection re-established → pending will
             # MUST NOT be sent (MQTT5 3.1.3.2.2)
@@ -125,8 +132,16 @@ class ConnectionManager:
                 sess = self._takeover(old_chan)
             elif client_id in self._detached:
                 sess, _ts, _exp = self._detached.pop(client_id)
+            elif self.cluster is not None:
+                # the session may live on another node: pull it over
+                # (emqx_cm:takeover_session RPC path)
+                loc = self.cluster.locate_client(client_id)
+                if loc is not None and loc != self.cluster.name:
+                    sess = self.cluster.remote_takeover(client_id, loc)
+                    if sess is not None:
+                        sess.client_id = client_id
             if sess is not None:
-                self._channels[client_id] = channel
+                self._register(client_id, channel)
                 if self.broker is not None:
                     sess.resume(self.broker)
                 return sess, True
@@ -135,8 +150,13 @@ class ConnectionManager:
                 self.broker.metrics.inc("session.created")
                 self.broker.hooks.run(
                     "session.created", (client_id, sess.info()))
-            self._channels[client_id] = channel
+            self._register(client_id, channel)
             return sess, False
+
+    def _register(self, client_id: str, channel) -> None:
+        self._channels[client_id] = channel
+        if self.cluster is not None:
+            self.cluster.client_up(client_id)
 
     def _new_session(self, client_id: str, clean_start: bool,
                      opts: Optional[dict]) -> Session:
@@ -166,6 +186,8 @@ class ConnectionManager:
         stale = self._detached.pop(client_id, None)
         if stale is not None and self.broker is not None:
             self.broker.subscriber_down(stale[0])
+        if self.cluster is not None:
+            self.cluster.client_down(client_id)
         if self.broker is not None:
             self.broker.metrics.inc("session.discarded")
 
@@ -198,6 +220,8 @@ class ConnectionManager:
                 session.broker = self.broker
                 self.broker.subscriber_down(session)
                 self.broker.metrics.inc("session.terminated")
+            if self.cluster is not None:
+                self.cluster.client_down(client_id)
 
     def expire_sessions(self, now: Optional[float] = None) -> int:
         now = time.time() if now is None else now
@@ -206,6 +230,8 @@ class ConnectionManager:
         for cid in dead:
             sess, _, _ = self._detached.pop(cid)
             self.cancel_will(cid, fire=True)  # session end publishes it
+            if self.cluster is not None:
+                self.cluster.client_down(cid)
             if self.broker is not None:
                 self.broker.subscriber_down(sess)
                 self.broker.metrics.inc("session.terminated")

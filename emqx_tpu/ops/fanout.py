@@ -55,6 +55,33 @@ def build_fanout(
     return FanoutTable(row_ptr, sub_ids, num_filters, total)
 
 
+@jax.jit
+def pick_shared(
+    fan: FanoutTable,
+    match_ids: jax.Array,  # int32[B, M] shared-group filter ids (-1 pad)
+    seed: jax.Array,       # int32[B] per-message pick seed (e.g. guid hash)
+) -> jax.Array:
+    """One member per matched shared-group filter — the device form of
+    the reference's `hash` dispatch strategy
+    (src/emqx_shared_sub.erl:229-275): member = seed mod group size,
+    read straight out of the group-membership CSR. Round-robin/sticky
+    keep host state and stay host-side; hash is stateless and batches.
+
+    Returns int32[B, M] subscriber ids (-1 where no pick).
+    """
+    def one(ids, s):
+        safe = jnp.maximum(ids, 0)
+        lens = fan.row_ptr[safe + 1] - fan.row_ptr[safe]
+        starts = fan.row_ptr[safe]
+        valid = (ids >= 0) & (lens > 0)
+        idx = starts + jnp.where(
+            valid, s % jnp.maximum(lens, 1), 0)
+        idx = jnp.clip(idx, 0, fan.sub_ids.shape[0] - 1)
+        return jnp.where(valid, fan.sub_ids[idx], -1)
+
+    return jax.vmap(one)(match_ids, seed)
+
+
 @functools.partial(jax.jit, static_argnames=("d",))
 def gather_subscribers(
     fan: FanoutTable,

@@ -122,6 +122,27 @@ def _compact(cands: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     return packed, count > k
 
 
+def depth_bucket(word_ids, n_words, min_levels: int = 2):
+    """Slice the level axis to the smallest power of two covering the
+    batch's deepest topic. The scan runs L+1 steps whether or not any
+    topic uses them (static shapes), so a 16-level capacity costs 17
+    steps even for 5-level traffic — bucketing to 8 nearly halves the
+    walk. Pow2 buckets bound jit variants to log2(L_max) shapes.
+
+    Call with host (numpy) arrays, before device transfer. Topics
+    flagged too-deep (n_words < 0) stay on the overflow path.
+    """
+    import numpy as _np
+
+    L = word_ids.shape[1]
+    max_n = int(_np.max(n_words)) if n_words.size else 0
+    lb = max(1, min_levels)
+    while lb < max_n:
+        lb *= 2
+    lb = min(lb, L)
+    return word_ids[:, :lb], n_words
+
+
 @functools.partial(jax.jit, static_argnames=("k", "m"))
 def match_batch(
     auto: Automaton,

@@ -34,7 +34,7 @@ import numpy as np
 from emqx_tpu import topic as T
 from emqx_tpu.oracle import TrieOracle
 from emqx_tpu.ops.csr import Automaton, build_automaton
-from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.match import depth_bucket, match_batch
 from emqx_tpu.ops.tokenize import WordTable, encode_batch
 from emqx_tpu.types import Route
 
@@ -272,6 +272,7 @@ class Router:
         # ctypes calls drop the GIL, so the map can rehash mid-read
         with self._lock:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
+        ids, n = depth_bucket(ids, n)
         res = match_batch(auto, ids, n, sysm, k=cfg.active_k, m=cfg.max_matches)
         mid = np.asarray(res.ids)
         ovf = np.asarray(res.overflow)

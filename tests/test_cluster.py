@@ -185,3 +185,68 @@ def test_join_syncs_existing_routes():
     assert b.router.has_route("pre/existing")
     b.broker.publish(Message(topic="pre/existing"))
     assert len(s.inbox) == 1
+
+
+# -- cluster clientid registry + cross-node takeover ------------------------
+
+def test_registry_replicates_client_location():
+    nodes, clusters = _mk_cluster(2)
+    n0, n1 = nodes
+    sess, present = n0.cm.open_session("c1", True, channel=object())
+    assert not present
+    assert clusters[0].locate_client("c1") == "n0"
+    assert clusters[1].locate_client("c1") == "n0"
+
+
+def test_cross_node_takeover_moves_session_and_subs():
+    nodes, clusters = _mk_cluster(2)
+    n0, n1 = nodes
+    chan0 = object()
+    sess, _ = n0.cm.open_session("mv", True, channel=chan0,
+                                 expiry_interval=300)
+    from emqx_tpu.types import SubOpts
+    sess.subscribe("mv/t", SubOpts(qos=1))
+    # detach on n0 (persistent session stays there)
+    n0.cm.connection_closed("mv", chan0, sess, 300)
+    # publish while away queues into the detached session via n0
+    # (qos1: offline qos0 is dropped by default, like the reference)
+    n0.broker.publish(Message(topic="mv/t", payload=b"away", qos=1))
+    # reconnect on the OTHER node with clean_start=False
+    sess2, present = n1.cm.open_session("mv", False, channel=object())
+    assert present and sess2 is sess
+    assert "mv/t" in sess2.subscriptions
+    assert clusters[0].locate_client("mv") == "n1"
+    assert clusters[1].locate_client("mv") == "n1"
+    # n0 no longer holds the subscriber; n1's broker delivers now
+    assert sess2 not in n0.broker.subscribers("mv/t")
+    assert sess2 in n1.broker.subscribers("mv/t")
+    # the while-away message survived the move (mqueue)
+    sess2.replay()
+    payloads = [m.payload for pid, m in sess2.drain_outbox()
+                if hasattr(m, "payload")]
+    assert b"away" in payloads
+
+
+def test_cross_node_clean_start_discards_remote_session():
+    nodes, clusters = _mk_cluster(2)
+    n0, n1 = nodes
+    chan0 = object()
+    sess, _ = n0.cm.open_session("cs", True, channel=chan0,
+                                 expiry_interval=300)
+    sess.subscribe("cs/t", None)
+    n0.cm.connection_closed("cs", chan0, sess, 300)
+    assert n0.cm.session_count() == 1
+    sess2, present = n1.cm.open_session("cs", True, channel=object())
+    assert not present and sess2 is not sess
+    # old detached session was discarded on n0
+    assert "cs" not in n0.cm._detached
+    assert clusters[1].locate_client("cs") == "n1"
+
+
+def test_nodedown_purges_registry():
+    nodes, clusters = _mk_cluster(2)
+    n0, n1 = nodes
+    n0.cm.open_session("gone", True, channel=object())
+    assert clusters[1].locate_client("gone") == "n0"
+    clusters[1].handle_nodedown("n0")
+    assert clusters[1].locate_client("gone") is None

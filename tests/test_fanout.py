@@ -52,3 +52,23 @@ def test_gather_large_random_parity():
         if not ovf[b]:
             got = [x for x in np.asarray(subs)[b] if x >= 0]
             assert sorted(got) == sorted(int(x) for x in expect)
+
+
+def test_pick_shared_hash_strategy():
+    import jax.numpy as jnp
+    import numpy as np
+    from emqx_tpu.ops.fanout import build_fanout, pick_shared
+
+    # group-membership CSR: filter 0 -> [10, 11, 12]; 1 -> [20]; 2 -> []
+    fan = build_fanout({0: [10, 11, 12], 1: [20]}, num_filters=3)
+    ids = jnp.array([[0, 1, -1], [2, 0, -1]], dtype=jnp.int32)
+    seed = jnp.array([4, 7], dtype=jnp.int32)
+    out = np.asarray(pick_shared(fan, ids, seed))
+    assert out[0, 0] == 10 + (4 % 3)
+    assert out[0, 1] == 20          # single member, any seed
+    assert out[0, 2] == -1          # padded
+    assert out[1, 0] == -1          # empty group
+    assert out[1, 1] == 10 + (7 % 3)
+    # deterministic per seed: same seed -> same member
+    out2 = np.asarray(pick_shared(fan, ids, seed))
+    assert (out == out2).all()
