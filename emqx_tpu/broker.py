@@ -23,9 +23,14 @@ from __future__ import annotations
 import logging
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+import numpy as np
+
 from emqx_tpu import topic as T
+from emqx_tpu.broker_helper import FanoutManager, unpack_sids
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
+from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
+from emqx_tpu.ops.fanout import gather_subscribers_src
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.shared_sub import SharedSub
 from emqx_tpu.types import Message, SubOpts
@@ -48,6 +53,11 @@ class Broker:
         self.hooks = hooks or Hooks()
         self.metrics = metrics or Metrics()
         self.shared = shared or SharedSub()
+        # subscriber-id registry + device fan-out tables
+        # (emqx_broker_helper analogue; see broker_helper.py)
+        rcfg = self.router.config
+        self.helper = FanoutManager(threshold=rcfg.fanout_threshold,
+                                    use_device=rcfg.use_device)
         # filter -> {subscriber: SubOpts}   (emqx_subscriber / emqx_suboption)
         self._subscribers: Dict[str, Dict[object, SubOpts]] = {}
         # subscriber -> {filter: SubOpts}   (emqx_subscription)
@@ -86,6 +96,7 @@ class Broker:
         else:
             self._subscribers.setdefault(flt, {})[sub] = opts
             if not resub:
+                self.helper.subscribe(flt, sub)
                 self.router.add_route(flt, dest=self.node)
         return opts
 
@@ -107,7 +118,10 @@ class Broker:
                 ftab.pop(sub, None)
                 if not ftab:
                     del self._subscribers[flt]
+            self.helper.unsubscribe(flt, sub)
             self.router.delete_route(flt, dest=self.node)
+        if sub not in self._subscriptions:
+            self.helper.release(sub)
         return True
 
     def subscriber_down(self, sub: object) -> None:
@@ -162,8 +176,16 @@ class Broker:
         return self.publish_batch([msg])[0]
 
     def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
-        """Batch publish: one compiled device match for the whole
-        batch, then per-message dispatch. The TPU hot path."""
+        """Batch publish — the TPU hot path.
+
+        One compiled device *match* for the whole batch, then one
+        compiled device *fan-out* (CSR subscriber gather for small
+        filters + Pallas bitmap OR for >threshold filters); the host
+        loop is only the delivery tail (sub-id → session ``deliver``)
+        plus remote/shared routing. Mirrors the reference's two hot
+        loops (trie walk src/emqx_trie.erl:161-186; subscriber fold
+        src/emqx_broker.erl:283-309) as two device calls.
+        """
         live: List[Tuple[int, Message]] = []
         results = [0] * len(msgs)
         for i, msg in enumerate(msgs):
@@ -180,22 +202,74 @@ class Broker:
             live.append((i, out))
         if not live:
             return results
-        matched = self.router.match_filters([m.topic for _, m in live])
-        for (i, msg), filters in zip(live, matched):
-            if not filters:
-                self.metrics.inc("messages.dropped")
-                self.metrics.inc("messages.dropped.no_subscribers")
-                self.hooks.run("message.dropped", (msg, "no_subscribers"))
+        topics = [m.topic for _, m in live]
+        if not self.router.config.use_device or not self.router.has_routes():
+            for (i, msg), filters in zip(
+                    live, self.router.match_filters(topics)):
+                if not filters:
+                    self._drop_no_subs(msg)
+                    continue
+                results[i] = self._route(filters, msg)
+            return results
+
+        # device match (HOT LOOP 1) → device fan-out (HOT LOOP 2)
+        ids_dev, ids_np, ovf_np, id_map, epoch = \
+            self.router.match_ids(topics)
+        st = self.helper.state(epoch, id_map)
+        cfg = self.router.config
+        subs_np = src_np = dovf_np = union_np = bovf_np = None
+        if st is not None and st.fan is not None:
+            subs_d, src_d, _cnt, dovf_d = gather_subscribers_src(
+                st.fan, ids_dev, d=cfg.fanout_d)
+            subs_np = np.asarray(subs_d)
+            src_np = np.asarray(src_d)
+            dovf_np = np.asarray(dovf_d)
+        if st is not None and st.bm is not None:
+            rows_d, bovf_d = rows_for_matches(
+                st.bm, ids_dev, mb=cfg.fanout_mb)
+            union_np = np.asarray(
+                or_bitmaps_auto(st.bm.bitmaps, rows_d))
+            bovf_np = np.asarray(bovf_d)
+
+        for row, (i, msg) in enumerate(live):
+            if ovf_np[row]:
+                # match overflow: this topic's result is unknown —
+                # full host path for it (exact parity, no truncation)
+                filters = self.router.host_match(msg.topic)
+                if not filters:
+                    self._drop_no_subs(msg)
+                    continue
+                results[i] = self._route(filters, msg)
                 continue
-            results[i] = self._route(filters, msg)
+            filters = [id_map[j] for j in ids_np[row] if j >= 0]
+            filters = [f for f in filters if f is not None]
+            if not filters:
+                self._drop_no_subs(msg)
+                continue
+            results[i] = self._route_device(
+                row, filters, msg, st, subs_np, src_np, dovf_np,
+                union_np, bovf_np, ids_np, id_map)
         return results
 
-    def _route(self, filters: List[str], msg: Message) -> int:
+    def _drop_no_subs(self, msg: Message) -> None:
+        self.metrics.inc("messages.dropped")
+        self.metrics.inc("messages.dropped.no_subscribers")
+        self.hooks.run("message.dropped", (msg, "no_subscribers"))
+
+    def _route(self, filters: List[str], msg: Message,
+               local_deliver=None) -> int:
         """Fan a matched message out to local subscribers, shared
-        groups, and remote nodes (route/2 + aggre/1 + forward/4)."""
+        groups, and remote nodes (route/2 + aggre/1 + forward/4).
+
+        ``local_deliver(local_filters) -> int`` overrides the local
+        delivery step (the device fan-out tail plugs in here); the
+        default is the host dispatch loop. Shared/remote destinations
+        always resolve host-side — they are per-group/per-node picks,
+        not per-subscriber."""
         n = 0
         remote: set = set()  # (node, filter) — aggre/1 dedup
         shared: Dict[Tuple[str, str], List[str]] = {}  # (group,flt)->nodes
+        local: List[str] = []
         for flt in filters:
             for route in self.router.lookup_routes(flt):
                 dest = route.dest
@@ -203,9 +277,15 @@ class Broker:
                     group, node = dest
                     shared.setdefault((group, flt), []).append(node)
                 elif dest == self.node:
-                    n += self.dispatch(flt, msg)
+                    local.append(flt)
                 else:
                     remote.add((dest, flt))
+        if local:
+            if local_deliver is not None:
+                n += local_deliver(local)
+            else:
+                for flt in local:
+                    n += self.dispatch(flt, msg)
         for (group, flt), nodes in shared.items():
             if self.shared_router is not None:
                 # cluster: ONE delivery per group across all nodes
@@ -220,25 +300,109 @@ class Broker:
                 self.metrics.inc("messages.forward")
         return n
 
+    def _route_device(self, row: int, filters: List[str], msg: Message,
+                      st, subs_np, src_np, dovf_np, union_np, bovf_np,
+                      ids_np, id_map) -> int:
+        """Route one matched message with local delivery from the
+        device fan-out arrays (gathered sub-id slots + bitmap union)
+        instead of the ``_subscribers`` dicts."""
+        def local_deliver(local_filters: List[str]) -> int:
+            overflowed = (dovf_np is not None and dovf_np[row]) or \
+                (bovf_np is not None and bovf_np[row]) or st is None
+            if overflowed:
+                # per-message capacity exceeded: host dispatch loop
+                return sum(self.dispatch(flt, msg)
+                           for flt in local_filters)
+            n = 0
+            per_filter: Dict[str, int] = {}
+            if subs_np is not None:
+                for k in range(subs_np.shape[1]):
+                    sid = subs_np[row, k]
+                    if sid < 0:
+                        break  # slots are front-packed
+                    flt = id_map[src_np[row, k]]
+                    sub = self.helper.registry.lookup(int(sid))
+                    if sub is not None and flt is not None:
+                        d = self._deliver_one(flt, sub, msg)
+                        if d:
+                            per_filter[flt] = per_filter.get(flt, 0) + d
+            if union_np is not None and st.big_fids:
+                self._deliver_big(row, msg, st, union_np,
+                                  ids_np, id_map, per_filter)
+            for flt, cnt in per_filter.items():
+                n += cnt
+                self.metrics.inc("messages.delivered", cnt)
+                self.hooks.run("message.delivered", (msg, cnt))
+            return n
+
+        return self._route(filters, msg, local_deliver=local_deliver)
+
+    def _deliver_big(self, row: int, msg: Message, st, union_np,
+                     ids_np, id_map, per_filter: Dict[str, int]) -> None:
+        """Deliver a message's bitmap-path (>threshold) fan-out: the
+        device OR'd the matched big rows into one subscriber bitmap;
+        the tail walks its set bits, accumulating counts into
+        ``per_filter``. With multiple matched big filters each
+        (filter, member) pair delivers separately — per-subscription
+        semantics, as the reference's shard walk."""
+        matched_big = [int(j) for j in ids_np[row]
+                       if j >= 0 and int(j) in st.big_fids]
+        if not matched_big:
+            return
+        sids = unpack_sids(union_np[row])
+        if len(matched_big) == 1:
+            flt = id_map[matched_big[0]]
+            for sid in sids:
+                sub = self.helper.registry.lookup(int(sid))
+                if sub is not None:
+                    d = self._deliver_one(flt, sub, msg)
+                    if d:
+                        per_filter[flt] = per_filter.get(flt, 0) + d
+        else:
+            rows_by_fid = [(fid, id_map[fid],
+                            self.helper.members(id_map[fid]))
+                           for fid in matched_big]
+            for sid in sids:
+                isid = int(sid)
+                sub = self.helper.registry.lookup(isid)
+                if sub is None:
+                    continue
+                for fid, flt, members in rows_by_fid:
+                    if isid in members:
+                        d = self._deliver_one(flt, sub, msg)
+                        if d:
+                            per_filter[flt] = per_filter.get(flt, 0) + d
+
+    def _deliver_one(self, topic_filter: str, sub: object,
+                     msg: Message) -> int:
+        """One (filter, subscriber) delivery with the no-local check;
+        the deliver carries the *subscribed filter* so the session can
+        resolve its subopts (emqx_broker.erl:298)."""
+        opts = self._subscribers.get(topic_filter, {}).get(sub)
+        if opts is None:
+            return 0  # unsubscribed since the tables were built
+        if opts.nl and getattr(sub, "client_id", None) == msg.from_:
+            self.metrics.inc("delivery.dropped")
+            self.metrics.inc("delivery.dropped.no_local")
+            return 0
+        try:
+            sub.deliver(topic_filter, msg)
+            return 1
+        except Exception:
+            log.exception("deliver to %r failed", sub)
+            return 0
+
     def dispatch(self, topic_filter: str, msg: Message) -> int:
         """Deliver to every local subscriber of ``topic_filter``
-        (emqx_broker.erl:283-309)."""
+        (emqx_broker.erl:283-309) — the host dispatch loop, used by
+        the no-device configuration and as the per-message overflow
+        fallback of the device fan-out path."""
         ftab = self._subscribers.get(topic_filter)
         if not ftab:
             return 0
         n = 0
-        for sub, opts in list(ftab.items()):
-            if opts.nl and getattr(sub, "client_id", None) == msg.from_:
-                self.metrics.inc("delivery.dropped")
-                self.metrics.inc("delivery.dropped.no_local")
-                continue
-            try:
-                # the deliver carries the *subscribed filter* so the
-                # session can resolve its subopts (emqx_broker.erl:298)
-                sub.deliver(topic_filter, msg)
-                n += 1
-            except Exception:
-                log.exception("deliver to %r failed", sub)
+        for sub in list(ftab):
+            n += self._deliver_one(topic_filter, sub, msg)
         if n:
             self.metrics.inc("messages.delivered", n)
             self.hooks.run("message.delivered", (msg, n))

@@ -83,6 +83,42 @@ def pick_shared(
 
 
 @functools.partial(jax.jit, static_argnames=("d",))
+def gather_subscribers_src(
+    fan: FanoutTable,
+    match_ids: jax.Array,  # int32[B, M] (-1 padded)
+    *,
+    d: int = 1024,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Like :func:`gather_subscribers` but also returns the *source
+    filter id* per output slot — the broker's delivery tail needs the
+    matched filter to resolve per-subscription options (the reference
+    dispatches per ``{Topic, SubPid}`` pair, src/emqx_broker.erl:298).
+
+    Returns ``(subs[B, d], src[B, d], count[B], overflow[B])``; both
+    ``subs`` and ``src`` are -1 padded.
+    """
+    def one(ids):
+        safe = jnp.maximum(ids, 0)
+        lens = jnp.where(
+            ids >= 0, fan.row_ptr[safe + 1] - fan.row_ptr[safe], 0)
+        cum = jnp.cumsum(lens)
+        total = cum[-1]
+        starts = fan.row_ptr[safe]
+        slots = jnp.arange(d, dtype=jnp.int32)
+        row = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+        row_c = jnp.minimum(row, ids.shape[0] - 1)
+        base = cum[row_c] - lens[row_c]
+        idx = starts[row_c] + (slots - base)
+        idx = jnp.clip(idx, 0, fan.sub_ids.shape[0] - 1)
+        valid = slots < jnp.minimum(total, d)
+        subs = jnp.where(valid, fan.sub_ids[idx], -1)
+        src = jnp.where(valid, ids[row_c], -1)
+        return subs, src, total, total > d
+
+    return jax.vmap(one)(match_ids)
+
+
+@functools.partial(jax.jit, static_argnames=("d",))
 def gather_subscribers(
     fan: FanoutTable,
     match_ids: jax.Array,  # int32[B, M] (-1 padded)
@@ -94,22 +130,9 @@ def gather_subscribers(
     Returns ``(subs[B, d], count[B], overflow[B])`` where ``subs`` is
     -1 padded and ``count`` is the true delivery count (may exceed
     ``d`` — then overflow is set and only d are materialized).
-    """
-    def one(ids):
-        safe = jnp.maximum(ids, 0)
-        lens = jnp.where(
-            ids >= 0, fan.row_ptr[safe + 1] - fan.row_ptr[safe], 0)
-        cum = jnp.cumsum(lens)                      # inclusive
-        total = cum[-1]
-        starts = fan.row_ptr[safe]
-        slots = jnp.arange(d, dtype=jnp.int32)
-        row = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
-        row_c = jnp.minimum(row, ids.shape[0] - 1)
-        base = cum[row_c] - lens[row_c]             # exclusive prefix
-        idx = starts[row_c] + (slots - base)
-        idx = jnp.clip(idx, 0, fan.sub_ids.shape[0] - 1)
-        valid = slots < jnp.minimum(total, d)
-        subs = jnp.where(valid, fan.sub_ids[idx], -1)
-        return subs, total, total > d
 
-    return jax.vmap(one)(match_ids)
+    Delegates to :func:`gather_subscribers_src`, dropping the source
+    ids (XLA dead-code-eliminates the unused gather under jit).
+    """
+    subs, _, count, overflow = gather_subscribers_src(fan, match_ids, d=d)
+    return subs, count, overflow

@@ -47,6 +47,12 @@ class MatcherConfig:
     min_batch: int = 8      # batch padding bucket floor (pow2 buckets)
     use_device: bool = True
     use_native: bool = True  # C++ trie/encoder when the .so is present
+    # device fan-out (broker_helper): filters with more subscribers
+    # than the threshold move from the CSR gather to bitmap rows
+    # (the reference's ?SHARD=1024, src/emqx_broker_helper.erl:55)
+    fanout_threshold: int = 1024
+    fanout_d: int = 1024     # per-message small-filter delivery slots
+    fanout_mb: int = 16      # per-message big(bitmap)-filter slots
 
 
 class Router:
@@ -182,6 +188,10 @@ class Router:
     def topics(self) -> List[str]:
         return list(self._routes)
 
+    def has_routes(self) -> bool:
+        """O(1) emptiness probe for the publish hot path."""
+        return bool(self._routes)
+
     def lookup_routes(self, filter_: str) -> List[Route]:
         dests = self._routes.get(filter_, {})
         return [Route(filter_, d) for d in dests]
@@ -236,11 +246,13 @@ class Router:
             return auto
 
     def automaton(self) -> tuple:
-        """(automaton, id→filter snapshot) — a consistent pair."""
+        """(automaton, id→filter snapshot, epoch) — a consistent
+        triple. The epoch (rebuild counter) keys derived device state
+        (fan-out tables) to this snapshot's id space."""
         with self._lock:
             if self._dirty or self._auto is None:
                 self.rebuild()
-            return self._auto, self._auto_map
+            return self._auto, self._auto_map, self._rebuilds
 
     # -- matching (emqx_router:match_routes/1) ----------------------------
 
@@ -252,16 +264,24 @@ class Router:
             out.extend(self.lookup_routes(f))
         return out
 
-    def match_filters(self, topics: Sequence[str]) -> List[List[str]]:
-        """Batch: matched filter list per topic (device + oracle
-        fallback)."""
-        if not topics:
-            return []
-        if not self.config.use_device or not self._routes:
-            with self._lock:
-                return [self._t_match(t) for t in topics]
+    def host_match(self, topic: str) -> List[str]:
+        """Host-side exact match (the oracle fallback path)."""
+        with self._lock:
+            return self._t_match(topic)
+
+    def match_ids(self, topics: Sequence[str]):
+        """Device match of a topic batch in snapshot-id space.
+
+        Returns ``(ids_dev, ids_np, ovf_np, id_map, epoch)``:
+        ``ids_dev`` is the device int32[B_pad, M] match array (feed it
+        straight into the fan-out gather — no host round-trip),
+        ``ids_np``/``ovf_np`` are host copies sliced to ``len(topics)``,
+        and ``(id_map, epoch)`` is the automaton snapshot that gives
+        the ids meaning. Rows with ``ovf_np`` set exceeded a kernel
+        bound — resolve those topics via :meth:`host_match`.
+        """
         cfg = self.config
-        auto, id_map = self.automaton()
+        auto, id_map, epoch = self.automaton()
         B = len(topics)
         bucket = cfg.min_batch
         while bucket < B:
@@ -273,14 +293,25 @@ class Router:
         with self._lock:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n = depth_bucket(ids, n)
-        res = match_batch(auto, ids, n, sysm, k=cfg.active_k, m=cfg.max_matches)
-        mid = np.asarray(res.ids)
-        ovf = np.asarray(res.overflow)
+        res = match_batch(auto, ids, n, sysm, k=cfg.active_k,
+                          m=cfg.max_matches)
+        ids_np = np.asarray(res.ids)[:B]
+        ovf_np = np.asarray(res.overflow)[:B]
+        return res.ids, ids_np, ovf_np, id_map, epoch
+
+    def match_filters(self, topics: Sequence[str]) -> List[List[str]]:
+        """Batch: matched filter list per topic (device + oracle
+        fallback)."""
+        if not topics:
+            return []
+        if not self.config.use_device or not self._routes:
+            with self._lock:
+                return [self._t_match(t) for t in topics]
+        _, mid, ovf, id_map, _ = self.match_ids(topics)
         out: List[List[str]] = []
-        for i in range(B):
+        for i in range(len(topics)):
             if ovf[i]:
-                with self._lock:
-                    out.append(self._t_match(topics[i]))
+                out.append(self.host_match(topics[i]))
             else:
                 row = [id_map[j] for j in mid[i] if j >= 0]
                 out.append([f for f in row if f is not None])
