@@ -416,12 +416,20 @@ def main():
     }), flush=True)
 
 
+def live():
+    """BENCH_MODE=live — socket-to-deliver over loopback TCP through
+    the full broker stack (see emqx_tpu/bench_live.py)."""
+    from emqx_tpu.bench_live import live as _live
+    _live()
+
+
 # mode -> (entry fn name, success-path metric name, unit); the
 # fail-soft record must carry the SAME metric name the mode reports
 # on success, or a failed run vanishes from per-metric time series
 _MODES = {
     "bigfan": ("bigfan", "bigfan_bitmap_deliveries", "deliveries/sec"),
     "shared": ("shared", "shared_dispatch_throughput", "msgs/sec"),
+    "live": ("live", "live_socket_throughput", "msgs/sec"),
     None: ("main", "publish_match_fanout_throughput", "msgs/sec"),
 }
 

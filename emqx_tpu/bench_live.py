@@ -1,0 +1,214 @@
+"""BENCH_MODE=live — the socket-to-deliver benchmark.
+
+Round-1's bench only timed the compiled kernels; this mode measures
+the LIVE path the reference's own load tests exercise: real MQTT
+clients over loopback TCP → frame parse → channel FSM → ingress
+batcher → device match+fan-out → session → serialize → socket.
+Reference shape: emqtt-driven client suites
+(/root/reference/test/emqx_client_SUITE.erl) at benchmark scale.
+
+Publishers pipeline QoS0 PUBLISHes whose payload carries the send
+timestamp; each delivery received by a subscriber yields one latency
+sample. Reports end-to-end deliveries/sec plus p50/p99
+socket-to-deliver latency.
+
+Env knobs: LIVE_PUBS, LIVE_SUBS, LIVE_TOPICS, LIVE_SECS,
+LIVE_PIPELINE (outstanding publishes per publisher), BENCH_PLATFORM.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import struct
+import time
+
+import numpy as np
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import Parser, serialize
+from emqx_tpu.mqtt.packet import Connect, Publish, Subscribe
+
+
+class _Peer:
+    """Tiny single-purpose client (the package must not import
+    tests/); only what the bench needs: CONNECT, SUBSCRIBE, pipelined
+    QoS0 PUBLISH, and a receive loop that timestamps deliveries."""
+
+    def __init__(self, cid: str) -> None:
+        self.cid = cid
+        self.parser = Parser(version=C.MQTT_V4)
+        self.reader = None
+        self.writer = None
+        self.latencies: list = []
+        self.received = 0
+
+    async def connect(self, port: int) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", port)
+        await self._send(Connect(client_id=self.cid, clean_start=True,
+                                 proto_ver=C.MQTT_V4))
+        await self._read_packet()  # CONNACK
+
+    async def _send(self, pkt) -> None:
+        self.writer.write(serialize(pkt, C.MQTT_V4))
+        await self.writer.drain()
+
+    async def _read_packet(self):
+        while True:
+            pkts = self.parser.feed(await self.reader.read(65536))
+            if pkts:
+                return pkts[0]
+
+    async def subscribe(self, flt: str) -> None:
+        await self._send(Subscribe(packet_id=1,
+                                   topic_filters=[(flt, {"qos": 0})]))
+        await self._read_packet()  # SUBACK
+
+    async def recv_loop(self) -> None:
+        """Count deliveries + record socket-to-deliver latency from
+        the embedded send timestamp."""
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    return
+                now = time.perf_counter_ns()
+                for pkt in self.parser.feed(data):
+                    if isinstance(pkt, Publish):
+                        self.received += 1
+                        (ts,) = struct.unpack_from("<q", pkt.payload)
+                        self.latencies.append((now - ts) / 1e6)
+        except (asyncio.CancelledError, ConnectionResetError):
+            return
+
+    async def publish_loop(self, topics, stop, pipeline: int) -> int:
+        """Pipelined QoS0 publishing until ``stop`` is set; drains
+        the socket buffer every ``pipeline`` sends so the OS buffer
+        (not this coroutine) is the limiter."""
+        sent = 0
+        i = 0
+        while not stop.is_set():
+            topic = topics[i % len(topics)]
+            i += 1
+            payload = struct.pack("<q", time.perf_counter_ns())
+            self.writer.write(serialize(
+                Publish(topic=topic, payload=payload, qos=0),
+                C.MQTT_V4))
+            sent += 1
+            if sent % pipeline == 0:
+                await self.writer.drain()
+                # drain() does not yield below the high-water mark;
+                # yield explicitly so the broker/receivers run
+                await asyncio.sleep(0)
+        await self.writer.drain()
+        return sent
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+async def _run() -> dict:
+    from emqx_tpu.node import Node
+
+    n_pubs = int(os.environ.get("LIVE_PUBS", "8"))
+    n_subs = int(os.environ.get("LIVE_SUBS", "8"))
+    n_topics = int(os.environ.get("LIVE_TOPICS", "64"))
+    secs = float(os.environ.get("LIVE_SECS", "5"))
+    pipeline = int(os.environ.get("LIVE_PIPELINE", "64"))
+
+    node = Node(boot_listeners=False, batch_linger_ms=1.0)
+    lst = node.add_listener(port=0)
+    await node.start()
+
+    topics = [f"bench/t{i}/v" for i in range(n_topics)]
+    subs = []
+    for i in range(n_subs):
+        s = _Peer(f"sub{i}")
+        await s.connect(lst.port)
+        # mixed literal/wildcard subscription shapes
+        await s.subscribe("bench/+/v" if i % 2 else f"bench/t{i}/#")
+        subs.append(s)
+    recv_tasks = [asyncio.ensure_future(s.recv_loop()) for s in subs]
+
+    pubs = []
+    for i in range(n_pubs):
+        p = _Peer(f"pub{i}")
+        await p.connect(lst.port)
+        pubs.append(p)
+
+    # warmup: force the jit compiles outside the timed window
+    warm_stop = asyncio.Event()
+    warm = [asyncio.ensure_future(
+        p.publish_loop(topics, warm_stop, pipeline)) for p in pubs]
+    await asyncio.sleep(0.5)
+    warm_stop.set()
+    await asyncio.gather(*warm)
+    await asyncio.sleep(0.5)
+    for s in subs:
+        s.latencies.clear()
+        s.received = 0
+    base_flushes = node.ingress.flushes
+    base_submitted = node.ingress.submitted
+
+    stop = asyncio.Event()
+    t0 = time.perf_counter()
+    pub_tasks = [asyncio.ensure_future(
+        p.publish_loop(topics, stop, pipeline)) for p in pubs]
+    await asyncio.sleep(secs)
+    stop.set()
+    sent = sum(await asyncio.gather(*pub_tasks))
+    await asyncio.sleep(0.5)  # drain in-flight deliveries
+    elapsed = time.perf_counter() - t0
+
+    received = sum(s.received for s in subs)
+    lats = np.concatenate([np.asarray(s.latencies, dtype=np.float64)
+                           for s in subs if s.latencies]) \
+        if any(s.latencies for s in subs) else np.zeros(1)
+    flushes = node.ingress.flushes - base_flushes
+    submitted = node.ingress.submitted - base_submitted
+
+    for t in recv_tasks:
+        t.cancel()
+    for peer in subs + pubs:
+        peer.close()
+    await node.stop()
+
+    return {
+        "sent": sent,
+        "received": received,
+        "elapsed_s": round(elapsed, 3),
+        "deliveries_per_s": received / elapsed,
+        "publishes_per_s": sent / elapsed,
+        "p50_ms": float(np.percentile(lats, 50)),
+        "p99_ms": float(np.percentile(lats, 99)),
+        "avg_device_batch": round(submitted / flushes, 2) if flushes else 0,
+        "pubs": n_pubs, "subs": n_subs,
+    }
+
+
+def live() -> None:
+    import sys
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    info = asyncio.run(_run())
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "live_socket_throughput",
+        "value": round(info["deliveries_per_s"], 1),
+        "unit": "msgs/sec",
+        "vs_baseline": round(info["deliveries_per_s"] / 1_000_000, 3),
+        "p50_batch_ms": round(info["p50_ms"], 3),
+        "p99_batch_ms": round(info["p99_ms"], 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    live()

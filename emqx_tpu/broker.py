@@ -64,6 +64,9 @@ class Broker:
         self._subscriptions: Dict[object, Dict[str, SubOpts]] = {}
         # pluggable cross-node forwarder (emqx_rpc seam); set by cluster
         self.forwarder = None
+        # ingress batcher (ingress.py); Node attaches one so channels
+        # batch their PUBLISH broker calls per tick
+        self.ingress = None
         # cluster-wide shared-group router: (group, flt, nodes, msg)
         # -> local delivery count; None = single-node (local pick)
         self.shared_router = None

@@ -87,6 +87,10 @@ class Channel:
         self.on_close = None          # force-close the socket
         self.on_deliver = None        # new outbox items are ready
         self.send_oob = None          # out-of-band packet send (kick)
+        # publish futures whose acks are still pending at the ingress
+        # batcher — error-path acks queue behind them to preserve
+        # MQTT-4.6.0 ack ordering
+        self._pending_pubs: List = []
 
     # -- helpers ----------------------------------------------------------
 
@@ -315,6 +319,11 @@ class Channel:
             msg.topic = mount(self.mountpoint, msg.topic)
         try:
             if pkt.qos == C.QOS_2:
+                self.session.check_awaiting_rel(pkt.packet_id)
+            deferred = self._publish_batched(pkt, msg)
+            if deferred:
+                return []
+            if pkt.qos == C.QOS_2:
                 n = self.session.publish(pkt.packet_id, msg)
                 rc = RC.SUCCESS if n else RC.NO_MATCHING_SUBSCRIBERS
                 self.broker.metrics.inc("packets.pubrec.sent")
@@ -324,8 +333,10 @@ class Channel:
         except SessionError as e:
             if pkt.qos == C.QOS_2:
                 self.broker.metrics.inc("packets.pubrec.sent")
-                return [self._ack(C.PUBREC, pkt.packet_id,
-                                  e.rc if self.proto_ver == C.MQTT_V5 else 0)]
+                return self._emit_ordered(
+                    [self._ack(C.PUBREC, pkt.packet_id,
+                               e.rc if self.proto_ver == C.MQTT_V5
+                               else 0)])
             return self._puback_for(pkt, e.rc)
         if pkt.qos == C.QOS_1:
             rc = RC.SUCCESS if n else RC.NO_MATCHING_SUBSCRIBERS
@@ -334,13 +345,77 @@ class Channel:
                               rc if self.proto_ver == C.MQTT_V5 else 0)]
         return []
 
-    def _puback_for(self, pkt: Publish, rc: int) -> List[Packet]:
-        if pkt.qos == C.QOS_1:
-            return [self._ack(C.PUBACK, pkt.packet_id,
-                              rc if self.proto_ver == C.MQTT_V5 else 0)]
+    def _publish_batched(self, pkt: Publish, msg) -> bool:
+        """Hand the message to the ingress batcher; the QoS1/2 ack is
+        sent from the flush callback (SURVEY §2.2 row 1 — publishes
+        batched per tick into one device call). False = no batcher or
+        no event loop: caller publishes synchronously."""
+        batcher = getattr(self.broker, "ingress", None)
+        if batcher is None or self.send_oob is None:
+            return False
+        if pkt.qos == C.QOS_0:
+            # fire-and-forget: no ack to defer, no future to consume
+            return batcher.submit(msg, want_result=False) is not None
+        fut = batcher.submit(msg)
+        if fut is None:
+            return False
         if pkt.qos == C.QOS_2:
-            return [self._ack(C.PUBREC, pkt.packet_id,
-                              rc if self.proto_ver == C.MQTT_V5 else 0)]
+            # window slot reserved now (checked by the caller); the
+            # PUBREC completes when the batch lands
+            self.session.record_awaiting_rel(pkt.packet_id)
+        ack_type = C.PUBREC if pkt.qos == C.QOS_2 else C.PUBACK
+        name = "pubrec" if pkt.qos == C.QOS_2 else "puback"
+        pid = pkt.packet_id
+        self._pending_pubs.append(fut)
+
+        def _done(f) -> None:
+            try:
+                self._pending_pubs.remove(f)
+            except ValueError:
+                pass
+            if self.closed or self.send_oob is None:
+                return  # QoS1/2 clients re-send; at-least-once holds
+            if f.exception() is not None:
+                # the batch failed: do NOT ack — an ack here would be
+                # a lie the client can't recover from (at-least-once
+                # depends on its retransmit)
+                return
+            rc = RC.SUCCESS if f.result() else RC.NO_MATCHING_SUBSCRIBERS
+            self.broker.metrics.inc(f"packets.{name}.sent")
+            self.send_oob([self._ack(
+                ack_type, pid,
+                rc if self.proto_ver == C.MQTT_V5 else 0)])
+
+        fut.add_done_callback(_done)
+        return True
+
+    def _emit_ordered(self, pkts: List[Packet]) -> List[Packet]:
+        """Send ``pkts`` now — unless batched publish acks are still
+        pending on this channel, in which case they queue behind the
+        last one (MQTT-4.6.0: acks go out in the order the PUBLISHes
+        arrived)."""
+        if not self._pending_pubs or self.send_oob is None:
+            return pkts
+        last = self._pending_pubs[-1]
+
+        def _after(_f, pkts=pkts) -> None:
+            if not self.closed and self.send_oob is not None:
+                self.send_oob(pkts)
+
+        last.add_done_callback(_after)
+        return []
+
+    def _puback_for(self, pkt: Publish, rc: int) -> List[Packet]:
+        """Error-path PUBACK/PUBREC — queued behind any batched acks
+        still pending so acks keep PUBLISH arrival order."""
+        if pkt.qos == C.QOS_1:
+            return self._emit_ordered(
+                [self._ack(C.PUBACK, pkt.packet_id,
+                           rc if self.proto_ver == C.MQTT_V5 else 0)])
+        if pkt.qos == C.QOS_2:
+            return self._emit_ordered(
+                [self._ack(C.PUBREC, pkt.packet_id,
+                           rc if self.proto_ver == C.MQTT_V5 else 0)])
         return []
 
     # PUBACK family ------------------------------------------------------

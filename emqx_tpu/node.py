@@ -22,6 +22,7 @@ from emqx_tpu.ctl import Ctl
 from emqx_tpu.flapping import Flapping, FlappingConfig
 from emqx_tpu.gc import GlobalGc
 from emqx_tpu.hooks import Hooks
+from emqx_tpu.ingress import IngressBatcher
 from emqx_tpu.monitors import OsMon, SysMon, VmMon
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.modules import ModuleRegistry
@@ -43,7 +44,10 @@ class Node:
                  matcher: Optional[MatcherConfig] = None,
                  boot_listeners: bool = True,
                  sys_interval: float = 60.0,
-                 load_default_modules: bool = False) -> None:
+                 load_default_modules: bool = False,
+                 batch_ingress: bool = True,
+                 batch_size: int = 256,
+                 batch_linger_ms: float = 0.0) -> None:
         self.name = name
         self.zone = zone or get_zone()
         # kernel services (emqx_kernel_sup)
@@ -56,6 +60,13 @@ class Node:
         self.broker = Broker(router=self.router, hooks=self.hooks,
                              metrics=self.metrics, node=name)
         self.broker.tracer = self.tracer
+        # ingress batcher: PUBLISHes from all connections aggregate
+        # into one device publish_batch per tick (ingress.py)
+        self.ingress = (IngressBatcher(self.broker,
+                                       batch_size=batch_size,
+                                       linger_ms=batch_linger_ms)
+                        if batch_ingress else None)
+        self.broker.ingress = self.ingress
         # connection/session management (emqx_cm_sup)
         self.cm = ConnectionManager(broker=self.broker)
         self.broker.banned = Banned()
@@ -142,6 +153,8 @@ class Node:
         for t in self._bg_tasks:
             t.cancel()
         self._bg_tasks.clear()
+        if self.ingress is not None:
+            self.ingress.flush_now()
         for lst in self.listeners:
             await lst.stop()
         self._started = False

@@ -135,15 +135,24 @@ class Session:
     def publish(self, packet_id: Optional[int], msg: Message) -> int:
         """Returns the delivery count from the broker."""
         if msg.qos == QOS_2:
-            if (self.max_awaiting_rel
-                    and len(self.awaiting_rel) >= self.max_awaiting_rel):
-                raise SessionError(RC_RECEIVE_MAXIMUM_EXCEEDED)
-            if packet_id in self.awaiting_rel:
-                raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
+            self.check_awaiting_rel(packet_id)
             n = self.broker.publish(msg) if self.broker else 0
-            self.awaiting_rel[packet_id] = time.time()
+            self.record_awaiting_rel(packet_id)
             return n
         return self.broker.publish(msg) if self.broker else 0
+
+    def check_awaiting_rel(self, packet_id: Optional[int]) -> None:
+        """QoS2 receive-window checks, split from :meth:`publish` so
+        the batched ingress path can validate synchronously while the
+        broker call itself is deferred to the batch flush."""
+        if (self.max_awaiting_rel
+                and len(self.awaiting_rel) >= self.max_awaiting_rel):
+            raise SessionError(RC_RECEIVE_MAXIMUM_EXCEEDED)
+        if packet_id in self.awaiting_rel:
+            raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
+
+    def record_awaiting_rel(self, packet_id: Optional[int]) -> None:
+        self.awaiting_rel[packet_id] = time.time()
 
     def pubrel(self, packet_id: int) -> None:
         if packet_id not in self.awaiting_rel:

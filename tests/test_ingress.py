@@ -1,0 +1,185 @@
+"""Ingress batching: per-tick PUBLISH aggregation into one device
+call, with QoS acks deferred to the batch flush (SURVEY §2.2 row 1;
+accumulator semantics after src/emqx_batch.erl:1-91)."""
+
+import asyncio
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.ingress import IngressBatcher
+from emqx_tpu.node import Node
+from emqx_tpu.types import Message
+from mqtt_client import TestClient
+
+
+class Rec:
+    def __init__(self, cid="r"):
+        self.client_id = cid
+        self.got = []
+
+    def deliver(self, f, m):
+        self.got.append(m.topic)
+
+
+async def test_tick_aggregation_one_device_call():
+    b = Broker()
+    s = Rec()
+    b.subscribe(s, "t/+")
+    bat = IngressBatcher(b, batch_size=100)
+    futs = [bat.submit(Message(topic=f"t/{i}")) for i in range(5)]
+    assert all(f is not None for f in futs)
+    assert bat.flushes == 0  # nothing flushed inside this tick
+    await asyncio.sleep(0)   # next loop iteration -> call_soon flush
+    counts = [await f for f in futs]
+    assert counts == [1] * 5
+    assert bat.flushes == 1  # 5 messages, ONE publish_batch
+    assert s.got == [f"t/{i}" for i in range(5)]
+
+
+async def test_size_triggered_flush():
+    b = Broker()
+    s = Rec()
+    b.subscribe(s, "x")
+    bat = IngressBatcher(b, batch_size=3)
+    f1 = bat.submit(Message(topic="x"))
+    f2 = bat.submit(Message(topic="x"))
+    f3 = bat.submit(Message(topic="x"))  # cap hit: flush inline
+    assert f3.done() and f1.done() and f2.done()
+    assert bat.flushes == 1 and bat.max_batch == 3
+    assert await f1 == 1 and await f2 == 1 and await f3 == 1
+
+
+def test_submit_without_loop_falls_back():
+    b = Broker()
+    bat = IngressBatcher(b)
+    assert bat.submit(Message(topic="t")) is None  # sync caller path
+
+
+async def test_live_batched_acks_all_qos():
+    """Real sockets end to end: QoS0/1/2 publishes flow through the
+    batcher (Node default), acks complete at flush, deliveries
+    arrive."""
+    n = Node(boot_listeners=False)
+    lst = n.add_listener(port=0)
+    await n.start()
+    try:
+        sub = TestClient("sub", version=5)
+        await sub.connect(port=lst.port)
+        await sub.subscribe("a/#", qos=2)
+        pub = TestClient("pub", version=5)
+        await pub.connect(port=lst.port)
+        await pub.publish("a/zero", b"0", qos=0)
+        await pub.publish("a/one", b"1", qos=1)    # PUBACK deferred
+        await pub.publish("a/two", b"2", qos=2)    # PUBREC deferred
+        topics = sorted([(await sub.recv()).topic for _ in range(3)])
+        assert topics == ["a/one", "a/two", "a/zero"]
+        assert n.ingress.submitted == 3
+        assert n.ingress.flushes >= 1
+        await pub.disconnect()
+        await sub.disconnect()
+    finally:
+        await n.stop()
+
+
+async def test_live_concurrent_publishers_batch_together():
+    """Publishes from many connections in the same tick share one
+    flush (the whole point of ingress batching)."""
+    n = Node(boot_listeners=False, batch_linger_ms=5.0)
+    lst = n.add_listener(port=0)
+    await n.start()
+    try:
+        sub = TestClient("sub")
+        await sub.connect(port=lst.port)
+        await sub.subscribe("c/+")
+        pubs = []
+        for i in range(8):
+            p = TestClient(f"p{i}")
+            await p.connect(port=lst.port)
+            pubs.append(p)
+        # fire all QoS1 publishes concurrently: acks gate on the flush
+        await asyncio.gather(*(
+            p.publish(f"c/{i}", b"x", qos=1)
+            for i, p in enumerate(pubs)))
+        got = sorted([(await sub.recv()).topic for _ in range(8)])
+        assert got == sorted(f"c/{i}" for i in range(8))
+        assert n.ingress.submitted == 8
+        # linger collects across connections: strictly fewer flushes
+        # than messages
+        assert n.ingress.flushes < 8
+        for p in pubs:
+            await p.disconnect()
+        await sub.disconnect()
+    finally:
+        await n.stop()
+
+
+async def test_ack_order_preserved_with_error_acks():
+    """MQTT-4.6.0: a rejected PUBLISH's ack must not overtake the
+    deferred ack of an earlier accepted one."""
+    import asyncio as aio
+
+    from emqx_tpu.mqtt import constants as C
+    from emqx_tpu.mqtt.packet import Publish
+
+    n = Node(boot_listeners=False, batch_linger_ms=20.0)
+    lst = n.add_listener(port=0)
+    await n.start()
+    try:
+        c = TestClient("c", version=5)
+        await c.connect(port=lst.port)
+        # pid=7 QoS2 accepted (PUBREC defers to flush); then pid=7
+        # again -> PACKET_IDENTIFIER_IN_USE error PUBREC, which must
+        # queue BEHIND the first ack despite being ready instantly
+        await c.send(Publish(topic="q/t", qos=2, packet_id=7))
+        await c.send(Publish(topic="q/t", qos=2, packet_id=7))
+        a1 = await aio.wait_for(c.acks.get(), 5)
+        a2 = await aio.wait_for(c.acks.get(), 5)
+        assert a1.type == C.PUBREC and a2.type == C.PUBREC
+        assert a1.reason_code in (0x00, 0x10)   # no-matching-subs ok
+        assert a2.reason_code == 0x91           # identifier in use
+        c.writer.close()
+    finally:
+        await n.stop()
+
+
+async def test_flush_failure_sends_no_ack():
+    """A failed device batch must NOT be acked — the QoS1 client's
+    retransmit is the recovery path (at-least-once)."""
+    import asyncio as aio
+
+    from emqx_tpu.mqtt.packet import Publish
+
+    n = Node(boot_listeners=False)
+    lst = n.add_listener(port=0)
+    await n.start()
+    try:
+        c = TestClient("c", version=4)
+        await c.connect(port=lst.port)
+
+        def boom(msgs):
+            raise RuntimeError("device gone")
+
+        orig = n.broker.publish_batch
+        n.broker.publish_batch = boom
+        await c.send(Publish(topic="a/b", qos=1, packet_id=3))
+        with __import__("pytest").raises(aio.TimeoutError):
+            await aio.wait_for(c.acks.get(), 0.3)
+        # broker recovers -> the retransmit is acked
+        n.broker.publish_batch = orig
+        await c.send(Publish(topic="a/b", qos=1, packet_id=3, dup=True))
+        ack = await aio.wait_for(c.acks.get(), 5)
+        assert ack.packet_id == 3
+        c.writer.close()
+    finally:
+        await n.stop()
+
+
+async def test_flush_error_resolves_futures():
+    class Boom(Broker):
+        def publish_batch(self, msgs):
+            raise RuntimeError("device gone")
+
+    bat = IngressBatcher(Boom(), batch_size=2)
+    f1 = bat.submit(Message(topic="t"))
+    f2 = bat.submit(Message(topic="t"))
+    assert f1.done() and isinstance(f1.exception(), RuntimeError)
+    assert f2.done() and isinstance(f2.exception(), RuntimeError)
