@@ -35,6 +35,7 @@ from emqx_tpu import topic as T
 from emqx_tpu.oracle import TrieOracle
 from emqx_tpu.ops.csr import Automaton, build_automaton
 from emqx_tpu.ops.match import depth_bucket, match_batch
+from emqx_tpu.ops.patch import AutoPatcher, PatchOverflow
 from emqx_tpu.ops.tokenize import WordTable, encode_batch
 from emqx_tpu.types import Route
 
@@ -81,12 +82,17 @@ class Router:
         self._id_to_filter: List[Optional[str]] = []
         self._free_ids: List[int] = []
         self._auto: Optional[Automaton] = None  # live device automaton
-        # id→filter snapshot taken when _auto was built: translations
-        # of device match ids must use the map the automaton encodes,
-        # not the live one (ids are recycled across rebuilds)
-        self._auto_map: tuple = ()
+        # id→filter snapshot the automaton encodes: kept in lockstep
+        # by the patcher on incremental route changes, replaced on
+        # full rebuilds (ids are recycled across generations)
+        self._auto_map: List[Optional[str]] = []
         self._dirty = True
         self._rebuilds = 0
+        self._patches = 0
+        # O(delta) maintenance (ops/patch.py): host mirror of the live
+        # automaton; None until the first flatten
+        self._patcher: Optional[AutoPatcher] = None
+        self._grow = {"state": 1, "edge": 1}  # rebuild growth factors
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
 
@@ -143,9 +149,37 @@ class Router:
                 dests = {}
                 self._routes[filter_] = dests
                 self._t_insert(filter_, fid)
-                self._dirty = True
+                self._patch_insert(filter_, fid)
             dests[dest] = dests.get(dest, 0) + 1
             return fid
+
+    def _patch_insert(self, filter_: str, fid: int) -> None:
+        """O(depth) patch of the live automaton; falls back to a full
+        rebuild flag on capacity overflow (call under the lock)."""
+        if self._dirty or self._patcher is None:
+            self._dirty = True
+            return
+        try:
+            self._patcher.insert(filter_, fid)
+            self._map_set(fid, filter_)
+        except PatchOverflow as e:
+            kind = "state" if "state" in str(e) else "edge"
+            self._grow[kind] = 2
+            self._dirty = True
+
+    def _patch_delete(self, filter_: str, fid: int) -> None:
+        if self._dirty or self._patcher is None:
+            self._dirty = True
+            return
+        self._patcher.delete(filter_)
+        self._map_set(fid, None)
+        if self._patcher.needs_compaction(len(self._filter_ids)):
+            self._dirty = True  # tombstones dominate: re-flatten
+
+    def _map_set(self, fid: int, filter_: Optional[str]) -> None:
+        while fid >= len(self._auto_map):
+            self._auto_map.append(None)
+        self._auto_map[fid] = filter_
 
     def delete_route(self, filter_: str, dest: object = None) -> None:
         dest = self.node if dest is None else dest
@@ -162,7 +196,7 @@ class Router:
                 fid = self._filter_ids.pop(filter_)
                 self._id_to_filter[fid] = None
                 self._free_ids.append(fid)
-                self._dirty = True
+                self._patch_delete(filter_, fid)
 
     def has_route(self, filter_: str) -> bool:
         return filter_ in self._routes
@@ -212,7 +246,7 @@ class Router:
                     fid = self._filter_ids.pop(f)
                     self._id_to_filter[fid] = None
                     self._free_ids.append(fid)
-                    self._dirty = True
+                    self._patch_delete(f, fid)
 
     def stats(self) -> Dict[str, int]:
         return {
