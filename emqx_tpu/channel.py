@@ -57,12 +57,17 @@ DISCONNECTED = "disconnected"
 class Channel:
     def __init__(self, broker, cm, zone: Optional[Zone] = None,
                  peername: Tuple[str, int] = ("127.0.0.1", 0),
-                 listener: str = "tcp:default") -> None:
+                 listener: str = "tcp:default",
+                 peercert: Optional[dict] = None) -> None:
         self.broker = broker
         self.cm = cm
         self.zone = zone or get_zone()
         self.peername = peername
         self.listener = listener
+        # TLS peer certificate (getpeercert() dict) when the listener
+        # terminated TLS — the reference exposes it to auth plugins
+        # via conninfo (src/emqx_channel.erl peercert enrichment)
+        self.peercert = peercert
         self.state = IDLE
         self.proto_ver = C.MQTT_V4
         self.client_id = ""

@@ -41,9 +41,16 @@ class Connection:
         self.writer = writer
         self.zone = zone or get_zone()
         peer = writer.get_extra_info("peername") or ("?", 0)
+        peercert = None
+        ssl_obj = writer.get_extra_info("ssl_object")
+        if ssl_obj is not None:
+            try:
+                peercert = ssl_obj.getpeercert()
+            except Exception:
+                peercert = None
         self.channel = Channel(broker, cm, zone=self.zone,
                                peername=(str(peer[0]), int(peer[1])),
-                               listener=listener)
+                               listener=listener, peercert=peercert)
         self.channel.on_close = self._close_transport
         self.channel.on_deliver = self._schedule_flush
         self.channel.send_oob = self._send_packets
@@ -228,7 +235,8 @@ class Listener:
     def __init__(self, broker, cm, host: str = "127.0.0.1",
                  port: int = 1883, zone: Optional[Zone] = None,
                  name: str = "tcp:default",
-                 max_connections: int = 1024000) -> None:
+                 max_connections: int = 1024000,
+                 ssl_context=None) -> None:
         self.broker = broker
         self.cm = cm
         self.host = host
@@ -236,6 +244,9 @@ class Listener:
         self.zone = zone or get_zone()
         self.name = name
         self.max_connections = max_connections
+        # ssl.SSLContext → TLS-terminating listener (mqtt:ssl / wss);
+        # built from TlsOptions by emqx_tpu.tls.make_server_context
+        self.ssl_context = ssl_context
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._handshaking: set = set()
@@ -274,7 +285,8 @@ class Listener:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port)
+            self._on_client, self.host, self.port,
+            ssl=self.ssl_context)
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
         log.info("listener %s on %s:%s", self.name, self.host, self.port)

@@ -112,12 +112,37 @@ class Node:
 
     def add_ws_listener(self, host: str = "127.0.0.1", port: int = 8083,
                         path: str = "/mqtt", zone: Optional[Zone] = None,
-                        name: str = "ws:default"):
+                        name: str = "ws:default", ssl_context=None):
         from emqx_tpu.ws_connection import WsListener
         lst = WsListener(self.broker, self.cm, host=host, port=port,
-                         path=path, zone=zone or self.zone, name=name)
+                         path=path, zone=zone or self.zone, name=name,
+                         ssl_context=ssl_context)
         self.listeners.append(lst)
         return lst
+
+    def add_tls_listener(self, host: str = "127.0.0.1", port: int = 8883,
+                         tls_options=None, zone: Optional[Zone] = None,
+                         name: str = "ssl:default") -> Listener:
+        """TLS-terminating MQTT listener (reference mqtt:ssl via
+        esockd, src/emqx_listeners.erl:43-76)."""
+        from emqx_tpu.tls import TlsOptions, make_server_context
+        ctx = make_server_context(tls_options or TlsOptions())
+        lst = Listener(self.broker, self.cm, host=host, port=port,
+                       zone=zone or self.zone, name=name,
+                       ssl_context=ctx)
+        self.listeners.append(lst)
+        return lst
+
+    def add_wss_listener(self, host: str = "127.0.0.1", port: int = 8084,
+                         path: str = "/mqtt", tls_options=None,
+                         zone: Optional[Zone] = None,
+                         name: str = "wss:default"):
+        """TLS WebSocket listener (reference https:wss via cowboy)."""
+        from emqx_tpu.tls import TlsOptions, make_server_context
+        ctx = make_server_context(tls_options or TlsOptions())
+        return self.add_ws_listener(host=host, port=port, path=path,
+                                    zone=zone, name=name,
+                                    ssl_context=ctx)
 
     async def start(self) -> None:
         if self._started:

@@ -1,0 +1,108 @@
+"""TLS listener support: SSLContext construction from listener options.
+
+Mirrors the reference's esockd ssl_options surface
+(src/emqx_listeners.erl:43-76 starts `mqtt:ssl` listeners; the
+reference's client suite drives two-way-cert SSL,
+test/emqx_client_SUITE.erl:78-86 with fixtures in test/certs/). The
+asyncio transport stack takes a ready ``ssl.SSLContext``, so this
+module is the translation layer from EMQX-style options
+(cacertfile / certfile / keyfile / verify / fail_if_no_peer_cert)
+to a configured context, shared by the TCP-TLS listener and the WSS
+listener.
+
+TLS-PSK: Python 3.13 added ``SSLContext.set_psk_server_callback``;
+on interpreters that have it, a :class:`emqx_tpu.psk.PskAuth`
+resolver is wired straight into the handshake (the reference's
+``'tls_handshake.psk_lookup'`` hookpoint, src/emqx_psk.erl:31). On
+older interpreters the seam stays host-side (see psk.py docstring).
+"""
+
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+#: esockd-style verify atoms → ssl module constants
+_VERIFY = {
+    "verify_none": ssl.CERT_NONE,
+    "verify_peer": ssl.CERT_OPTIONAL,
+}
+
+
+@dataclass
+class TlsOptions:
+    """Listener ssl_options (reference: etc/emqx.conf listener.ssl.*)."""
+
+    certfile: Optional[str] = None
+    keyfile: Optional[str] = None
+    cacertfile: Optional[str] = None
+    #: "verify_none" | "verify_peer" (esockd atoms)
+    verify: str = "verify_none"
+    #: with verify_peer: reject clients that present no certificate
+    fail_if_no_peer_cert: bool = False
+    ciphers: Optional[str] = None
+    #: minimum protocol version, e.g. "tlsv1.2"
+    tls_version: str = "tlsv1.2"
+    #: identity→key store for TLS-PSK (3.13+ interpreters only)
+    psk: Optional[object] = None
+    #: PSK hint sent in ServerKeyExchange
+    psk_identity_hint: str = "emqx_tpu"
+
+
+_TLS_VERSIONS = {
+    "tlsv1.2": ssl.TLSVersion.TLSv1_2,
+    "tlsv1.3": ssl.TLSVersion.TLSv1_3,
+}
+
+
+def make_server_context(opts: TlsOptions) -> ssl.SSLContext:
+    """Build the server-side context for a TLS/WSS listener.
+
+    Raises ``ValueError`` at configure time when no server certificate
+    is supplied (and no PSK store that could replace it) — otherwise
+    the listener would start cleanly and every handshake would die
+    with an unexplained NO_SHARED_CIPHER.
+    """
+    if not opts.certfile and opts.psk is None:
+        raise ValueError(
+            "TLS listener needs ssl_options.certfile (or a psk store)")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = _TLS_VERSIONS.get(
+        opts.tls_version, ssl.TLSVersion.TLSv1_2)
+    if opts.certfile:
+        ctx.load_cert_chain(opts.certfile, opts.keyfile)
+    mode = _VERIFY.get(opts.verify, ssl.CERT_NONE)
+    if mode != ssl.CERT_NONE and opts.fail_if_no_peer_cert:
+        mode = ssl.CERT_REQUIRED
+    if mode != ssl.CERT_NONE and opts.cacertfile:
+        ctx.load_verify_locations(opts.cacertfile)
+    ctx.verify_mode = mode
+    if opts.ciphers:
+        ctx.set_ciphers(opts.ciphers)
+    if opts.psk is not None and hasattr(ctx, "set_psk_server_callback"):
+        lookup = opts.psk.lookup  # PskAuth → hook-chain resolver
+
+        def _psk_cb(identity):
+            key = lookup(identity or "")
+            return key if key is not None else b""
+
+        ctx.set_psk_server_callback(_psk_cb, opts.psk_identity_hint)
+    return ctx
+
+
+def make_client_context(cacertfile: Optional[str] = None,
+                        certfile: Optional[str] = None,
+                        keyfile: Optional[str] = None,
+                        verify: bool = True) -> ssl.SSLContext:
+    """Client-side context for tests and the embedded test client
+    (the role of emqtt's ssl opts in the reference suites)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if cacertfile:
+        ctx.load_verify_locations(cacertfile)
+    if certfile:
+        ctx.load_cert_chain(certfile, keyfile)
+    if not verify:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx

@@ -275,9 +275,11 @@ class WsListener(Listener):
     def __init__(self, broker, cm, host: str = "127.0.0.1",
                  port: int = 8083, path: str = "/mqtt",
                  zone: Optional[Zone] = None, name: str = "ws:default",
-                 max_connections: int = 1024000) -> None:
+                 max_connections: int = 1024000,
+                 ssl_context=None) -> None:
         super().__init__(broker, cm, host=host, port=port, zone=zone,
-                         name=name, max_connections=max_connections)
+                         name=name, max_connections=max_connections,
+                         ssl_context=ssl_context)
         self.path = path
 
     async def _handshake(self, reader, writer) -> bool:

@@ -1,0 +1,94 @@
+"""Self-signed certificate fixtures for the TLS suites.
+
+Plays the role of the reference's static ``test/certs/`` directory
+(test/emqx_client_SUITE.erl:78-86 drives one- and two-way SSL with
+cacert/cert/key fixtures) — generated at test time with
+``cryptography`` instead of checked-in PEMs.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+_NOW = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+_EXP = _NOW + datetime.timedelta(days=3650)
+
+
+def _name(cn: str) -> x509.Name:
+    return x509.Name([
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, "emqx_tpu-test"),
+        x509.NameAttribute(NameOID.COMMON_NAME, cn),
+    ])
+
+
+def _write_key(path: str, key) -> None:
+    with open(path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+
+
+def _write_cert(path: str, cert) -> None:
+    with open(path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+
+def generate_cert_chain(dirpath: str) -> dict:
+    """CA + server cert (SAN 127.0.0.1/localhost) + client cert.
+
+    Returns {"cacert", "cert", "key", "client_cert", "client_key"}
+    paths — the same roles as test/certs/{cacert,cert,key,
+    client-cert,client-key}.pem in the reference.
+    """
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("emqx-tpu-test-ca"))
+        .issuer_name(_name("emqx-tpu-test-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_NOW).not_valid_after(_EXP)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256()))
+
+    def issue(cn, san=None):
+        key = ec.generate_private_key(ec.SECP256R1())
+        b = (x509.CertificateBuilder()
+             .subject_name(_name(cn))
+             .issuer_name(ca_cert.subject)
+             .public_key(key.public_key())
+             .serial_number(x509.random_serial_number())
+             .not_valid_before(_NOW).not_valid_after(_EXP))
+        if san:
+            b = b.add_extension(x509.SubjectAlternativeName(san),
+                                critical=False)
+        return key, b.sign(ca_key, hashes.SHA256())
+
+    srv_key, srv_cert = issue("127.0.0.1", [
+        x509.DNSName("localhost"),
+        x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+    ])
+    cli_key, cli_cert = issue("test-client")
+
+    paths = {
+        "cacert": os.path.join(dirpath, "cacert.pem"),
+        "cert": os.path.join(dirpath, "cert.pem"),
+        "key": os.path.join(dirpath, "key.pem"),
+        "client_cert": os.path.join(dirpath, "client-cert.pem"),
+        "client_key": os.path.join(dirpath, "client-key.pem"),
+    }
+    _write_cert(paths["cacert"], ca_cert)
+    _write_cert(paths["cert"], srv_cert)
+    _write_key(paths["key"], srv_key)
+    _write_cert(paths["client_cert"], cli_cert)
+    _write_key(paths["client_key"], cli_key)
+    return paths

@@ -36,8 +36,9 @@ class TestClient:
         return self._pkt_id
 
     async def connect(self, host="127.0.0.1", port=1883,
-                      timeout=5.0) -> Connack:
-        self.reader, self.writer = await asyncio.open_connection(host, port)
+                      timeout=5.0, ssl=None) -> Connack:
+        self.reader, self.writer = await asyncio.open_connection(
+            host, port, ssl=ssl)
         self._task = asyncio.get_event_loop().create_task(self._read_loop())
         await self.send(Connect(
             proto_ver=self.version,

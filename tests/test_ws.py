@@ -103,9 +103,9 @@ class WsTestClient:
         self.acks = asyncio.Queue()
 
     async def connect(self, port: int, path: str = "/mqtt",
-                      subprotocol: str = "mqtt"):
+                      subprotocol: str = "mqtt", ssl=None):
         self.reader, self.writer = await asyncio.open_connection(
-            "127.0.0.1", port)
+            "127.0.0.1", port, ssl=ssl)
         key = base64.b64encode(os.urandom(16)).decode()
         req = (f"GET {path} HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
