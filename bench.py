@@ -423,6 +423,99 @@ def live():
     _live()
 
 
+def churn():
+    """BENCH_MODE=churn — match latency under route churn (VERDICT
+    round-1 item 4: 10k subscribe/s against a large filter set must
+    leave match p99 unaffected; rebuild cost amortized by O(delta)
+    patches, reference O(depth) semantics src/emqx_trie.erl:82-116).
+
+    Reports p99 batch-match latency WITH churn; ``vs_baseline`` is
+    the no-churn p99 / churn p99 ratio (1.0 = unaffected)."""
+    import sys
+    import threading
+
+    jax = _jax_with_retry()
+
+    from emqx_tpu.router import MatcherConfig, Router
+
+    rng = random.Random(0)
+    n_subs = int(os.environ.get("BENCH_SUBS", "1000000"))
+    B = int(os.environ.get("BENCH_BATCH", "256"))
+    rate = int(os.environ.get("BENCH_CHURN_RATE", "10000"))
+    iters = int(os.environ.get("BENCH_ITERS", "60"))
+
+    filters, vocab = build_filters(rng, n_subs, 64)
+    r = Router(MatcherConfig())
+    t0 = time.time()
+    for f in filters:
+        r.add_route(f)
+    topics = ["/".join(zipf_choice(rng, lvl) for lvl in vocab[:4])
+              for _ in range(B * 8)]
+    batches = [(topics[i * B:(i + 1) * B],) for i in range(8)]
+    r.match_ids(batches[0][0])  # flatten + match-kernel jit warm
+    r.add_route("warm/patch/path")  # drain-scatter jit warm (fixed
+    r.match_ids(batches[0][0])      # chunk shape: compiles once, here)
+    r.delete_route("warm/patch/path")
+    r.match_ids(batches[0][0])
+    build_s = time.time() - t0
+
+    def step(batch):
+        _, ids_np, _, _, _ = r.match_ids(batch)
+        return ids_np
+
+    p50_base, p99_base = _latency_pass(step, batches, lambda x: x, iters)
+
+    stop = threading.Event()
+    churned = [0]
+
+    def churner():
+        # alternating add/delete of fresh filters at `rate`/s: every
+        # mutation exercises the patch path (insert + tombstone)
+        i = 0
+        interval = 1.0 / max(1, rate)
+        next_t = time.perf_counter()
+        while not stop.is_set():
+            if i % 2 == 0:
+                r.add_route(f"churn/{i}/leaf")
+            else:
+                r.delete_route(f"churn/{i - 1}/leaf")
+            churned[0] += 1
+            i += 1
+            next_t += interval
+            pause = next_t - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+
+    th = threading.Thread(target=churner, daemon=True)
+    t1 = time.time()
+    th.start()
+    p50_churn, p99_churn = _latency_pass(step, batches, lambda x: x, iters)
+    stop.set()
+    th.join(timeout=5)
+    wall = time.time() - t1
+    st = r.stats()
+    info = {
+        "subs": n_subs, "batch": B, "build_s": round(build_s, 1),
+        "churn_target_rate": rate,
+        "churn_achieved_rate": round(churned[0] / max(wall, 1e-9), 1),
+        "p50_ms_no_churn": round(p50_base, 3),
+        "p99_ms_no_churn": round(p99_base, 3),
+        "p50_ms_churn": round(p50_churn, 3),
+        "rebuilds": st["rebuilds"], "patches": st["patches"],
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "churn_match_p99_ms",
+        "value": round(p99_churn, 3),
+        "unit": "ms",
+        "vs_baseline": round(p99_base / p99_churn, 3)
+        if p99_churn > 0 else 0.0,
+        "p50_batch_ms": round(p50_churn, 3),
+        "p99_batch_ms": round(p99_churn, 3),
+    }), flush=True)
+
+
 # mode -> (entry fn name, success-path metric name, unit); the
 # fail-soft record must carry the SAME metric name the mode reports
 # on success, or a failed run vanishes from per-metric time series
@@ -430,6 +523,7 @@ _MODES = {
     "bigfan": ("bigfan", "bigfan_bitmap_deliveries", "deliveries/sec"),
     "shared": ("shared", "shared_dispatch_throughput", "msgs/sec"),
     "live": ("live", "live_socket_throughput", "msgs/sec"),
+    "churn": ("churn", "churn_match_p99_ms", "ms"),
     None: ("main", "publish_match_fanout_throughput", "msgs/sec"),
 }
 

@@ -91,8 +91,13 @@ def rows_for_matches(table: BitmapTable, match_ids: jax.Array,
     out). ``mb`` bounds the number of big filters one topic can
     match; the overflow flag [B] marks topics that exceeded it
     (host fallback, as in ops.match)."""
-    safe = jnp.maximum(match_ids, 0)
-    rows = jnp.where(match_ids >= 0, table.big_row[safe], -1)
+    # ids at/above the table's filter capacity (patched into the
+    # automaton after this table was built) have no row; clamping
+    # would alias them onto the LAST filter's bitmap — an entire
+    # unrelated subscriber set
+    in_range = (match_ids >= 0) & (match_ids < table.big_row.shape[0])
+    safe = jnp.where(in_range, match_ids, 0)
+    rows = jnp.where(in_range, table.big_row[safe], -1)
     # pack valid rows to the front (cumsum+scatter, as in ops.match)
     valid = rows >= 0
     pos = jnp.cumsum(valid, axis=1) - 1

@@ -70,10 +70,14 @@ def pick_shared(
     Returns int32[B, M] subscriber ids (-1 where no pick).
     """
     def one(ids, s):
-        safe = jnp.maximum(ids, 0)
+        # ids beyond the table's filter capacity (patched into the
+        # automaton after this table was built) must drop, not clamp:
+        # a clamp would deliver to the last row's unrelated group
+        in_range = (ids >= 0) & (ids < fan.row_ptr.shape[0] - 1)
+        safe = jnp.where(in_range, ids, 0)
         lens = fan.row_ptr[safe + 1] - fan.row_ptr[safe]
         starts = fan.row_ptr[safe]
-        valid = (ids >= 0) & (lens > 0)
+        valid = in_range & (lens > 0)
         idx = starts + jnp.where(
             valid, s % jnp.maximum(lens, 1), 0)
         idx = jnp.clip(idx, 0, fan.sub_ids.shape[0] - 1)
@@ -98,9 +102,12 @@ def gather_subscribers_src(
     ``subs`` and ``src`` are -1 padded.
     """
     def one(ids):
-        safe = jnp.maximum(ids, 0)
+        # out-of-capacity ids (automaton patched past this table's
+        # build) contribute zero length — never clamp into a row
+        in_range = (ids >= 0) & (ids < fan.row_ptr.shape[0] - 1)
+        safe = jnp.where(in_range, ids, 0)
         lens = jnp.where(
-            ids >= 0, fan.row_ptr[safe + 1] - fan.row_ptr[safe], 0)
+            in_range, fan.row_ptr[safe + 1] - fan.row_ptr[safe], 0)
         cum = jnp.cumsum(lens)
         total = cum[-1]
         starts = fan.row_ptr[safe]

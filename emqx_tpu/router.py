@@ -64,6 +64,12 @@ class Router:
         self.config = config or MatcherConfig()
         self.node = node
         self._lock = threading.RLock()
+        # word-table guard, finer than _lock: interning rehashes the
+        # word map, which must not race the match path's encode reads.
+        # Matchers take ONLY this lock (briefly, around encode), so a
+        # long flatten under _lock — background compaction — never
+        # stalls them. Order: _lock before _wt_lock, never the reverse.
+        self._wt_lock = threading.RLock()
         self._native = None
         if self.config.use_native:
             try:
@@ -80,12 +86,20 @@ class Router:
         self._routes: Dict[str, Dict[object, int]] = {}
         self._filter_ids: Dict[str, int] = {}
         self._id_to_filter: List[Optional[str]] = []
+        # ids are recycled only across rebuild generations: a freed id
+        # quarantines in _pending_free until the next full flatten
+        # (which replaces the published id-map object), so any map a
+        # matcher holds is append-only + tombstone-only — a recycled
+        # id can never retranslate to a different filter mid-read
         self._free_ids: List[int] = []
+        self._pending_free: List[int] = []
         self._auto: Optional[Automaton] = None  # live device automaton
-        # id→filter snapshot the automaton encodes: kept in lockstep
-        # by the patcher on incremental route changes, replaced on
-        # full rebuilds (ids are recycled across generations)
+        # id→filter list the live automaton encodes: appended/tombstoned
+        # in place by the patcher, REPLACED (new object) on rebuild
         self._auto_map: List[Optional[str]] = []
+        # (auto, map, epoch) snapshot: one-reference read for matchers
+        # (attribute assignment is atomic — no lock on the match path)
+        self._published: Optional[tuple] = None
         self._dirty = True
         self._rebuilds = 0
         self._patches = 0
@@ -93,14 +107,22 @@ class Router:
         # automaton; None until the first flatten
         self._patcher: Optional[AutoPatcher] = None
         self._grow = {"state": 1, "edge": 1}  # rebuild growth factors
+        self._compacting = False  # background compaction in flight
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
 
     def _t_insert(self, filter_: str, fid: int) -> None:
-        if self._native is not None:
-            self._native.insert(filter_, fid)
-        else:
-            self._trie.insert(filter_)
+        with self._wt_lock:  # interning mutates the word table
+            if self._native is not None:
+                self._native.insert(filter_, fid)
+            else:
+                self._trie.insert(filter_)
+                # pre-intern literal words so the flatten (which may
+                # run on the compaction thread concurrently with
+                # encode reads) never mutates the word table
+                for w in T.words(filter_):
+                    if w not in (T.PLUS, T.HASH):
+                        self._table.intern(w)
 
     def _t_delete(self, filter_: str) -> None:
         if self._native is not None:
@@ -160,21 +182,31 @@ class Router:
             self._dirty = True
             return
         try:
-            self._patcher.insert(filter_, fid)
+            with self._wt_lock:  # patcher.insert interns new words
+                self._patcher.insert(filter_, fid)
             self._map_set(fid, filter_)
+            self._patches += 1
         except PatchOverflow as e:
-            kind = "state" if "state" in str(e) else "edge"
-            self._grow[kind] = 2
+            # the patcher may hold a dangling partial insert now
+            # (broken flag set); _dirty forces a re-flatten before
+            # any apply, so the partial queue is discarded
+            self._grow[e.kind] = 2
             self._dirty = True
 
     def _patch_delete(self, filter_: str, fid: int) -> None:
         if self._dirty or self._patcher is None:
             self._dirty = True
             return
-        self._patcher.delete(filter_)
+        with self._wt_lock:  # delete's word walk may intern
+            self._patcher.delete(filter_)
         self._map_set(fid, None)
+        self._patches += 1
         if self._patcher.needs_compaction(len(self._filter_ids)):
-            self._dirty = True  # tombstones dominate: re-flatten
+            # tombstones dominate. The tombstoned automaton is still
+            # CORRECT (just wasteful), so compaction runs on a
+            # background thread and swaps atomically — matchers never
+            # stall on it (only capacity overflows rebuild inline)
+            self._schedule_compaction()
 
     def _map_set(self, fid: int, filter_: Optional[str]) -> None:
         while fid >= len(self._auto_map):
@@ -195,7 +227,7 @@ class Router:
                 self._t_delete(filter_)
                 fid = self._filter_ids.pop(filter_)
                 self._id_to_filter[fid] = None
-                self._free_ids.append(fid)
+                self._pending_free.append(fid)
                 self._patch_delete(filter_, fid)
 
     def has_route(self, filter_: str) -> bool:
@@ -245,7 +277,7 @@ class Router:
                     self._t_delete(f)
                     fid = self._filter_ids.pop(f)
                     self._id_to_filter[fid] = None
-                    self._free_ids.append(fid)
+                    self._pending_free.append(fid)
                     self._patch_delete(f, fid)
 
     def stats(self) -> Dict[str, int]:
@@ -253,6 +285,7 @@ class Router:
             "routes.count": sum(len(d) for d in self._routes.values()),
             "topics.count": len(self._routes),
             "rebuilds": self._rebuilds,
+            "patches": self._patches,
         }
 
     # -- automaton lifecycle ---------------------------------------------
@@ -261,32 +294,96 @@ class Router:
         """Flatten the trie to a fresh automaton (double-buffered: the
         previous one stays live for concurrent matchers until swap)."""
         with self._lock:
-            prev = self._auto
-            cap_s = prev.row_ptr.shape[0] - 1 if prev is not None else None
-            cap_e = prev.edge_word.shape[0] if prev is not None else None
-            if self._native is not None:
-                auto = self._native.flatten(
-                    state_capacity=cap_s, edge_capacity=cap_e)
-            else:
-                auto = build_automaton(
-                    self._trie, self._filter_ids, self._table,
-                    state_capacity=cap_s, edge_capacity=cap_e)
-            if self.config.use_device:
-                auto = jax.device_put(auto)
-            self._auto = auto
-            self._auto_map = tuple(self._id_to_filter)
-            self._dirty = False
-            self._rebuilds += 1
-            return auto
+            return self._rebuild_locked()
+
+    def _rebuild_locked(self) -> Automaton:
+        prev = self._auto
+        cap_s = cap_e = None
+        if prev is not None:
+            # honor the growth factors a PatchOverflow requested, so
+            # near-full generations don't re-overflow immediately
+            cap_s = (prev.row_ptr.shape[0] - 1) * self._grow["state"]
+            cap_e = prev.edge_word.shape[0] * self._grow["edge"]
+        if self._native is not None:
+            host_auto = self._native.flatten(
+                state_capacity=cap_s, edge_capacity=cap_e)
+            intern = self._native.intern
+        else:
+            host_auto = build_automaton(
+                self._trie, self._filter_ids, self._table,
+                state_capacity=cap_s, edge_capacity=cap_e)
+            intern = self._table.intern
+        auto = host_auto
+        if self.config.use_device:
+            auto = jax.device_put(host_auto)
+        # the mirror copies host arrays (no device→host readback)
+        self._patcher = AutoPatcher(host_auto, intern)
+        self._auto = auto
+        self._auto_map = list(self._id_to_filter)  # NEW object: old
+        # snapshots freeze, so quarantined ids may recycle now
+        self._free_ids.extend(self._pending_free)
+        self._pending_free.clear()
+        self._dirty = False
+        self._grow = {"state": 1, "edge": 1}
+        self._rebuilds += 1
+        self._published = (auto, self._auto_map, self._rebuilds)
+        return auto
+
+    def _apply_patches_locked(self) -> None:
+        """Drain the patcher's update queue into a fresh device
+        automaton and publish it (call under the lock)."""
+        self._auto = self._patcher.apply_updates(self._auto)
+        self._published = (self._auto, self._auto_map, self._rebuilds)
+
+    def _schedule_compaction(self) -> None:
+        if self._compacting:
+            return
+        self._compacting = True
+
+        def _bg():
+            try:
+                with self._lock:
+                    # a sync rebuild may have beaten us to it (fresh
+                    # patcher, tombstones gone): re-check, don't
+                    # re-flatten for nothing
+                    if (not self._dirty and self._patcher is not None
+                            and self._patcher.needs_compaction(
+                                len(self._filter_ids))):
+                        self._rebuild_locked()
+            finally:
+                self._compacting = False
+
+        threading.Thread(target=_bg, daemon=True,
+                         name="router-compaction").start()
 
     def automaton(self) -> tuple:
         """(automaton, id→filter snapshot, epoch) — a consistent
         triple. The epoch (rebuild counter) keys derived device state
-        (fan-out tables) to this snapshot's id space."""
-        with self._lock:
-            if self._dirty or self._auto is None:
-                self.rebuild()
-            return self._auto, self._auto_map, self._rebuilds
+        (fan-out tables) to this snapshot's id space.
+
+        Fast path is lock-free: one reference read of the published
+        snapshot. The lock is taken only to re-flatten (automaton
+        dirty — capacity overflow or first build) or to drain queued
+        O(delta) patches into a new buffer generation. The dirty check
+        always precedes the patch drain: a broken patcher (partial
+        insert after overflow) is discarded by the rebuild before its
+        queue could ever reach the device."""
+        pub = self._published
+        if pub is None or self._dirty:
+            with self._lock:
+                if self._dirty or self._auto is None:
+                    self._rebuild_locked()
+                elif self._patcher is not None and self._patcher.dirty:
+                    self._apply_patches_locked()
+                return self._published
+        if self._patcher is not None and self._patcher.dirty:
+            with self._lock:
+                if self._dirty:
+                    self._rebuild_locked()
+                elif self._patcher.dirty:
+                    self._apply_patches_locked()
+                return self._published
+        return pub
 
     # -- matching (emqx_router:match_routes/1) ----------------------------
 
@@ -321,10 +418,12 @@ class Router:
         while bucket < B:
             bucket *= 2
         padded = list(topics) + ["\x00/pad"] * (bucket - B)
-        # under the lock: the native word table must not be read
-        # (wt_lookup) while a concurrent add_route interns into it —
-        # ctypes calls drop the GIL, so the map can rehash mid-read
-        with self._lock:
+        # the word table must not be read (wt_lookup) while a
+        # concurrent add_route interns into it — ctypes calls drop
+        # the GIL, so the map can rehash mid-read. The fine-grained
+        # _wt_lock (not _lock) keeps matchers running through a long
+        # background-compaction flatten
+        with self._wt_lock:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n = depth_bucket(ids, n)
         res = match_batch(auto, ids, n, sysm, k=cfg.active_k,

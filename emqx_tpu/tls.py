@@ -67,20 +67,43 @@ def make_server_context(opts: TlsOptions) -> ssl.SSLContext:
     if not opts.certfile and opts.psk is None:
         raise ValueError(
             "TLS listener needs ssl_options.certfile (or a psk store)")
+    if opts.verify not in _VERIFY:
+        # a typo ('verifyPeer') must not silently disable mutual TLS
+        raise ValueError(
+            f"unknown ssl_options.verify {opts.verify!r} "
+            f"(expected one of {sorted(_VERIFY)})")
+    psk_only = opts.psk is not None and not opts.certfile
+    if psk_only and not hasattr(ssl.SSLContext,
+                                "set_psk_server_callback"):
+        raise ValueError(
+            "PSK-only TLS listener needs Python 3.13+ "
+            "(ssl has no server-side PSK API here); add a certfile "
+            "or terminate PSK in a fronting proxy")
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.minimum_version = _TLS_VERSIONS.get(
         opts.tls_version, ssl.TLSVersion.TLSv1_2)
     if opts.certfile:
         ctx.load_cert_chain(opts.certfile, opts.keyfile)
-    mode = _VERIFY.get(opts.verify, ssl.CERT_NONE)
+    mode = _VERIFY[opts.verify]
     if mode != ssl.CERT_NONE and opts.fail_if_no_peer_cert:
         mode = ssl.CERT_REQUIRED
-    if mode != ssl.CERT_NONE and opts.cacertfile:
+    if mode != ssl.CERT_NONE:
+        if not opts.cacertfile:
+            # CERT_REQUIRED with an empty trust store rejects every
+            # client at handshake time — fail at configure time
+            raise ValueError(
+                "ssl_options.verify=verify_peer needs a cacertfile")
         ctx.load_verify_locations(opts.cacertfile)
     ctx.verify_mode = mode
     if opts.ciphers:
         ctx.set_ciphers(opts.ciphers)
     if opts.psk is not None and hasattr(ctx, "set_psk_server_callback"):
+        if psk_only:
+            # CPython PSK callbacks apply to TLS <= 1.2 only, and PSK
+            # suites are absent from the default cipher list
+            ctx.maximum_version = ssl.TLSVersion.TLSv1_2
+            if not opts.ciphers:
+                ctx.set_ciphers("PSK")
         lookup = opts.psk.lookup  # PskAuth → hook-chain resolver
 
         def _psk_cb(identity):

@@ -85,3 +85,19 @@ def test_or_empty_rows():
     rows = np.full((3, 4), -1, np.int32)
     out = np.asarray(or_bitmaps_auto(t.bitmaps, rows))
     assert out.sum() == 0
+
+
+def test_rows_for_matches_out_of_capacity_fid_drops():
+    """Clamping an out-of-capacity fid would OR in the LAST filter's
+    bitmap — an entire unrelated subscriber set."""
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops.bitmap import build_bitmaps, rows_for_matches
+
+    bm = build_bitmaps({3: [1, 2, 3]}, 4, 64)
+    f_cap = bm.big_row.shape[0]
+    ids = jnp.array([[f_cap + 1, 3, -1, -1]], dtype=jnp.int32)
+    rows, ovf = rows_for_matches(bm, ids, mb=4)
+    got = [int(r) for r in np.asarray(rows)[0] if r >= 0]
+    assert got == [0]               # only filter 3's row
+    assert not bool(np.asarray(ovf)[0])

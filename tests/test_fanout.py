@@ -72,3 +72,33 @@ def test_pick_shared_hash_strategy():
     # deterministic per seed: same seed -> same member
     out2 = np.asarray(pick_shared(fan, ids, seed))
     assert (out == out2).all()
+
+
+def test_out_of_capacity_fid_drops_not_clamps():
+    """A fid at/above the table's filter capacity (a filter patched
+    into the automaton after this table was built) must contribute
+    nothing — clamping would alias it onto the last row."""
+    import jax.numpy as jnp
+
+    fan = build_fanout({0: [10, 11], 1: [20]}, 2)
+    f_cap = fan.row_ptr.shape[0] - 1
+    ids = jnp.array([[f_cap + 3, 0, -1, -1]], dtype=jnp.int32)
+    subs, count, ovf = gather_subscribers(fan, ids, d=8)
+    got = sorted(int(s) for s in np.asarray(subs)[0] if s >= 0)
+    assert got == [10, 11]          # only filter 0's members
+    assert int(np.asarray(count)[0]) == 2
+    assert not bool(np.asarray(ovf)[0])
+
+
+def test_pick_shared_out_of_capacity_fid_drops():
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops.fanout import pick_shared
+
+    fan = build_fanout({0: [5, 6, 7]}, 1)
+    f_cap = fan.row_ptr.shape[0] - 1
+    ids = jnp.array([[f_cap + 2, 0]], dtype=jnp.int32)
+    seed = jnp.array([1], dtype=jnp.int32)
+    picks = np.asarray(pick_shared(fan, ids, seed))[0]
+    assert picks[0] == -1           # dropped, not clamped
+    assert picks[1] in (5, 6, 7)

@@ -1,0 +1,147 @@
+"""O(delta) automaton patching: parity against full re-flattens.
+
+The patcher must produce an automaton the match kernel cannot
+distinguish from a fresh flatten of the same filter set (only state
+ids differ, which the kernel never observes). Reference semantics:
+src/emqx_trie.erl:82-116 insert/delete are O(depth) row updates.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.ops.csr import build_automaton
+from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.patch import AutoPatcher, PatchOverflow
+from emqx_tpu.ops.tokenize import WordTable, encode_batch
+
+WORDS = ["a", "b", "c", "dd", "ee", "sensor", "x"]
+
+
+def _rand_filter(rng):
+    depth = rng.randint(1, 5)
+    ws = []
+    for i in range(depth):
+        p = rng.random()
+        if p < 0.2:
+            ws.append("+")
+        elif p < 0.3 and i == depth - 1:
+            ws.append("#")
+        else:
+            ws.append(rng.choice(WORDS))
+    return "/".join(ws)
+
+
+def _match_set(auto, table, fids_rev, topic):
+    ids, n, sysm = encode_batch(table, [topic] * 8, 8)
+    res = match_batch(auto, ids, n, sysm, k=32, m=64)
+    row = np.asarray(res.ids)[0]
+    assert not bool(np.asarray(res.overflow)[0])
+    return {fids_rev[j] for j in row if j >= 0}
+
+
+def _build(filters, table, caps=(None, None)):
+    trie = TrieOracle()
+    fids = {}
+    for f in filters:
+        trie.insert(f)
+        fids[f] = len(fids)
+        for w in f.split("/"):
+            if w not in ("+", "#"):
+                table.intern(w)
+    auto = build_automaton(trie, fids, table,
+                           state_capacity=caps[0], edge_capacity=caps[1])
+    return auto, fids
+
+
+def test_patched_matches_equal_fresh_flatten():
+    rng = random.Random(7)
+    table = WordTable()
+    base = sorted({_rand_filter(rng) for _ in range(40)})
+    # padded capacity so ~25 patches fit without overflow
+    auto, fids = _build(base, table, caps=(512, 512))
+    patcher = AutoPatcher(auto, table.intern)
+
+    live = dict(fids)
+    extra = sorted({_rand_filter(rng) for _ in range(60)}
+                   - set(base))[:25]
+    for f in extra:
+        fid = len(live)
+        live[f] = fid
+        patcher.insert(f, fid)
+    drops = rng.sample(base, 8)
+    for f in drops:
+        assert patcher.delete(f)
+        del live[f]
+    patched = patcher.apply_updates(auto)
+
+    # fresh flatten of the same live set = ground truth
+    t2 = WordTable()
+    fresh, fresh_fids = _build(sorted(live), t2)
+    rev_p = {v: k for k, v in live.items()}
+    rev_f = {v: k for k, v in fresh_fids.items()}
+    for _ in range(200):
+        topic = "/".join(rng.choice(WORDS)
+                         for _ in range(rng.randint(1, 5)))
+        got = _match_set(patched, table, rev_p, topic)
+        want = _match_set(fresh, t2, rev_f, topic)
+        assert got == want, (topic, got, want)
+
+
+def test_patch_is_incremental_not_queued_forever():
+    table = WordTable()
+    auto, fids = _build(["a/b"], table, caps=(64, 64))
+    p = AutoPatcher(auto, table.intern)
+    p.insert("a/c", 1)
+    assert p.dirty
+    out = p.apply_updates(auto)
+    assert not p.dirty
+    # original buffers untouched (double-buffering)
+    rev = {0: "a/b", 1: "a/c"}
+    assert _match_set(out, table, rev, "a/c") == {"a/c"}
+    assert _match_set(auto, table, rev, "a/c") == set()
+
+
+def test_overflow_marks_broken_and_blocks_apply():
+    table = WordTable()
+    auto, fids = _build(["a"], table)  # min capacity (16)
+    p = AutoPatcher(auto, table.intern)
+    with pytest.raises(PatchOverflow):
+        # deep filter: exhausts the 16-state capacity mid-walk
+        p.insert("/".join(f"w{i}" for i in range(20)), 1)
+    assert p.broken
+    with pytest.raises(PatchOverflow):
+        p.insert("b", 2)
+    with pytest.raises(PatchOverflow):
+        p.delete("a")
+    with pytest.raises(AssertionError):
+        p.apply_updates(auto)  # partial queue must never be applied
+
+
+def test_delete_missing_filter_returns_false():
+    table = WordTable()
+    auto, _ = _build(["x/y", "x/+"], table, caps=(64, 64))
+    p = AutoPatcher(auto, table.intern)
+    assert not p.delete("x/z")
+    assert not p.delete("x/y/z")
+    assert not p.delete("q/#")
+    assert not p.dirty
+    assert p.delete("x/+")
+    assert p.tombstones == 1
+
+
+def test_delete_then_reinsert_same_filter_single_drain():
+    """Both writes target the same automaton slot; the drain must
+    dedup by index (last wins) — repeated indices in one .at[].set
+    apply in implementation-defined order."""
+    table = WordTable()
+    auto, fids = _build(["a/b", "c"], table, caps=(64, 64))
+    p = AutoPatcher(auto, table.intern)
+    assert p.delete("a/b")
+    p.insert("a/b", fids["a/b"])  # same drain as the delete
+    out = p.apply_updates(auto)
+    rev = {v: k for k, v in fids.items()}
+    assert _match_set(out, table, rev, "a/b") == {"a/b"}
+    assert _match_set(out, table, rev, "c") == {"c"}

@@ -53,9 +53,13 @@ def test_filter_id_recycling():
     fid = r.filter_id("a")
     r.delete_route("a")
     r.add_route("b")
-    assert r.filter_id("b") == fid  # recycled
+    # freed ids quarantine within a buffer generation: published id
+    # maps are append-only + tombstone-only, so a concurrent matcher
+    # can never see fid retranslate to a different filter
+    assert r.filter_id("b") != fid
+    r.rebuild()  # generation swap releases the quarantine
     r.add_route("c")
-    assert r.filter_id("c") != fid
+    assert r.filter_id("c") == fid  # recycled across generations
 
 
 def test_cleanup_routes_on_nodedown():
@@ -87,3 +91,82 @@ def test_sys_topic_routing():
     r.add_route("$SYS/#")
     assert [rt.topic for rt in r.match_routes("$SYS/x")] == ["$SYS/#"]
     assert sorted(rt.topic for rt in r.match_routes("plain")) == ["#"]
+
+
+# -- O(delta) patch path (ops/patch.py wired through the router) ------------
+
+def test_patches_avoid_rebuild():
+    """Route churn after the first flatten goes through the patcher:
+    new filters match without a full re-flatten (the round-1 verdict's
+    churn-stall item)."""
+    r = _mk()
+    for i in range(20):
+        r.add_route(f"seed/{i}")
+    r.match_routes("seed/1")  # first flatten (pow2-padded capacity)
+    base = r.stats()["rebuilds"]
+    for i in range(10):  # fits the padded headroom: pure patches
+        r.add_route(f"c{i}")
+    for i in range(10):
+        assert [rt.topic for rt in r.match_routes(f"c{i}")] == [f"c{i}"]
+    st = r.stats()
+    assert st["rebuilds"] == base, "patching must not trigger rebuilds"
+    assert st["patches"] >= 10
+
+
+def test_patch_delete_tombstones():
+    r = _mk()
+    for i in range(8):
+        r.add_route(f"d/{i}")
+    r.match_routes("d/0")  # flatten
+    base = r.stats()["rebuilds"]
+    r.delete_route("d/3")
+    assert r.match_routes("d/3") == []
+    assert [rt.topic for rt in r.match_routes("d/4")] == ["d/4"]
+    assert r.stats()["rebuilds"] == base
+
+
+def test_patch_overflow_falls_back_to_rebuild():
+    """Exceeding the padded capacity mid-churn re-flattens (with
+    doubled capacity) and keeps matching correct."""
+    r = _mk()
+    r.add_route("p/0")
+    r.match_routes("p/0")
+    # way past the min capacity of the first tiny flatten
+    for i in range(1, 200):
+        r.add_route(f"p/{i}/q/{i}")
+    assert [rt.topic for rt in r.match_routes("p/7/q/7")] == ["p/7/q/7"]
+    st = r.stats()
+    assert st["rebuilds"] >= 2  # at least one overflow re-flatten
+    # after the re-flatten (doubled capacity) churn patches again
+    r.add_route("post/rebuild")
+    assert [rt.topic for rt in r.match_routes("post/rebuild")] \
+        == ["post/rebuild"]
+
+
+def test_patch_reuses_freed_id_across_generations():
+    """A fid recycled after a rebuild patches into the automaton and
+    matches the NEW filter only."""
+    r = _mk()
+    r.add_route("old/filter")
+    r.match_routes("old/filter")
+    r.delete_route("old/filter")
+    r.rebuild()
+    r.add_route("new/filter")  # recycles old's fid via the patcher
+    assert [rt.topic for rt in r.match_routes("new/filter")] \
+        == ["new/filter"]
+    assert r.match_routes("old/filter") == []
+
+
+def test_published_snapshot_is_stable_across_churn():
+    """A matcher-held (auto, map) snapshot stays translation-safe
+    while routes churn underneath it."""
+    r = _mk()
+    r.add_route("keep/a")
+    r.add_route("gone/b")
+    auto, id_map, epoch = r.automaton()
+    fid_gone = r.filter_id("gone/b")
+    r.delete_route("gone/b")      # tombstone: map[fid] -> None
+    for i in range(10):
+        r.add_route(f"more/{i}")  # appends, never rewrites fid_gone
+    assert id_map[fid_gone] is None or id_map[fid_gone] == "gone/b"
+    assert id_map[r.filter_id("keep/a")] == "keep/a"
