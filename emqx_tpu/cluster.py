@@ -101,6 +101,9 @@ class Cluster:
         node.broker.shared_router = self._route_shared
         if isinstance(self.transport, LocalTransport):
             self.transport.register(self.name, self)
+        elif hasattr(self.transport, "cluster"):
+            # socket transport: inbound RPCs route back through us
+            self.transport.cluster = self
 
     # -- membership (ekka) ------------------------------------------------
 
@@ -120,6 +123,36 @@ class Cluster:
                 self._push_owned_routes()
             else:
                 self.transport.call(m, "push_routes")
+
+    def join_remote(self, host: str, port: int) -> None:
+        """Join a cluster through a peer's socket address (the
+        ``emqx_ctl cluster join`` flow over the wire): fetch the
+        peer's member + address book, merge, propagate the union to
+        every member, then sync routes all around — the same protocol
+        :meth:`join` runs for in-process peers."""
+        tr = self.transport
+        info = tr.call_addr((host, port), "cluster_info")
+        addrs = dict(info["addrs"])
+        # the peer self-reports its bind address, which may be
+        # unroutable from here (0.0.0.0, loopback on another host);
+        # the dialed address demonstrably works — use it, and
+        # propagate it to the rest of the cluster
+        addrs[info["name"]] = (host, port)
+        addrs.update(tr.addr_book())
+        union = sorted(set(self.members) | set(info["members"]))
+        for m, a in addrs.items():
+            if m != self.name:
+                tr.register_peer(m, *a)
+        for m in union:
+            if m == self.name:
+                self._set_members(union)
+            else:
+                tr.call(m, "set_members_net", union, addrs)
+        for m in union:
+            if m == self.name:
+                self._push_owned_routes()
+            else:
+                tr.call(m, "push_routes")
 
     def _set_members(self, members: List[str]) -> None:
         with self._lock:
@@ -315,6 +348,15 @@ class Cluster:
             return self._local_takeover(args[0])
         if op == "set_members":
             return self._set_members(args[0])
+        if op == "cluster_info":
+            return {"name": self.name, "members": list(self.members),
+                    "addrs": self.transport.addr_book()}
+        if op == "set_members_net":
+            members, addrs = args
+            for m, a in addrs.items():
+                if m != self.name:
+                    self.transport.register_peer(m, *a)
+            return self._set_members(members)
         if op == "push_routes":
             return self._push_owned_routes()
         if op == "nodedown":

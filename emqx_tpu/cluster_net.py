@@ -1,0 +1,292 @@
+"""Socket transport for the cluster: the gen_rpc data plane over TCP.
+
+The reference's distribution stack runs two planes (SURVEY §2.3):
+gen_rpc TCP clients keyed per node for the data plane
+(src/emqx_rpc.erl:33-60) and native distribution for control calls.
+Here one asyncio TCP link per peer carries both, behind the same
+:class:`~emqx_tpu.cluster.Transport` seam the in-process
+``LocalTransport`` implements — the Cluster logic cannot tell them
+apart (that seam-isolation is the reference's own testing strategy,
+SURVEY §4).
+
+Design:
+
+  - **Own IO thread.** The transport runs a private event loop on a
+    daemon thread. Synchronous ``call``/``cast`` from broker code
+    (which may itself be running on the node's server loop) submit
+    work to the IO loop and — for calls — block on a future with a
+    timeout. Data-plane forwards use ``cast`` (fire-and-forget), so
+    the publish path never blocks on a peer.
+  - **Inbound dispatch on the owner loop.** Received RPCs mutate
+    broker/session state whose wakeups (``call_soon``) must land on
+    the node's serving loop; the transport therefore trampolines
+    inbound handling onto the loop captured at ``serve()`` time and
+    only falls back to inline execution in loop-less (sync test)
+    processes.
+  - **Frames.** 4-byte big-endian length + pickle of
+    ``(kind, req_id, payload)``. Pickle is acceptable here for the
+    same reason Erlang term transfer is: a cluster link is a trusted,
+    cookie-gated channel between co-versioned peers (the reference
+    gates distribution with the Erlang cookie). The listener rejects
+    peers whose hello does not carry the shared cookie.
+  - **Per-peer connection cache** with lazy (re)connect, mirroring
+    gen_rpc's per-key client sockets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from emqx_tpu.cluster import Transport
+
+log = logging.getLogger("emqx_tpu.cluster_net")
+
+_LEN = struct.Struct(">I")
+_MAX_FRAME = 64 * 1024 * 1024
+_HELLO, _CAST, _CALL, _REPLY, _ERR = "hello", "cast", "call", "reply", "err"
+
+
+async def _send_frame(writer: asyncio.StreamWriter, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    writer.write(_LEN.pack(len(data)) + data)
+    await writer.drain()
+
+
+async def _recv_frame(reader: asyncio.StreamReader):
+    head = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(head)
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"cluster frame too large: {n}")
+    return pickle.loads(await reader.readexactly(n))
+
+
+class SocketTransport(Transport):
+    """TCP transport between OS-process nodes.
+
+    One instance per node: ``serve()`` starts the listener (and the
+    IO thread), ``register_peer`` records peer addresses (propagated
+    cluster-wide by ``Cluster.join_remote``).
+    """
+
+    def __init__(self, name: str, host: str = "127.0.0.1",
+                 port: int = 0, cookie: str = "emqxtpu",
+                 call_timeout: float = 10.0) -> None:
+        self.name = name
+        self.host = host
+        self.port = port           # actual port known after serve()
+        self.cookie = cookie
+        self.call_timeout = call_timeout
+        self.cluster = None        # set by Cluster.attach_transport
+        self._peers: Dict[str, Tuple[str, int]] = {}
+        self._conns: Dict[Tuple[str, int], tuple] = {}  # addr -> (r, w, lock)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._owner_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve(self) -> Tuple[str, int]:
+        """Start the IO thread + listener; returns the bound addr.
+        Captures the caller's running loop (if any) as the owner loop
+        for inbound dispatch."""
+        try:
+            self._owner_loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._owner_loop = None
+        self._thread = threading.Thread(
+            target=self._io_main, daemon=True,
+            name=f"cluster-io-{self.name}")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise ConnectionError("cluster transport failed to start")
+        return self.host, self.port
+
+    def _io_main(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._on_peer, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def close(self) -> None:
+        if self._loop is None:
+            return
+
+        async def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            for _, w, _l in list(self._conns.values()):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            self._conns.clear()
+            self._loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
+            self._thread.join(timeout=5)
+        except Exception:
+            pass
+
+    # -- address book ------------------------------------------------------
+
+    def register_peer(self, node: str, host: str, port: int) -> None:
+        self._peers[node] = (host, port)
+
+    def addr_book(self) -> Dict[str, Tuple[str, int]]:
+        book = dict(self._peers)
+        book[self.name] = (self.host, self.port)
+        return book
+
+    # -- outbound ----------------------------------------------------------
+
+    def cast(self, node: str, op: str, *args) -> None:
+        addr = self._peers.get(node)
+        if addr is None:
+            raise ConnectionError(f"unknown node: {node}")
+        fut = asyncio.run_coroutine_threadsafe(
+            self._send(addr, (_CAST, 0, (op, args))), self._loop)
+        try:
+            fut.result(timeout=self.call_timeout)
+        except (ConnectionError, asyncio.TimeoutError, OSError,
+                asyncio.IncompleteReadError) as e:
+            raise ConnectionError(f"cast to {node} failed: {e}") from e
+
+    def call(self, node: str, op: str, *args):
+        addr = self._peers.get(node)
+        if addr is None:
+            raise ConnectionError(f"unknown node: {node}")
+        return self.call_addr(addr, op, *args)
+
+    def call_addr(self, addr: Tuple[str, int], op: str, *args):
+        """Call a peer by raw address (used before its name is known
+        — the join handshake)."""
+        fut = asyncio.run_coroutine_threadsafe(
+            self._request(addr, op, args), self._loop)
+        try:
+            return fut.result(timeout=self.call_timeout)
+        except (ConnectionError, asyncio.TimeoutError, OSError,
+                asyncio.IncompleteReadError, TimeoutError) as e:
+            raise ConnectionError(f"call {op} to {addr} failed: {e}") from e
+
+    async def _connect(self, addr: Tuple[str, int]):
+        ent = self._conns.get(addr)
+        if ent is not None and not ent[1].is_closing():
+            return ent
+        reader, writer = await asyncio.open_connection(*addr)
+        await _send_frame(writer, (_HELLO, 0, (self.name, self.cookie)))
+        kind, _, ok = await _recv_frame(reader)
+        if kind != _REPLY or not ok:
+            writer.close()
+            raise ConnectionError(f"cluster hello rejected by {addr}")
+        ent = (reader, writer, asyncio.Lock())
+        self._conns[addr] = ent
+        return ent
+
+    async def _send(self, addr, frame) -> None:
+        reader, writer, lock = await self._connect(addr)
+        try:
+            async with lock:
+                await _send_frame(writer, frame)
+        except (ConnectionError, OSError):
+            self._conns.pop(addr, None)
+            raise
+
+    async def _request(self, addr, op, args):
+        reader, writer, lock = await self._connect(addr)
+        try:
+            async with lock:  # one in-flight call per link: serialize
+                await _send_frame(writer, (_CALL, 1, (op, args)))
+                while True:
+                    kind, _, payload = await _recv_frame(reader)
+                    if kind == _REPLY:
+                        return payload
+                    if kind == _ERR:
+                        raise RuntimeError(f"remote error: {payload}")
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            self._conns.pop(addr, None)
+            raise
+
+    # -- inbound -----------------------------------------------------------
+
+    async def _on_peer(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        name = None
+        try:
+            kind, _, (name, cookie) = await _recv_frame(reader)
+            if kind != _HELLO or cookie != self.cookie:
+                name = None
+                await _send_frame(writer, (_REPLY, 0, False))
+                return
+            await _send_frame(writer, (_REPLY, 0, True))
+            while True:
+                kind, req, (op, args) = await _recv_frame(reader)
+                if kind == _CAST:
+                    try:
+                        await self._dispatch(op, args)
+                    except Exception:
+                        log.exception("cast %s from %s failed", op, peer)
+                elif kind == _CALL:
+                    try:
+                        res = await self._dispatch(op, args)
+                        await _send_frame(writer, (_REPLY, req, res))
+                    except Exception as e:
+                        log.exception("call %s from %s failed", op, peer)
+                        await _send_frame(writer, (_ERR, req, repr(e)))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            # Erlang-distribution semantics: losing an established
+            # link from a peer IS a nodedown (a TCP write to a dead
+            # peer doesn't error until the retransmit gives up, so
+            # cast failure alone detects death far too late)
+            if name is not None and self.cluster is not None \
+                    and name in self._peers:
+                try:
+                    await self._dispatch("nodedown", (name,))
+                except Exception:
+                    log.exception("nodedown dispatch for %s failed", name)
+
+    async def _dispatch(self, op: str, args):
+        """Run one inbound RPC on the node's serving loop (state
+        wakeups must land there); inline on the IO thread when the
+        node runs loop-less (sync tests)."""
+        if self.cluster is None:
+            raise RuntimeError("transport not attached to a cluster")
+        owner = self._owner_loop
+        if owner is not None and owner.is_running():
+            cfut: "asyncio.Future" = self._loop.create_future()
+
+            def _run():
+                try:
+                    res = self.cluster.handle_rpc(op, *args)
+                    self._loop.call_soon_threadsafe(
+                        cfut.set_result, res)
+                except Exception as e:
+                    self._loop.call_soon_threadsafe(cfut.set_exception, e)
+
+            owner.call_soon_threadsafe(_run)
+            return await cfut
+        return self.cluster.handle_rpc(op, *args)

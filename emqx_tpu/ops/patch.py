@@ -257,8 +257,16 @@ class AutoPatcher:
         col = [(c, i, v) for (c, i), v in col_d.items()]
         ht_d = {(b, s): (st, w, ch) for b, s, st, w, ch in ht}
         ht = [(b, s, st, w, ch) for (b, s), (st, w, ch) in ht_d.items()]
-        n = _CHUNK
         while col or ht:
+            # largest ladder rung the remaining backlog fills: a big
+            # idle-accumulated queue drains in few passes instead of
+            # ceil(K/128) full-capacity copies
+            rem = max(len(col), len(ht))
+            n = _CHUNKS[-1]  # smallest rung is the floor
+            for size in _CHUNKS:
+                if rem >= size:
+                    n = size
+                    break
             c_part, col = col[:n], col[n:]
             h_part, ht = ht[:n], ht[n:]
             ci = np.full((3, n), _OOB, dtype=np.int32)
@@ -280,7 +288,10 @@ class AutoPatcher:
                              n_edges=self.n_edges)
 
 
-_CHUNK = 128  # fixed drain chunk: one jit shape for every drain
+# drain chunk ladder, largest first: bounded compile count (one
+# specialization per rung), small steady-state pad, few passes for
+# a large idle-accumulated backlog
+_CHUNKS = (32768, 4096, 128)
 
 
 @jax.jit

@@ -349,6 +349,14 @@ class Router:
                     if (not self._dirty and self._patcher is not None
                             and self._patcher.needs_compaction(
                                 len(self._filter_ids))):
+                        # drain queued patches FIRST: with the queue
+                        # clean, matchers arriving during the long
+                        # flatten stay on the lock-free fast path
+                        # (patcher.dirty would send them to the
+                        # locked branch — stalling the whole match
+                        # plane for the flatten)
+                        if self._patcher.dirty:
+                            self._apply_patches_locked()
                         self._rebuild_locked()
             finally:
                 self._compacting = False
@@ -369,21 +377,22 @@ class Router:
         insert after overflow) is discarded by the rebuild before its
         queue could ever reach the device."""
         pub = self._published
-        if pub is None or self._dirty:
-            with self._lock:
-                if self._dirty or self._auto is None:
-                    self._rebuild_locked()
-                elif self._patcher is not None and self._patcher.dirty:
-                    self._apply_patches_locked()
-                return self._published
-        if self._patcher is not None and self._patcher.dirty:
-            with self._lock:
-                if self._dirty:
-                    self._rebuild_locked()
-                elif self._patcher.dirty:
-                    self._apply_patches_locked()
-                return self._published
-        return pub
+        if pub is not None and not self._dirty and not (
+                self._patcher is not None and self._patcher.dirty):
+            return pub
+        with self._lock:
+            return self._sync_locked()
+
+    def _sync_locked(self) -> tuple:
+        """Bring the published snapshot current (call under the
+        lock). Dirty check FIRST — that ordering is the invariant
+        that discards a broken patcher's partial queue via the
+        rebuild before it could ever be applied."""
+        if self._dirty or self._auto is None:
+            self._rebuild_locked()
+        elif self._patcher is not None and self._patcher.dirty:
+            self._apply_patches_locked()
+        return self._published
 
     # -- matching (emqx_router:match_routes/1) ----------------------------
 

@@ -79,6 +79,12 @@ def make_server_context(opts: TlsOptions) -> ssl.SSLContext:
             "PSK-only TLS listener needs Python 3.13+ "
             "(ssl has no server-side PSK API here); add a certfile "
             "or terminate PSK in a fronting proxy")
+    if psk_only and opts.tls_version == "tlsv1.3":
+        # PSK callbacks apply to TLS <= 1.2 only; min 1.3 + max 1.2
+        # would build a context no handshake can satisfy
+        raise ValueError(
+            "PSK-only TLS is a TLS <= 1.2 feature; "
+            "tls_version must be tlsv1.2")
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.minimum_version = _TLS_VERSIONS.get(
         opts.tls_version, ssl.TLSVersion.TLSv1_2)
