@@ -112,17 +112,7 @@ class Cluster:
         to/from every member (transitive: all members of both sides
         learn the union)."""
         union = sorted(set(self.members) | set(other.members))
-        for m in union:
-            if m == self.name:
-                self._set_members(union)
-            else:
-                self.transport.call(m, "set_members", union)
-        # every member pushes its owned routes to every other member
-        for m in union:
-            if m == self.name:
-                self._push_owned_routes()
-            else:
-                self.transport.call(m, "push_routes")
+        self._propagate_union(union)
 
     def join_remote(self, host: str, port: int) -> None:
         """Join a cluster through a peer's socket address (the
@@ -139,20 +129,35 @@ class Cluster:
         # propagate it to the rest of the cluster
         addrs[info["name"]] = (host, port)
         addrs.update(tr.addr_book())
+        if tr.host in ("0.0.0.0", "::", ""):
+            # same problem in reverse: advertise the local interface
+            # the working dial went out of, not the wildcard bind
+            local_ip = tr.local_ip_for((host, port))
+            if local_ip:
+                addrs[self.name] = (local_ip, tr.port)
         union = sorted(set(self.members) | set(info["members"]))
         for m, a in addrs.items():
             if m != self.name:
                 tr.register_peer(m, *a)
+        self._propagate_union(union, addrs)
+
+    def _propagate_union(self, union: List[str],
+                         addrs: Optional[Dict] = None) -> None:
+        """Tell every member the merged membership (and, over a
+        socket transport, the address book), then sync routes all
+        around — shared by in-process join and join_remote."""
         for m in union:
             if m == self.name:
                 self._set_members(union)
+            elif addrs is not None:
+                self.transport.call(m, "set_members_net", union, addrs)
             else:
-                tr.call(m, "set_members_net", union, addrs)
+                self.transport.call(m, "set_members", union)
         for m in union:
             if m == self.name:
                 self._push_owned_routes()
             else:
-                tr.call(m, "push_routes")
+                self.transport.call(m, "push_routes")
 
     def _set_members(self, members: List[str]) -> None:
         with self._lock:
@@ -348,6 +353,8 @@ class Cluster:
             return self._local_takeover(args[0])
         if op == "set_members":
             return self._set_members(args[0])
+        if op == "ping":
+            return "pong"
         if op == "cluster_info":
             return {"name": self.name, "members": list(self.members),
                     "addrs": self.transport.addr_book()}

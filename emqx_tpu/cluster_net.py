@@ -155,19 +155,40 @@ class SocketTransport(Transport):
         book[self.name] = (self.host, self.port)
         return book
 
+    def local_ip_for(self, addr: Tuple[str, int]) -> Optional[str]:
+        """The local interface IP a connection to ``addr`` uses —
+        the routable self-advertisement when bound to a wildcard."""
+        async def _sockname():
+            _, writer, _ = await self._connect(addr)
+            sn = writer.get_extra_info("sockname")
+            return sn[0] if sn else None
+
+        try:
+            return asyncio.run_coroutine_threadsafe(
+                _sockname(), self._loop).result(timeout=self.call_timeout)
+        except Exception:
+            return None
+
     # -- outbound ----------------------------------------------------------
 
     def cast(self, node: str, op: str, *args) -> None:
+        """Fire-and-forget (gen_rpc async cast): enqueue on the IO
+        loop and return — the publish path must never block on a
+        peer. Raises only for an unknown node; a dead peer is
+        detected by the link monitor (EOF → probe → nodedown), not
+        by the sender."""
         addr = self._peers.get(node)
         if addr is None:
             raise ConnectionError(f"unknown node: {node}")
         fut = asyncio.run_coroutine_threadsafe(
             self._send(addr, (_CAST, 0, (op, args))), self._loop)
-        try:
-            fut.result(timeout=self.call_timeout)
-        except (ConnectionError, asyncio.TimeoutError, OSError,
-                asyncio.IncompleteReadError) as e:
-            raise ConnectionError(f"cast to {node} failed: {e}") from e
+
+        def _done(f):
+            exc = f.exception()
+            if exc is not None:
+                log.debug("cast %s to %s failed: %s", op, node, exc)
+
+        fut.add_done_callback(_done)
 
     def call(self, node: str, op: str, *args):
         addr = self._peers.get(node)
@@ -259,22 +280,45 @@ class SocketTransport(Transport):
             except Exception:
                 pass
             # Erlang-distribution semantics: losing an established
-            # link from a peer IS a nodedown (a TCP write to a dead
-            # peer doesn't error until the retransmit gives up, so
-            # cast failure alone detects death far too late)
+            # link from a peer signals nodedown (a TCP write to a
+            # dead peer doesn't error until the retransmit gives up,
+            # so cast failure alone detects death far too late). But
+            # a transient drop (idle middlebox reset) must NOT purge
+            # a live member — probe before declaring death.
             if name is not None and self.cluster is not None \
                     and name in self._peers:
-                try:
-                    await self._dispatch("nodedown", (name,))
-                except Exception:
-                    log.exception("nodedown dispatch for %s failed", name)
+                self._loop.create_task(self._probe_then_nodedown(name))
+
+    async def _probe_then_nodedown(self, name: str) -> None:
+        addr = self._peers.get(name)
+        for attempt in range(3):
+            try:
+                self._conns.pop(addr, None)  # force a fresh dial
+                if await self._request(addr, "ping", ()) == "pong":
+                    return  # alive: the drop was transient
+            except Exception:
+                pass
+            await asyncio.sleep(0.3 * (attempt + 1))
+        try:
+            await self._dispatch("nodedown", (name,))
+        except Exception:
+            log.exception("nodedown dispatch for %s failed", name)
 
     async def _dispatch(self, op: str, args):
-        """Run one inbound RPC on the node's serving loop (state
-        wakeups must land there); inline on the IO thread when the
-        node runs loop-less (sync tests)."""
+        """Run one inbound RPC.
+
+        Control-plane ops touch only lock-guarded router/cluster
+        state and run directly on the IO thread — crucially, they
+        stay serviceable while the owner loop is blocked in a
+        synchronous outbound ``call`` (two nodes joining each other
+        simultaneously would otherwise deadlock until timeout).
+        Data/session ops (forwards, takeover, discard) mutate session
+        state whose wakeups must land on the node's serving loop, so
+        they trampoline there."""
         if self.cluster is None:
             raise RuntimeError("transport not attached to a cluster")
+        if op not in _OWNER_OPS:
+            return self.cluster.handle_rpc(op, *args)
         owner = self._owner_loop
         if owner is not None and owner.is_running():
             cfut: "asyncio.Future" = self._loop.create_future()
@@ -290,3 +334,10 @@ class SocketTransport(Transport):
             owner.call_soon_threadsafe(_run)
             return await cfut
         return self.cluster.handle_rpc(op, *args)
+
+
+#: ops that touch per-session state: must run on the node's serving
+#: loop. Everything else (membership, routes, registry, ping) is
+#: lock-guarded and runs on the IO thread.
+_OWNER_OPS = frozenset(
+    {"forward", "forward_shared", "discard_client", "takeover_client"})

@@ -69,6 +69,7 @@ class Connection:
         self._gc = (GcPolicy(*self.zone.force_gc_policy)
                     if self.zone.force_gc_policy else None)
         self._timers: list = []
+        self._loop = None  # serving loop, captured by run()
 
     # -- IO ----------------------------------------------------------------
 
@@ -89,11 +90,24 @@ class Connection:
 
     def _schedule_flush(self) -> None:
         """Wake the writer when the broker delivered into our session
-        from another connection's task."""
+        from another connection's task — or from another THREAD (the
+        cluster IO thread delivering a forwarded publish): the wakeup
+        must land on this connection's own loop, never the caller's."""
+        loop = self._loop
+        if loop is None:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                self._flush_deliver()  # loop-less (sync tests)
+                return
         try:
-            asyncio.get_running_loop().call_soon(self._flush_deliver)
+            running = asyncio.get_running_loop()
         except RuntimeError:
-            self._flush_deliver()  # no loop (sync tests): flush inline
+            running = None
+        if running is loop:
+            loop.call_soon(self._flush_deliver)
+        else:
+            loop.call_soon_threadsafe(self._flush_deliver)
 
     def _flush_deliver(self) -> None:
         if self._closing:
@@ -118,6 +132,7 @@ class Connection:
 
     async def run(self) -> None:
         """The connection loop: read → parse → channel → write."""
+        self._loop = asyncio.get_running_loop()
         idle_deadline = time.time() + self.zone.idle_timeout
         try:
             while not self._closing:

@@ -176,9 +176,12 @@ def publish_step(
 ):
     """The full multi-chip publish step.
 
-    Returns (match_ids [B, T*m], sub_ids [B, T*d], stats) where stats
-    is a dict of mesh-summed counters (matches, deliveries, overflows)
-    — the device metric accumulator.
+    Returns ``(match_ids [B, T*m], sub_ids [B, T*d], overflow [B],
+    stats)``: per-row overflow marks topics whose match or fan-out
+    exceeded a kernel bound on ANY trie shard (the caller resolves
+    those host-side — same contract as the single-chip
+    ``match_batch``), and stats is a dict of mesh-summed counters
+    (matches, deliveries, overflows) — the device metric accumulator.
     """
     T = mesh.shape["trie"]
 
@@ -202,17 +205,21 @@ def publish_step(
         # the union of all trie shards' match ids
         all_ids = jax.lax.all_gather(res.ids, "trie", axis=1, tiled=True)
         all_subs = jax.lax.all_gather(subs, "trie", axis=1, tiled=True)
+        # per-row overflow, OR-reduced over the trie axis: one shard
+        # overflowing means the row's union is incomplete
+        row_ovf = jax.lax.psum(
+            (res.overflow | dovf).astype(jnp.int32), "trie") > 0
         stats = {
             "matches": jax.lax.psum(jnp.sum(res.count), ("data", "trie")),
             "deliveries": jax.lax.psum(jnp.sum(dcount), ("data", "trie")),
             "overflows": jax.lax.psum(
                 jnp.sum(res.overflow | dovf), ("data", "trie")),
         }
-        return all_ids, all_subs, stats
+        return all_ids, all_subs, row_ovf, stats
 
     return jax.shard_map(
         local, mesh=mesh,
         in_specs=(P("trie"), P("trie"), P("data"), P("data"), P("data")),
-        out_specs=(P("data"), P("data"), P()),
+        out_specs=(P("data"), P("data"), P("data"), P()),
         check_vma=False,  # scan carries start replicated, become varying
     )(auto, fan, word_ids, n_words, sys_mask)

@@ -48,6 +48,11 @@ class MatcherConfig:
     min_batch: int = 8      # batch padding bucket floor (pow2 buckets)
     use_device: bool = True
     use_native: bool = True  # C++ trie/encoder when the .so is present
+    # multi-chip: a (data × trie) jax Mesh shards the filter set over
+    # the 'trie' axis and the publish batch over 'data'; matching goes
+    # through parallel.sharded.publish_step (ICI all-gather of match
+    # ids). BASELINE config 5's product path.
+    mesh: Optional[object] = None
     # device fan-out (broker_helper): filters with more subscribers
     # than the threshold move from the CSR gather to bitmap rows
     # (the reference's ?SHARD=1024, src/emqx_broker_helper.erl:55)
@@ -71,7 +76,10 @@ class Router:
         # stalls them. Order: _lock before _wt_lock, never the reverse.
         self._wt_lock = threading.RLock()
         self._native = None
-        if self.config.use_native:
+        # sharded (multi-chip) mode flattens per trie shard through
+        # the Python builder — the native engine owns one monolithic
+        # trie, so it stays off when a mesh is configured
+        if self.config.use_native and self.config.mesh is None:
             try:
                 from emqx_tpu.ops import native as _native_mod
                 if _native_mod.available():
@@ -108,6 +116,7 @@ class Router:
         self._patcher: Optional[AutoPatcher] = None
         self._grow = {"state": 1, "edge": 1}  # rebuild growth factors
         self._compacting = False  # background compaction in flight
+        self._dummy_fan = None    # sharded publish_step filler fan
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
 
@@ -296,7 +305,9 @@ class Router:
         with self._lock:
             return self._rebuild_locked()
 
-    def _rebuild_locked(self) -> Automaton:
+    def _rebuild_locked(self):
+        if self.config.mesh is not None:
+            return self._rebuild_sharded_locked()
         prev = self._auto
         cap_s = cap_e = None
         if prev is not None:
@@ -325,6 +336,37 @@ class Router:
         self._pending_free.clear()
         self._dirty = False
         self._grow = {"state": 1, "edge": 1}
+        self._rebuilds += 1
+        self._published = (auto, self._auto_map, self._rebuilds)
+        return auto
+
+    def _rebuild_sharded_locked(self):
+        """Flatten the filter set into per-shard automatons stacked
+        over the mesh's trie axis (parallel/sharded.py). Sharded mode
+        trades O(delta) patching for scale: mutations re-flatten
+        (the shard assignment is round-robin over the sorted filter
+        set, so a stable set keeps stable shards)."""
+        from emqx_tpu.parallel.sharded import (
+            ShardedFanout, build_sharded, place_sharded, shard_filters)
+
+        mesh = self.config.mesh
+        n_trie = mesh.shape["trie"]
+        filters = sorted(self._routes)
+        shards = shard_filters(filters, n_trie)
+        auto = build_sharded(shards, self._filter_ids, self._table)
+        auto = place_sharded(mesh, auto)
+        if self._dummy_fan is None:
+            # publish_step's fan input when the caller only matches
+            # (with_fanout=False): minimal, never read
+            self._dummy_fan = place_sharded(mesh, ShardedFanout(
+                row_ptr=np.zeros((n_trie, 2), np.int32),
+                sub_ids=np.full((n_trie, 1), -1, np.int32)))
+        self._auto = auto
+        self._auto_map = list(self._id_to_filter)
+        self._free_ids.extend(self._pending_free)
+        self._pending_free.clear()
+        self._patcher = None
+        self._dirty = False
         self._rebuilds += 1
         self._published = (auto, self._auto_map, self._rebuilds)
         return auto
@@ -421,6 +463,8 @@ class Router:
         bound — resolve those topics via :meth:`host_match`.
         """
         cfg = self.config
+        if cfg.mesh is not None:
+            return self._match_ids_sharded(topics)
         auto, id_map, epoch = self.automaton()
         B = len(topics)
         bucket = cfg.min_batch
@@ -440,6 +484,32 @@ class Router:
         ids_np = np.asarray(res.ids)[:B]
         ovf_np = np.asarray(res.overflow)[:B]
         return res.ids, ids_np, ovf_np, id_map, epoch
+
+    def _match_ids_sharded(self, topics: Sequence[str]):
+        """Multi-chip match: the batch is sharded over the mesh's
+        'data' axis, each trie shard matches its slice, match ids are
+        all-gathered over ICI. Same return contract as
+        :meth:`match_ids` (the ids array is [B_pad, T*m])."""
+        from emqx_tpu.parallel.sharded import place_batch, publish_step
+
+        cfg = self.config
+        mesh = cfg.mesh
+        auto, id_map, epoch = self.automaton()
+        B = len(topics)
+        unit = cfg.min_batch * mesh.shape["data"]
+        bucket = unit  # bucket must split evenly over the data axis
+        while bucket < B:
+            bucket *= 2
+        padded = list(topics) + ["\x00/pad"] * (bucket - B)
+        with self._wt_lock:
+            ids, n, sysm = self._encode(padded, cfg.max_levels)
+        ids, n, sysm = place_batch(mesh, ids, n, sysm)
+        all_ids, _subs, ovf, _stats = publish_step(
+            mesh, auto, self._dummy_fan, ids, n, sysm,
+            k=cfg.active_k, m=cfg.max_matches, d=8, with_fanout=False)
+        ids_np = np.asarray(all_ids)[:B]
+        ovf_np = np.asarray(ovf)[:B]
+        return all_ids, ids_np, ovf_np, id_map, epoch
 
     def match_filters(self, topics: Sequence[str]) -> List[List[str]]:
         """Batch: matched filter list per topic (device + oracle

@@ -65,7 +65,7 @@ def test_sharded_match_parity(n_data, n_trie):
     fan_d = place_sharded(mesh, fan)
     b = place_batch(mesh, ids_np, n_np, sys_np)
 
-    ids, subs, stats = publish_step(
+    ids, subs, ovf, stats = publish_step(
         mesh, auto_d, fan_d, *b, k=32, m=32, d=64)
     ids = np.asarray(ids)
     subs = np.asarray(subs)
@@ -90,3 +90,81 @@ def rows_lookup(rows, fid):
         if fid in shard_rows:
             return shard_rows[fid]
     return []
+
+
+# -- product integration: Router on a mesh (VERDICT round-1 item 7) ---------
+
+def test_router_sharded_match_parity():
+    """Router(mesh=...) matches through publish_step with exact
+    oracle parity — BASELINE config 5's product path on the virtual
+    8-device mesh."""
+    import random
+
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.parallel.mesh import default_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+
+    rng = random.Random(3)
+    mesh = default_mesh(8)
+    r = Router(MatcherConfig(mesh=mesh), node="n1")
+    oracle = TrieOracle()
+    words = ["a", "b", "c", "dd", "s"]
+    filters = set()
+    while len(filters) < 60:
+        depth = rng.randint(1, 4)
+        ws = [rng.choice(words + ["+"]) for _ in range(depth)]
+        if rng.random() < 0.2:
+            ws[-1] = "#"
+        filters.add("/".join(ws))
+    for f in filters:
+        r.add_route(f)
+        oracle.insert(f)
+    topics = ["/".join(rng.choice(words) for _ in range(rng.randint(1, 4)))
+              for _ in range(40)]
+    got = r.match_filters(topics)
+    for t, g in zip(topics, got):
+        assert sorted(g) == sorted(oracle.match(t)), t
+
+
+def test_router_sharded_mutation_rebuilds():
+    from emqx_tpu.parallel.mesh import default_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+
+    r = Router(MatcherConfig(mesh=default_mesh(8)), node="n1")
+    r.add_route("a/+")
+    assert [f for [f] in [r.match_filters(["a/x"])[0]]] == ["a/+"]
+    base = r.stats()["rebuilds"]
+    r.add_route("b/#")
+    assert sorted(r.match_filters(["b/z/q"])[0]) == ["b/#"]
+    assert r.stats()["rebuilds"] == base + 1  # sharded mode re-flattens
+    r.delete_route("a/+")
+    assert r.match_filters(["a/x"])[0] == []
+
+
+def test_broker_on_mesh_end_to_end():
+    """Full product stack on the mesh: Broker.publish fans out via
+    the sharded match + the real FanoutManager tables."""
+    from emqx_tpu.broker import Broker
+    from emqx_tpu.parallel.mesh import default_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+    from emqx_tpu.types import Message
+
+    class Rec:
+        def __init__(self):
+            self.got = []
+
+        def deliver(self, topic, msg):
+            self.got.append((topic, msg.payload))
+
+    mesh = default_mesh(8)
+    b = Broker(router=Router(MatcherConfig(mesh=mesh), node="local"))
+    subs = [Rec() for _ in range(12)]
+    for i, s in enumerate(subs):
+        b.subscribe(s, f"room/{i}/+")
+    everyone = Rec()
+    b.subscribe(everyone, "room/#")
+    n = b.publish(Message(topic="room/3/temp", payload=b"hot"))
+    assert n == 2  # room/3/+ and room/#
+    assert subs[3].got == [("room/3/+", b"hot")]
+    assert all(not s.got for j, s in enumerate(subs) if j != 3)
+    assert everyone.got == [("room/#", b"hot")]
