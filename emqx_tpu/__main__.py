@@ -1,0 +1,55 @@
+"""``python -m emqx_tpu [--config etc/emqx_tpu.toml]`` — run a broker
+node (the reference's ``emqx start`` / emqx_app boot,
+src/emqx_app.erl:31-44)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="emqx_tpu", description="TPU-native MQTT broker node")
+    ap.add_argument("--config", "-c", default=None,
+                    help="TOML config file (see etc/emqx_tpu.toml)")
+    ap.add_argument("--port", type=int, default=1883,
+                    help="TCP listener port when no config file is given")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+
+    from emqx_tpu.logger import setup as setup_logger
+    setup_logger(level=getattr(logging, args.log_level.upper(), logging.INFO))
+
+    if args.config:
+        from emqx_tpu.config import boot_from_file
+        node = boot_from_file(args.config)
+    else:
+        from emqx_tpu.node import Node
+        node = Node(boot_listeners=False)
+        node.add_listener(host=args.host, port=args.port)
+
+    async def run():
+        await node.start()
+        for lst in node.listeners:
+            print(f"listening: {lst.name} on {lst.host}:{lst.port}",
+                  flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        await node.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

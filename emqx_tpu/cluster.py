@@ -92,6 +92,8 @@ class Cluster:
         # sessions so cross-node takeover can find the owner
         self._registry: Dict[str, str] = {}
         node.cm.cluster = self
+        if hasattr(node, "cluster"):
+            node.cluster = self  # node-level accessor (ctl, config)
         # intercept local route mutations for replication
         self._orig_add = node.router.add_route
         self._orig_del = node.router.delete_route

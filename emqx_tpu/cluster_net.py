@@ -287,13 +287,22 @@ class SocketTransport(Transport):
             # a live member — probe before declaring death.
             if name is not None and self.cluster is not None \
                     and name in self._peers:
-                self._loop.create_task(self._probe_then_nodedown(name))
+                coro = self._probe_then_nodedown(name)
+                try:
+                    self._loop.create_task(coro)
+                except RuntimeError:  # transport shutting down
+                    coro.close()
 
     async def _probe_then_nodedown(self, name: str) -> None:
         addr = self._peers.get(name)
         for attempt in range(3):
             try:
-                self._conns.pop(addr, None)  # force a fresh dial
+                stale = self._conns.pop(addr, None)  # force fresh dial
+                if stale is not None:
+                    try:
+                        stale[1].close()  # don't leak the old socket
+                    except Exception:
+                        pass
                 if await self._request(addr, "ping", ()) == "pong":
                     return  # alive: the drop was transient
             except Exception:

@@ -1,0 +1,201 @@
+"""Config-file layer: TOML → zones, listeners, node settings.
+
+The reference boots from a 2,257-line ``etc/emqx.conf`` rendered by
+cuttlefish into app env, then snapshotted into zones for lock-free
+per-connection reads (src/emqx_zone.erl:89-95; zone sections at
+etc/emqx.conf:698-907; listeners carry their zone,
+src/emqx_listeners.erl:43-76). This module is that pipeline with
+TOML (stdlib ``tomllib``) as the schema language:
+
+    [node]
+    name = "emqx_tpu@127.0.0.1"
+    sys_interval = 60.0
+    cookie = "secret"          # cluster transport cookie
+    cluster_port = 4370        # 0 = ephemeral, omit = no transport
+
+    [zones.default]
+    max_packet_size = 1048576
+    allow_anonymous = true
+
+    [zones.external]
+    idle_timeout = 10.0
+    ratelimit_bytes_in = [102400, 204800]   # (rate/sec, burst)
+
+    [[listeners]]
+    type = "tcp"               # tcp | ws | ssl | wss
+    port = 1883
+    zone = "external"
+
+    [[listeners]]
+    type = "ssl"
+    port = 8883
+    certfile = "etc/certs/cert.pem"
+    keyfile = "etc/certs/key.pem"
+    cacertfile = "etc/certs/cacert.pem"
+    verify = "verify_peer"
+    fail_if_no_peer_cert = true
+
+Unknown zone keys are rejected (a typo must not silently fall back
+to a default — the cuttlefish schema gives the reference the same
+property).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from typing import Any, Dict, List, Optional
+
+from emqx_tpu.zone import Zone, set_zone
+
+#: Zone fields that arrive from TOML as 2-lists but are tuples in the
+#: dataclass ((rate, burst) pairs; force_gc_policy is (count, bytes))
+_TUPLE_FIELDS = {"ratelimit_msg_in", "ratelimit_bytes_in",
+                 "quota_conn_messages", "force_gc_policy"}
+
+_LISTENER_TYPES = {"tcp", "ws", "ssl", "wss"}
+_TLS_KEYS = {"certfile", "keyfile", "cacertfile", "verify",
+             "fail_if_no_peer_cert", "ciphers", "tls_version"}
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class ListenerConfig:
+    type: str
+    port: int
+    host: str = "127.0.0.1"
+    zone: str = "default"
+    name: Optional[str] = None
+    path: str = "/mqtt"          # ws/wss
+    max_connections: int = 1024000
+    tls: Optional[dict] = None   # ssl/wss: TlsOptions kwargs
+
+
+@dataclasses.dataclass
+class NodeConfig:
+    name: str = "emqx_tpu@127.0.0.1"
+    sys_interval: float = 60.0
+    cookie: Optional[str] = None
+    cluster_port: Optional[int] = None
+    zones: Dict[str, Zone] = dataclasses.field(default_factory=dict)
+    listeners: List[ListenerConfig] = dataclasses.field(
+        default_factory=list)
+    load_default_modules: bool = False
+
+
+def _build_zone(name: str, raw: Dict[str, Any]) -> Zone:
+    known = {f.name for f in dataclasses.fields(Zone)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown zone setting: zones.{name}.{key}")
+        if key in _TUPLE_FIELDS and isinstance(val, list):
+            val = tuple(val)
+        kwargs[key] = val
+    return Zone(name=name, **kwargs)
+
+
+def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
+    raw = dict(raw)
+    ltype = raw.pop("type", None)
+    if ltype not in _LISTENER_TYPES:
+        raise ConfigError(
+            f"listeners[{i}].type must be one of {sorted(_LISTENER_TYPES)},"
+            f" got {ltype!r}")
+    if "port" not in raw:
+        raise ConfigError(f"listeners[{i}] needs a port")
+    tls = {k: raw.pop(k) for k in list(raw) if k in _TLS_KEYS}
+    if ltype in ("ssl", "wss") and "certfile" not in tls:
+        raise ConfigError(f"listeners[{i}] ({ltype}) needs a certfile")
+    if ltype in ("tcp", "ws") and tls:
+        # an operator who sets certfile on a tcp listener meant ssl;
+        # serving plaintext on a port believed TLS-terminated is the
+        # worst possible silent fallback
+        raise ConfigError(
+            f"listeners[{i}] ({ltype}) does not take TLS settings "
+            f"({sorted(tls)}); did you mean type = \"ssl\"/\"wss\"?")
+    known = {f.name for f in dataclasses.fields(ListenerConfig)}
+    for key in raw:
+        if key not in known:
+            raise ConfigError(f"unknown listener setting: "
+                              f"listeners[{i}].{key}")
+    return ListenerConfig(type=ltype, tls=tls or None, **raw)
+
+
+def load_config(path: str) -> NodeConfig:
+    """Parse + validate a TOML config file into a NodeConfig."""
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    return parse_config(raw)
+
+
+def parse_config(raw: Dict[str, Any]) -> NodeConfig:
+    cfg = NodeConfig()
+    node = raw.get("node", {})
+    for key in node:
+        if key not in ("name", "sys_interval", "cookie", "cluster_port",
+                       "load_default_modules"):
+            raise ConfigError(f"unknown node setting: node.{key}")
+    cfg.name = node.get("name", cfg.name)
+    cfg.sys_interval = float(node.get("sys_interval", cfg.sys_interval))
+    cfg.cookie = node.get("cookie")
+    cfg.cluster_port = node.get("cluster_port")
+    cfg.load_default_modules = bool(
+        node.get("load_default_modules", False))
+    for name, zraw in raw.get("zones", {}).items():
+        cfg.zones[name] = _build_zone(name, zraw)
+    for i, lraw in enumerate(raw.get("listeners", [])):
+        lc = _build_listener(i, lraw)
+        if lc.zone != "default" and lc.zone not in cfg.zones:
+            # same invariant as unknown keys: a zone typo must not
+            # silently run the listener with default limits
+            raise ConfigError(
+                f"listeners[{i}].zone {lc.zone!r} is not defined "
+                f"(zones: {sorted(cfg.zones) or ['default']})")
+        cfg.listeners.append(lc)
+    return cfg
+
+
+def build_node(cfg: NodeConfig):
+    """Instantiate a Node (listeners attached, not yet started) from
+    a parsed config; registers the zones globally so ``get_zone``
+    resolves them (the reference's ETS zone snapshot)."""
+    from emqx_tpu.node import Node
+    from emqx_tpu.tls import TlsOptions
+
+    for zone in cfg.zones.values():
+        set_zone(zone)
+    default = cfg.zones.get("default")
+    node = Node(name=cfg.name, zone=default,
+                sys_interval=cfg.sys_interval,
+                load_default_modules=cfg.load_default_modules,
+                boot_listeners=False)
+    for i, lc in enumerate(cfg.listeners):
+        zone = cfg.zones.get(lc.zone)
+        name = lc.name or f"{lc.type}:{i}"
+        kw = dict(host=lc.host, port=lc.port, zone=zone, name=name,
+                  max_connections=lc.max_connections)
+        if lc.type == "tcp":
+            node.add_listener(**kw)
+        elif lc.type == "ws":
+            node.add_ws_listener(path=lc.path, **kw)
+        elif lc.type == "ssl":
+            node.add_tls_listener(tls_options=TlsOptions(**lc.tls), **kw)
+        else:  # wss
+            node.add_wss_listener(path=lc.path,
+                                  tls_options=TlsOptions(**lc.tls), **kw)
+    if cfg.cluster_port is not None:
+        # socket transport + cluster agent come up inside
+        # node.start() (the transport needs the serving loop)
+        node.enable_cluster(port=cfg.cluster_port,
+                            cookie=cfg.cookie or "emqxtpu")
+    return node
+
+
+def boot_from_file(path: str):
+    """One-call boot: ``node = await boot_from_file(...).start()``
+    pattern — returns the built (unstarted) Node."""
+    return build_node(load_config(path))

@@ -91,6 +91,10 @@ class Node:
         self._load_default_modules = load_default_modules
         self._started = False
         self._bg_tasks: list = []
+        # cluster agent (set by enable_cluster + start, or by an
+        # externally constructed Cluster attaching itself)
+        self.cluster = None
+        self._cluster_cfg: Optional[tuple] = None
         self.stats.register_update(self._update_stats)
 
     # convenience accessors
@@ -104,45 +108,61 @@ class Node:
 
     def add_listener(self, host: str = "127.0.0.1", port: int = 1883,
                      zone: Optional[Zone] = None,
-                     name: str = "tcp:default") -> Listener:
+                     name: str = "tcp:default",
+                     max_connections: int = 1024000) -> Listener:
         lst = Listener(self.broker, self.cm, host=host, port=port,
-                       zone=zone or self.zone, name=name)
+                       zone=zone or self.zone, name=name,
+                       max_connections=max_connections)
         self.listeners.append(lst)
         return lst
 
     def add_ws_listener(self, host: str = "127.0.0.1", port: int = 8083,
                         path: str = "/mqtt", zone: Optional[Zone] = None,
-                        name: str = "ws:default", ssl_context=None):
+                        name: str = "ws:default", ssl_context=None,
+                        max_connections: int = 1024000):
         from emqx_tpu.ws_connection import WsListener
         lst = WsListener(self.broker, self.cm, host=host, port=port,
                          path=path, zone=zone or self.zone, name=name,
-                         ssl_context=ssl_context)
+                         ssl_context=ssl_context,
+                         max_connections=max_connections)
         self.listeners.append(lst)
         return lst
 
     def add_tls_listener(self, host: str = "127.0.0.1", port: int = 8883,
                          tls_options=None, zone: Optional[Zone] = None,
-                         name: str = "ssl:default") -> Listener:
+                         name: str = "ssl:default",
+                         max_connections: int = 1024000) -> Listener:
         """TLS-terminating MQTT listener (reference mqtt:ssl via
         esockd, src/emqx_listeners.erl:43-76)."""
         from emqx_tpu.tls import TlsOptions, make_server_context
         ctx = make_server_context(tls_options or TlsOptions())
         lst = Listener(self.broker, self.cm, host=host, port=port,
                        zone=zone or self.zone, name=name,
-                       ssl_context=ctx)
+                       ssl_context=ctx,
+                       max_connections=max_connections)
         self.listeners.append(lst)
         return lst
 
     def add_wss_listener(self, host: str = "127.0.0.1", port: int = 8084,
                          path: str = "/mqtt", tls_options=None,
                          zone: Optional[Zone] = None,
-                         name: str = "wss:default"):
+                         name: str = "wss:default",
+                         max_connections: int = 1024000):
         """TLS WebSocket listener (reference https:wss via cowboy)."""
         from emqx_tpu.tls import TlsOptions, make_server_context
         ctx = make_server_context(tls_options or TlsOptions())
         return self.add_ws_listener(host=host, port=port, path=path,
                                     zone=zone, name=name,
-                                    ssl_context=ctx)
+                                    ssl_context=ctx,
+                                    max_connections=max_connections)
+
+    def enable_cluster(self, port: int = 0, host: str = "127.0.0.1",
+                       cookie: str = "emqxtpu") -> None:
+        """Arrange for a socket cluster transport + Cluster agent to
+        come up during :meth:`start` (the transport captures the
+        serving loop). ``node.cluster.join_remote(host, port)`` joins
+        a peer once started."""
+        self._cluster_cfg = (host, port, cookie)
 
     async def start(self) -> None:
         if self._started:
@@ -153,6 +173,15 @@ class Node:
             self.add_listener()
         for lst in self.listeners:
             await lst.start()
+        if self._cluster_cfg is not None and self.cluster is None:
+            from emqx_tpu.cluster import Cluster
+            from emqx_tpu.cluster_net import SocketTransport
+            host, port, cookie = self._cluster_cfg
+            tr = SocketTransport(self.name, host=host, port=port,
+                                 cookie=cookie)
+            tr.serve()
+            self.cluster = Cluster(self, transport=tr)
+            log.info("cluster transport on %s:%s", tr.host, tr.port)
         # vm_mon watches the node-wide connection count, so the
         # watermark denominator is the summed listener capacity
         total_cap = sum(lst.max_connections for lst in self.listeners)
@@ -182,6 +211,10 @@ class Node:
             self.ingress.flush_now()
         for lst in self.listeners:
             await lst.stop()
+        if self.cluster is not None and self._cluster_cfg is not None:
+            close = getattr(self.cluster.transport, "close", None)
+            if close is not None:
+                close()
         self._started = False
 
     async def _housekeeping(self) -> None:
