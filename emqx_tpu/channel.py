@@ -33,6 +33,7 @@ from emqx_tpu.logger import set_metadata_clientid, set_metadata_peername
 from emqx_tpu.mountpoint import mount, replvar, unmount
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqtt.frame import serialize as wire_serialize
 from emqx_tpu.mqtt_caps import PUB_DROP_CODES, check_pub, check_sub
 from emqx_tpu.mqtt.packet import (Auth, Connack, Connect, Disconnect,
                                   PacketError, Packet, PubAck, Publish,
@@ -79,6 +80,12 @@ class Channel:
         self.acl_cache = AclCache()
         self.access = AccessControl(broker.hooks, self.zone)
         self.alias_in: Dict[int, str] = {}   # v5 inbound topic aliases
+        # v5 outbound aliases: per-connection, bounded by the
+        # client's Topic-Alias-Maximum (src/emqx_channel.erl
+        # topic alias out, :1244-1301)
+        self.alias_out: Dict[str, int] = {}
+        self.client_alias_max = 0
+        self.client_max_packet: Optional[int] = None
         self.mountpoint: Optional[str] = None
         self.connected_at: Optional[float] = None
         self.disconnect_reason: Optional[str] = None
@@ -236,6 +243,13 @@ class Channel:
             if receive_max:
                 sess_opts["max_inflight"] = min(
                     sess_opts["max_inflight"] or receive_max, receive_max)
+            # client-side limits the server must honor on delivery:
+            # outbound aliases (MQTT-3.1.2-26) and the hard cap on
+            # packets we may send (MQTT-3.1.2-24: drop, don't send)
+            self.client_alias_max = int(
+                pkt.properties.get("Topic-Alias-Maximum", 0) or 0)
+            self.client_max_packet = pkt.properties.get(
+                "Maximum-Packet-Size")
         self.session, session_present = self.cm.open_session(
             client_id, pkt.clean_start, self, sess_opts)
         self.session.broker = self.broker
@@ -616,6 +630,33 @@ class Channel:
             pub = from_message(pid, msg)
             if self.proto_ver != C.MQTT_V5:
                 pub.properties = {}
+            if self.client_max_packet and len(
+                    wire_serialize(pub, self.proto_ver)) \
+                    > self.client_max_packet:
+                # MQTT-3.1.2-24: may not send past the client's cap.
+                # Gate BEFORE alias assignment (the client must never
+                # be told an alias whose defining packet it never got)
+                # and BEFORE the sent metrics; the inflight slot is
+                # released as 'discarded but acknowledged'.
+                self.broker.metrics.inc("delivery.dropped")
+                self.broker.metrics.inc("delivery.dropped.too_large")
+                if pid is not None and self.session is not None:
+                    self.session.discard_delivery(pid)
+                continue
+            if self.proto_ver == C.MQTT_V5 and self.client_alias_max:
+                # server-side alias assignment: first delivery of a
+                # topic carries name + alias, repeats carry only the
+                # alias (empty topic) — saving the topic bytes on
+                # every hot-topic delivery
+                pub.properties = dict(pub.properties or {})
+                alias = self.alias_out.get(pub.topic)
+                if alias is not None:
+                    pub.properties["Topic-Alias"] = alias
+                    pub.topic = ""
+                elif len(self.alias_out) < self.client_alias_max:
+                    alias = len(self.alias_out) + 1
+                    self.alias_out[pub.topic] = alias
+                    pub.properties["Topic-Alias"] = alias
             self.broker.metrics.inc("packets.publish.sent")
             self.broker.metrics.inc_sent(msg)
             out.append(pub)

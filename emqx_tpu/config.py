@@ -196,6 +196,6 @@ def build_node(cfg: NodeConfig):
 
 
 def boot_from_file(path: str):
-    """One-call boot: ``node = await boot_from_file(...).start()``
-    pattern — returns the built (unstarted) Node."""
+    """Build a Node from a config file (listeners attached, not yet
+    started): ``node = boot_from_file(path); await node.start()``."""
     return build_node(load_config(path))

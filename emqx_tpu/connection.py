@@ -79,8 +79,31 @@ class Connection:
         return data
 
     def _send_packets(self, pkts) -> None:
+        from emqx_tpu.mqtt.packet import Publish
+        max_out = self.channel.client_max_packet
         for pkt in pkts:
             data = serialize(pkt, self.channel.proto_ver)
+            if max_out and len(data) > max_out:
+                # MQTT-3.1.2-24 covers EVERY packet. PUBLISHes are
+                # gated in Channel.handle_deliver (before alias and
+                # inflight effects); this is the backstop plus the
+                # non-PUBLISH handling: trim optional properties,
+                # and if the packet still can't fit, close rather
+                # than violate the client's declared limit.
+                if isinstance(pkt, Publish):
+                    self.broker.metrics.inc("delivery.dropped")
+                    self.broker.metrics.inc("delivery.dropped.too_large")
+                    continue
+                if getattr(pkt, "properties", None):
+                    pkt.properties = {}
+                    data = serialize(pkt, self.channel.proto_ver)
+                if len(data) > max_out:
+                    log.warning(
+                        "cannot fit %s under client max packet %d: "
+                        "closing %s", type(pkt).__name__, max_out,
+                        self.channel.peername)
+                    self._close_transport()
+                    return
             self.send_bytes += len(data)
             self.send_pkts += 1
             self.broker.metrics.inc("packets.sent")

@@ -294,12 +294,20 @@ class Parser:
                 qos = opts & 0x03
                 if self.strict and qos > 2:
                     raise FrameError("bad_subqos")
-                filters.append((flt, {
-                    "qos": qos,
-                    "nl": (opts >> 2) & 0x01,
-                    "rap": (opts >> 3) & 0x01,
-                    "rh": (opts >> 4) & 0x03,
-                }))
+                if v5:
+                    filters.append((flt, {
+                        "qos": qos,
+                        "nl": (opts >> 2) & 0x01,
+                        "rap": (opts >> 3) & 0x01,
+                        "rh": (opts >> 4) & 0x03,
+                    }))
+                else:
+                    # v3/v3.1.1: the byte is Requested QoS only; the
+                    # upper bits are reserved [MQTT-3.8.3-4]
+                    if self.strict and opts & 0xFC:
+                        raise FrameError("bad_subopts_reserved_bits")
+                    filters.append((flt, {"qos": qos, "nl": 0,
+                                          "rap": 0, "rh": 0}))
             if self.strict and not filters:
                 raise FrameError("empty_topic_filters")
             return Subscribe(packet_id=pid, properties=props,
@@ -447,8 +455,14 @@ def serialize(pkt: Packet, version: int = C.MQTT_V4) -> bytes:
         if v5:
             body += _ser_props(pkt.properties)
         for flt, opts in pkt.topic_filters:
-            o = (opts.get("qos", 0) | (opts.get("nl", 0) << 2)
-                 | (opts.get("rap", 0) << 3) | (opts.get("rh", 0) << 4))
+            if v5:
+                o = (opts.get("qos", 0) | (opts.get("nl", 0) << 2)
+                     | (opts.get("rap", 0) << 3)
+                     | (opts.get("rh", 0) << 4))
+            else:
+                # v3/v3.1.1: QoS only; upper bits reserved-zero
+                # [MQTT-3.8.3-4]
+                o = opts.get("qos", 0)
             body += _w_str(flt) + bytes([o])
     elif isinstance(pkt, Suback):
         body = _w_u16(pkt.packet_id)

@@ -172,6 +172,16 @@ class Session:
         self.dequeue()
         return msg
 
+    def discard_delivery(self, packet_id: int) -> None:
+        """Release an inflight slot for a PUBLISH the transport could
+        not legally send (client Maximum-Packet-Size, MQTT-3.1.2-24:
+        the message is 'discarded but treated as acknowledged') —
+        without this the slot leaks and the retry timer re-drops the
+        same message forever."""
+        if self.inflight.lookup(packet_id) is not None:
+            self.inflight.delete(packet_id)
+            self.dequeue()
+
     def pubrec(self, packet_id: int) -> Message:
         val = self.inflight.lookup(packet_id)
         if val is None:

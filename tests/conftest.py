@@ -24,6 +24,20 @@ import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _zone_isolation():
+    """The zone registry is process-global (the reference's ETS
+    snapshot); tests that register zones (config-file suite) must
+    not leak them — a poisoned 'default' zone (tiny max_packet_size)
+    breaks unrelated suites in run-order-dependent ways."""
+    from emqx_tpu import zone
+    saved = dict(zone._zones)
+    yield
+    zone._zones.clear()
+    zone._zones.update(saved)
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -17,10 +17,12 @@ class TestClient:
     __test__ = False  # not a pytest class
 
     def __init__(self, client_id: str, version: int = C.MQTT_V4,
-                 clean_start: bool = True, **connect_kw) -> None:
+                 clean_start: bool = True, auto_ack: bool = True,
+                 **connect_kw) -> None:
         self.client_id = client_id
         self.version = version
         self.clean_start = clean_start
+        self.auto_ack = auto_ack  # False: flow-control tests ack by hand
         self.connect_kw = connect_kw
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
@@ -59,10 +61,10 @@ class TestClient:
                     if isinstance(pkt, Publish):
                         await self.inbox.put(pkt)
                         # auto-ack inbound QoS1/2
-                        if pkt.qos == 1:
+                        if pkt.qos == 1 and self.auto_ack:
                             await self.send(PubAck(type=C.PUBACK,
                                                    packet_id=pkt.packet_id))
-                        elif pkt.qos == 2:
+                        elif pkt.qos == 2 and self.auto_ack:
                             await self.send(PubAck(type=C.PUBREC,
                                                    packet_id=pkt.packet_id))
                     elif isinstance(pkt, PubAck) and pkt.type == C.PUBREL:
@@ -78,11 +80,13 @@ class TestClient:
         self.writer.write(serialize(pkt, self.version))
         await self.writer.drain()
 
-    async def subscribe(self, *filters, qos=0, timeout=5.0) -> Suback:
+    async def subscribe(self, *filters, qos=0, timeout=5.0,
+                        props: Optional[dict] = None) -> Suback:
         pid = self.next_pkt_id()
         tf = [(f, {"qos": qos, "nl": 0, "rap": 0, "rh": 0})
               if isinstance(f, str) else f for f in filters]
-        await self.send(Subscribe(packet_id=pid, topic_filters=tf))
+        await self.send(Subscribe(packet_id=pid, topic_filters=tf,
+                                  properties=props or {}))
         ack = await asyncio.wait_for(self.acks.get(), timeout)
         assert isinstance(ack, Suback), ack
         return ack

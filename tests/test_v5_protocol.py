@@ -249,3 +249,237 @@ async def test_overlapping_subscriptions_deliver_per_subscription():
         assert {m1.payload, m2.payload} == {b"m"}
         await c.close()
         await pub.close()
+
+
+# -- request/response (t_request_response) ----------------------------------
+
+async def test_request_response_pattern():
+    """Response-Topic + Correlation-Data flow end-to-end: the
+    responder replies to the request's Response-Topic echoing its
+    Correlation-Data (reference t_request_response via
+    emqx_request_sender/handler)."""
+    async with broker_node() as node:
+        responder = TestClient("rr-resp", version=C.MQTT_V5)
+        await responder.connect(port=_port(node))
+        await responder.subscribe("svc/echo", qos=1)
+        requester = TestClient("rr-req", version=C.MQTT_V5)
+        await requester.connect(port=_port(node))
+        await requester.subscribe("svc/replies/rr-req", qos=1)
+
+        await requester.publish(
+            "svc/echo", b"what-time", qos=1,
+            props={"Response-Topic": "svc/replies/rr-req",
+                   "Correlation-Data": b"req-42"})
+        req = await responder.recv(10)
+        assert req.properties["Response-Topic"] == "svc/replies/rr-req"
+        assert req.properties["Correlation-Data"] == b"req-42"
+        await responder.publish(
+            req.properties["Response-Topic"], b"noon", qos=1,
+            props={"Correlation-Data":
+                   req.properties["Correlation-Data"]})
+        resp = await requester.recv(10)
+        assert resp.payload == b"noon"
+        assert resp.properties["Correlation-Data"] == b"req-42"
+        await responder.close()
+        await requester.close()
+
+
+# -- subscription identifiers (t_subscribe_subid) ---------------------------
+
+async def test_subscription_identifier_delivered():
+    async with broker_node() as node:
+        c = TestClient("subid1", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.subscribe("sid/a", qos=1,
+                          props={"Subscription-Identifier": 7})
+        pub = TestClient("subidp", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("sid/a", b"x", qos=1)
+        m = await c.recv(10)
+        assert m.properties.get("Subscription-Identifier") == 7
+        await c.close()
+        await pub.close()
+
+
+async def test_subscription_identifier_per_overlapping_sub():
+    """Overlapping subscriptions deliver one PUBLISH per subscription,
+    each carrying ITS subid."""
+    async with broker_node() as node:
+        c = TestClient("subid2", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.subscribe("sid/b/+", qos=0,
+                          props={"Subscription-Identifier": 1})
+        await c.subscribe("sid/b/#", qos=0,
+                          props={"Subscription-Identifier": 2})
+        pub = TestClient("subid2p", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("sid/b/x", b"y", qos=0)
+        got = sorted([
+            (await c.recv(10)).properties["Subscription-Identifier"],
+            (await c.recv(10)).properties["Subscription-Identifier"]])
+        assert got == [1, 2]
+        await c.close()
+        await pub.close()
+
+
+async def test_subscription_identifier_on_shared_sub():
+    async with broker_node() as node:
+        c = TestClient("subid3", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.subscribe("$share/g1/sid/c", qos=1,
+                          props={"Subscription-Identifier": 9})
+        pub = TestClient("subid3p", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("sid/c", b"s", qos=1)
+        m = await c.recv(10)
+        assert m.properties.get("Subscription-Identifier") == 9
+        await c.close()
+        await pub.close()
+
+
+# -- flow control (t_connect_limit_timeout / receive maximum) ---------------
+
+async def test_receive_maximum_limits_inflight():
+    """Receive-Maximum=2 on CONNECT: the server holds at most two
+    unacked QoS1 deliveries in flight; acking releases the next."""
+    async with broker_node() as node:
+        c = TestClient("rm1", version=C.MQTT_V5, auto_ack=False,
+                       properties={"Receive-Maximum": 2})
+        await c.connect(port=_port(node))
+        await c.subscribe("rm/t", qos=1)
+        pub = TestClient("rmp", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        for i in range(5):
+            await pub.publish("rm/t", b"%d" % i, qos=1)
+        first = await c.recv(10)
+        second = await c.recv(10)
+        with contextlib.suppress(asyncio.TimeoutError):
+            extra = await c.recv(0.7)
+            raise AssertionError(f"third in-flight delivery: {extra}")
+        # ack one → exactly one more arrives
+        from emqx_tpu.mqtt.packet import PubAck
+        await c.send(PubAck(type=C.PUBACK, packet_id=first.packet_id))
+        third = await c.recv(10)
+        assert third.payload == b"2"
+        with contextlib.suppress(asyncio.TimeoutError):
+            await c.recv(0.7)
+            raise AssertionError("window exceeded after one ack")
+        await c.close()
+        await pub.close()
+
+
+# -- message expiry on delivery (t_publish_message_expiry) ------------------
+
+async def test_message_expiry_drops_queued_message():
+    async with broker_node() as node:
+        c1 = TestClient("mx1", version=C.MQTT_V5,
+                        properties={"Session-Expiry-Interval": 7200})
+        await c1.connect(port=_port(node))
+        await c1.subscribe("mx/t", qos=1)
+        await c1.disconnect()
+        pub = TestClient("mxp", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("mx/t", b"fleeting", qos=1,
+                          props={"Message-Expiry-Interval": 1})
+        await pub.publish("mx/t", b"durable", qos=1,
+                          props={"Message-Expiry-Interval": 3600})
+        await asyncio.sleep(1.5)  # first expires in the queue
+        c2 = TestClient("mx1", version=C.MQTT_V5, clean_start=False,
+                        properties={"Session-Expiry-Interval": 7200})
+        await c2.connect(port=_port(node))
+        m = await c2.recv(10)
+        assert m.payload == b"durable"
+        # the survivor's expiry interval shrank while queued
+        assert m.properties["Message-Expiry-Interval"] < 3600
+        with contextlib.suppress(asyncio.TimeoutError):
+            extra = await c2.recv(0.7)
+            raise AssertionError(f"expired message delivered: {extra}")
+        await c2.close()
+        await pub.close()
+
+
+# -- server-side topic alias out (t_publish_topic_alias) --------------------
+
+async def test_server_assigns_outbound_topic_alias():
+    """Client advertises Topic-Alias-Maximum: the server's first
+    delivery carries topic + alias, repeats carry ONLY the alias
+    (empty topic)."""
+    async with broker_node() as node:
+        c = TestClient("ta-out", version=C.MQTT_V5,
+                       properties={"Topic-Alias-Maximum": 4})
+        await c.connect(port=_port(node))
+        await c.subscribe("ta/hot", qos=0)
+        pub = TestClient("ta-outp", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("ta/hot", b"m1", qos=0)
+        first = await c.recv(10)
+        assert first.topic == "ta/hot"
+        alias = first.properties.get("Topic-Alias")
+        assert alias is not None
+        await pub.publish("ta/hot", b"m2", qos=0)
+        second = await c.recv(10)
+        assert second.topic == ""                 # alias only
+        assert second.properties["Topic-Alias"] == alias
+        await c.close()
+        await pub.close()
+
+
+async def test_no_outbound_alias_without_client_maximum():
+    async with broker_node() as node:
+        c = TestClient("ta-none", version=C.MQTT_V5)  # no alias max
+        await c.connect(port=_port(node))
+        await c.subscribe("ta/cold", qos=0)
+        pub = TestClient("ta-nonep", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("ta/cold", b"m", qos=0)
+        await pub.publish("ta/cold", b"m2", qos=0)
+        for _ in range(2):
+            m = await c.recv(10)
+            assert m.topic == "ta/cold"
+            assert "Topic-Alias" not in m.properties
+        await c.close()
+        await pub.close()
+
+
+# -- maximum packet size out (t_connack_max_packet_size) --------------------
+
+async def test_client_maximum_packet_size_drops_oversized_delivery():
+    async with broker_node() as node:
+        c = TestClient("mps1", version=C.MQTT_V5,
+                       properties={"Maximum-Packet-Size": 256})
+        await c.connect(port=_port(node))
+        await c.subscribe("mps/t", qos=0)
+        pub = TestClient("mpsp", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("mps/t", b"x" * 1024, qos=0)   # too big: drop
+        await pub.publish("mps/t", b"small", qos=0)
+        m = await c.recv(10)
+        assert m.payload == b"small"
+        assert node.metrics.val("delivery.dropped.too_large") >= 1
+        await c.close()
+        await pub.close()
+
+
+async def test_oversized_qos1_releases_inflight_window():
+    """A size-dropped QoS1 delivery is 'discarded but acknowledged'
+    (MQTT-3.1.2-24): its inflight slot frees, so later small
+    messages still flow — the slot must not leak into a permanently
+    wedged Receive-Maximum window."""
+    async with broker_node() as node:
+        c = TestClient("mps2", version=C.MQTT_V5,
+                       properties={"Maximum-Packet-Size": 256,
+                                   "Receive-Maximum": 2})
+        await c.connect(port=_port(node))
+        await c.subscribe("mps2/t", qos=1)
+        pub = TestClient("mps2p", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        # fill the 2-slot window with oversized messages, twice over
+        for _ in range(4):
+            await pub.publish("mps2/t", b"x" * 1024, qos=1)
+        for i in range(3):
+            await pub.publish("mps2/t", b"ok%d" % i, qos=1)
+        got = [await c.recv(10) for _ in range(3)]
+        assert [m.payload for m in got] == [b"ok0", b"ok1", b"ok2"]
+        assert node.metrics.val("delivery.dropped.too_large") == 4
+        await c.close()
+        await pub.close()
