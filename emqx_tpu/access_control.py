@@ -33,14 +33,18 @@ class ClientInfo(dict):
 
 
 class AccessControl:
-    def __init__(self, hooks: Hooks, zone: Optional[Zone] = None) -> None:
+    def __init__(self, hooks: Hooks, zone: Optional[Zone] = None,
+                 metrics=None) -> None:
         self.hooks = hooks
         self.zone = zone or Zone()
+        self.metrics = metrics
 
     def authenticate(self, clientinfo: ClientInfo) -> dict:
         """Returns an auth result dict with at least
         ``{"auth_result": "success"|<error>, "anonymous": bool}``.
         Raises nothing; callers map failures to CONNACK codes."""
+        if self.metrics is not None:
+            self.metrics.inc("client.authenticate")
         default = {
             "auth_result": "success" if self.zone.allow_anonymous
             else "not_authorized",
@@ -57,7 +61,11 @@ class AccessControl:
         if cache is not None:
             hit = cache.get(pubsub, topic)
             if hit is not None:
+                if self.metrics is not None:
+                    self.metrics.inc("client.acl.cache_hit")
                 return hit
+        if self.metrics is not None:
+            self.metrics.inc("client.check_acl")
         result = self.hooks.run_fold(
             "client.check_acl", (dict(clientinfo), pubsub, topic),
             self.zone.acl_nomatch)

@@ -202,6 +202,9 @@ class Broker:
                 self.hooks.run("message.dropped",
                                (out if out is not None else msg, "vetoed"))
                 continue
+            self.metrics.inc("messages.publish")
+            if out.flags.get("retain"):
+                self.metrics.inc("messages.retained")
             live.append((i, out))
         if not live:
             return results

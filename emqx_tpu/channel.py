@@ -78,7 +78,8 @@ class Channel:
         self.keepalive: Optional[Keepalive] = None
         self.will: Optional[Message] = None
         self.acl_cache = AclCache()
-        self.access = AccessControl(broker.hooks, self.zone)
+        self.access = AccessControl(broker.hooks, self.zone,
+                            metrics=broker.metrics)
         self.alias_in: Dict[int, str] = {}   # v5 inbound topic aliases
         # v5 outbound aliases: per-connection, bounded by the
         # client's Topic-Alias-Maximum (src/emqx_channel.erl
@@ -119,6 +120,8 @@ class Channel:
         self.disconnect_reason = RC.name(rc5)
         self._shutdown(close_transport=False)
         self.close_after_send = True
+        self.broker.metrics.inc("packets.connack.sent")
+        self.broker.metrics.inc("client.connack")
         return [Connack(reason_code=rc)]
 
     # -- inbound ----------------------------------------------------------
@@ -149,7 +152,17 @@ class Channel:
             if isinstance(pkt, Disconnect):
                 return self._in_disconnect(pkt)
             if isinstance(pkt, Auth):
-                # enhanced auth is negotiated by hook; no built-in method
+                self.broker.metrics.inc("packets.auth.received")
+                # enhanced auth is negotiated by hook; no built-in
+                # method: continue-authentication answered via the
+                # 'client.enhanced_authenticate' fold when registered
+                acc = self.broker.hooks.run_fold(
+                    "client.enhanced_authenticate",
+                    (dict(self.clientinfo), pkt.properties), None)
+                if acc is not None:
+                    self.broker.metrics.inc("packets.auth.sent")
+                    return [Auth(reason_code=acc.get("rc", 0),
+                                 properties=acc.get("properties", {}))]
                 return []
         except SessionError as e:
             log.warning("session error: %s", e)
@@ -283,6 +296,7 @@ class Channel:
             if self.zone.max_packet_size:
                 props["Maximum-Packet-Size"] = self.zone.max_packet_size
         self.broker.metrics.inc("packets.connack.sent")
+        self.broker.metrics.inc("client.connack")
         out: List[Packet] = [Connack(session_present=session_present,
                                      reason_code=RC.SUCCESS,
                                      properties=props)]
@@ -453,7 +467,10 @@ class Channel:
                     self.session.pubrec(pkt.packet_id)
                     rc = RC.SUCCESS
                 except SessionError as e:
-                    self.broker.metrics.inc("packets.pubrec.missed")
+                    self.broker.metrics.inc(
+                        "packets.pubrec.inuse"
+                        if e.rc == RC.PACKET_IDENTIFIER_IN_USE
+                        else "packets.pubrec.missed")
                     rc = e.rc
                 self.broker.metrics.inc("packets.pubrel.sent")
                 return [self._ack(C.PUBREL, pkt.packet_id,
@@ -474,10 +491,15 @@ class Channel:
                 self.session.pubcomp(pkt.packet_id)
                 self.broker.metrics.inc("messages.acked")
         except SessionError as e:
+            in_use = e.rc == RC.PACKET_IDENTIFIER_IN_USE
             if t == C.PUBACK:
-                self.broker.metrics.inc("packets.puback.missed")
+                self.broker.metrics.inc(
+                    "packets.puback.inuse" if in_use
+                    else "packets.puback.missed")
             elif t == C.PUBCOMP:
-                self.broker.metrics.inc("packets.pubcomp.missed")
+                self.broker.metrics.inc(
+                    "packets.pubcomp.inuse" if in_use
+                    else "packets.pubcomp.missed")
             log.debug("ack error: %s", e)
         out.extend(self.handle_deliver())
         return out
@@ -630,19 +652,7 @@ class Channel:
             pub = from_message(pid, msg)
             if self.proto_ver != C.MQTT_V5:
                 pub.properties = {}
-            if self.client_max_packet and len(
-                    wire_serialize(pub, self.proto_ver)) \
-                    > self.client_max_packet:
-                # MQTT-3.1.2-24: may not send past the client's cap.
-                # Gate BEFORE alias assignment (the client must never
-                # be told an alias whose defining packet it never got)
-                # and BEFORE the sent metrics; the inflight slot is
-                # released as 'discarded but acknowledged'.
-                self.broker.metrics.inc("delivery.dropped")
-                self.broker.metrics.inc("delivery.dropped.too_large")
-                if pid is not None and self.session is not None:
-                    self.session.discard_delivery(pid)
-                continue
+            new_alias_topic = None
             if self.proto_ver == C.MQTT_V5 and self.client_alias_max:
                 # server-side alias assignment: first delivery of a
                 # topic carries name + alias, repeats carry only the
@@ -656,7 +666,33 @@ class Channel:
                 elif len(self.alias_out) < self.client_alias_max:
                     alias = len(self.alias_out) + 1
                     self.alias_out[pub.topic] = alias
+                    new_alias_topic = pub.topic
                     pub.properties["Topic-Alias"] = alias
+            if self.client_max_packet and len(
+                    wire_serialize(pub, self.proto_ver)) \
+                    > self.client_max_packet:
+                # MQTT-3.1.2-24: may not send past the client's cap.
+                # The gate measures the FINAL packet (alias included).
+                # A packet only over the cap because of a freshly
+                # assigned alias is sent plain instead (rolled back —
+                # the client must never see an alias whose defining
+                # packet it never got).
+                if new_alias_topic is not None:
+                    self.alias_out.pop(new_alias_topic, None)
+                    pub.topic = new_alias_topic
+                    pub.properties.pop("Topic-Alias", None)
+                    new_alias_topic = None
+                if len(wire_serialize(pub, self.proto_ver)) \
+                        > self.client_max_packet:
+                    # genuinely oversized: discarded but treated as
+                    # acknowledged — the inflight slot frees, before
+                    # the sent metrics
+                    self.broker.metrics.inc("delivery.dropped")
+                    self.broker.metrics.inc(
+                        "delivery.dropped.too_large")
+                    if pid is not None and self.session is not None:
+                        self.session.discard_delivery(pid)
+                    continue
             self.broker.metrics.inc("packets.publish.sent")
             self.broker.metrics.inc_sent(msg)
             out.append(pub)

@@ -87,6 +87,11 @@ class Cluster:
         self.members: List[str] = [self.name]
         self._lock = threading.Lock()
         self._shared_rr: Dict[Tuple[str, str], int] = {}
+        # replicated per-node shared-group member counts: the
+        # reference picks over the full replicated member table
+        # (src/emqx_shared_sub.erl:229-244); replicating COUNTS gives
+        # the same distribution without replicating member pids
+        self._shared_weights: Dict[Tuple[str, str, str], int] = {}
         # replicated clientid -> node registry (emqx_cm_registry:
         # Mnesia bag emqx_channel_registry); covers live and detached
         # sessions so cross-node takeover can find the owner
@@ -101,6 +106,14 @@ class Cluster:
         node.router.delete_route = self._del_route_replicated
         node.broker.forwarder = self._forward
         node.broker.shared_router = self._route_shared
+        # intercept shared-membership mutations to replicate weights
+        shared = node.broker.shared
+        self._orig_shared_sub = shared.subscribe
+        self._orig_shared_unsub = shared.unsubscribe
+        self._orig_shared_down = shared.subscriber_down
+        shared.subscribe = self._shared_sub_replicated
+        shared.unsubscribe = self._shared_unsub_replicated
+        shared.subscriber_down = self._shared_down_replicated
         if isinstance(self.transport, LocalTransport):
             self.transport.register(self.name, self)
         elif hasattr(self.transport, "cluster"):
@@ -170,6 +183,12 @@ class Cluster:
             for r in self.node.router.lookup_routes(flt):
                 if self._owned(r.dest, self.name):
                     self._broadcast("route_add", flt, r.dest)
+        # new joiners also need our shared-group weights
+        for (group, flt), members in \
+                self.node.broker.shared._subs.items():
+            if members:
+                self._broadcast("shared_weight", group, flt,
+                                self.name, len(members))
 
     @staticmethod
     def _owned(dest, name: str) -> bool:
@@ -198,6 +217,8 @@ class Cluster:
             dead = [c for c, n in self._registry.items() if n == name]
             for c in dead:
                 del self._registry[c]
+            for k in [k for k in self._shared_weights if k[2] == name]:
+                del self._shared_weights[k]
         self._purge_node_routes(name)
 
     # -- clientid registry + cross-node takeover (emqx_cm_registry) -------
@@ -301,18 +322,61 @@ class Cluster:
         except ConnectionError:
             self.handle_nodedown(node)
 
+    def _local_shared_count(self, group: str, flt: str) -> int:
+        return len(self.node.broker.shared._subs.get((group, flt), ()))
+
+    def _broadcast_weight(self, group: str, flt: str) -> None:
+        self._broadcast("shared_weight", group, flt, self.name,
+                        self._local_shared_count(group, flt))
+
+    def _shared_sub_replicated(self, group, flt, sub) -> None:
+        self._orig_shared_sub(group, flt, sub)
+        self._broadcast_weight(group, flt)
+
+    def _shared_unsub_replicated(self, group, flt, sub) -> None:
+        self._orig_shared_unsub(group, flt, sub)
+        self._broadcast_weight(group, flt)
+
+    def _shared_down_replicated(self, sub) -> None:
+        before = [k for k, m in self.node.broker.shared._subs.items()
+                  if sub in m]
+        self._orig_shared_down(sub)
+        for group, flt in before:
+            self._broadcast_weight(group, flt)
+
+    def _weight(self, group: str, flt: str, node: str) -> int:
+        if node == self.name:
+            return max(1, self._local_shared_count(group, flt))
+        return max(1, self._shared_weights.get((group, flt, node), 1))
+
     def _route_shared(self, group: str, flt: str, nodes: List[str],
                       msg: Message) -> int:
-        """One delivery per (group, filter) cluster-wide: round-robin
-        over the member nodes, then the picked node's local strategy
-        chooses the subscriber."""
+        """One delivery per (group, filter) cluster-wide: weighted
+        round-robin over the member nodes (weight = that node's
+        member count, replicated on membership changes), then the
+        picked node's local strategy chooses the subscriber — a node
+        with 100 members gets 100x the share of a node with 1,
+        matching the reference's pick over the global member table
+        (src/emqx_shared_sub.erl:229-244)."""
         if not nodes:
             return 0
         key = (group, flt)
-        n = self._shared_rr.get(key, -1)
-        n = (n + 1) % len(nodes)
-        self._shared_rr[key] = n
-        target = sorted(nodes)[n]
+        ordered = sorted(nodes)
+        # under the lock: the IO thread (forwarded publishes) and the
+        # serving loop both route shared messages — the rr counter is
+        # a read-modify-write, and weights are written by handle_rpc
+        with self._lock:
+            weights = [self._weight(group, flt, x) for x in ordered]
+            total = sum(weights)
+            n = (self._shared_rr.get(key, -1) + 1) % total
+            self._shared_rr[key] = n
+        target = ordered[-1]
+        acc = 0
+        for node_name, w in zip(ordered, weights):
+            acc += w
+            if n < acc:
+                target = node_name
+                break
         if target == self.name:
             return self.node.broker.shared.dispatch(group, flt, msg)
         try:
@@ -357,6 +421,14 @@ class Cluster:
             return self._set_members(args[0])
         if op == "ping":
             return "pong"
+        if op == "shared_weight":
+            group, flt, node, count = args
+            with self._lock:
+                if count > 0:
+                    self._shared_weights[(group, flt, node)] = count
+                else:
+                    self._shared_weights.pop((group, flt, node), None)
+            return None
         if op == "cluster_info":
             return {"name": self.name, "members": list(self.members),
                     "addrs": self.transport.addr_book()}

@@ -70,24 +70,36 @@ class ConnectionManager:
         self.cancel_will(client_id)
         try:
             loop = asyncio.get_running_loop()
+            handle = loop.call_later(delay, self._fire_will, client_id)
         except RuntimeError:
-            # no event loop (sync drivers): no way to time the delay,
-            # publish now rather than silently dropping the will
-            if self.broker is not None:
-                self.broker.publish(msg)
-            return
-        handle = loop.call_later(delay, self._fire_will, client_id)
-        self._pending_wills[client_id] = (handle, msg)
+            # no event loop (sync drivers): approximate the delay
+            # with a timer thread so the semantics survive
+            timer = threading.Timer(delay, self._fire_will, (client_id,))
+            timer.daemon = True
+            timer.start()
+            handle = timer
+        with self._lock:
+            self._pending_wills[client_id] = (handle, msg)
 
     def _fire_will(self, client_id: str) -> None:
-        ent = self._pending_wills.pop(client_id, None)
+        """Timer expiry: publish the delayed will — unless the client
+        reconnected while the timer was in flight (MQTT5 3.1.3.2.2:
+        MUST NOT send after re-establishment). The timer callback may
+        race a reconnect on another loop/thread, so the reconnect
+        check happens under the registry lock."""
+        with self._lock:
+            if self._channels.get(client_id) is not None:
+                self._pending_wills.pop(client_id, None)
+                return  # re-established: will is void
+            ent = self._pending_wills.pop(client_id, None)
         if ent is not None and self.broker is not None:
             self.broker.publish(ent[1])
 
     def cancel_will(self, client_id: str, fire: bool = False) -> None:
         """Drop a pending will; ``fire=True`` publishes it instead
         (session ended before the delay elapsed)."""
-        ent = self._pending_wills.pop(client_id, None)
+        with self._lock:
+            ent = self._pending_wills.pop(client_id, None)
         if ent is None:
             return
         handle, msg = ent

@@ -91,11 +91,22 @@ class Connection:
                 # and if the packet still can't fit, close rather
                 # than violate the client's declared limit.
                 if isinstance(pkt, Publish):
+                    # unreachable in normal operation: the channel
+                    # gates PUBLISHes (with inflight release + alias
+                    # rollback) before they get here
+                    log.warning("oversized PUBLISH reached transport "
+                                "backstop (%d > %d)", len(data), max_out)
                     self.broker.metrics.inc("delivery.dropped")
                     self.broker.metrics.inc("delivery.dropped.too_large")
                     continue
-                if getattr(pkt, "properties", None):
-                    pkt.properties = {}
+                props = getattr(pkt, "properties", None)
+                if props:
+                    # MQTT-3.2.2.3: only Reason String / User
+                    # Properties may be dropped to fit — mandatory
+                    # properties (Assigned-Client-Identifier, server
+                    # limits) must survive
+                    props.pop("Reason-String", None)
+                    props.pop("User-Property", None)
                     data = serialize(pkt, self.channel.proto_ver)
                 if len(data) > max_out:
                     log.warning(

@@ -89,6 +89,12 @@ def setup(level: int = logging.INFO,
                 h.setLevel(level)
                 return h
         handler = logging.StreamHandler()
+    else:
+        # an explicit handler REPLACES prior broker handlers — a
+        # second setup(handler=...) must not double every log line
+        for h in list(root.handlers):
+            if isinstance(h.formatter, BrokerFormatter):
+                root.removeHandler(h)
     handler.addFilter(MetadataFilter())
     handler.setFormatter(BrokerFormatter())
     root.addHandler(handler)

@@ -70,10 +70,16 @@ SESSION_METRICS = [
 AUTH_ACL_METRICS = [
     "client.auth.anonymous", "client.acl.cache_hit", "client.acl.deny",
 ]
+# on-device accumulators (psum'd in the sharded publish step), folded
+# into the host array by Metrics.fold_device_stats — the pdict-batched
+# counter idea (src/emqx_pd.erl) applied across the PCIe boundary
+DEVICE_METRICS = [
+    "device.matches", "device.deliveries", "device.overflows",
+]
 
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
-               + AUTH_ACL_METRICS)
+               + AUTH_ACL_METRICS + DEVICE_METRICS)
 
 
 class Metrics:
@@ -115,6 +121,12 @@ class Metrics:
     def inc_sent(self, msg) -> None:
         self.inc("messages.sent")
         self.inc(f"messages.qos{min(msg.qos, 2)}.sent")
+
+    def fold_device_stats(self, stats: Dict[str, int]) -> None:
+        """Fold a drained device accumulator (matches/deliveries/
+        overflows) into the host counters — one transfer per flush."""
+        for key, val in stats.items():
+            self.inc(f"device.{key}", int(val))
 
 
 _global = Metrics()

@@ -248,6 +248,9 @@ class Node:
         stats.setstat("subscribers.count",
                       sum(len(v) for v in self.broker._subscribers.values()),
                       "subscribers.max")
+        dev = self.router.drain_device_stats()
+        if any(dev.values()):
+            self.metrics.fold_device_stats(dev)
 
     # -- facade (src/emqx.erl:26-64) --------------------------------------
 

@@ -25,6 +25,7 @@ workers, emqx_router.erl:185-186); here a mutex serializes mutations.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -117,6 +118,10 @@ class Router:
         self._grow = {"state": 1, "edge": 1}  # rebuild growth factors
         self._compacting = False  # background compaction in flight
         self._dummy_fan = None    # sharded publish_step filler fan
+        # device stat accumulators (sharded publish_step psums),
+        # drained asynchronously by the stats flush — appending the
+        # jax scalars defers the host transfer to drain time
+        self._dev_stats: deque = deque(maxlen=65536)
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
 
@@ -504,12 +509,24 @@ class Router:
         with self._wt_lock:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n, sysm = place_batch(mesh, ids, n, sysm)
-        all_ids, _subs, ovf, _stats = publish_step(
+        all_ids, _subs, ovf, stats = publish_step(
             mesh, auto, self._dummy_fan, ids, n, sysm,
             k=cfg.active_k, m=cfg.max_matches, d=8, with_fanout=False)
+        self._dev_stats.append(stats)
         ids_np = np.asarray(all_ids)[:B]
         ovf_np = np.asarray(ovf)[:B]
         return all_ids, ids_np, ovf_np, id_map, epoch
+
+    def drain_device_stats(self) -> Dict[str, int]:
+        """Sum and clear the accumulated device-side counters (one
+        host transfer per pending step — called from the periodic
+        stats flush, not the publish path)."""
+        out = {"matches": 0, "deliveries": 0, "overflows": 0}
+        while self._dev_stats:
+            st = self._dev_stats.popleft()
+            for k in out:
+                out[k] += int(st[k])
+        return out
 
     def match_filters(self, topics: Sequence[str]) -> List[List[str]]:
         """Batch: matched filter list per topic (device + oracle

@@ -351,13 +351,22 @@ class Session:
             sh = msg.get_header("shared")
             if sh and not msg.is_expired():
                 out.append((sh[0], sh[1], sh[2], True))
+        kept: List[Message] = []
         while not self.mqueue.is_empty():
             msg = self.mqueue.pop()
             if msg is None:
                 break
             sh = msg.get_header("shared")
-            if sh and not msg.is_expired():
-                out.append((sh[0], sh[1], sh[2], False))
+            if sh:
+                if not msg.is_expired():
+                    out.append((sh[0], sh[1], sh[2], False))
+                # expired shared messages drop here — they must not
+                # re-occupy queue capacity in a handed-over session
+            else:
+                kept.append(msg)  # non-shared queued messages stay:
+                # the session may be handed over, not destroyed
+        for m in kept:
+            self.mqueue.push(m)
         return out
 
     def takeover(self) -> None:

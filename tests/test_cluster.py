@@ -250,3 +250,38 @@ def test_nodedown_purges_registry():
     assert clusters[1].locate_client("gone") == "n0"
     clusters[1].handle_nodedown("n0")
     assert clusters[1].locate_client("gone") is None
+
+
+def test_shared_group_weighted_by_member_count():
+    """A node with 3 members gets 3x the deliveries of a node with 1
+    (the reference picks over the replicated member table,
+    src/emqx_shared_sub.erl:229-244 — node-level uniform round-robin
+    would skew per-member load 3:1 the other way)."""
+    (n0, n1), _ = _mk_cluster(2)
+    heavy = [Q(f"h{i}") for i in range(3)]
+    for s in heavy:
+        n0.broker.subscribe(s, "$share/g/work")
+    light = Q("l0")
+    n1.broker.subscribe(light, "$share/g/work")
+    for _ in range(40):
+        n1.broker.publish(Message(topic="work"))
+    n0_total = sum(len(s.inbox) for s in heavy)
+    assert n0_total + len(light.inbox) == 40
+    assert n0_total == 30, (n0_total, len(light.inbox))  # 3:1 split
+    # and within n0 the local strategy spreads over its members
+    assert all(len(s.inbox) == 10 for s in heavy)
+
+
+def test_shared_weight_updates_on_unsubscribe():
+    (n0, n1), _ = _mk_cluster(2)
+    a, b = Q("a"), Q("b")
+    n0.broker.subscribe(a, "$share/g/w2")
+    n0.broker.subscribe(b, "$share/g/w2")
+    c = Q("c")
+    n1.broker.subscribe(c, "$share/g/w2")
+    n0.broker.unsubscribe(b, "$share/g/w2")
+    for _ in range(10):
+        n1.broker.publish(Message(topic="w2"))
+    # 1:1 after the unsubscribe dropped n0's weight to 1
+    assert len(a.inbox) == 5 and len(c.inbox) == 5, \
+        (len(a.inbox), len(b.inbox), len(c.inbox))

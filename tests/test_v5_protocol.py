@@ -483,3 +483,39 @@ async def test_oversized_qos1_releases_inflight_window():
         assert node.metrics.val("delivery.dropped.too_large") == 4
         await c.close()
         await pub.close()
+
+
+async def test_alias_overhead_falls_back_to_plain_topic():
+    """A packet that fits the client's Maximum-Packet-Size only
+    WITHOUT the Topic-Alias property is delivered plain; the alias
+    assignment rolls back (the client must never later receive an
+    alias whose defining packet was dropped)."""
+    from emqx_tpu.mqtt.frame import serialize as ser
+    from emqx_tpu.mqtt.packet import Publish as P
+
+    topic, payload = "alb/t", b"p" * 64
+    cap = len(ser(P(topic=topic, payload=payload, qos=0,
+                    properties={}), C.MQTT_V5))
+    async with broker_node() as node:
+        c = TestClient("alb", version=C.MQTT_V5,
+                       properties={"Topic-Alias-Maximum": 4,
+                                   "Maximum-Packet-Size": cap})
+        await c.connect(port=_port(node))
+        await c.subscribe(topic, qos=0)
+        pub = TestClient("albp", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        # exactly at cap without alias -> sent plain, no alias burned
+        await pub.publish(topic, payload, qos=0)
+        m1 = await c.recv(10)
+        assert m1.topic == topic and "Topic-Alias" not in m1.properties
+        # smaller payload fits WITH an alias -> alias established
+        await pub.publish(topic, b"small", qos=0)
+        m2 = await c.recv(10)
+        assert m2.properties.get("Topic-Alias") is not None
+        assert m2.topic == topic  # defining packet carries the name
+        await pub.publish(topic, b"small2", qos=0)
+        m3 = await c.recv(10)
+        assert m3.topic == "" and "Topic-Alias" in m3.properties
+        assert node.metrics.val("delivery.dropped.too_large") == 0
+        await c.close()
+        await pub.close()
