@@ -67,6 +67,64 @@ class Ctl:
                               "list | start client|topic <v> | stop client|topic <v>")
         self.register_command("vm", self._vm,
                               "host/runtime introspection (emqx_vm)")
+        self.register_command(
+            "cluster", self._cluster,
+            "status | join <host:port> | leave  (emqx_ctl cluster)")
+        self.register_command("listeners", self._listeners,
+                              "list listeners + connection counts")
+        from emqx_tpu.profiling import register_ctl
+        register_ctl(self)
+
+    def _listeners(self, args) -> str:
+        out = []
+        for lst in self.node.listeners:
+            out.append({
+                "name": lst.name,
+                "bind": f"{lst.host}:{lst.port}",
+                "tls": lst.ssl_context is not None,
+                "zone": lst.zone.name,
+                "current_connections": lst.current_connections(),
+                "max_connections": lst.max_connections,
+            })
+        return json.dumps(out, indent=2)
+
+    def _cluster(self, args) -> str:
+        cl = getattr(self.node, "cluster", None)
+        if cl is None:
+            return ("clustering not enabled "
+                    "(set [node] cluster_port in the config, or "
+                    "attach a Cluster)")
+        if not args or args[0] == "status":
+            peers = {}
+            book = getattr(cl.transport, "addr_book", None)
+            if book is not None:
+                peers = {k: f"{v[0]}:{v[1]}" for k, v in book().items()}
+            return json.dumps({"node": cl.name,
+                               "members": sorted(cl.members),
+                               "addresses": peers}, indent=2)
+        if args[0] == "join":
+            import asyncio
+            import threading
+
+            host, _, port = args[1].rpartition(":")
+            host = host or "127.0.0.1"
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                cl.join_remote(host, int(port))  # management shell
+                return f"joined; members: {sorted(cl.members)}"
+            # called ON the serving loop: join_remote blocks on
+            # network calls (up to the transport timeout per member)
+            # — run it on a worker so MQTT serving never stalls
+            threading.Thread(
+                target=lambda: cl.join_remote(host, int(port)),
+                daemon=True, name="ctl-cluster-join").start()
+            return ("join started in background; "
+                    "run 'cluster status' to confirm")
+        if args[0] == "leave":
+            cl.leave()
+            return "left the cluster"
+        raise ValueError(f"bad subcommand: {args[0]}")
 
     def _vm(self, args) -> str:
         from emqx_tpu import vm

@@ -1,0 +1,133 @@
+"""Device profiling: jax-profiler traces + per-kernel timing.
+
+The reference profiles with BEAM VM introspection (emqx_vm.erl) and
+system monitors (SURVEY §5 "Tracing/profiling"); the TPU equivalent
+is the XLA profiler (TensorBoard-format traces of every kernel) plus
+wall-clock timing of the compiled steps themselves. Exposed as:
+
+  - :func:`trace` — context manager writing a profiler trace dir
+    (inspect with TensorBoard / xprof);
+  - :class:`KernelTimer` — named wall-clock accumulators with
+    block-until-ready semantics (per-kernel timing for bench modes
+    and the ``profile`` ctl command);
+  - ctl integration: ``profile start <dir>`` / ``profile stop`` on a
+    live node (registered by Node via :func:`register_ctl`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import deque
+from typing import Dict, Optional
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """XLA profiler trace over the enclosed block (device + host)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class KernelTimer:
+    """Named wall-clock timing for compiled steps.
+
+    Usage — the span yields a capture function; pass it the step's
+    output so the timer can block on it (otherwise only async
+    DISPATCH time is measured, microseconds instead of the device
+    execution)::
+
+        with timer.span("match") as done:
+            done(step(x))
+
+    p50/p99 per name; samples ring-buffered (a long-lived node must
+    not grow timing lists without bound).
+    """
+
+    MAX_SAMPLES = 4096
+
+    def __init__(self) -> None:
+        self._samples: Dict[str, deque] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str, block=None):
+        import jax
+
+        t0 = time.perf_counter()
+        holder = {}
+
+        def _block(x):
+            holder["out"] = x
+            return x
+
+        try:
+            yield _block
+        finally:
+            if "out" in holder:
+                jax.block_until_ready(holder["out"])
+            self.record(name, (time.perf_counter() - t0) * 1000.0)
+
+    def record(self, name: str, ms: float) -> None:
+        self._samples.setdefault(
+            name, deque(maxlen=self.MAX_SAMPLES)).append(ms)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        import numpy as np
+
+        out = {}
+        for name, xs in self._samples.items():
+            arr = np.asarray(xs)
+            out[name] = {
+                "count": int(arr.size),
+                "p50_ms": float(np.percentile(arr, 50)),
+                "p99_ms": float(np.percentile(arr, 99)),
+                "total_ms": float(arr.sum()),
+            }
+        return out
+
+    def reset(self) -> None:
+        self._samples.clear()
+
+
+_active: Dict[str, Optional[str]] = {"dir": None}
+
+
+def register_ctl(ctl) -> None:
+    """``profile start <dir> | stop | kernels`` on a live node."""
+    import json
+
+    def _profile(args):
+        import jax
+
+        if not args:
+            return f"profiling: {'on -> ' + _active['dir'] if _active['dir'] else 'off'}"
+        if args[0] == "start":
+            if _active["dir"] is not None:
+                return f"already tracing to {_active['dir']}"
+            logdir = args[1] if len(args) > 1 else "/tmp/emqx_tpu_trace"
+            jax.profiler.start_trace(logdir)
+            _active["dir"] = logdir
+            return f"tracing to {logdir} (view with TensorBoard)"
+        if args[0] == "stop":
+            if _active["dir"] is None:
+                return "not tracing"
+            jax.profiler.stop_trace()
+            out = _active["dir"]
+            _active["dir"] = None
+            return f"trace written to {out}"
+        if args[0] == "kernels":
+            return json.dumps(timer.stats(), indent=2)
+        raise ValueError(f"bad subcommand: {args[0]}")
+
+    ctl.register_command("profile", _profile,
+                         "start [dir] | stop | kernels")
+
+
+#: process-wide timer the router/bench feed (opt-in: spans only
+#: recorded where instrumented)
+timer = KernelTimer()

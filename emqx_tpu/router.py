@@ -311,8 +311,20 @@ class Router:
             return self._rebuild_locked()
 
     def _rebuild_locked(self):
-        if self.config.mesh is not None:
-            return self._rebuild_sharded_locked()
+        import time as _time
+
+        from emqx_tpu.profiling import timer as _ktimer
+
+        t0 = _time.perf_counter()
+        try:
+            if self.config.mesh is not None:
+                return self._rebuild_sharded_locked()
+            return self._rebuild_single_locked()
+        finally:
+            _ktimer.record("automaton.rebuild",
+                           (_time.perf_counter() - t0) * 1000.0)
+
+    def _rebuild_single_locked(self) -> Automaton:
         prev = self._auto
         cap_s = cap_e = None
         if prev is not None:

@@ -205,3 +205,52 @@ port = 0
 
     asyncio.get_event_loop_policy().new_event_loop() \
         .run_until_complete(main())
+
+
+def test_ctl_cluster_commands(tmp_path):
+    """emqx_ctl-style cluster join/status/leave through the CLI
+    against two config-booted nodes."""
+    def write(name, fname):
+        p = tmp_path / fname
+        p.write_text(f"""
+[node]
+name = "{name}"
+cookie = "ctl-c"
+cluster_port = 0
+
+[[listeners]]
+type = "tcp"
+port = 0
+""")
+        return str(p)
+
+    async def main():
+        n1 = boot_from_file(write("ctl1@x", "a.toml"))
+        n2 = boot_from_file(write("ctl2@x", "b.toml"))
+        await n1.start()
+        await n2.start()
+        try:
+            out = n1.ctl.run(["cluster", "status"])
+            assert '"ctl1@x"' in out
+            port2 = n2.cluster.transport.port
+            out = n1.ctl.run(["cluster", "join", f"127.0.0.1:{port2}"])
+            # on a running loop the join goes to a worker thread so
+            # the serving loop never blocks on the network
+            assert "background" in out
+            deadline = asyncio.get_running_loop().time() + 20
+            while sorted(n2.cluster.members) != ["ctl1@x", "ctl2@x"]:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+            out = n1.ctl.run(["cluster", "leave"])
+            assert "left" in out
+            assert n1.cluster.members == ["ctl1@x"]
+            deadline = asyncio.get_running_loop().time() + 10
+            while "ctl1@x" in n2.cluster.members:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+        finally:
+            await n1.stop()
+            await n2.stop()
+
+    asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(main())

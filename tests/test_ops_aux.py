@@ -177,3 +177,50 @@ def test_ctl_vm_command():
     n = Node(boot_listeners=False)
     out = n.ctl.run(["vm"])
     assert '"cpu_count"' in out and '"rss"' in out
+
+
+# -- profiling (SURVEY §5 tracing/profiling: jax-profiler + kernel timing) --
+
+def test_kernel_timer_spans_and_stats():
+    import jax.numpy as jnp
+
+    from emqx_tpu.profiling import KernelTimer
+
+    t = KernelTimer()
+    for _ in range(5):
+        with t.span("mul") as done:
+            done(jnp.ones((64, 64)) * 2.0)
+    t.record("host_phase", 1.5)
+    st = t.stats()
+    assert st["mul"]["count"] == 5
+    assert st["mul"]["p99_ms"] >= st["mul"]["p50_ms"] >= 0
+    assert st["host_phase"]["total_ms"] == 1.5
+    t.reset()
+    assert t.stats() == {}
+
+
+def test_profiler_trace_writes_artifacts(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.profiling import trace
+
+    logdir = str(tmp_path / "trace")
+    with trace(logdir):
+        jax.block_until_ready(jnp.ones((32, 32)) @ jnp.ones((32, 32)))
+    import os
+    found = [os.path.join(dp, f) for dp, _, fs in os.walk(logdir)
+             for f in fs]
+    assert found, "profiler wrote no trace artifacts"
+
+
+def test_rebuild_recorded_in_kernel_timer():
+    from emqx_tpu.profiling import timer
+    from emqx_tpu.router import MatcherConfig, Router
+
+    timer.reset()
+    r = Router(MatcherConfig())
+    r.add_route("prof/+")
+    r.match_filters(["prof/x"])
+    st = timer.stats()
+    assert st.get("automaton.rebuild", {}).get("count", 0) >= 1
