@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
@@ -18,8 +19,14 @@ class BanRule:
 
 
 class Banned:
+    """Writers arrive from the serving loop (CLI/flapping), the
+    housekeeping task, AND — with a socket cluster — the transport IO
+    thread (replicated ban applies), so the table takes a lock; the
+    check() hot path holds it only for dict probes."""
+
     def __init__(self) -> None:
         self._rules: Dict[Tuple[str, str], BanRule] = {}
+        self._lock = threading.Lock()
 
     def create(self, kind: str, value: str, by: str = "admin",
                reason: str = "", duration: Optional[float] = None) -> BanRule:
@@ -27,14 +34,38 @@ class Banned:
             raise ValueError(f"bad ban kind: {kind}")
         until = time.time() + duration if duration is not None else None
         rule = BanRule(who=(kind, value), by=by, reason=reason, until=until)
-        self._rules[rule.who] = rule
+        with self._lock:
+            self._rules[rule.who] = rule
         return rule
 
+    @staticmethod
+    def _outlasts(a: Optional[float], b: Optional[float]) -> bool:
+        """Does expiry ``a`` last at least as long as ``b``?
+        (None = forever.)"""
+        return a is None or (b is not None and a >= b)
+
+    def apply(self, kind: str, value: str, by: str, reason: str,
+              until: Optional[float]) -> None:
+        """Install a replicated rule with an absolute expiry. Merge
+        rule: the LONGER ban wins — a stale short ban synced from one
+        member must never clobber another member's permanent ban for
+        the same identity."""
+        if until is not None and time.time() > until:
+            return  # already expired: never install
+        with self._lock:
+            cur = self._rules.get((kind, value))
+            if cur is not None and self._outlasts(cur.until, until):
+                return
+            self._rules[(kind, value)] = BanRule(
+                who=(kind, value), by=by, reason=reason, until=until)
+
     def delete(self, kind: str, value: str) -> None:
-        self._rules.pop((kind, value), None)
+        with self._lock:
+            self._rules.pop((kind, value), None)
 
     def look_up(self, kind: str, value: str) -> Optional[BanRule]:
-        return self._rules.get((kind, value))
+        with self._lock:
+            return self._rules.get((kind, value))
 
     def check(self, clientid: str = "", username: Optional[str] = None,
               peerhost: str = "") -> bool:
@@ -42,21 +73,33 @@ class Banned:
         now = time.time()
         for who in (("clientid", clientid), ("username", username or ""),
                     ("peerhost", peerhost)):
-            rule = self._rules.get(who)
+            with self._lock:
+                rule = self._rules.get(who)
+                if rule is not None and rule.until is not None \
+                        and now > rule.until:
+                    # lazy expiry — re-checked under the lock so a
+                    # concurrent refreshed ban is never deleted
+                    del self._rules[who]
+                    rule = None
             if rule is not None:
-                if rule.until is not None and now > rule.until:
-                    del self._rules[who]  # lazy expiry
-                    continue
                 return True
         return False
 
     def expire(self, now: Optional[float] = None) -> int:
         now = time.time() if now is None else now
-        dead = [w for w, r in self._rules.items()
-                if r.until is not None and now > r.until]
-        for w in dead:
-            del self._rules[w]
-        return len(dead)
+        n = 0
+        with self._lock:
+            for w in [w for w, r in self._rules.items()
+                      if r.until is not None and now > r.until]:
+                # until re-checked inside the lock: a replicated
+                # refresh racing this sweep must survive
+                r = self._rules.get(w)
+                if r is not None and r.until is not None \
+                        and now > r.until:
+                    del self._rules[w]
+                    n += 1
+        return n
 
     def info(self) -> list:
-        return list(self._rules.values())
+        with self._lock:
+            return list(self._rules.values())

@@ -114,6 +114,14 @@ class Cluster:
         shared.subscribe = self._shared_sub_replicated
         shared.unsubscribe = self._shared_unsub_replicated
         shared.subscriber_down = self._shared_down_replicated
+        # replicate the ban table (the reference's emqx_banned is a
+        # replicated Mnesia table: a ban on one node bans everywhere)
+        banned = node.broker.banned
+        if banned is not None:
+            self._orig_ban_create = banned.create
+            self._orig_ban_delete = banned.delete
+            banned.create = self._ban_create_replicated
+            banned.delete = self._ban_delete_replicated
         if isinstance(self.transport, LocalTransport):
             self.transport.register(self.name, self)
         elif hasattr(self.transport, "cluster"):
@@ -189,6 +197,15 @@ class Cluster:
             if members:
                 self._broadcast("shared_weight", group, flt,
                                 self.name, len(members))
+        # ...and the ban table (idempotent: every member pushes, the
+        # receiving apply() merges longest-ban-wins). Expired rules
+        # are swept first so a stale entry is never pushed at all.
+        banned = self.node.broker.banned
+        if banned is not None:
+            banned.expire()
+            for rule in banned.info():
+                self._broadcast("ban_add", rule.who[0], rule.who[1],
+                                rule.by, rule.reason, rule.until)
 
     @staticmethod
     def _owned(dest, name: str) -> bool:
@@ -344,6 +361,17 @@ class Cluster:
         for group, flt in before:
             self._broadcast_weight(group, flt)
 
+    def _ban_create_replicated(self, kind, value, by="admin",
+                               reason="", duration=None):
+        rule = self._orig_ban_create(kind, value, by=by, reason=reason,
+                                     duration=duration)
+        self._broadcast("ban_add", kind, value, by, reason, rule.until)
+        return rule
+
+    def _ban_delete_replicated(self, kind, value) -> None:
+        self._orig_ban_delete(kind, value)
+        self._broadcast("ban_del", kind, value)
+
     def _weight(self, group: str, flt: str, node: str) -> int:
         if node == self.name:
             return max(1, self._local_shared_count(group, flt))
@@ -421,6 +449,19 @@ class Cluster:
             return self._set_members(args[0])
         if op == "ping":
             return "pong"
+        if op == "ban_add":
+            kind, value, by, reason, until = args
+            banned = self.node.broker.banned
+            if banned is not None:
+                banned.apply(kind, value, by, reason, until)
+            return None
+        if op == "ban_del":
+            # remote apply MUST bypass the replicated wrapper — going
+            # through banned.delete would re-broadcast and ping-pong
+            # between the members forever
+            if getattr(self, "_orig_ban_delete", None) is not None:
+                self._orig_ban_delete(*args)
+            return None
         if op == "shared_weight":
             group, flt, node, count = args
             with self._lock:

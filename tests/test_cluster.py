@@ -285,3 +285,39 @@ def test_shared_weight_updates_on_unsubscribe():
     # 1:1 after the unsubscribe dropped n0's weight to 1
     assert len(a.inbox) == 5 and len(c.inbox) == 5, \
         (len(a.inbox), len(b.inbox), len(c.inbox))
+
+
+def test_ban_replication_cluster_wide():
+    """A ban created on one node rejects connections on every node
+    (the reference's emqx_banned is a replicated Mnesia table); the
+    delete lifts it everywhere; a new joiner receives the table."""
+    (n0, n1), (c0, c1) = _mk_cluster(2)
+    n0.broker.banned.create("clientid", "evil", duration=600)
+    assert n1.broker.banned.check(clientid="evil")
+    n1.broker.banned.delete("clientid", "evil")
+    assert not n0.broker.banned.check(clientid="evil")
+    # join sync: a third node learns existing bans
+    n0.broker.banned.create("peerhost", "10.0.0.9")
+    n2 = Node(name="n2", boot_listeners=False)
+    c2 = Cluster(n2, c0.transport)
+    c0.join(c2)
+    assert n2.broker.banned.check(peerhost="10.0.0.9")
+
+
+def test_ban_merge_longer_ban_wins():
+    """Join-sync must never let a stale short ban clobber a permanent
+    one (apply() merges longest-wins; expired rules never install)."""
+    import time as _t
+
+    from emqx_tpu.banned import Banned
+
+    b = Banned()
+    b.create("clientid", "x")          # permanent
+    b.apply("clientid", "x", "peer", "", _t.time() + 5)  # shorter
+    assert b.look_up("clientid", "x").until is None  # permanent kept
+    b.apply("clientid", "x", "peer", "", _t.time() - 5)  # expired
+    assert b.look_up("clientid", "x").until is None
+    b2 = Banned()
+    b2.create("clientid", "y", duration=5)
+    b2.apply("clientid", "y", "peer", "", None)  # longer (forever)
+    assert b2.look_up("clientid", "y").until is None  # upgraded

@@ -1,0 +1,193 @@
+"""Property-style randomized suites for the pure components.
+
+Mirrors the reference's remaining PropEr suites (test/props/):
+prop_emqx_base62, prop_emqx_reason_codes, prop_emqx_psk, plus
+invariant fuzzing for the session data structures (inflight window,
+priority queue, mqueue drop policy) that the reference covers with
+randomized CT cases. (prop_emqx_frame's analogue lives in
+test_frame_fuzz.py; prop_emqx_json is stdlib json by design;
+prop_emqx_rpc's badrpc filtering is covered by the transport error
+paths in test_cluster_net.py.)
+"""
+
+import random
+
+import pytest
+
+from emqx_tpu.inflight import Inflight, KeyExists
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqueue import MQueue
+from emqx_tpu.pqueue import PQueue
+from emqx_tpu.types import Message
+from emqx_tpu.utils import base62
+
+
+# -- prop_emqx_base62 -------------------------------------------------------
+
+def test_base62_roundtrip_random_ints():
+    rng = random.Random(62)
+    for _ in range(2000):
+        n = rng.randrange(0, 1 << rng.randint(1, 128))
+        assert base62.decode(base62.encode(n)) == n
+
+
+def test_base62_ordering_and_alphabet():
+    # encodes use only the declared alphabet; zero encodes non-empty
+    assert base62.encode(0)
+    rng = random.Random(63)
+    for _ in range(500):
+        n = rng.randrange(0, 1 << 64)
+        s = base62.encode(n)
+        assert all(c in base62._ALPHABET for c in s)
+
+
+# -- prop_emqx_reason_codes -------------------------------------------------
+
+def test_reason_code_names_total_over_catalog():
+    """Every exported v5 code has a stable name; unknown codes map to
+    the catch-all instead of raising (prop_emqx_reason_codes)."""
+    codes = [v for k, v in vars(RC).items()
+             if k.isupper() and isinstance(v, int)]
+    assert len(set(codes)) > 30
+    for c in codes:
+        n = RC.name(c)
+        assert isinstance(n, str) and n
+    for c in range(0x00, 0xFF):
+        assert isinstance(RC.name(c), str)
+
+
+def test_connack_compat_total_and_in_v3_range():
+    """v5 CONNACK codes translate to a valid v3 code for every byte
+    value (the v3 CONNACK return space is 0..5)."""
+    for c in range(0x80, 0x100):
+        v3 = RC.compat("connack", c)
+        assert v3 is None or 0 <= v3 <= 5, (hex(c), v3)
+    # spot-pins from the reference table (emqx_reason_codes.erl)
+    assert RC.compat("connack", RC.UNSUPPORTED_PROTOCOL_VERSION) == 1
+    assert RC.compat("connack", RC.CLIENT_IDENTIFIER_NOT_VALID) == 2
+    assert RC.compat("connack", RC.SERVER_UNAVAILABLE) == 3
+    assert RC.compat("connack", RC.BAD_USERNAME_OR_PASSWORD) == 4
+    assert RC.compat("connack", RC.NOT_AUTHORIZED) == 5
+
+
+# -- prop_emqx_psk ----------------------------------------------------------
+
+def test_psk_lookup_chain_property():
+    """First resolver that knows the identity wins; unknown
+    identities fall through every resolver to None."""
+    from emqx_tpu.hooks import Hooks
+    from emqx_tpu.psk import PskAuth
+
+    rng = random.Random(7)
+    hooks = Hooks()
+    stores = [
+        {f"id{i}": bytes([i, j]) for i in range(rng.randint(1, 20))}
+        for j in range(3)
+    ]
+    auths = [PskAuth(hooks, s, priority=-j)
+             for j, s in enumerate(stores)]
+    for _ in range(300):
+        ident = f"id{rng.randint(0, 25)}"
+        got = auths[0].lookup(ident)
+        want = None
+        for s in stores:  # priority order = registration order here
+            if ident in s:
+                want = s[ident]
+                break
+        assert got == want, (ident, got, want)
+
+
+# -- inflight window invariants --------------------------------------------
+
+def test_inflight_window_invariants_random_ops():
+    rng = random.Random(11)
+    inf = Inflight(max_size=16)
+    model = {}
+    for _ in range(3000):
+        op = rng.random()
+        key = rng.randint(1, 40)
+        if op < 0.5:
+            if key in model:
+                with pytest.raises(KeyError):
+                    inf.insert(key, key * 10)
+            elif not inf.is_full():
+                # fullness is the CALLER's check (the session gates
+                # on is_full before inserting, emqx_session.erl)
+                inf.insert(key, key * 10)
+                model[key] = key * 10
+        elif op < 0.75:
+            if key in model:
+                inf.delete(key)
+                del model[key]
+        else:
+            assert inf.lookup(key) == model.get(key)
+        assert len(inf) == len(model)
+        assert inf.is_full() == (len(model) >= 16)
+    assert sorted(inf.keys()) == sorted(model)
+
+
+# -- priority queue invariants ----------------------------------------------
+
+def test_pqueue_pops_highest_priority_fifo_within_class():
+    rng = random.Random(13)
+    q = PQueue()
+    model = {}
+    seq = 0
+    for _ in range(2000):
+        if rng.random() < 0.6 or not any(model.values()):
+            prio = rng.choice([0, 1, 2, 5])
+            q.push(("item", seq), prio)
+            model.setdefault(prio, []).append(("item", seq))
+            seq += 1
+        else:
+            ok, item = q.pop()
+            best = max(p for p, xs in model.items() if xs)
+            assert ok and item == model[best].pop(0)
+    while True:
+        ok, item = q.pop()
+        if not ok:
+            break
+        best = max(p for p, xs in model.items() if xs)
+        assert item == model[best].pop(0)
+    assert not any(model.values())
+
+
+# -- mqueue drop policy ------------------------------------------------------
+
+def _msg(topic, qos=1):
+    return Message(topic=topic, payload=b"", qos=qos)
+
+
+def test_mqueue_drop_oldest_within_priority_class():
+    rng = random.Random(17)
+    q = MQueue(max_len=5, priorities={"hot": 9}, store_qos0=True)
+    model = {9: [], 0: []}
+    for i in range(500):
+        topic = rng.choice(["hot", "cold"])
+        prio = 9 if topic == "hot" else 0
+        m = _msg(f"{topic}", qos=rng.randint(0, 2))
+        dropped = q.push(m)
+        model[prio].append(m)
+        if len(model[prio]) > 5:
+            oldest = model[prio].pop(0)
+            assert dropped is oldest, i
+        else:
+            assert dropped is None
+    # drains hot class first, FIFO inside each class
+    out = []
+    while True:
+        m = q.pop()
+        if m is None:
+            break
+        out.append(m)
+    assert out == model[9] + model[0]
+
+
+def test_mqueue_qos0_unstored_when_disabled():
+    q = MQueue(max_len=10, store_qos0=False)
+    m0 = _msg("a", qos=0)
+    assert q.push(m0) is m0  # bounced straight back
+    m1 = _msg("a", qos=1)
+    assert q.push(m1) is None
+    assert q.pop() is m1
