@@ -45,16 +45,22 @@ class Banned:
         return a is None or (b is not None and a >= b)
 
     def apply(self, kind: str, value: str, by: str, reason: str,
-              until: Optional[float]) -> None:
-        """Install a replicated rule with an absolute expiry. Merge
-        rule: the LONGER ban wins — a stale short ban synced from one
-        member must never clobber another member's permanent ban for
-        the same identity."""
+              until: Optional[float], overwrite: bool = False) -> None:
+        """Install a replicated rule with an absolute expiry.
+
+        ``overwrite=True`` is a LIVE create relayed from a peer: it
+        replaces whatever is here, exactly as the originating node's
+        own create() did — otherwise tables diverge (an operator
+        shortening a ban must win everywhere). ``overwrite=False`` is
+        a join-time table sync: longest-ban-wins merge, so a stale
+        short ban from one member never clobbers another member's
+        permanent rule."""
         if until is not None and time.time() > until:
             return  # already expired: never install
         with self._lock:
             cur = self._rules.get((kind, value))
-            if cur is not None and self._outlasts(cur.until, until):
+            if not overwrite and cur is not None \
+                    and self._outlasts(cur.until, until):
                 return
             self._rules[(kind, value)] = BanRule(
                 who=(kind, value), by=by, reason=reason, until=until)
@@ -87,18 +93,12 @@ class Banned:
 
     def expire(self, now: Optional[float] = None) -> int:
         now = time.time() if now is None else now
-        n = 0
         with self._lock:
-            for w in [w for w, r in self._rules.items()
-                      if r.until is not None and now > r.until]:
-                # until re-checked inside the lock: a replicated
-                # refresh racing this sweep must survive
-                r = self._rules.get(w)
-                if r is not None and r.until is not None \
-                        and now > r.until:
-                    del self._rules[w]
-                    n += 1
-        return n
+            dead = [w for w, r in self._rules.items()
+                    if r.until is not None and now > r.until]
+            for w in dead:
+                del self._rules[w]
+        return len(dead)
 
     def info(self) -> list:
         with self._lock:

@@ -204,8 +204,9 @@ class Cluster:
         if banned is not None:
             banned.expire()
             for rule in banned.info():
+                # sync push: merge (longest wins), never overwrite
                 self._broadcast("ban_add", rule.who[0], rule.who[1],
-                                rule.by, rule.reason, rule.until)
+                                rule.by, rule.reason, rule.until, False)
 
     @staticmethod
     def _owned(dest, name: str) -> bool:
@@ -269,6 +270,12 @@ class Cluster:
         except ConnectionError:
             self.handle_nodedown(node)
             return None
+        except Exception:
+            # a takeover failure must degrade to a fresh session,
+            # never kill the CONNECT (the reference's badrpc path)
+            log.exception("remote takeover of %s from %s failed",
+                          client_id, node)
+            return None
 
     def _local_takeover(self, client_id: str):
         cm = self.node.cm
@@ -281,9 +288,13 @@ class Cluster:
         cm.cancel_will(client_id)  # connection re-established elsewhere
         if sess is not None:
             # hand-off: drop table entries here without death-path
-            # side effects; the new node's resume() resubscribes
+            # side effects; the new node's resume() resubscribes.
+            # The broker/notify references MUST be severed: over a
+            # socket transport the session travels pickled, and a
+            # broker drags thread locks + device arrays with it
             self.node.broker.detach_subscriber(sess)
             sess.notify = None
+            sess.broker = None
         return sess
 
     def _purge_node_routes(self, name: str) -> None:
@@ -365,7 +376,10 @@ class Cluster:
                                reason="", duration=None):
         rule = self._orig_ban_create(kind, value, by=by, reason=reason,
                                      duration=duration)
-        self._broadcast("ban_add", kind, value, by, reason, rule.until)
+        # live create: peers overwrite, as this node's own create()
+        # just did — LWW everywhere keeps the tables convergent
+        self._broadcast("ban_add", kind, value, by, reason,
+                        rule.until, True)
         return rule
 
     def _ban_delete_replicated(self, kind, value) -> None:
@@ -450,10 +464,11 @@ class Cluster:
         if op == "ping":
             return "pong"
         if op == "ban_add":
-            kind, value, by, reason, until = args
+            kind, value, by, reason, until, overwrite = args
             banned = self.node.broker.banned
             if banned is not None:
-                banned.apply(kind, value, by, reason, until)
+                banned.apply(kind, value, by, reason, until,
+                             overwrite=overwrite)
             return None
         if op == "ban_del":
             # remote apply MUST bypass the replicated wrapper — going

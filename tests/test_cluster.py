@@ -321,3 +321,17 @@ def test_ban_merge_longer_ban_wins():
     b2.create("clientid", "y", duration=5)
     b2.apply("clientid", "y", "peer", "", None)  # longer (forever)
     assert b2.look_up("clientid", "y").until is None  # upgraded
+
+
+def test_live_ban_create_overwrites_cluster_wide():
+    """A live create must replace the rule EVERYWHERE (an operator
+    shortening a permanent ban wins), while join-sync merges; mixed
+    semantics would leave the tables permanently divergent."""
+    (n0, n1), _ = _mk_cluster(2)
+    n0.broker.banned.create("clientid", "z")            # permanent
+    assert n1.broker.banned.look_up("clientid", "z").until is None
+    n1.broker.banned.create("clientid", "z", duration=60)  # shorten
+    r0 = n0.broker.banned.look_up("clientid", "z")
+    r1 = n1.broker.banned.look_up("clientid", "z")
+    assert r0.until is not None and r1.until is not None
+    assert abs(r0.until - r1.until) < 1.0  # convergent

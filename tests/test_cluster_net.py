@@ -171,3 +171,107 @@ def test_cookie_mismatch_rejected():
             if proc.poll() is None:
                 proc.kill()
     asyncio.run(main())
+
+
+def test_cross_process_session_takeover():
+    """A persistent session created on the subprocess node (with a
+    queued message) moves to this process over the socket transport —
+    the session object travels pickled through the takeover call
+    (emqx_cm:takeover_session RPC, src/emqx_cm.erl:263-272)."""
+    async def main():
+        proc = _spawn_child2("secret-2")
+        try:
+            ready = await _read_line(proc, "READY")
+            peer_cl, peer_mqtt = int(ready.split()[1]), int(ready.split()[2])
+
+            from emqx_tpu.node import Node
+            a = Node(name="nodeA2", boot_listeners=False)
+            a.add_listener(port=0)
+            await a.start()
+            tr = SocketTransport("nodeA2", cookie="secret-2")
+            tr.serve()
+            cl = Cluster(a, transport=tr)
+            cl.join_remote("127.0.0.1", peer_cl)
+
+            # a persistent session on B: subscribe, disconnect, then
+            # B queues a message into the detached session
+            from mqtt_client import TestClient
+            from emqx_tpu.mqtt import constants as MC
+            c1 = TestClient("mover", version=MC.MQTT_V5,
+                            properties={"Session-Expiry-Interval": 7200})
+            await c1.connect(port=peer_mqtt)
+            await c1.subscribe("tk/t", qos=1)
+            await c1.disconnect()
+            proc.stdin.write(b"PUB tk/t queued-on-b\n")
+            proc.stdin.flush()
+            await asyncio.sleep(1.0)
+
+            # reconnect on A: cross-node takeover pulls the pickled
+            # session (queued message included) over the wire
+            c2 = TestClient("mover", version=MC.MQTT_V5,
+                            clean_start=False,
+                            properties={"Session-Expiry-Interval": 7200})
+            ack = await c2.connect(port=a.listeners[0].port, timeout=30)
+            assert ack.session_present, "session not found via registry"
+            m = await asyncio.wait_for(c2.inbox.get(), 30)
+            assert m.payload == b"queued-on-b"
+            await c2.disconnect()
+
+            proc.stdin.write(b"QUIT\n")
+            proc.stdin.flush()
+            proc.wait(timeout=30)
+            await a.stop()
+            tr.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+    asyncio.run(main())
+
+
+CHILD2 = r"""
+import asyncio, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from emqx_tpu.node import Node
+from emqx_tpu.cluster import Cluster
+from emqx_tpu.cluster_net import SocketTransport
+from emqx_tpu.types import Message
+
+
+async def main():
+    cookie = sys.argv[1]
+    n = Node(name="nodeB2", boot_listeners=False)
+    n.add_listener(port=0)
+    await n.start()
+    tr = SocketTransport("nodeB2", cookie=cookie)
+    tr.serve()
+    cl = Cluster(n, transport=tr)
+    print(f"READY {tr.port} {n.listeners[0].port}", flush=True)
+    reader = asyncio.StreamReader()
+    await asyncio.get_running_loop().connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin)
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        parts = line.decode().split()
+        if parts[0] == "PUB":
+            n.broker.publish(
+                Message(topic=parts[1], payload=parts[2].encode()))
+        elif parts[0] == "QUIT":
+            break
+    await n.stop()
+    tr.close()
+
+
+asyncio.run(main())
+"""
+
+
+def _spawn_child2(cookie):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-c", CHILD2, cookie],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, cwd=REPO)
