@@ -56,7 +56,13 @@ class Banned:
         short ban from one member never clobbers another member's
         permanent rule."""
         if until is not None and time.time() > until:
-            return  # already expired: never install
+            # expired in transit (broadcast delay / clock skew). An
+            # overwrite must still take effect as a DELETE — the
+            # originator's table expires the rule too; a no-op here
+            # would leave this node holding the replaced rule forever
+            if overwrite:
+                self.delete(kind, value)
+            return
         with self._lock:
             cur = self._rules.get((kind, value))
             if not overwrite and cur is not None \

@@ -46,6 +46,15 @@ class Flapping:
         if t.count >= self.config.max_count:
             del self._tracks[clientid]
             if self.banned is not None:
+                # never DOWNGRADE an existing longer/permanent ban
+                # (e.g. an operator rule): the auto-ban replicates
+                # with live-create overwrite semantics, so a short
+                # flapping ban would replace it cluster-wide
+                cur = self.banned.look_up("clientid", clientid)
+                until = time.time() + self.config.ban_time
+                if cur is not None and (
+                        cur.until is None or cur.until >= until):
+                    return
                 self.banned.create(
                     "clientid", clientid, by="flapping",
                     reason=f"flapping: {t.count} in {self.config.window}s",

@@ -335,3 +335,34 @@ def test_live_ban_create_overwrites_cluster_wide():
     r1 = n1.broker.banned.look_up("clientid", "z")
     assert r0.until is not None and r1.until is not None
     assert abs(r0.until - r1.until) < 1.0  # convergent
+
+
+def test_flapping_ban_never_downgrades_operator_ban():
+    """A flapping auto-ban (short) must not replace a permanent
+    operator ban — its live-create would replicate the downgrade
+    cluster-wide."""
+    from emqx_tpu.banned import Banned
+    from emqx_tpu.flapping import Flapping, FlappingConfig
+
+    b = Banned()
+    b.create("clientid", "vip-banned")  # operator: permanent
+    f = Flapping(banned=b,
+                 config=FlappingConfig(max_count=2, window=60,
+                                       ban_time=5))
+    for _ in range(3):
+        f.disconnected("vip-banned", "1.2.3.4")
+    rule = b.look_up("clientid", "vip-banned")
+    assert rule is not None and rule.until is None  # still permanent
+
+
+def test_ban_apply_expired_overwrite_deletes():
+    import time as _t
+
+    from emqx_tpu.banned import Banned
+
+    b = Banned()
+    b.create("clientid", "q")  # permanent
+    # an overwrite that expired in transit must DELETE (the
+    # originator's table has expired it too), not no-op
+    b.apply("clientid", "q", "op", "", _t.time() - 1, overwrite=True)
+    assert b.look_up("clientid", "q") is None

@@ -191,3 +191,57 @@ def test_mqueue_qos0_unstored_when_disabled():
     m1 = _msg("a", qos=1)
     assert q.push(m1) is None
     assert q.pop() is m1
+
+
+# -- prop_emqx_sys: $SYS heartbeat content ----------------------------------
+
+def test_sys_heartbeat_topics_and_payload_types():
+    """Every heartbeat publication is a $SYS-flagged message under
+    $SYS/brokers/<node>/..., with string-decimal payloads for
+    stats/metrics and the catalog names intact — and $SYS traffic
+    never reaches a root-wildcard subscriber (emqx_trie $SYS
+    exclusion, the parity oracle's core rule)."""
+    from emqx_tpu.broker import Broker
+    from emqx_tpu.stats import Stats
+    from emqx_tpu.sys_topics import SysTopics
+    from emqx_tpu.types import Message
+
+    b = Broker()
+    got = []
+
+    class SysSub:
+        def deliver(self, topic, msg):
+            got.append((msg.topic, msg.payload, msg.flags.get("sys")))
+
+    class RootSub:
+        def __init__(self):
+            self.leaked = []
+
+        def deliver(self, topic, msg):
+            self.leaked.append(msg.topic)
+
+    b.subscribe(SysSub(), "$SYS/#")
+    root = RootSub()
+    b.subscribe(root, "#")
+    st = Stats()
+    st.setstat("connections.count", 3, "connections.max")
+    sys_t = SysTopics(b, node="n@h", stats=st, interval=60)
+    sys_t.heartbeat()
+
+    assert got, "heartbeat published nothing"
+    prefix = "$SYS/brokers"
+    by_topic = {}
+    for topic, payload, sysflag in got:
+        assert topic.startswith(prefix), topic
+        assert sysflag, f"missing sys flag on {topic}"
+        by_topic[topic] = payload
+    assert by_topic["$SYS/brokers"] == b"n@h"
+    assert by_topic[f"{prefix}/n@h/uptime"].isdigit()
+    assert by_topic[f"{prefix}/n@h/version"]
+    assert by_topic[f"{prefix}/n@h/stats/connections.count"] == b"3"
+    # all stats/metrics payloads parse as integers
+    for topic, payload in by_topic.items():
+        if "/stats/" in topic or "/metrics/" in topic:
+            int(payload)
+    # $SYS exclusion: the root wildcard saw none of it
+    assert root.leaked == [], root.leaked
