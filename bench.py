@@ -80,7 +80,10 @@ def _jax_with_retry(tries: int = None, delay: float = 8.0,
         if ok:
             return jax
         if attempt >= tries:
-            raise BenchInitError(f"backend init failed: {res!r}")
+            # `from res` keeps the real init traceback in the
+            # fail-soft record's stderr dump
+            raise BenchInitError(
+                f"backend init failed: {res!r}") from res
         try:
             from jax.extend.backend import clear_backends
             clear_backends()

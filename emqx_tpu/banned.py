@@ -59,9 +59,14 @@ class Banned:
             # expired in transit (broadcast delay / clock skew). An
             # overwrite must still take effect as a DELETE — the
             # originator's table expires the rule too; a no-op here
-            # would leave this node holding the replaced rule forever
+            # would leave this node holding the replaced rule forever.
+            # Direct pop, NOT self.delete: on a clustered node that
+            # attribute is the replicating wrapper, and a receive
+            # path must never re-broadcast (ping-pong / concurrent-
+            # create deletion)
             if overwrite:
-                self.delete(kind, value)
+                with self._lock:
+                    self._rules.pop((kind, value), None)
             return
         with self._lock:
             cur = self._rules.get((kind, value))
@@ -70,6 +75,25 @@ class Banned:
                 return
             self._rules[(kind, value)] = BanRule(
                 who=(kind, value), by=by, reason=reason, until=until)
+
+    def create_unless_outlasted(self, kind: str, value: str,
+                                by: str = "auto", reason: str = "",
+                                duration: Optional[float] = None
+                                ) -> Optional[BanRule]:
+        """Atomic check-and-create for AUTO bans (flapping): installs
+        only if no existing rule outlasts the new one — the compare
+        must live under the table lock, or a permanent operator ban
+        applied between a caller's look_up and create would still be
+        overwritten (and the downgrade would replicate)."""
+        until = time.time() + duration if duration is not None else None
+        with self._lock:
+            cur = self._rules.get((kind, value))
+            if cur is not None and self._outlasts(cur.until, until):
+                return None
+            rule = BanRule(who=(kind, value), by=by, reason=reason,
+                           until=until)
+            self._rules[rule.who] = rule
+        return rule
 
     def delete(self, kind: str, value: str) -> None:
         with self._lock:

@@ -120,8 +120,10 @@ class Cluster:
         if banned is not None:
             self._orig_ban_create = banned.create
             self._orig_ban_delete = banned.delete
+            self._orig_ban_auto = banned.create_unless_outlasted
             banned.create = self._ban_create_replicated
             banned.delete = self._ban_delete_replicated
+            banned.create_unless_outlasted = self._ban_auto_replicated
         if isinstance(self.transport, LocalTransport):
             self.transport.register(self.name, self)
         elif hasattr(self.transport, "cluster"):
@@ -380,6 +382,15 @@ class Cluster:
         # just did — LWW everywhere keeps the tables convergent
         self._broadcast("ban_add", kind, value, by, reason,
                         rule.until, True)
+        return rule
+
+    def _ban_auto_replicated(self, kind, value, by="auto", reason="",
+                             duration=None):
+        rule = self._orig_ban_auto(kind, value, by=by, reason=reason,
+                                   duration=duration)
+        if rule is not None:  # only an actual install replicates
+            self._broadcast("ban_add", kind, value, by, reason,
+                            rule.until, True)
         return rule
 
     def _ban_delete_replicated(self, kind, value) -> None:

@@ -46,16 +46,14 @@ class Flapping:
         if t.count >= self.config.max_count:
             del self._tracks[clientid]
             if self.banned is not None:
-                # never DOWNGRADE an existing longer/permanent ban
-                # (e.g. an operator rule): the auto-ban replicates
-                # with live-create overwrite semantics, so a short
-                # flapping ban would replace it cluster-wide
-                cur = self.banned.look_up("clientid", clientid)
-                until = time.time() + self.config.ban_time
-                if cur is not None and (
-                        cur.until is None or cur.until >= until):
-                    return
-                self.banned.create(
+                # atomic check-and-create: never DOWNGRADE an
+                # existing longer/permanent ban (e.g. an operator
+                # rule) — the auto-ban replicates with live-create
+                # overwrite semantics, so a short flapping ban would
+                # replace it cluster-wide. The compare lives inside
+                # Banned under its lock (a permanent ban applied
+                # between a look_up and a create must win).
+                self.banned.create_unless_outlasted(
                     "clientid", clientid, by="flapping",
                     reason=f"flapping: {t.count} in {self.config.window}s",
                     duration=self.config.ban_time)

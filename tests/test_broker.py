@@ -183,3 +183,34 @@ def test_publish_batch():
         Message(topic="a/1"), Message(topic="b/1"), Message(topic="a/2")])
     assert counts == [1, 0, 1]
     assert len(s.inbox) == 2
+
+
+def test_package_facade():
+    """Module-level subscribe/publish/hook — emqx.erl:26-64 parity on
+    a process-default broker."""
+    import emqx_tpu
+
+    class S:
+        def __init__(self):
+            self.got = []
+
+        def deliver(self, t, m):
+            self.got.append(m.payload)
+
+    # the default broker is process-global: use unique topics
+    s = S()
+    emqx_tpu.subscribe(s, "facade/+")
+    n = emqx_tpu.publish(Message(topic="facade/x", payload=b"hi"))
+    assert n == 1 and s.got == [b"hi"]
+    assert emqx_tpu.unsubscribe(s, "facade/+")
+    assert emqx_tpu.publish(Message(topic="facade/x")) == 0
+    seen = []
+
+    def on_pub(msg, acc=None):
+        seen.append(msg.topic)
+        return acc
+
+    emqx_tpu.hook("message.publish", on_pub)
+    emqx_tpu.publish(Message(topic="facade/hooked"))
+    assert "facade/hooked" in seen
+    emqx_tpu.unhook("message.publish", on_pub)
