@@ -388,9 +388,13 @@ class Cluster:
                              duration=None):
         rule = self._orig_ban_auto(kind, value, by=by, reason=reason,
                                    duration=duration)
-        if rule is not None:  # only an actual install replicates
+        if rule is not None:  # only an actual install replicates —
+            # and with MERGE semantics (overwrite=False), matching
+            # create_unless_outlasted's own never-downgrade contract:
+            # an auto ban racing a replicated operator ban must not
+            # replace it on the peers
             self._broadcast("ban_add", kind, value, by, reason,
-                            rule.until, True)
+                            rule.until, False)
         return rule
 
     def _ban_delete_replicated(self, kind, value) -> None:

@@ -89,6 +89,8 @@ class SocketTransport(Transport):
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = threading.Event()
+        self._probing: set = set()      # peers with a probe in flight
+        self._probe_tasks: set = set()  # cancelled at close()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -131,6 +133,15 @@ class SocketTransport(Transport):
         async def _shutdown():
             if self._server is not None:
                 self._server.close()
+            pending = list(self._probe_tasks)
+            for task in pending:
+                task.cancel()
+            if pending:
+                # cancel() only schedules the CancelledError; the
+                # tasks must actually unwind before the loop stops,
+                # or loop.close() still reports them destroyed-
+                # while-pending
+                await asyncio.gather(*pending, return_exceptions=True)
             for _, w, _l in list(self._conns.values()):
                 try:
                     w.close()
@@ -212,7 +223,8 @@ class SocketTransport(Transport):
         if ent is not None and not ent[1].is_closing():
             return ent
         reader, writer = await asyncio.open_connection(*addr)
-        await _send_frame(writer, (_HELLO, 0, (self.name, self.cookie)))
+        await _send_frame(writer, (_HELLO, 0,
+                                   (self.name, self.cookie, False)))
         kind, _, ok = await _recv_frame(reader)
         if kind != _REPLY or not ok:
             writer.close()
@@ -252,11 +264,17 @@ class SocketTransport(Transport):
         peer = writer.get_extra_info("peername")
         name = None
         try:
-            kind, _, (name, cookie) = await _recv_frame(reader)
+            kind, _, hello = await _recv_frame(reader)
+            name, cookie = hello[0], hello[1]
+            is_probe = bool(hello[2]) if len(hello) > 2 else False
             if kind != _HELLO or cookie != self.cookie:
                 name = None
                 await _send_frame(writer, (_REPLY, 0, False))
                 return
+            if is_probe:
+                # a liveness probe's disconnect is expected, never a
+                # link-drop signal
+                name = None
             await _send_frame(writer, (_REPLY, 0, True))
             while True:
                 kind, req, (op, args) = await _recv_frame(reader)
@@ -286,32 +304,64 @@ class SocketTransport(Transport):
             # a transient drop (idle middlebox reset) must NOT purge
             # a live member — probe before declaring death.
             if name is not None and self.cluster is not None \
-                    and name in self._peers:
+                    and name in self._peers and name not in self._probing:
                 coro = self._probe_then_nodedown(name)
                 try:
-                    self._loop.create_task(coro)
+                    task = self._loop.create_task(coro)
+                    self._probe_tasks.add(task)
+                    task.add_done_callback(self._probe_tasks.discard)
                 except RuntimeError:  # transport shutting down
                     coro.close()
 
     async def _probe_then_nodedown(self, name: str) -> None:
-        addr = self._peers.get(name)
-        for attempt in range(3):
-            try:
-                stale = self._conns.pop(addr, None)  # force fresh dial
-                if stale is not None:
-                    try:
-                        stale[1].close()  # don't leak the old socket
-                    except Exception:
-                        pass
-                if await self._request(addr, "ping", ()) == "pong":
-                    return  # alive: the drop was transient
-            except Exception:
-                pass
-            await asyncio.sleep(0.3 * (attempt + 1))
+        if name in self._probing:
+            return  # one probe per peer: a storm of link drops must
+            # not fan out into a storm of probes
+        self._probing.add(name)
         try:
-            await self._dispatch("nodedown", (name,))
+            addr = self._peers.get(name)
+            for attempt in range(3):
+                if await self._probe_once(addr):
+                    return  # alive: the drop was transient
+                await asyncio.sleep(0.3 * (attempt + 1))
+            try:
+                await self._dispatch("nodedown", (name,))
+            except Exception:
+                log.exception("nodedown dispatch for %s failed", name)
+        finally:
+            self._probing.discard(name)
+
+    async def _probe_once(self, addr) -> bool:
+        """Liveness ping over a DEDICATED throwaway connection. The
+        cached data connection must not be touched: closing it to
+        force a fresh dial would drop the peer's inbound link, firing
+        the peer's own probe against us — a mutual probe/close storm
+        that can sever a call in flight."""
+        writer = None
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(*addr), timeout=3.0)
+            # probe-flagged hello: the peer must NOT treat this
+            # connection's close as a link drop, or every successful
+            # probe would trigger a counter-probe — two healthy nodes
+            # ping-ponging probes forever
+            await _send_frame(writer, (_HELLO, 0,
+                                       (self.name, self.cookie, True)))
+            kind, _, ok = await asyncio.wait_for(_recv_frame(reader), 3.0)
+            if kind != _REPLY or not ok:
+                return False
+            await _send_frame(writer, (_CALL, 1, ("ping", ())))
+            kind, _, payload = await asyncio.wait_for(
+                _recv_frame(reader), 3.0)
+            return kind == _REPLY and payload == "pong"
         except Exception:
-            log.exception("nodedown dispatch for %s failed", name)
+            return False
+        finally:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
 
     async def _dispatch(self, op: str, args):
         """Run one inbound RPC.

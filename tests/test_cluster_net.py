@@ -204,7 +204,14 @@ def test_cross_process_session_takeover():
             await c1.disconnect()
             proc.stdin.write(b"PUB tk/t queued-on-b\n")
             proc.stdin.flush()
-            await asyncio.sleep(1.0)
+            # client_up replication is an async cast: the takeover
+            # can only find the session once A's registry has it
+            deadline = asyncio.get_running_loop().time() + 30
+            while cl.locate_client("mover") != "nodeB2":
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "registry entry never replicated"
+                await asyncio.sleep(0.2)
+            await asyncio.sleep(0.5)  # let the queued PUB land too
 
             # reconnect on A: cross-node takeover pulls the pickled
             # session (queued message included) over the wire
