@@ -91,6 +91,7 @@ class SocketTransport(Transport):
         self._started = threading.Event()
         self._probing: set = set()      # peers with a probe in flight
         self._probe_tasks: set = set()  # cancelled at close()
+        self._closing = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -129,6 +130,10 @@ class SocketTransport(Transport):
     def close(self) -> None:
         if self._loop is None:
             return
+        # set BEFORE the shutdown callback runs: an _on_peer EOF
+        # firing during the cancel/gather must not spawn a fresh
+        # probe task that escapes it
+        self._closing = True
 
         async def _shutdown():
             if self._server is not None:
@@ -223,8 +228,10 @@ class SocketTransport(Transport):
         if ent is not None and not ent[1].is_closing():
             return ent
         reader, writer = await asyncio.open_connection(*addr)
-        await _send_frame(writer, (_HELLO, 0,
-                                   (self.name, self.cookie, False)))
+        # data-plane hello stays a 2-tuple (receivers default the
+        # probe flag to False): only probes need the third field, and
+        # an older receiver would crash unpacking a 3-tuple
+        await _send_frame(writer, (_HELLO, 0, (self.name, self.cookie)))
         kind, _, ok = await _recv_frame(reader)
         if kind != _REPLY or not ok:
             writer.close()
@@ -304,7 +311,8 @@ class SocketTransport(Transport):
             # a transient drop (idle middlebox reset) must NOT purge
             # a live member — probe before declaring death.
             if name is not None and self.cluster is not None \
-                    and name in self._peers and name not in self._probing:
+                    and name in self._peers \
+                    and name not in self._probing and not self._closing:
                 coro = self._probe_then_nodedown(name)
                 try:
                     task = self._loop.create_task(coro)

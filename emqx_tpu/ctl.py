@@ -72,8 +72,23 @@ class Ctl:
             "status | join <host:port> | leave  (emqx_ctl cluster)")
         self.register_command("listeners", self._listeners,
                               "list listeners + connection counts")
+        self.register_command("log", self._log,
+                              "set-level <debug|info|warning|error> | show")
         from emqx_tpu.profiling import register_ctl
         register_ctl(self)
+
+    def _log(self, args) -> str:
+        import logging
+        root = logging.getLogger("emqx_tpu")
+        if not args or args[0] == "show":
+            return f"level: {logging.getLevelName(root.level)}"
+        if args[0] == "set-level":
+            level = getattr(logging, args[1].upper(), None)
+            if not isinstance(level, int):
+                raise ValueError(f"bad level: {args[1]}")
+            root.setLevel(level)
+            return f"level: {logging.getLevelName(root.level)}"
+        raise ValueError(f"bad subcommand: {args[0]}")
 
     def _listeners(self, args) -> str:
         out = []

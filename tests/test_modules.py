@@ -327,3 +327,23 @@ def test_plugins_lifecycle(tmp_path):
     n2.plugins.register(p2)
     n2.plugins.load_all()
     assert p2.loads == 1
+
+
+def test_ctl_log_level():
+    import logging
+
+    n = Node(boot_listeners=False)
+    root = logging.getLogger("emqx_tpu")
+    saved = root.level
+    try:
+        out = n.ctl.run(["log", "set-level", "debug"])
+        assert "DEBUG" in out
+        assert root.level == logging.DEBUG
+        assert "DEBUG" in n.ctl.run(["log", "show"])
+        out = n.ctl.run(["log", "set-level", "bogus"])
+        assert "error" in out
+    finally:
+        root.setLevel(saved)  # process-global: never leak a level
+    # profile registration survives (regression: inserting a command
+    # mid-_register_builtins once orphaned it)
+    assert "profile" in n.ctl.run(["help"])
