@@ -344,17 +344,26 @@ class SocketTransport(Transport):
         cached data connection must not be touched: closing it to
         force a fresh dial would drop the peer's inbound link, firing
         the peer's own probe against us — a mutual probe/close storm
-        that can sever a call in flight."""
+        that can sever a call in flight.
+
+        The hello carries the probe flag (the peer must not treat
+        this connection's close as a link drop). A peer too old to
+        know the flag dies unpacking the 3-tuple, so a failed flagged
+        attempt retries once unflagged — a false nodedown against a
+        live legacy peer would be worse than one stray counter-probe.
+        """
+        if await self._probe_dial(addr, flagged=True):
+            return True
+        return await self._probe_dial(addr, flagged=False)
+
+    async def _probe_dial(self, addr, flagged: bool) -> bool:
         writer = None
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*addr), timeout=3.0)
-            # probe-flagged hello: the peer must NOT treat this
-            # connection's close as a link drop, or every successful
-            # probe would trigger a counter-probe — two healthy nodes
-            # ping-ponging probes forever
-            await _send_frame(writer, (_HELLO, 0,
-                                       (self.name, self.cookie, True)))
+            hello = (self.name, self.cookie, True) if flagged \
+                else (self.name, self.cookie)
+            await _send_frame(writer, (_HELLO, 0, hello))
             kind, _, ok = await asyncio.wait_for(_recv_frame(reader), 3.0)
             if kind != _REPLY or not ok:
                 return False

@@ -83,10 +83,15 @@ class Ctl:
         if not args or args[0] == "show":
             return f"level: {logging.getLevelName(root.level)}"
         if args[0] == "set-level":
+            if len(args) < 2:
+                raise ValueError("set-level needs a level")
             level = getattr(logging, args[1].upper(), None)
             if not isinstance(level, int):
                 raise ValueError(f"bad level: {args[1]}")
-            root.setLevel(level)
+            # through logger.setup: a pinned handler level would
+            # silently swallow records the logger now admits
+            from emqx_tpu.logger import setup as _setup
+            _setup(level=level)
             return f"level: {logging.getLevelName(root.level)}"
         raise ValueError(f"bad subcommand: {args[0]}")
 

@@ -1,0 +1,112 @@
+"""Dedicated suites for the small utility modules, mirroring the
+reference's emqx_keepalive_SUITE / emqx_mountpoint_SUITE /
+emqx_tracer_SUITE and the esockd rate-limit behavior emqx_limiter
+wraps."""
+
+import time
+
+import pytest
+
+from emqx_tpu.keepalive import Keepalive
+from emqx_tpu.limiter import TokenBucket
+from emqx_tpu.mountpoint import mount, replvar, unmount
+from emqx_tpu.tracer import Tracer
+from emqx_tpu.types import Message
+
+
+# -- emqx_keepalive_SUITE ---------------------------------------------------
+
+def test_keepalive_byte_delta():
+    ka = Keepalive(interval=60)
+    assert ka.check_interval() == 90.0  # 1.5x per the MQTT spec
+    assert not ka.check(0)     # no bytes ever: dead
+    assert ka.check(100)       # progress
+    assert not ka.check(100)   # idle for a full interval: dead
+    assert ka.check(150)
+
+
+# -- emqx_mountpoint_SUITE --------------------------------------------------
+
+def test_mountpoint_mount_unmount_roundtrip():
+    mp = "tenant-a/"
+    assert mount(mp, "dev/1") == "tenant-a/dev/1"
+    assert unmount(mp, "tenant-a/dev/1") == "dev/1"
+    assert unmount(mp, "other/dev") == "other/dev"  # foreign topic
+    assert mount(None, "t") == "t"
+    assert unmount(None, "t") == "t"
+    assert mount("", "t") == "t"
+
+
+def test_mountpoint_replvar():
+    assert replvar("%c/", client_id="c1") == "c1/"
+    assert replvar("u/%u/c/%c/", client_id="c1",
+                   username="alice") == "u/alice/c/c1/"
+    # no username: %u stays (the reference substitutes only known vars)
+    assert replvar("%u/", client_id="c1") == "%u/"
+    assert replvar(None, client_id="c1") is None
+    assert replvar("", client_id="c1") == ""
+
+
+# -- limiter (esockd_rate_limit semantics) ----------------------------------
+
+def test_token_bucket_burst_then_pause():
+    tb = TokenBucket(rate=100.0, burst=10.0)
+    for _ in range(10):
+        assert tb.consume(1.0) == 0.0  # burst capacity is free
+    pause = tb.consume(5.0)
+    assert pause > 0.0                 # exhausted: caller must pause
+    assert pause == pytest.approx(5.0 / 100.0, rel=0.3)
+
+
+def test_token_bucket_refills_with_time():
+    tb = TokenBucket(rate=1000.0, burst=5.0)
+    tb.consume(5.0)
+    assert not tb.check(5.0)
+    time.sleep(0.01)                   # ~10 tokens refilled, cap 5
+    assert tb.check(5.0)
+    assert tb.consume(5.0) == 0.0
+
+
+def test_token_bucket_check_does_not_consume():
+    tb = TokenBucket(rate=10.0, burst=2.0)
+    assert tb.check(2.0)
+    assert tb.check(2.0)               # peeking twice changes nothing
+    assert tb.consume(2.0) == 0.0
+
+
+# -- emqx_tracer_SUITE ------------------------------------------------------
+
+def _msg(topic, payload=b"x", from_="c1"):
+    return Message(topic=topic, payload=payload, from_=from_)
+
+
+def test_tracer_topic_filter():
+    t = Tracer()
+    sink = t.start_trace("topic", "a/b")
+    t.trace_publish(_msg("a/b"))
+    t.trace_publish(_msg("other"))
+    assert len(sink) == 1 and "a/b" in sink[0]
+    assert t.lookup_traces() == [("topic", "a/b")]
+    assert t.stop_trace("topic", "a/b")
+    t.trace_publish(_msg("a/b"))
+    assert len(sink) == 1              # stopped: nothing more
+
+
+def test_tracer_clientid_filter_and_double_start():
+    t = Tracer()
+    sink = t.start_trace("clientid", "c9")
+    t.trace_publish(_msg("t", from_="c9"))
+    t.trace_publish(_msg("t", from_="other"))
+    assert len(sink) == 1
+    with pytest.raises(ValueError):
+        t.start_trace("clientid", "c9")  # already_traced
+    assert not t.stop_trace("clientid", "unknown")
+
+
+def test_tracer_independent_instances():
+    t1, t2 = Tracer(), Tracer()
+    s1 = t1.start_trace("topic", "x")
+    t2.trace_publish(_msg("x"))        # t2 has no traces: no-op
+    assert s1 == []
+    t1.trace_publish(_msg("x"))
+    assert len(s1) == 1
