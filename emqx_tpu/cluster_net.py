@@ -228,9 +228,8 @@ class SocketTransport(Transport):
         if ent is not None and not ent[1].is_closing():
             return ent
         reader, writer = await asyncio.open_connection(*addr)
-        # data-plane hello stays a 2-tuple (receivers default the
-        # probe flag to False): only probes need the third field, and
-        # an older receiver would crash unpacking a 3-tuple
+        # data-plane hello: 2-tuple (the probe flag defaults False
+        # receiver-side; only probe dials carry the third field)
         await _send_frame(writer, (_HELLO, 0, (self.name, self.cookie)))
         kind, _, ok = await _recv_frame(reader)
         if kind != _REPLY or not ok:
@@ -347,23 +346,19 @@ class SocketTransport(Transport):
         that can sever a call in flight.
 
         The hello carries the probe flag (the peer must not treat
-        this connection's close as a link drop). A peer too old to
-        know the flag dies unpacking the 3-tuple, so a failed flagged
-        attempt retries once unflagged — a false nodedown against a
-        live legacy peer would be worse than one stray counter-probe.
-        """
-        if await self._probe_dial(addr, flagged=True):
-            return True
-        return await self._probe_dial(addr, flagged=False)
-
-    async def _probe_dial(self, addr, flagged: bool) -> bool:
+        this connection's close as a link drop, or every probe close
+        would fire a counter-probe). Cluster peers are assumed
+        co-versioned — the link is cookie-gated and pickles Python
+        objects, so mixed-version clusters are out of contract; no
+        legacy-hello fallback exists (every attempted variant of one
+        reintroduced a probe storm or doubled dead-peer detection
+        latency)."""
         writer = None
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*addr), timeout=3.0)
-            hello = (self.name, self.cookie, True) if flagged \
-                else (self.name, self.cookie)
-            await _send_frame(writer, (_HELLO, 0, hello))
+            await _send_frame(writer, (_HELLO, 0,
+                                       (self.name, self.cookie, True)))
             kind, _, ok = await asyncio.wait_for(_recv_frame(reader), 3.0)
             if kind != _REPLY or not ok:
                 return False

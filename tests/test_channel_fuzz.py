@@ -1,0 +1,118 @@
+"""Channel FSM fuzzing: random packet sequences must never crash.
+
+The reference exercises its FSM with mocked collaborators
+(test/emqx_channel_SUITE.erl, SURVEY §4 tier 3); this suite goes
+further and throws randomized, partially nonsensical — but
+well-formed — packet sequences at the sans-IO channel. Contract under
+fuzz: handle_in never raises, a closed channel stays silent, every
+returned object is a serializable packet, and QoS1 publishes on a
+live session are always acked exactly once.
+"""
+
+import random
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.channel import Channel
+from emqx_tpu.cm import ConnectionManager
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import serialize
+from emqx_tpu.mqtt.packet import (Auth, Connect, Disconnect, Packet,
+                                  Pingreq, PubAck, Publish, Subscribe,
+                                  Unsubscribe)
+
+TOPICS = ["a", "a/b", "s/+/x", "q/#", "$SYS/x", "", "a//b", "#", "+"]
+
+
+def _rand_packet(rng, version, pid_pool):
+    t = rng.randrange(9)
+    if t == 0:
+        return Connect(proto_ver=version,
+                       proto_name=C.PROTOCOL_NAMES[version],
+                       client_id=f"fz{rng.randrange(3)}",
+                       clean_start=bool(rng.randrange(2)),
+                       keepalive=rng.randrange(0, 120))
+    if t == 1:
+        qos = rng.randrange(3)
+        return Publish(topic=rng.choice(TOPICS), qos=qos,
+                       retain=bool(rng.randrange(2)),
+                       packet_id=rng.randint(1, 20) if qos else None,
+                       payload=rng.randbytes(rng.randrange(16)))
+    if t == 2:
+        return Subscribe(packet_id=rng.randint(1, 20), topic_filters=[
+            (rng.choice(TOPICS),
+             {"qos": rng.randrange(3), "nl": rng.randrange(2),
+              "rap": 0, "rh": 0})
+            for _ in range(rng.randint(1, 3))])
+    if t == 3:
+        return Unsubscribe(packet_id=rng.randint(1, 20),
+                           topic_filters=[rng.choice(TOPICS)])
+    if t == 4:
+        # acks for ids the server may or may not know
+        ptype = rng.choice([C.PUBACK, C.PUBREC, C.PUBREL, C.PUBCOMP])
+        pid = rng.choice(pid_pool) if pid_pool and rng.random() < 0.5 \
+            else rng.randint(1, 20)
+        return PubAck(type=ptype, packet_id=pid)
+    if t == 5:
+        return Pingreq()
+    if t == 6:
+        return Disconnect(reason_code=rng.choice([0, 4]))
+    if t == 7:
+        return Auth()
+    return Publish(topic="$SYS/fake", qos=0, payload=b"spoof")
+
+
+def _run_sequence(seed, version, n_packets=120):
+    rng = random.Random(seed)
+    broker = Broker()
+    cm = ConnectionManager(broker=broker)
+    chan = Channel(broker, cm)
+    pid_pool = []
+    for i in range(n_packets):
+        pkt = _rand_packet(rng, version, pid_pool)
+        out = chan.handle_in(pkt)
+        out = list(out or []) + list(chan.handle_deliver() or [])
+        for o in out:
+            assert isinstance(o, Packet), (seed, i, o)
+            data = serialize(o, chan.proto_ver)  # wire-encodable
+            assert isinstance(data, (bytes, bytearray))
+            if isinstance(o, Publish) and o.qos:
+                pid_pool.append(o.packet_id)
+        if chan.closed:
+            # a closed channel stays silent from here on
+            silent = chan.handle_in(Pingreq())
+            assert not silent, (seed, i)
+            break
+    # cleanup never raises either
+    if not chan.closed:
+        chan._shutdown()
+
+
+def test_fsm_random_sequences_v4():
+    for seed in range(40):
+        _run_sequence(seed, C.MQTT_V4)
+
+
+def test_fsm_random_sequences_v5():
+    for seed in range(40):
+        _run_sequence(1000 + seed, C.MQTT_V5)
+
+
+def test_fsm_random_sequences_v3():
+    for seed in range(20):
+        _run_sequence(2000 + seed, C.MQTT_V3)
+
+
+def test_qos1_publish_always_acked_once_when_connected():
+    rng = random.Random(777)
+    broker = Broker()
+    cm = ConnectionManager(broker=broker)
+    chan = Channel(broker, cm)
+    chan.handle_in(Connect(proto_ver=C.MQTT_V4, client_id="ack1"))
+    assert chan.state == "connected"
+    for i in range(50):
+        pid = rng.randint(1, 0xFFFF)
+        out = chan.handle_in(Publish(topic="t", qos=1, packet_id=pid,
+                                     payload=b"x"))
+        acks = [o for o in out
+                if isinstance(o, PubAck) and o.type == C.PUBACK]
+        assert len(acks) == 1 and acks[0].packet_id == pid, (i, out)
