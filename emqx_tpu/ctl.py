@@ -88,13 +88,8 @@ class Ctl:
             level = getattr(logging, args[1].upper(), None)
             if not isinstance(level, int):
                 raise ValueError(f"bad level: {args[1]}")
-            root.setLevel(level)
-            # existing handlers with a pinned level would silently
-            # swallow records the logger now admits; adjust them —
-            # but never CREATE a handler (an embedding app may route
-            # broker logs through its own handlers via propagation)
-            for h in root.handlers:
-                h.setLevel(level)
+            from emqx_tpu.logger import set_level
+            set_level(level)
             return f"level: {logging.getLevelName(root.level)}"
         raise ValueError(f"bad subcommand: {args[0]}")
 

@@ -1,0 +1,99 @@
+"""Compact full-stack soak: concurrent publishers, subscriber churn,
+and a mid-stream takeover, with a zero-QoS1-loss assertion.
+
+The reference's takeover suite streams traffic through one session
+(test/emqx_takeover_SUITE.erl); this drives the whole node — ingress
+batcher, device match, fan-out, sessions — under concurrent load to
+catch interaction bugs no single-feature suite sees.
+"""
+
+import asyncio
+
+from emqx_tpu.mqtt import constants as C
+from tests.helpers import broker_node, node_port as _port
+from tests.mqtt_client import TestClient
+
+N_PUBS = 4
+MSGS_PER_PUB = 40
+
+
+async def test_soak_mixed_load_no_qos1_loss():
+    async with broker_node() as node:
+        port = _port(node)
+
+        # durable subscriber whose session will be taken over mid-run
+        sub = TestClient("soak-sub", version=C.MQTT_V5,
+                        properties={"Session-Expiry-Interval": 7200})
+        await sub.connect(port=port)
+        await sub.subscribe("soak/+/data", qos=1)
+
+        # churner adds/removes unrelated filters the whole time
+        churner = TestClient("soak-churn")
+        await churner.connect(port=port)
+
+        async def churn():
+            for i in range(30):
+                await churner.subscribe(f"churn/{i}/+")
+                if i % 3 == 2:
+                    await churner.unsubscribe(f"churn/{i - 1}/+")
+                await asyncio.sleep(0.01)
+
+        async def publish_stream(k):
+            pub = TestClient(f"soak-pub{k}")
+            await pub.connect(port=port)
+            for i in range(MSGS_PER_PUB):
+                await pub.publish(f"soak/{k}/data",
+                                  f"{k}:{i}".encode(), qos=1,
+                                  timeout=60)
+            await pub.disconnect()
+
+        got = set()
+        takeover_done = asyncio.Event()
+
+        async def drain_with_takeover():
+            nonlocal sub
+            while len(got) < N_PUBS * MSGS_PER_PUB:
+                try:
+                    m = await asyncio.wait_for(sub.inbox.get(), 30)
+                except asyncio.TimeoutError:
+                    break
+                got.add(m.payload)
+                if len(got) == N_PUBS * MSGS_PER_PUB // 3 \
+                        and not takeover_done.is_set():
+                    takeover_done.set()
+                    # same clientid reconnects: kicks the old
+                    # connection, resumes the session, replays
+                    newc = TestClient(
+                        "soak-sub", version=C.MQTT_V5,
+                        clean_start=False,
+                        properties={"Session-Expiry-Interval": 7200})
+                    ack = await newc.connect(port=port, timeout=30)
+                    assert ack.session_present
+                    # the old client object may hold delivered-and-
+                    # auto-acked messages in its inbox: the broker is
+                    # done with them, so the TEST must not drop them
+                    while not sub.inbox.empty():
+                        got.add(sub.inbox.get_nowait().payload)
+                    sub = newc
+
+        await asyncio.gather(
+            churn(), drain_with_takeover(),
+            *(publish_stream(k) for k in range(N_PUBS)))
+        # drain the tail after the publishers finish
+        deadline = asyncio.get_running_loop().time() + 30
+        while len(got) < N_PUBS * MSGS_PER_PUB and \
+                asyncio.get_running_loop().time() < deadline:
+            try:
+                m = await asyncio.wait_for(sub.inbox.get(), 5)
+                got.add(m.payload)
+            except asyncio.TimeoutError:
+                pass
+
+        want = {f"{k}:{i}".encode()
+                for k in range(N_PUBS) for i in range(MSGS_PER_PUB)}
+        missing = want - got
+        assert not missing, \
+            f"lost {len(missing)} QoS1 messages: {sorted(missing)[:8]}"
+        assert takeover_done.is_set()
+        await sub.disconnect()
+        await churner.disconnect()
