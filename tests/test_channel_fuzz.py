@@ -23,14 +23,18 @@ from emqx_tpu.mqtt.packet import (Auth, Connect, Disconnect, Packet,
 TOPICS = ["a", "a/b", "s/+/x", "q/#", "$SYS/x", "", "a//b", "#", "+"]
 
 
+def _connect_pkt(rng, version):
+    return Connect(proto_ver=version,
+                   proto_name=C.PROTOCOL_NAMES[version],
+                   client_id=f"fz{rng.randrange(3)}",
+                   clean_start=bool(rng.randrange(2)),
+                   keepalive=rng.randrange(0, 120))
+
+
 def _rand_packet(rng, version, pid_pool):
     t = rng.randrange(9)
     if t == 0:
-        return Connect(proto_ver=version,
-                       proto_name=C.PROTOCOL_NAMES[version],
-                       client_id=f"fz{rng.randrange(3)}",
-                       clean_start=bool(rng.randrange(2)),
-                       keepalive=rng.randrange(0, 120))
+        return _connect_pkt(rng, version)
     if t == 1:
         qos = rng.randrange(3)
         return Publish(topic=rng.choice(TOPICS), qos=qos,
@@ -59,14 +63,6 @@ def _rand_packet(rng, version, pid_pool):
     if t == 7:
         return Auth()
     return Publish(topic="$SYS/fake", qos=0, payload=b"spoof")
-
-
-def _connect_pkt(rng, version):
-    return Connect(proto_ver=version,
-                   proto_name=C.PROTOCOL_NAMES[version],
-                   client_id=f"fz{rng.randrange(3)}",
-                   clean_start=bool(rng.randrange(2)),
-                   keepalive=rng.randrange(0, 120))
 
 
 def _run_sequence(seed, version, n_packets=120):

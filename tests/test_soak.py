@@ -97,3 +97,76 @@ async def test_soak_mixed_load_no_qos1_loss():
         assert takeover_done.is_set()
         await sub.disconnect()
         await churner.disconnect()
+
+
+X_PUBS = 3
+X_MSGS = 30
+
+
+async def test_soak_cross_node_no_qos1_loss():
+    """Two-node variant over real MQTT sockets: subscribers on node B,
+    publishers on node A, route churn throughout — every QoS1 message
+    must cross the cluster seam, with no duplicates."""
+    from emqx_tpu.cluster import Cluster, LocalTransport
+    from emqx_tpu.node import Node
+
+    transport = LocalTransport()
+    a = Node(name="soakA", boot_listeners=False)
+    b = Node(name="soakB", boot_listeners=False)
+    a.add_listener(port=0)
+    b.add_listener(port=0)
+    await a.start()
+    await b.start()
+    ca, cb = Cluster(a, transport), Cluster(b, transport)
+    ca.join(cb)
+    try:
+        sub = TestClient("xsub")
+        await sub.connect(port=b.listeners[0].port)
+        await sub.subscribe("xn/+/d", qos=1)
+        churner = TestClient("xchurn")
+        await churner.connect(port=b.listeners[0].port)
+
+        async def churn():
+            for i in range(25):
+                await churner.subscribe(f"xc/{i}")
+                await asyncio.sleep(0.01)
+
+        async def stream(k):
+            pub = TestClient(f"xpub{k}")
+            await pub.connect(port=a.listeners[0].port)
+            for i in range(X_MSGS):
+                await pub.publish(f"xn/{k}/d", f"{k}:{i}".encode(),
+                                  qos=1, timeout=60)
+            await pub.disconnect()
+
+        got = {}
+
+        async def drain():
+            want_n = X_PUBS * X_MSGS
+            deadline = asyncio.get_running_loop().time() + 60
+            while len(got) < want_n and \
+                    asyncio.get_running_loop().time() < deadline:
+                try:
+                    m = await asyncio.wait_for(sub.inbox.get(), 5)
+                    got[m.payload] = got.get(m.payload, 0) + 1
+                except asyncio.TimeoutError:
+                    pass
+
+        await asyncio.gather(churn(), drain(),
+                             *(stream(k) for k in range(X_PUBS)))
+        # tail-drain so a late duplicate would be counted, not raced
+        await asyncio.sleep(0.5)
+        while not sub.inbox.empty():
+            m = sub.inbox.get_nowait()
+            got[m.payload] = got.get(m.payload, 0) + 1
+        want = {f"{k}:{i}".encode()
+                for k in range(X_PUBS) for i in range(X_MSGS)}
+        missing = want - set(got)
+        assert not missing, f"lost across nodes: {sorted(missing)[:8]}"
+        dups = {p: n for p, n in got.items() if n > 1}
+        assert not dups, f"duplicate cross-node deliveries: {dups}"
+        await sub.disconnect()
+        await churner.disconnect()
+    finally:
+        await a.stop()
+        await b.stop()
