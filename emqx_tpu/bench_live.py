@@ -13,7 +13,10 @@ sample. Reports end-to-end deliveries/sec plus p50/p99
 socket-to-deliver latency.
 
 Env knobs: LIVE_PUBS, LIVE_SUBS, LIVE_TOPICS, LIVE_SECS,
-LIVE_PIPELINE (outstanding publishes per publisher), BENCH_PLATFORM.
+LIVE_PIPELINE (outstanding publishes per publisher), LIVE_RATE
+(publishes/sec per publisher; 0 = saturate — percentiles then
+measure queue depth, use a paced rate for meaningful latency),
+BENCH_PLATFORM.
 """
 
 from __future__ import annotations
@@ -83,12 +86,19 @@ class _Peer:
         except (asyncio.CancelledError, ConnectionResetError):
             return
 
-    async def publish_loop(self, topics, stop, pipeline: int) -> int:
+    async def publish_loop(self, topics, stop, pipeline: int,
+                           rate: float = 0.0) -> int:
         """Pipelined QoS0 publishing until ``stop`` is set; drains
         the socket buffer every ``pipeline`` sends so the OS buffer
-        (not this coroutine) is the limiter."""
+        (not this coroutine) is the limiter.
+
+        ``rate`` > 0 paces to that many publishes/sec instead of
+        saturating: under saturation the latency percentiles measure
+        QUEUE DEPTH, not service time — the paced mode is the one
+        whose p50/p99 mean anything."""
         sent = 0
         i = 0
+        next_t = time.perf_counter()
         while not stop.is_set():
             topic = topics[i % len(topics)]
             i += 1
@@ -97,7 +107,28 @@ class _Peer:
                 Publish(topic=topic, payload=payload, qos=0),
                 C.MQTT_V4))
             sent += 1
-            if sent % pipeline == 0:
+            if rate > 0:
+                await self.writer.drain()
+                next_t += 1.0 / rate
+                now = time.perf_counter()
+                if next_t < now:
+                    # fell behind (a stall, or rate > achievable):
+                    # re-anchor rather than burst full-speed to catch
+                    # up — a catch-up burst puts the samples right
+                    # back into the queue-depth regime this mode
+                    # exists to avoid
+                    next_t = now
+                pause = next_t - now
+                if pause > 0:
+                    try:
+                        # stop-aware: a low rate (long pause) must not
+                        # overshoot the timed window by up to 1/rate
+                        await asyncio.wait_for(stop.wait(), pause)
+                    except asyncio.TimeoutError:
+                        pass
+                else:
+                    await asyncio.sleep(0)
+            elif sent % pipeline == 0:
                 await self.writer.drain()
                 # drain() does not yield below the high-water mark;
                 # yield explicitly so the broker/receivers run
@@ -120,6 +151,9 @@ async def _run() -> dict:
     n_topics = int(os.environ.get("LIVE_TOPICS", "64"))
     secs = float(os.environ.get("LIVE_SECS", "5"))
     pipeline = int(os.environ.get("LIVE_PIPELINE", "64"))
+    # per-publisher publishes/sec; 0 = saturate (latency then
+    # measures queue depth, not service time)
+    rate = float(os.environ.get("LIVE_RATE", "0"))
 
     node = Node(boot_listeners=False, batch_linger_ms=1.0)
     lst = node.add_listener(port=0)
@@ -144,7 +178,7 @@ async def _run() -> dict:
     # warmup: force the jit compiles outside the timed window
     warm_stop = asyncio.Event()
     warm = [asyncio.ensure_future(
-        p.publish_loop(topics, warm_stop, pipeline)) for p in pubs]
+        p.publish_loop(topics, warm_stop, pipeline, rate)) for p in pubs]
     await asyncio.sleep(0.5)
     warm_stop.set()
     await asyncio.gather(*warm)
@@ -158,7 +192,7 @@ async def _run() -> dict:
     stop = asyncio.Event()
     t0 = time.perf_counter()
     pub_tasks = [asyncio.ensure_future(
-        p.publish_loop(topics, stop, pipeline)) for p in pubs]
+        p.publish_loop(topics, stop, pipeline, rate)) for p in pubs]
     await asyncio.sleep(secs)
     stop.set()
     sent = sum(await asyncio.gather(*pub_tasks))
@@ -188,6 +222,7 @@ async def _run() -> dict:
         "p99_ms": float(np.percentile(lats, 99)),
         "avg_device_batch": round(submitted / flushes, 2) if flushes else 0,
         "pubs": n_pubs, "subs": n_subs,
+        "paced_rate_per_pub": rate,
     }
 
 
