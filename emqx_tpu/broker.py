@@ -31,11 +31,54 @@ from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
 from emqx_tpu.ops.fanout import gather_subscribers_src
+from emqx_tpu.ops.pack import (budget_for, mask_pad_rows, pack_fanout,
+                               pack_matches, pack_union_rows)
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.shared_sub import SharedSub
 from emqx_tpu.types import Message, SubOpts
 
 log = logging.getLogger("emqx_tpu.broker")
+
+
+class PendingBatch:
+    """An in-flight batched publish (see :meth:`Broker.publish_begin`).
+
+    Carries the host bookkeeping (live messages, snapshot id map,
+    fan-out state) plus the dispatched device values; after
+    :meth:`Broker.publish_fetch` the packed host copies. ``done``
+    short-circuits: the host path (below the device threshold, empty
+    route table, vetoed-out batch) computes ``results`` inside
+    ``publish_begin`` and never touches the device. A sharded mesh
+    always takes the device path (its match syncs over ICI inside
+    the step, but fan-out/pack fetch still runs in
+    ``publish_fetch`` — possibly on an executor thread)."""
+
+    __slots__ = (
+        "done", "results", "live", "host_topics", "id_map", "epoch",
+        "st", "ids_dev", "ovf_dev", "pm", "pq",
+        "m_ptr_d", "ids_packed_d",
+        "dovf_d", "f_ptr_d", "subs_packed_d", "src_packed_d",
+        "bovf_d", "sel_d", "rows_packed_d", "bm_total_d",
+        "m_ptr", "ids_packed", "ovf",
+        "dovf", "f_ptr", "subs_packed", "src_packed",
+        "bovf", "sel", "rows_packed",
+    )
+
+    def __init__(self) -> None:
+        self.done = False
+        self.results: List[int] = []
+        self.live: List[Tuple[int, Message]] = []
+        self.host_topics: Optional[List[str]] = None
+        self.st = None
+        self.ids_dev = self.ovf_dev = None
+        self.m_ptr_d = self.ids_packed_d = None
+        self.dovf_d = self.f_ptr_d = None
+        self.subs_packed_d = self.src_packed_d = None
+        self.bovf_d = self.sel_d = self.rows_packed_d = None
+        self.bm_total_d = None
+        self.dovf = self.f_ptr = self.subs_packed = None
+        self.src_packed = None
+        self.bovf = self.sel = self.rows_packed = None
 
 
 class Broker:
@@ -75,6 +118,10 @@ class Broker:
         self.flapping = None
         self.delayed = None
         self.tracer = None
+        # learned packed-transfer budgets per batch bucket: a workload
+        # whose steady-state fan-out exceeds the configured budget
+        # would otherwise pay a re-pack + second transfer EVERY batch
+        self._pack_budgets: Dict[int, List[int]] = {}
 
     # -- subscribe / unsubscribe (emqx_broker.erl:127-196) ----------------
 
@@ -179,18 +226,43 @@ class Broker:
         return self.publish_batch([msg])[0]
 
     def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
-        """Batch publish — the TPU hot path.
+        """Batch publish — the TPU hot path, synchronously.
 
-        One compiled device *match* for the whole batch, then one
-        compiled device *fan-out* (CSR subscriber gather for small
-        filters + Pallas bitmap OR for >threshold filters); the host
-        loop is only the delivery tail (sub-id → session ``deliver``)
-        plus remote/shared routing. Mirrors the reference's two hot
-        loops (trie walk src/emqx_trie.erl:161-186; subscriber fold
-        src/emqx_broker.erl:283-309) as two device calls.
+        One compiled device *match* for the whole batch, one compiled
+        device *fan-out* (CSR subscriber gather for small filters +
+        Pallas bitmap OR for >threshold filters), one compiled *pack*
+        (sparse compaction, ops/pack.py), ONE coalesced device→host
+        transfer; the host loop is only the delivery tail (sub-id →
+        session ``deliver``) plus remote/shared routing. Mirrors the
+        reference's two hot loops (trie walk src/emqx_trie.erl:161-186;
+        subscriber fold src/emqx_broker.erl:283-309).
+
+        The async ingress path calls the three phases separately so
+        the blocking transfer runs off the event loop and batches
+        pipeline (:mod:`emqx_tpu.ingress`).
         """
-        live: List[Tuple[int, Message]] = []
-        results = [0] * len(msgs)
+        pb = self.publish_begin(msgs)
+        if pb.done:
+            return pb.results
+        self.publish_fetch(pb)
+        return self.publish_finish(pb)
+
+    def publish_begin(self, msgs: Sequence[Message],
+                      defer_host: bool = False) -> PendingBatch:
+        """Phase 1 — host pre-work + device dispatch, no sync.
+
+        Runs hooks/veto/metrics, picks host vs device matching
+        (:meth:`Router.use_device_now`), and for the device path
+        enqueues match → fan-out → pack without any device→host
+        transfer. Returns a :class:`PendingBatch`; if ``pb.done`` the
+        results are already computed (host path).
+
+        ``defer_host`` postpones host-path ROUTING to
+        :meth:`publish_finish` (``pb.done`` stays False): the pipelined
+        ingress uses it while earlier batches are still in flight so a
+        host-path batch cannot deliver ahead of them."""
+        pb = PendingBatch()
+        pb.results = [0] * len(msgs)
         for i, msg in enumerate(msgs):
             self.metrics.inc_msg(msg)
             if self.tracer is not None:
@@ -205,57 +277,181 @@ class Broker:
             self.metrics.inc("messages.publish")
             if out.flags.get("retain"):
                 self.metrics.inc("messages.retained")
-            live.append((i, out))
-        if not live:
-            return results
-        topics = [m.topic for _, m in live]
-        if not self.router.config.use_device or not self.router.has_routes():
-            for (i, msg), filters in zip(
-                    live, self.router.match_filters(topics)):
-                if not filters:
-                    self._drop_no_subs(msg)
-                    continue
-                results[i] = self._route(filters, msg)
-            return results
+            pb.live.append((i, out))
+        if not pb.live:
+            pb.done = True
+            return pb
+        topics = [m.topic for _, m in pb.live]
+        cfg = self.router.config
+        if not self.router.use_device_now():
+            if defer_host:
+                pb.host_topics = topics
+            else:
+                self._publish_host(pb, topics)
+                pb.done = True
+            return pb
 
         # device match (HOT LOOP 1) → device fan-out (HOT LOOP 2)
-        ids_dev, ids_np, ovf_np, id_map, epoch = \
-            self.router.match_ids(topics)
-        st = self.helper.state(epoch, id_map)
-        cfg = self.router.config
-        subs_np = src_np = dovf_np = union_np = bovf_np = None
+        # → pack (transfer compaction); all async-dispatched
+        pb.ids_dev, pb.ovf_dev, pb.id_map, pb.epoch = \
+            self.router.match_dispatch(topics)
+        # phantom pad-row matches (wildcards match the pad topic) must
+        # not reach the fan-out/pack kernels or the learned budgets
+        pb.ids_dev = mask_pad_rows(pb.ids_dev,
+                                   np.int32(len(topics)))
+        pb.st = self.helper.state(pb.epoch, pb.id_map)
+        bucket = pb.ids_dev.shape[0]
+        budgets = self._pack_budgets.setdefault(
+            bucket, [budget_for(bucket, cfg.pack_m),
+                     budget_for(bucket, cfg.pack_q), cfg.pack_rows])
+        pb.pm = budgets[0]
+        pb.m_ptr_d, pb.ids_packed_d = pack_matches(pb.ids_dev, pm=pb.pm)
+        st = pb.st
         if st is not None and st.fan is not None:
-            subs_d, src_d, _cnt, dovf_d = gather_subscribers_src(
-                st.fan, ids_dev, d=cfg.fanout_d)
-            subs_np = np.asarray(subs_d)
-            src_np = np.asarray(src_d)
-            dovf_np = np.asarray(dovf_d)
+            subs_d, src_d, _cnt, pb.dovf_d = gather_subscribers_src(
+                st.fan, pb.ids_dev, d=cfg.fanout_d)
+            pb.pq = budgets[1]
+            pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
+                pack_fanout(subs_d, src_d, pq=pb.pq)
         if st is not None and st.bm is not None:
-            rows_d, bovf_d = rows_for_matches(
-                st.bm, ids_dev, mb=cfg.fanout_mb)
-            union_np = np.asarray(
-                or_bitmaps_auto(st.bm.bitmaps, rows_d))
-            bovf_np = np.asarray(bovf_d)
+            rows_d, pb.bovf_d = rows_for_matches(
+                st.bm, pb.ids_dev, mb=cfg.fanout_mb)
+            union_d = or_bitmaps_auto(st.bm.bitmaps, rows_d)
+            has_big = (rows_d >= 0).any(axis=1)
+            pb.sel_d, pb.rows_packed_d, pb.bm_total_d = pack_union_rows(
+                union_d, has_big, pr=budgets[2])
+        return pb
 
-        for row, (i, msg) in enumerate(live):
-            if ovf_np[row]:
+    def _publish_host(self, pb: PendingBatch, topics: List[str]) -> None:
+        """Host-path matching + routing for a begun batch (below the
+        device threshold, device off, or empty route table)."""
+        for (i, msg), filters in zip(
+                pb.live, self.router.match_filters(topics)):
+            if not filters:
+                self._drop_no_subs(msg)
+                continue
+            pb.results[i] = self._route(filters, msg)
+
+    def publish_fetch(self, pb: PendingBatch) -> None:
+        """Phase 2 — the blocking device→host transfer, coalesced.
+
+        Touches no broker state (except monotonically raising the
+        learned pack budgets): safe to run on an executor thread
+        while the event loop keeps serving sockets. On packed-budget
+        overflow re-packs with the next power-of-two bucket (the
+        dispatched dense arrays are still live on device) and
+        remembers the grown budget for the bucket, so a steady-state
+        workload re-packs once, not per batch."""
+        if pb.done or pb.host_topics is not None:
+            return
+        import jax
+
+        cfg = self.router.config
+        budgets = self._pack_budgets.get(pb.ids_dev.shape[0])
+        while True:
+            fetch = [pb.m_ptr_d, pb.ids_packed_d, pb.ovf_dev]
+            if pb.f_ptr_d is not None:
+                fetch += [pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d,
+                          pb.dovf_d]
+            if pb.sel_d is not None:
+                fetch += [pb.sel_d, pb.rows_packed_d, pb.bm_total_d,
+                          pb.bovf_d]
+            got = jax.device_get(tuple(fetch))
+            it = iter(got)
+            m_ptr, ids_packed, ovf = next(it), next(it), next(it)
+            if pb.f_ptr_d is not None:
+                f_ptr, subs_p, src_p, dovf = (next(it), next(it),
+                                              next(it), next(it))
+            else:
+                f_ptr = subs_p = src_p = dovf = None
+            if pb.sel_d is not None:
+                sel, rows_p, bm_total, bovf = (next(it), next(it),
+                                               next(it), next(it))
+            else:
+                sel = rows_p = bm_total = bovf = None
+            # budget overflow → re-pack with the next bucket; rare
+            # (budgets start at cfg.pack_* × batch) and self-corrects
+            retry = False
+            if int(m_ptr[-1]) > pb.pm:
+                while pb.pm < int(m_ptr[-1]):
+                    pb.pm *= 2
+                if budgets is not None:
+                    budgets[0] = max(budgets[0], pb.pm)
+                pb.m_ptr_d, pb.ids_packed_d = pack_matches(
+                    pb.ids_dev, pm=pb.pm)
+                retry = True
+            if f_ptr is not None and int(f_ptr[-1]) > pb.pq:
+                while pb.pq < int(f_ptr[-1]):
+                    pb.pq *= 2
+                if budgets is not None:
+                    budgets[1] = max(budgets[1], pb.pq)
+                subs_d, src_d, _c, pb.dovf_d = gather_subscribers_src(
+                    pb.st.fan, pb.ids_dev, d=cfg.fanout_d)
+                pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
+                    pack_fanout(subs_d, src_d, pq=pb.pq)
+                retry = True
+            if bm_total is not None and int(bm_total) > pb.rows_packed_d.shape[0]:
+                rows_d, pb.bovf_d = rows_for_matches(
+                    pb.st.bm, pb.ids_dev, mb=cfg.fanout_mb)
+                union_d = or_bitmaps_auto(pb.st.bm.bitmaps, rows_d)
+                has_big = (rows_d >= 0).any(axis=1)
+                pr = pb.rows_packed_d.shape[0]
+                while pr < int(bm_total):
+                    pr *= 2
+                if budgets is not None:
+                    budgets[2] = max(budgets[2], pr)
+                pb.sel_d, pb.rows_packed_d, pb.bm_total_d = \
+                    pack_union_rows(union_d, has_big, pr=pr)
+                retry = True
+            if retry:
+                continue
+            pb.m_ptr = m_ptr
+            # slice to true occupancy before the per-element list
+            # conversion — the budget tail is dead -1 padding
+            pb.ids_packed = ids_packed[:int(m_ptr[-1])].tolist()
+            pb.ovf = ovf
+            pb.f_ptr = f_ptr
+            if subs_p is not None:
+                occ = int(f_ptr[-1])
+                pb.subs_packed = subs_p[:occ].tolist()
+                pb.src_packed = src_p[:occ].tolist()
+            else:
+                pb.subs_packed = pb.src_packed = None
+            pb.dovf = dovf
+            pb.sel = sel
+            pb.rows_packed = rows_p
+            pb.bovf = bovf
+            return
+
+    def publish_finish(self, pb: PendingBatch) -> List[int]:
+        """Phase 3 — the host delivery tail over the packed results
+        (must run where broker state is owned, i.e. the event loop)."""
+        if pb.done:
+            return pb.results
+        if pb.host_topics is not None:
+            self._publish_host(pb, pb.host_topics)
+            pb.done = True
+            return pb.results
+        m_ptr = pb.m_ptr
+        for row, (i, msg) in enumerate(pb.live):
+            if pb.ovf[row]:
                 # match overflow: this topic's result is unknown —
                 # full host path for it (exact parity, no truncation)
                 filters = self.router.host_match(msg.topic)
                 if not filters:
                     self._drop_no_subs(msg)
                     continue
-                results[i] = self._route(filters, msg)
+                pb.results[i] = self._route(filters, msg)
                 continue
-            filters = [id_map[j] for j in ids_np[row] if j >= 0]
+            row_ids = pb.ids_packed[m_ptr[row]:m_ptr[row + 1]]
+            filters = [pb.id_map[j] for j in row_ids]
             filters = [f for f in filters if f is not None]
             if not filters:
                 self._drop_no_subs(msg)
                 continue
-            results[i] = self._route_device(
-                row, filters, msg, st, subs_np, src_np, dovf_np,
-                union_np, bovf_np, ids_np, id_map)
-        return results
+            pb.results[i] = self._route_packed(row, row_ids, filters,
+                                               msg, pb)
+        return pb.results
 
     def _drop_no_subs(self, msg: Message) -> None:
         self.metrics.inc("messages.dropped")
@@ -306,35 +502,34 @@ class Broker:
                 self.metrics.inc("messages.forward")
         return n
 
-    def _route_device(self, row: int, filters: List[str], msg: Message,
-                      st, subs_np, src_np, dovf_np, union_np, bovf_np,
-                      ids_np, id_map) -> int:
+    def _route_packed(self, row: int, row_ids: List[int],
+                      filters: List[str], msg: Message,
+                      pb: PendingBatch) -> int:
         """Route one matched message with local delivery from the
-        device fan-out arrays (gathered sub-id slots + bitmap union)
-        instead of the ``_subscribers`` dicts."""
+        packed device fan-out results (gathered sub-id slots + bitmap
+        union rows) instead of the ``_subscribers`` dicts."""
         def local_deliver(local_filters: List[str]) -> int:
-            overflowed = (dovf_np is not None and dovf_np[row]) or \
-                (bovf_np is not None and bovf_np[row]) or st is None
+            overflowed = (pb.dovf is not None and pb.dovf[row]) or \
+                (pb.bovf is not None and pb.bovf[row]) or pb.st is None
             if overflowed:
                 # per-message capacity exceeded: host dispatch loop
                 return sum(self.dispatch(flt, msg)
                            for flt in local_filters)
             n = 0
             per_filter: Dict[str, int] = {}
-            if subs_np is not None:
-                for k in range(subs_np.shape[1]):
-                    sid = subs_np[row, k]
-                    if sid < 0:
-                        break  # slots are front-packed
-                    flt = id_map[src_np[row, k]]
-                    sub = self.helper.registry.lookup(int(sid))
+            id_map = pb.id_map
+            lookup = self.helper.registry.lookup
+            if pb.f_ptr is not None:
+                for k in range(pb.f_ptr[row], pb.f_ptr[row + 1]):
+                    flt = id_map[pb.src_packed[k]]
+                    sub = lookup(pb.subs_packed[k])
                     if sub is not None and flt is not None:
                         d = self._deliver_one(flt, sub, msg)
                         if d:
                             per_filter[flt] = per_filter.get(flt, 0) + d
-            if union_np is not None and st.big_fids:
-                self._deliver_big(row, msg, st, union_np,
-                                  ids_np, id_map, per_filter)
+            if pb.sel is not None and pb.sel[row] >= 0 \
+                    and pb.st.big_fids:
+                self._deliver_big(row, row_ids, msg, pb, per_filter)
             for flt, cnt in per_filter.items():
                 n += cnt
                 self.metrics.inc("messages.delivered", cnt)
@@ -343,19 +538,22 @@ class Broker:
 
         return self._route(filters, msg, local_deliver=local_deliver)
 
-    def _deliver_big(self, row: int, msg: Message, st, union_np,
-                     ids_np, id_map, per_filter: Dict[str, int]) -> None:
+    def _deliver_big(self, row: int, row_ids: List[int], msg: Message,
+                     pb: PendingBatch,
+                     per_filter: Dict[str, int]) -> None:
         """Deliver a message's bitmap-path (>threshold) fan-out: the
-        device OR'd the matched big rows into one subscriber bitmap;
-        the tail walks its set bits, accumulating counts into
+        device OR'd the matched big rows into one subscriber bitmap
+        (transferred only for rows that had one, ops/pack.py); the
+        tail walks its set bits, accumulating counts into
         ``per_filter``. With multiple matched big filters each
         (filter, member) pair delivers separately — per-subscription
         semantics, as the reference's shard walk."""
-        matched_big = [int(j) for j in ids_np[row]
-                       if j >= 0 and int(j) in st.big_fids]
+        st = pb.st
+        matched_big = [j for j in row_ids if j in st.big_fids]
         if not matched_big:
             return
-        sids = unpack_sids(union_np[row])
+        id_map = pb.id_map
+        sids = unpack_sids(pb.rows_packed[pb.sel[row]])
         if len(matched_big) == 1:
             flt = id_map[matched_big[0]]
             for sid in sids:

@@ -4,16 +4,29 @@ The reference ingests one message per connection-process receive;
 its generic size/interval accumulator (``src/emqx_batch.erl:1-91``)
 is applied to outbound bridges only. Here batching IS the ingress
 design (SURVEY §2.2 row 1): every connection's PUBLISH lands in one
-shared accumulator, and the whole batch goes through
-:meth:`~emqx_tpu.broker.Broker.publish_batch` — one compiled device
-match + fan-out for all messages that arrived in the same event-loop
-tick. QoS1/2 acks (PUBACK/PUBREC) are deferred and complete when the
-batch returns, so the wire contract is unchanged.
+shared accumulator, and the whole batch goes through the broker's
+three-phase batched publish — one compiled device match + fan-out +
+pack for all messages that arrived in the same event-loop tick.
+QoS1/2 acks (PUBACK/PUBREC) are deferred and complete when the batch
+returns, so the wire contract is unchanged.
+
+Pipelining: the device phases are split (broker.publish_begin /
+publish_fetch / publish_finish) so the blocking device→host transfer
+runs on an executor thread while the event loop keeps parsing
+sockets, and up to ``max_inflight`` batches overlap their transfers —
+device round-trip latency is hidden behind the next batch's
+accumulation instead of serializing the whole node (the classic
+accelerator-serving double-buffering). Delivery stays ordered:
+batch N+1's delivery tail awaits batch N's, so per-publisher
+in-order semantics hold across batch boundaries.
 
 Flush policy: a batch flushes when it reaches ``batch_size``, else on
 the next event-loop iteration (``call_soon`` — "everything that
 arrived this tick"), or after ``linger_ms`` when configured (trades
-latency for bigger device batches under light load).
+latency for bigger device batches under light load). When all
+``max_inflight`` slots are busy, arrivals keep accumulating and flush
+as a bigger batch the moment a slot frees — backpressure becomes
+batch growth, exactly the regime the device prefers.
 
 Callers without a running event loop (sync drivers, unit tests that
 poke the channel directly) fall back to the synchronous path:
@@ -24,6 +37,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 from emqx_tpu.types import Message
@@ -33,18 +47,30 @@ log = logging.getLogger("emqx_tpu.ingress")
 
 class IngressBatcher:
     def __init__(self, broker, batch_size: int = 256,
-                 linger_ms: float = 0.0) -> None:
+                 linger_ms: float = 0.0, max_inflight: int = 4) -> None:
         self.broker = broker
         self.batch_size = batch_size
         self.linger_ms = linger_ms
+        self.max_inflight = max(1, max_inflight)
         self._pending: List[Tuple[Message, asyncio.Future]] = []
         self._handle = None
+        self._inflight = 0
+        self._chain: Optional[asyncio.Task] = None  # ordered delivery
+        self._pool: Optional[ThreadPoolExecutor] = None
         # observability (emqx_batch keeps a counter too)
         self.flushes = 0
         self.submitted = 0
         self.max_batch = 0
+        self.max_queue = 0
 
     _DONE = object()  # sentinel: fire-and-forget submission accepted
+
+    def _executor(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.max_inflight,
+                thread_name_prefix="ingress-fetch")
+        return self._pool
 
     def submit(self, msg: Message, want_result: bool = True):
         """Queue one message. With ``want_result`` the returned future
@@ -59,6 +85,7 @@ class IngressBatcher:
         fut = loop.create_future() if want_result else None
         self._pending.append((msg, fut))
         self.submitted += 1
+        self.max_queue = max(self.max_queue, len(self._pending))
         if len(self._pending) >= self.batch_size:
             self._flush()
         elif len(self._pending) == 1:
@@ -69,36 +96,128 @@ class IngressBatcher:
                 self._handle = loop.call_soon(self._flush)
         return fut if fut is not None else self._DONE
 
-    def _flush(self) -> None:
+    def _take_pending(self):
+        """Shared flush prologue: cancel the linger timer, swap out
+        the accumulator, bump the counters."""
         if self._handle is not None:
             self._handle.cancel()
             self._handle = None
-        if not self._pending:
-            return
         pending, self._pending = self._pending, []
-        self.flushes += 1
-        self.max_batch = max(self.max_batch, len(pending))
+        if pending:
+            self.flushes += 1
+            self.max_batch = max(self.max_batch, len(pending))
+        return pending
+
+    def _flush(self) -> None:
+        if not self._pending or self._inflight >= self.max_inflight:
+            # all slots busy: keep accumulating; the completing batch
+            # re-flushes (bigger batch — backpressure as batch growth)
+            return
+        pending = self._take_pending()
+        # while earlier batches are in flight, a host-path batch must
+        # not route (and no batch may resolve) ahead of them — begin
+        # with deferred host routing and chain the completion
+        chain_active = (self._chain is not None
+                        and not self._chain.done())
         try:
-            results = self.broker.publish_batch([m for m, _ in pending])
+            pb = self.broker.publish_begin(
+                [m for m, _ in pending], defer_host=chain_active)
         except Exception as e:
             log.exception("ingress batch publish failed")
-            for _, fut in pending:
-                if fut is not None and not fut.done():
-                    fut.set_exception(e)
+            self._resolve_exc(pending, e)
             return
+        if pb.done and not chain_active:
+            self._resolve(pending, pb.results)
+            return
+        self._inflight += 1
+        loop = asyncio.get_running_loop()
+        prev = self._chain if chain_active else None
+        task = loop.create_task(self._complete(pb, pending, prev))
+        self._chain = task
+
+    async def _complete(self, pb, pending, prev) -> None:
+        """Fetch off-loop, then deliver in batch order."""
+        loop = asyncio.get_running_loop()
+        try:
+            if not pb.done and pb.host_topics is None:
+                await loop.run_in_executor(
+                    self._executor(), self.broker.publish_fetch, pb)
+            if prev is not None:
+                # ordered delivery across batches; a failed
+                # predecessor already resolved its own futures
+                try:
+                    await asyncio.shield(prev)
+                except Exception:
+                    pass
+            results = self.broker.publish_finish(pb)
+        except Exception as e:
+            log.exception("ingress batch completion failed")
+            self._resolve_exc(pending, e)
+            return
+        finally:
+            self._inflight -= 1
+            if self._pending:
+                # a slot freed while messages accumulated
+                self._flush()
+        self._resolve(pending, results)
+
+    @staticmethod
+    def _resolve(pending, results) -> None:
         for (_, fut), n in zip(pending, results):
             if fut is not None and not fut.done():
                 fut.set_result(n)
 
+    @staticmethod
+    def _resolve_exc(pending, e) -> None:
+        for _, fut in pending:
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+
     def flush_now(self) -> None:
-        """Drain whatever is pending (shutdown path)."""
-        self._flush()
+        """Drain whatever is pending synchronously (shutdown path and
+        loop-less callers); in-flight async batches are awaited by
+        :meth:`drain`."""
+        pending = self._take_pending()
+        if not pending:
+            return
+        try:
+            results = self.broker.publish_batch([m for m, _ in pending])
+        except Exception as e:
+            log.exception("ingress batch publish failed")
+            self._resolve_exc(pending, e)
+            return
+        self._resolve(pending, results)
+
+    async def drain(self) -> None:
+        """Wait for every in-flight batch, THEN flush what queued
+        behind them (node shutdown) — accumulated messages are always
+        newer than in-flight ones, so this order preserves delivery
+        order."""
+        while True:
+            chain = self._chain
+            if chain is not None and not chain.done():
+                try:
+                    await chain
+                except Exception:
+                    pass
+                continue
+            if self._pending:
+                self.flush_now()
+                continue
+            break
+        if self._pool is not None:
+            # reap the fetch threads; a restarted node lazily
+            # recreates the pool on its first device-path flush
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     def stats(self) -> dict:
         return {
             "ingress.submitted": self.submitted,
             "ingress.flushes": self.flushes,
             "ingress.max_batch": self.max_batch,
+            "ingress.max_queue": self.max_queue,
+            "ingress.inflight": self._inflight,
             "ingress.avg_batch": (
                 round(self.submitted / self.flushes, 2)
                 if self.flushes else 0.0),

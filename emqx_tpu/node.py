@@ -207,10 +207,12 @@ class Node:
         for t in self._bg_tasks:
             t.cancel()
         self._bg_tasks.clear()
-        if self.ingress is not None:
-            self.ingress.flush_now()
+        # listeners first: drain() loops until quiescent, which never
+        # happens while live connections keep submitting publishes
         for lst in self.listeners:
             await lst.stop()
+        if self.ingress is not None:
+            await self.ingress.drain()
         if self.cluster is not None and self._cluster_cfg is not None:
             close = getattr(self.cluster.transport, "close", None)
             if close is not None:

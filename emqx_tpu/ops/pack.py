@@ -1,0 +1,120 @@
+"""Device-side compaction of match + fan-out results for transfer.
+
+The product publish path ends with a device→host hand-off: the host
+delivery tail needs each message's matched filter ids and gathered
+subscriber ids. Fetching the *dense* kernel outputs (``ids[B, M]``,
+``subs/src[B, d]`` with d=1024) moves megabytes of ``-1`` padding per
+batch — pure waste on the host link, which is the classic accelerator
+serving bottleneck (and the reference never materializes padding at
+all: its trie match returns exactly the matched set,
+``src/emqx_trie.erl:161-186``).
+
+So the last device step packs the sparse results into CSR-style
+buffers sized by a static *budget*: a global cumsum assigns each valid
+element its output slot, a drop-mode scatter writes them, and the
+per-row counts become a row-pointer array. The host then transfers
+
+    m_ptr[B+1], packed_ids[PM], f_ptr[B+1], packed_subs[PQ],
+    packed_src[PQ]
+
+— tens of kilobytes instead of megabytes. Budgets are power-of-two
+bucketed (one compiled program per bucket, like the batch buckets);
+when a batch's true totals exceed the budget the caller re-packs with
+the next bucket (the totals are ``m_ptr[-1]``/``f_ptr[-1]``, so
+detection costs nothing extra).
+
+Big-filter (bitmap) fan-out rows compact the same way: only rows that
+actually matched a big filter transfer (``pack_union_rows``), so a
+batch with no big-fan-out traffic moves zero bitmap bytes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def mask_pad_rows(ids: jax.Array, n_rows: jax.Array) -> jax.Array:
+    """Blank the batch's padding rows (row index ≥ ``n_rows``) to -1.
+
+    The matcher pads batches to a power-of-two bucket with a dummy
+    topic; wildcard filters (``#``) can match it, and without this
+    mask those phantom rows inflate the packed totals — and the
+    learned budgets — by (bucket − B) × fan-out. ``n_rows`` is a
+    traced scalar so every batch size in a bucket shares one compile.
+    """
+    row = jnp.arange(ids.shape[0], dtype=jnp.int32)
+    return jnp.where((row < n_rows)[:, None], ids, -1)
+
+
+def budget_for(n_rows: int, per_row: int, floor: int = 64) -> int:
+    """Power-of-two packed-buffer budget for ``n_rows`` rows at an
+    expected ``per_row`` average occupancy."""
+    need = max(floor, n_rows * per_row)
+    out = floor
+    while out < need:
+        out *= 2
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("pm",))
+def pack_matches(ids: jax.Array, *, pm: int):
+    """Compact ``ids[B, M]`` (-1 padded) into a CSR pair.
+
+    Returns ``(m_ptr[B+1], packed_ids[pm])``; ``m_ptr[-1]`` is the
+    true total — if it exceeds ``pm`` the tail was dropped and the
+    caller must re-pack with a larger budget.
+    """
+    flat = ids.reshape(-1)
+    valid = flat >= 0
+    cnt = (ids >= 0).sum(axis=1, dtype=jnp.int32)
+    m_ptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(cnt, dtype=jnp.int32)])
+    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    tgt = jnp.where(valid, pos, pm)  # pm = out of range → dropped
+    packed = jnp.full((pm,), -1, jnp.int32).at[tgt].set(flat, mode="drop")
+    return m_ptr, packed
+
+
+@functools.partial(jax.jit, static_argnames=("pq",))
+def pack_fanout(subs: jax.Array, src: jax.Array, *, pq: int):
+    """Compact the gathered ``(subs, src)[B, d]`` pair (same -1
+    padding positions in both) into one CSR triple.
+
+    Returns ``(f_ptr[B+1], packed_subs[pq], packed_src[pq])`` with the
+    same overflow contract as :func:`pack_matches`.
+    """
+    flat_subs = subs.reshape(-1)
+    flat_src = src.reshape(-1)
+    valid = flat_subs >= 0
+    cnt = (subs >= 0).sum(axis=1, dtype=jnp.int32)
+    f_ptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(cnt, dtype=jnp.int32)])
+    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    tgt = jnp.where(valid, pos, pq)
+    packed_subs = jnp.full((pq,), -1, jnp.int32).at[tgt].set(
+        flat_subs, mode="drop")
+    packed_src = jnp.full((pq,), -1, jnp.int32).at[tgt].set(
+        flat_src, mode="drop")
+    return f_ptr, packed_subs, packed_src
+
+
+@functools.partial(jax.jit, static_argnames=("pr",))
+def pack_union_rows(union: jax.Array, has_big: jax.Array, *, pr: int):
+    """Compact the bitmap-union rows: only rows with ``has_big`` set
+    (the row matched ≥1 big filter) are materialized.
+
+    Returns ``(sel[B], rows[pr, W], total)`` where ``sel[b]`` is the
+    packed row index for message ``b`` (-1 = no big match) and
+    ``total`` > ``pr`` signals budget overflow (re-pack bigger).
+    """
+    hb = has_big.astype(jnp.int32)
+    pos = jnp.cumsum(hb) - 1
+    sel = jnp.where(has_big, pos, -1).astype(jnp.int32)
+    tgt = jnp.where(has_big, pos, pr)
+    rows = jnp.zeros((pr, union.shape[1]), union.dtype).at[tgt].set(
+        union, mode="drop")
+    return sel, rows, jnp.sum(hb)

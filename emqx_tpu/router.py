@@ -60,6 +60,19 @@ class MatcherConfig:
     fanout_threshold: int = 1024
     fanout_d: int = 1024     # per-message small-filter delivery slots
     fanout_mb: int = 16      # per-message big(bitmap)-filter slots
+    # below this many live filters the broker matches on HOST (the
+    # C++ trie): a device dispatch + result transfer costs fixed
+    # round-trip latency that only amortizes at scale, while the host
+    # walk is O(depth) hash lookups. The device automaton still
+    # maintains itself (patching/rebuilds) so crossing the threshold
+    # is just a branch flip, not a build.
+    device_min_filters: int = 1024
+    # packed-transfer budgets (ops/pack.py): expected average matched
+    # filters / deliveries per message and bitmap rows per batch; the
+    # publish path re-packs with the next pow2 bucket on overflow
+    pack_m: int = 8
+    pack_q: int = 16
+    pack_rows: int = 8
 
 
 class Router:
@@ -468,6 +481,55 @@ class Router:
         with self._lock:
             return self._t_match(topic)
 
+    def use_device_now(self) -> bool:
+        """The host/device matching policy for the product publish
+        path: the device automaton pays fixed dispatch + transfer
+        latency per call, so it only wins past a filter-count
+        threshold (below it the C++ trie walk is microseconds — the
+        reference's regime, where ETS reads are always 'host'). A
+        configured mesh is an explicit opt-in to sharded device
+        matching, so it bypasses the threshold (the dryrun exercises
+        tiny shapes); ``use_device=False`` wins over everything (the
+        debugging escape hatch)."""
+        cfg = self.config
+        if not cfg.use_device or not self._routes:
+            return False
+        if cfg.mesh is not None:
+            return True
+        return len(self._filter_ids) >= cfg.device_min_filters
+
+    def match_dispatch(self, topics: Sequence[str]):
+        """Dispatch-only device match: encode + enqueue the compiled
+        walk and return WITHOUT any device→host sync.
+
+        Returns ``(ids_dev, ovf_dev, id_map, epoch)`` — both arrays
+        are in-flight device values ([B_pad, M] / [B_pad]); feed
+        ``ids_dev`` straight into the fan-out/pack kernels and fetch
+        everything in one coalesced transfer later
+        (:meth:`Broker.publish_fetch`). ``(id_map, epoch)`` is the
+        automaton snapshot giving the ids meaning. On a mesh the
+        match runs the sharded ICI publish step ([B_pad, T·m] ids).
+        """
+        cfg = self.config
+        if cfg.mesh is not None:
+            return self._match_dispatch_sharded(topics)
+        auto, id_map, epoch = self.automaton()
+        bucket = cfg.min_batch
+        while bucket < len(topics):
+            bucket *= 2
+        padded = list(topics) + ["\x00/pad"] * (bucket - len(topics))
+        # the word table must not be read (wt_lookup) while a
+        # concurrent add_route interns into it — ctypes calls drop
+        # the GIL, so the map can rehash mid-read. The fine-grained
+        # _wt_lock (not _lock) keeps matchers running through a long
+        # background-compaction flatten
+        with self._wt_lock:
+            ids, n, sysm = self._encode(padded, cfg.max_levels)
+        ids, n = depth_bucket(ids, n)
+        res = match_batch(auto, ids, n, sysm, k=cfg.active_k,
+                          m=cfg.max_matches)
+        return res.ids, res.overflow, id_map, epoch
+
     def match_ids(self, topics: Sequence[str]):
         """Device match of a topic batch in snapshot-id space.
 
@@ -479,34 +541,19 @@ class Router:
         the ids meaning. Rows with ``ovf_np`` set exceeded a kernel
         bound — resolve those topics via :meth:`host_match`.
         """
-        cfg = self.config
-        if cfg.mesh is not None:
+        if self.config.mesh is not None:
             return self._match_ids_sharded(topics)
-        auto, id_map, epoch = self.automaton()
         B = len(topics)
-        bucket = cfg.min_batch
-        while bucket < B:
-            bucket *= 2
-        padded = list(topics) + ["\x00/pad"] * (bucket - B)
-        # the word table must not be read (wt_lookup) while a
-        # concurrent add_route interns into it — ctypes calls drop
-        # the GIL, so the map can rehash mid-read. The fine-grained
-        # _wt_lock (not _lock) keeps matchers running through a long
-        # background-compaction flatten
-        with self._wt_lock:
-            ids, n, sysm = self._encode(padded, cfg.max_levels)
-        ids, n = depth_bucket(ids, n)
-        res = match_batch(auto, ids, n, sysm, k=cfg.active_k,
-                          m=cfg.max_matches)
-        ids_np = np.asarray(res.ids)[:B]
-        ovf_np = np.asarray(res.overflow)[:B]
-        return res.ids, ids_np, ovf_np, id_map, epoch
+        ids_dev, ovf_dev, id_map, epoch = self.match_dispatch(topics)
+        ids_np = np.asarray(ids_dev)[:B]
+        ovf_np = np.asarray(ovf_dev)[:B]
+        return ids_dev, ids_np, ovf_np, id_map, epoch
 
-    def _match_ids_sharded(self, topics: Sequence[str]):
-        """Multi-chip match: the batch is sharded over the mesh's
-        'data' axis, each trie shard matches its slice, match ids are
-        all-gathered over ICI. Same return contract as
-        :meth:`match_ids` (the ids array is [B_pad, T*m])."""
+    def _match_dispatch_sharded(self, topics: Sequence[str]):
+        """Multi-chip match dispatch: the batch is sharded over the
+        mesh's 'data' axis, each trie shard matches its slice, match
+        ids are all-gathered over ICI; no device→host sync (same
+        contract as :meth:`match_dispatch`, ids are [B_pad, T·m])."""
         from emqx_tpu.parallel.sharded import place_batch, publish_step
 
         cfg = self.config
@@ -525,6 +572,12 @@ class Router:
             mesh, auto, self._dummy_fan, ids, n, sysm,
             k=cfg.active_k, m=cfg.max_matches, d=8, with_fanout=False)
         self._dev_stats.append(stats)
+        return all_ids, ovf, id_map, epoch
+
+    def _match_ids_sharded(self, topics: Sequence[str]):
+        """Sharded :meth:`match_ids` (host copies synced)."""
+        B = len(topics)
+        all_ids, ovf, id_map, epoch = self._match_dispatch_sharded(topics)
         ids_np = np.asarray(all_ids)[:B]
         ovf_np = np.asarray(ovf)[:B]
         return all_ids, ids_np, ovf_np, id_map, epoch
@@ -545,7 +598,7 @@ class Router:
         fallback)."""
         if not topics:
             return []
-        if not self.config.use_device or not self._routes:
+        if not self.use_device_now():
             with self._lock:
                 return [self._t_match(t) for t in topics]
         _, mid, ovf, id_map, _ = self.match_ids(topics)

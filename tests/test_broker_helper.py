@@ -61,8 +61,13 @@ def test_manager_state_small_and_big_split():
     assert st2 is not st
 
 
+def _dev_broker(**kw):
+    kw.setdefault("device_min_filters", 0)
+    return Broker(config=MatcherConfig(**kw))
+
+
 def test_broker_small_fanout_via_device_gather():
-    b = Broker()
+    b = _dev_broker()
     s1, s2 = Rec("c1"), Rec("c2")
     b.subscribe(s1, "home/+/temp")
     b.subscribe(s2, "home/kitchen/#")
@@ -79,7 +84,7 @@ def test_broker_bitmap_path_5k_subscribers():
     """VERDICT round-1 item 2: >threshold fan-out must flow through
     the bitmap tables in the product broker, Python only in the
     delivery tail."""
-    b = Broker()
+    b = _dev_broker()
     subs = [Rec(f"c{i}") for i in range(5000)]
     for s in subs:
         b.subscribe(s, "bcast/all")
@@ -106,8 +111,7 @@ def test_broker_two_big_filters_per_subscription_delivery():
     re-filtered per filter's member set, so an overlapping member gets
     one delivery PER subscription (reference semantics: dispatch per
     {Topic, SubPid} pair per matched route)."""
-    cfg = MatcherConfig(fanout_threshold=4)
-    b = Broker(config=cfg)
+    b = _dev_broker(fanout_threshold=4)
     g1 = [Rec(f"a{i}") for i in range(6)]
     g2 = [Rec(f"b{i}") for i in range(6)]
     both = Rec("both")
@@ -127,7 +131,7 @@ def test_broker_two_big_filters_per_subscription_delivery():
 
 
 def test_broker_nl_option_on_device_path():
-    b = Broker()
+    b = _dev_broker()
     s = Rec("me")
     b.subscribe(s, "a/b", SubOpts(nl=True))
     other = Rec("other")
@@ -140,8 +144,7 @@ def test_broker_nl_option_on_device_path():
 def test_broker_overflow_fallback_matches_host():
     """Per-message delivery slots exceeded → host dispatch fallback
     (same deliveries, exact parity)."""
-    cfg = MatcherConfig(fanout_d=8)
-    b = Broker(config=cfg)
+    b = _dev_broker(fanout_d=8)
     subs = [Rec(f"c{i}") for i in range(20)]  # > d=8, < threshold
     for s in subs:
         b.subscribe(s, "x/y")
@@ -154,7 +157,7 @@ def test_sid_not_recycled_across_pending_state():
     """A released subscriber id is quarantined until the next table
     rebuild — a fresh subscriber never aliases an old sid in tables
     still live."""
-    b = Broker()
+    b = _dev_broker()
     a = Rec("a")
     b.subscribe(a, "t/1")
     b.publish(Message(topic="t/1"))  # builds tables referencing a's sid
@@ -168,3 +171,76 @@ def test_sid_not_recycled_across_pending_state():
     n = b.publish(Message(topic="t/2"))
     assert n == 1 and c.got == [("t/2", "t/2")]
     assert a.got == [("t/1", "t/1")]  # nothing after its unsubscribe
+
+
+def test_pack_budget_overflow_repacks():
+    """Fan-out total past the packed-transfer budget: publish_fetch
+    re-packs with the next pow2 bucket — all deliveries still land."""
+    b = _dev_broker(pack_q=1)  # tiny budget: 1 sub/msg expected
+    subs = [Rec(f"c{i}") for i in range(300)]
+    for s in subs:
+        b.subscribe(s, "o/flow")
+    pb = b.publish_begin([Message(topic="o/flow")])
+    assert not pb.done
+    pq0 = pb.pq
+    b.publish_fetch(pb)
+    assert pb.pq > pq0  # budget grew
+    [n] = b.publish_finish(pb)
+    assert n == 300
+    assert all(s.got == [("o/flow", "o/flow")] for s in subs)
+
+
+def test_threshold_policy_host_vs_device():
+    """Below device_min_filters the publish path never touches the
+    device (pb.done from publish_begin); at/above it dispatches."""
+    from emqx_tpu.router import MatcherConfig
+
+    b = Broker(config=MatcherConfig(device_min_filters=3))
+    s1, s2 = Rec("c1"), Rec("c2")
+    b.subscribe(s1, "th/a")
+    b.subscribe(s2, "th/+")
+    pb = b.publish_begin([Message(topic="th/a")])
+    assert pb.done and pb.results == [2]  # host path, already routed
+    assert not b.router.use_device_now()
+    b.subscribe(s1, "th/c")  # 3rd filter: crosses the threshold
+    assert b.router.use_device_now()
+    pb2 = b.publish_begin([Message(topic="th/a")])
+    assert not pb2.done
+    b.publish_fetch(pb2)
+    assert b.publish_finish(pb2) == [2]
+
+
+def test_pack_budget_overflow_remembered_across_batches():
+    """A grown packed budget persists per batch bucket: the second
+    batch starts at the grown budget and needs no re-pack round."""
+    b = _dev_broker(pack_q=1)
+    subs = [Rec(f"c{i}") for i in range(300)]
+    for s in subs:
+        b.subscribe(s, "o/mem")
+    pb1 = b.publish_begin([Message(topic="o/mem")])
+    b.publish_fetch(pb1)
+    grown = pb1.pq
+    assert b.publish_finish(pb1) == [300]
+    pb2 = b.publish_begin([Message(topic="o/mem")])
+    assert pb2.pq == grown  # learned, no overflow round this time
+    b.publish_fetch(pb2)
+    assert pb2.pq == grown
+    assert b.publish_finish(pb2) == [300]
+
+
+def test_pad_rows_do_not_inflate_packed_totals():
+    """Wildcard filters match the batch's pad topic; the pack step
+    must see those phantom rows blanked or the packed totals (and
+    learned budgets) scale with the bucket, not the batch."""
+    b = _dev_broker()
+    s = Rec("w")
+    b.subscribe(s, "#")
+    b.subscribe(s, "+/pad")
+    pb = b.publish_begin([Message(topic="real/topic")])
+    assert not pb.done
+    b.publish_fetch(pb)
+    # exactly ONE live row's matches/fan-out, no pad-row inflation
+    assert int(pb.m_ptr[-1]) == 1          # only '#' matches
+    assert int(pb.f_ptr[-1]) == 1
+    assert b.publish_finish(pb) == [1]
+    assert s.got == [("#", "real/topic")]

@@ -155,16 +155,16 @@ async def test_flush_failure_sends_no_ack():
         c = TestClient("c", version=4)
         await c.connect(port=lst.port)
 
-        def boom(msgs):
+        def boom(msgs, defer_host=False):
             raise RuntimeError("device gone")
 
-        orig = n.broker.publish_batch
-        n.broker.publish_batch = boom
+        orig = n.broker.publish_begin
+        n.broker.publish_begin = boom
         await c.send(Publish(topic="a/b", qos=1, packet_id=3))
         with __import__("pytest").raises(aio.TimeoutError):
             await aio.wait_for(c.acks.get(), 0.3)
         # broker recovers -> the retransmit is acked
-        n.broker.publish_batch = orig
+        n.broker.publish_begin = orig
         await c.send(Publish(topic="a/b", qos=1, packet_id=3, dup=True))
         ack = await aio.wait_for(c.acks.get(), 5)
         assert ack.packet_id == 3
@@ -175,7 +175,7 @@ async def test_flush_failure_sends_no_ack():
 
 async def test_flush_error_resolves_futures():
     class Boom(Broker):
-        def publish_batch(self, msgs):
+        def publish_begin(self, msgs, defer_host=False):
             raise RuntimeError("device gone")
 
     bat = IngressBatcher(Boom(), batch_size=2)
@@ -183,3 +183,138 @@ async def test_flush_error_resolves_futures():
     f2 = bat.submit(Message(topic="t"))
     assert f1.done() and isinstance(f1.exception(), RuntimeError)
     assert f2.done() and isinstance(f2.exception(), RuntimeError)
+
+
+# -- pipelined (three-phase) flushes ---------------------------------
+
+
+def _dev_broker(**kw):
+    from emqx_tpu.router import MatcherConfig
+    kw.setdefault("device_min_filters", 0)
+    return Broker(config=MatcherConfig(**kw))
+
+
+async def test_device_path_flush_is_async():
+    """Above the device threshold the flush pipeline runs begin →
+    (executor) fetch → finish; futures resolve with correct counts."""
+    b = _dev_broker()
+    s = Rec()
+    b.subscribe(s, "t/+")
+    bat = IngressBatcher(b, batch_size=100)
+    futs = [bat.submit(Message(topic=f"t/{i}")) for i in range(5)]
+    await asyncio.sleep(0)  # tick flush -> async completion
+    counts = [await f for f in futs]
+    assert counts == [1] * 5
+    assert sorted(s.got) == sorted(f"t/{i}" for i in range(5))
+
+
+async def test_ordered_delivery_across_batches():
+    """Batch N+1 must not deliver before batch N even when its fetch
+    finishes first (per-publisher in-order semantics)."""
+    import time
+
+    b = _dev_broker()
+    s = Rec()
+    b.subscribe(s, "o/+")
+    orig_fetch = b.publish_fetch
+    delays = {"o/first": 0.15}
+
+    def slow_fetch(pb):
+        d = max((delays.get(m.topic, 0.0) for _, m in pb.live),
+                default=0.0)
+        if d:
+            time.sleep(d)
+        orig_fetch(pb)
+
+    b.publish_fetch = slow_fetch
+    bat = IngressBatcher(b, batch_size=1, max_inflight=4)
+    f1 = bat.submit(Message(topic="o/first"))
+    f2 = bat.submit(Message(topic="o/second"))
+    await asyncio.gather(f1, f2)
+    assert s.got == ["o/first", "o/second"]
+
+
+async def test_inflight_cap_accumulates_bigger_batches():
+    """With all pipeline slots busy, arrivals accumulate and flush as
+    one bigger batch when a slot frees (backpressure = batch growth)."""
+    import time
+
+    b = _dev_broker()
+    s = Rec()
+    b.subscribe(s, "p/+")
+    orig_fetch = b.publish_fetch
+
+    def slow_fetch(pb):
+        time.sleep(0.05)
+        orig_fetch(pb)
+
+    b.publish_fetch = slow_fetch
+    bat = IngressBatcher(b, batch_size=1, max_inflight=1)
+    futs = [bat.submit(Message(topic=f"p/{i}")) for i in range(10)]
+    await asyncio.gather(*futs)
+    assert sorted(s.got) == sorted(f"p/{i}" for i in range(10))
+    assert bat.flushes < 10  # accumulation happened
+    assert bat.max_batch > 1
+
+
+async def test_node_stop_drains_inflight():
+    n = Node(boot_listeners=False)
+    await n.start()
+    s = Rec()
+    n.broker.subscribe(s, "d/+")
+    n.ingress.submit(Message(topic="d/1"), want_result=False)
+    await n.stop()
+    assert s.got == ["d/1"]
+
+
+async def test_host_path_batch_ordered_behind_device_batch():
+    """A flush that would take the host path (threshold crossed
+    downward mid-pipeline) must still deliver AFTER the in-flight
+    device batch — begin defers host routing behind the chain."""
+    import time
+
+    from emqx_tpu.router import MatcherConfig
+
+    b = Broker(config=MatcherConfig(device_min_filters=2))
+    s1, s2 = Rec("r1"), Rec("r2")
+    b.subscribe(s1, "h/a")
+    b.subscribe(s2, "h/b")  # 2 filters -> device path
+    orig_fetch = b.publish_fetch
+
+    def slow_fetch(pb):
+        time.sleep(0.1)
+        orig_fetch(pb)
+
+    b.publish_fetch = slow_fetch
+    bat = IngressBatcher(b, batch_size=1, max_inflight=4)
+    f1 = bat.submit(Message(topic="h/a"))      # device, slow fetch
+    await asyncio.sleep(0)
+    b.unsubscribe(s2, "h/b")  # drop below threshold -> host path next
+    f2 = bat.submit(Message(topic="h/a"))      # host path, instant
+    await asyncio.gather(f1, f2)
+    assert len(s1.got) == 2  # both delivered, in submission order
+    # f2 resolved only after f1 (chained), so ordering held
+    assert await f1 == 1 and await f2 == 1
+
+
+async def test_drain_waits_for_inflight_before_flushing_queue():
+    """drain() must complete in-flight batches BEFORE publishing the
+    messages that queued behind them."""
+    import time
+
+    b = _dev_broker()
+    s = Rec()
+    b.subscribe(s, "z/+")
+    orig_fetch = b.publish_fetch
+
+    def slow_fetch(pb):
+        time.sleep(0.1)
+        orig_fetch(pb)
+
+    b.publish_fetch = slow_fetch
+    bat = IngressBatcher(b, batch_size=1, max_inflight=1)
+    bat.submit(Message(topic="z/old"), want_result=False)
+    await asyncio.sleep(0)      # old batch enters the pipeline
+    bat.submit(Message(topic="z/new"), want_result=False)  # queued
+    await bat.drain()
+    assert s.got == ["z/old", "z/new"]

@@ -219,7 +219,7 @@ def test_rebuild_recorded_in_kernel_timer():
     from emqx_tpu.router import MatcherConfig, Router
 
     timer.reset()
-    r = Router(MatcherConfig())
+    r = Router(MatcherConfig(device_min_filters=0))
     r.add_route("prof/+")
     r.match_filters(["prof/x"])
     st = timer.stats()

@@ -7,8 +7,10 @@ from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.types import Route
 
 
-def _mk(use_device=True):
-    return Router(MatcherConfig(use_device=use_device), node="node1")
+def _mk(use_device=True, **kw):
+    kw.setdefault("device_min_filters", 0)
+    return Router(MatcherConfig(use_device=use_device, **kw),
+                  node="node1")
 
 
 def test_add_delete_route():

@@ -168,3 +168,17 @@ def test_broker_on_mesh_end_to_end():
     assert subs[3].got == [("room/3/+", b"hot")]
     assert all(not s.got for j, s in enumerate(subs) if j != 3)
     assert everyone.got == [("room/#", b"hot")]
+
+
+def test_mesh_use_device_false_is_honored():
+    """MatcherConfig(mesh=..., use_device=False) must stay on the
+    host trie walk — the debugging escape hatch wins over the mesh."""
+    from emqx_tpu.parallel.mesh import default_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+
+    r = Router(MatcherConfig(mesh=default_mesh(8), use_device=False),
+               node="n1")
+    r.add_route("esc/+")
+    assert not r.use_device_now()
+    assert r.match_filters(["esc/x"]) == [["esc/+"]]
+    assert r.stats()["rebuilds"] == 0  # never flattened for a device
