@@ -55,6 +55,8 @@ def _jax_with_retry(tries: int = None, delay: float = 8.0,
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
+    from emqx_tpu.profiling import enable_compile_cache
+    enable_compile_cache()
     deadline = time.monotonic() + attempt_timeout
     attempt = 0
     while True:

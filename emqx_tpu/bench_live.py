@@ -16,6 +16,9 @@ Env knobs: LIVE_PUBS, LIVE_SUBS, LIVE_TOPICS, LIVE_SECS,
 LIVE_PIPELINE (outstanding publishes per publisher), LIVE_RATE
 (publishes/sec per publisher; 0 = saturate — percentiles then
 measure queue depth, use a paced rate for meaningful latency),
+LIVE_FILTERS (extra background subscriptions; push it past
+device_min_filters to measure the DEVICE live regime — default
+leaves the route table small, i.e. the host-match regime),
 BENCH_PLATFORM.
 """
 
@@ -155,9 +158,24 @@ async def _run() -> dict:
     # measures queue depth, not service time)
     rate = float(os.environ.get("LIVE_RATE", "0"))
 
+    # >0: subscribe a sink to this many extra filters so the route
+    # table crosses the device threshold — the live device regime
+    n_filters = int(os.environ.get("LIVE_FILTERS", "0"))
+
     node = Node(boot_listeners=False, batch_linger_ms=1.0)
     lst = node.add_listener(port=0)
     await node.start()
+
+    if n_filters:
+        class _Sink:
+            client_id = "bench-sink"
+
+            def deliver(self, f, m):
+                pass
+
+        sink = _Sink()
+        for i in range(n_filters):
+            node.broker.subscribe(sink, f"bg/{i // 100}/f{i}/+")
 
     topics = [f"bench/t{i}/v" for i in range(n_topics)]
     subs = []
@@ -175,7 +193,22 @@ async def _run() -> dict:
         await p.connect(lst.port)
         pubs.append(p)
 
-    # warmup: force the jit compiles outside the timed window
+    # warmup: force the jit compiles outside the timed window. In the
+    # device regime every pow2 padding bucket the capped ingress can
+    # hit must be compiled up front — an un-warmed bucket mid-window
+    # is a tens-of-seconds stall (once per machine with the
+    # persistent compile cache, but never inside the measurement)
+    if node.broker.router.use_device_now():
+        from emqx_tpu.types import Message as _Msg
+        bsz = 8
+        while True:
+            node.broker.publish_batch(
+                [_Msg(topic=topics[i % len(topics)],
+                      payload=struct.pack("<q", 0))
+                 for i in range(bsz)])
+            if bsz >= node.ingress.batch_cap:
+                break
+            bsz *= 2
     warm_stop = asyncio.Event()
     warm = [asyncio.ensure_future(
         p.publish_loop(topics, warm_stop, pipeline, rate)) for p in pubs]
@@ -223,16 +256,22 @@ async def _run() -> dict:
         "avg_device_batch": round(submitted / flushes, 2) if flushes else 0,
         "pubs": n_pubs, "subs": n_subs,
         "paced_rate_per_pub": rate,
+        "bg_filters": n_filters,
+        "regime": ("device" if node.broker.router.use_device_now()
+                   else "host"),
     }
 
 
 def live() -> None:
     import sys
 
+    from emqx_tpu.profiling import enable_compile_cache
+
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)
+    enable_compile_cache()
     info = asyncio.run(_run())
     print(json.dumps(info), file=sys.stderr, flush=True)
     print(json.dumps({

@@ -31,8 +31,9 @@ from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
 from emqx_tpu.ops.fanout import gather_subscribers_src
-from emqx_tpu.ops.pack import (budget_for, mask_pad_rows, pack_fanout,
-                               pack_matches, pack_union_rows)
+from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_rows,
+                               pack_fanout, pack_matches,
+                               pack_union_rows)
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.shared_sub import SharedSub
 from emqx_tpu.types import Message, SubOpts
@@ -303,7 +304,8 @@ class Broker:
         bucket = pb.ids_dev.shape[0]
         budgets = self._pack_budgets.setdefault(
             bucket, [budget_for(bucket, cfg.pack_m),
-                     budget_for(bucket, cfg.pack_q), cfg.pack_rows])
+                     budget_for(bucket, cfg.pack_q),
+                     max(1, cfg.pack_rows)])
         pb.pm = budgets[0]
         pb.m_ptr_d, pb.ids_packed_d = pack_matches(pb.ids_dev, pm=pb.pm)
         st = pb.st
@@ -347,8 +349,11 @@ class Broker:
         import jax
 
         cfg = self.router.config
-        budgets = self._pack_budgets.get(pb.ids_dev.shape[0])
+        Bp = pb.ids_dev.shape[0]
+        budgets = self._pack_budgets.get(Bp)
         while True:
+            # ONE device buffer → ONE transfer (the host link charges
+            # per-buffer round-trip latency; see ops/pack.bundle_i32)
             fetch = [pb.m_ptr_d, pb.ids_packed_d, pb.ovf_dev]
             if pb.f_ptr_d is not None:
                 fetch += [pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d,
@@ -356,17 +361,31 @@ class Broker:
             if pb.sel_d is not None:
                 fetch += [pb.sel_d, pb.rows_packed_d, pb.bm_total_d,
                           pb.bovf_d]
-            got = jax.device_get(tuple(fetch))
-            it = iter(got)
-            m_ptr, ids_packed, ovf = next(it), next(it), next(it)
+            buf = jax.device_get(bundle_i32(*fetch))
+            off = 0
+
+            def take(n):
+                nonlocal off
+                out = buf[off:off + n]
+                off += n
+                return out
+
+            m_ptr = take(Bp + 1)
+            ids_packed = take(pb.pm)
+            ovf = take(Bp).astype(bool)
             if pb.f_ptr_d is not None:
-                f_ptr, subs_p, src_p, dovf = (next(it), next(it),
-                                              next(it), next(it))
+                f_ptr = take(Bp + 1)
+                subs_p = take(pb.pq)
+                src_p = take(pb.pq)
+                dovf = take(Bp).astype(bool)
             else:
                 f_ptr = subs_p = src_p = dovf = None
             if pb.sel_d is not None:
-                sel, rows_p, bm_total, bovf = (next(it), next(it),
-                                               next(it), next(it))
+                pr, W = pb.rows_packed_d.shape
+                sel = take(Bp)
+                rows_p = take(pr * W).view(np.uint32).reshape(pr, W)
+                bm_total = int(take(1)[0])
+                bovf = take(Bp).astype(bool)
             else:
                 sel = rows_p = bm_total = bovf = None
             # budget overflow → re-pack with the next bucket; rare

@@ -47,11 +47,18 @@ log = logging.getLogger("emqx_tpu.ingress")
 
 class IngressBatcher:
     def __init__(self, broker, batch_size: int = 256,
-                 linger_ms: float = 0.0, max_inflight: int = 4) -> None:
+                 linger_ms: float = 0.0, max_inflight: int = 4,
+                 batch_cap: int = 0) -> None:
         self.broker = broker
         self.batch_size = batch_size
         self.linger_ms = linger_ms
         self.max_inflight = max(1, max_inflight)
+        # largest batch one flush may take (0 = 4× batch_size). An
+        # uncapped flush of an accumulated backlog walks through ever
+        # bigger pow2 padding buckets, each a fresh XLA compile on
+        # the hot path; the cap keeps steady-state traffic inside a
+        # handful of already-compiled buckets
+        self.batch_cap = batch_cap or batch_size * 4
         self._pending: List[Tuple[Message, asyncio.Future]] = []
         self._handle = None
         self._inflight = 0
@@ -96,44 +103,48 @@ class IngressBatcher:
                 self._handle = loop.call_soon(self._flush)
         return fut if fut is not None else self._DONE
 
-    def _take_pending(self):
-        """Shared flush prologue: cancel the linger timer, swap out
-        the accumulator, bump the counters."""
+    def _take_pending(self, cap: int = 0):
+        """Shared flush prologue: cancel the linger timer, take up to
+        ``cap`` messages (0 = all) off the accumulator, bump the
+        counters."""
         if self._handle is not None:
             self._handle.cancel()
             self._handle = None
-        pending, self._pending = self._pending, []
+        if cap and len(self._pending) > cap:
+            pending = self._pending[:cap]
+            del self._pending[:cap]
+        else:
+            pending, self._pending = self._pending, []
         if pending:
             self.flushes += 1
             self.max_batch = max(self.max_batch, len(pending))
         return pending
 
     def _flush(self) -> None:
-        if not self._pending or self._inflight >= self.max_inflight:
-            # all slots busy: keep accumulating; the completing batch
-            # re-flushes (bigger batch — backpressure as batch growth)
-            return
-        pending = self._take_pending()
-        # while earlier batches are in flight, a host-path batch must
-        # not route (and no batch may resolve) ahead of them — begin
-        # with deferred host routing and chain the completion
-        chain_active = (self._chain is not None
-                        and not self._chain.done())
-        try:
-            pb = self.broker.publish_begin(
-                [m for m, _ in pending], defer_host=chain_active)
-        except Exception as e:
-            log.exception("ingress batch publish failed")
-            self._resolve_exc(pending, e)
-            return
-        if pb.done and not chain_active:
-            self._resolve(pending, pb.results)
-            return
-        self._inflight += 1
-        loop = asyncio.get_running_loop()
-        prev = self._chain if chain_active else None
-        task = loop.create_task(self._complete(pb, pending, prev))
-        self._chain = task
+        # a capped take can leave a backlog: keep flushing chunks
+        # while pipeline slots are free
+        while self._pending and self._inflight < self.max_inflight:
+            pending = self._take_pending(cap=self.batch_cap)
+            # while earlier batches are in flight, a host-path batch
+            # must not route (and no batch may resolve) ahead of them
+            # — begin with deferred host routing, chain the completion
+            chain_active = (self._chain is not None
+                            and not self._chain.done())
+            try:
+                pb = self.broker.publish_begin(
+                    [m for m, _ in pending], defer_host=chain_active)
+            except Exception as e:
+                log.exception("ingress batch publish failed")
+                self._resolve_exc(pending, e)
+                continue
+            if pb.done and not chain_active:
+                self._resolve(pending, pb.results)
+                continue
+            self._inflight += 1
+            loop = asyncio.get_running_loop()
+            prev = self._chain if chain_active else None
+            task = loop.create_task(self._complete(pb, pending, prev))
+            self._chain = task
 
     async def _complete(self, pb, pending, prev) -> None:
         """Fetch off-loop, then deliver in batch order."""

@@ -102,6 +102,27 @@ def pack_fanout(subs: jax.Array, src: jax.Array, *, pq: int):
     return f_ptr, packed_subs, packed_src
 
 
+@jax.jit
+def bundle_i32(*parts: jax.Array) -> jax.Array:
+    """Concatenate heterogeneous packed outputs into ONE int32 vector.
+
+    A device→host fetch pays per-buffer round-trip latency on the
+    host link; bundling the whole packed result set (row pointers,
+    packed ids/subs/src, overflow flags, bitmap rows — bools widen,
+    uint32 bitcasts) into a single buffer makes the publish path's
+    fetch exactly one transfer. The host slices it apart with the
+    statically known section sizes (see ``Broker.publish_fetch``).
+    """
+    flat = []
+    for p in parts:
+        if p.dtype == jnp.uint32:
+            p = jax.lax.bitcast_convert_type(p, jnp.int32)
+        elif p.dtype != jnp.int32:
+            p = p.astype(jnp.int32)
+        flat.append(p.reshape(-1))
+    return jnp.concatenate(flat)
+
+
 @functools.partial(jax.jit, static_argnames=("pr",))
 def pack_union_rows(union: jax.Array, has_big: jax.Array, *, pr: int):
     """Compact the bitmap-union rows: only rows with ``has_big`` set

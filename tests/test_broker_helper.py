@@ -244,3 +244,13 @@ def test_pad_rows_do_not_inflate_packed_totals():
     assert int(pb.f_ptr[-1]) == 1
     assert b.publish_finish(pb) == [1]
     assert s.got == [("#", "real/topic")]
+
+
+def test_pack_rows_zero_does_not_hang():
+    """pack_rows=0 must not wedge publish_fetch's pow2 growth loop."""
+    b = _dev_broker(pack_rows=0, fanout_threshold=4)
+    subs = [Rec(f"c{i}") for i in range(8)]
+    for s in subs:
+        b.subscribe(s, "bm/zero")
+    n = b.publish(Message(topic="bm/zero"))
+    assert n == 8
