@@ -300,9 +300,12 @@ class Listener:
         self._conns: set = set()
         self._handshaking: set = set()
 
-    async def _handshake(self, reader, writer) -> bool:
+    async def _handshake(self, reader, writer):
         """Pre-MQTT negotiation; False rejects the socket (the
-        override is responsible for any error response)."""
+        override is responsible for any error response). An override
+        may return a replacement ``(reader, writer)`` pair — a
+        TLS-terminating engine substitutes its plaintext streams
+        (see psk_tls.PskTlsListener)."""
         return True
 
     async def _on_client(self, reader, writer) -> None:
@@ -311,26 +314,31 @@ class Listener:
             writer.close()
             return
         conn = None
-        self._handshaking.add(writer)
+        raw_writer = writer  # the socket writer, for set bookkeeping
+        self._handshaking.add(raw_writer)
         try:
-            if not await self._handshake(reader, writer):
+            hs = await self._handshake(reader, writer)
+            if hs is False:
                 return
+            if isinstance(hs, tuple):
+                reader, writer = hs
             conn = self.connection_class(
                 reader, writer, self.broker, self.cm,
                 zone=self.zone, listener=self.name)
             self._conns.add(conn)
-            self._handshaking.discard(writer)
+            self._handshaking.discard(raw_writer)
             await conn.run()
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
-            self._handshaking.discard(writer)
+            self._handshaking.discard(raw_writer)
             if conn is not None:
                 self._conns.discard(conn)
-            try:
-                writer.close()
-            except Exception:
-                pass
+            for w in (writer, raw_writer):
+                try:
+                    w.close()
+                except Exception:
+                    pass
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(

@@ -133,9 +133,26 @@ class Node:
                          name: str = "ssl:default",
                          max_connections: int = 1024000) -> Listener:
         """TLS-terminating MQTT listener (reference mqtt:ssl via
-        esockd, src/emqx_listeners.erl:43-76)."""
+        esockd, src/emqx_listeners.erl:43-76). A PSK-only option set
+        on an interpreter whose ``ssl`` lacks server-side PSK falls
+        through to the native OpenSSL engine (psk_tls.py)."""
+        import ssl as _ssl
+
         from emqx_tpu.tls import TlsOptions, make_server_context
-        ctx = make_server_context(tls_options or TlsOptions())
+        opts = tls_options or TlsOptions()
+        if (opts.psk is not None and not opts.certfile
+                and not hasattr(_ssl.SSLContext,
+                                "set_psk_server_callback")):
+            from emqx_tpu.psk_tls import PskTlsListener
+            lst = PskTlsListener(
+                self.broker, self.cm, host=host, port=port,
+                zone=zone or self.zone, name=name,
+                max_connections=max_connections, psk=opts.psk,
+                psk_identity_hint=opts.psk_identity_hint,
+                psk_ciphers=opts.ciphers or "PSK")
+            self.listeners.append(lst)
+            return lst
+        ctx = make_server_context(opts)
         lst = Listener(self.broker, self.cm, host=host, port=port,
                        zone=zone or self.zone, name=name,
                        ssl_context=ctx,

@@ -14,7 +14,9 @@ TLS-PSK: Python 3.13 added ``SSLContext.set_psk_server_callback``;
 on interpreters that have it, a :class:`emqx_tpu.psk.PskAuth`
 resolver is wired straight into the handshake (the reference's
 ``'tls_handshake.psk_lookup'`` hookpoint, src/emqx_psk.erl:31). On
-older interpreters the seam stays host-side (see psk.py docstring).
+older interpreters a PSK-only listener is served by the native
+ctypes-OpenSSL engine instead (:mod:`emqx_tpu.psk_tls`) —
+``Node.add_tls_listener`` picks the backend automatically.
 """
 
 from __future__ import annotations
@@ -76,9 +78,10 @@ def make_server_context(opts: TlsOptions) -> ssl.SSLContext:
     if psk_only and not hasattr(ssl.SSLContext,
                                 "set_psk_server_callback"):
         raise ValueError(
-            "PSK-only TLS listener needs Python 3.13+ "
-            "(ssl has no server-side PSK API here); add a certfile "
-            "or terminate PSK in a fronting proxy")
+            "PSK-only TLS needs the native engine on this "
+            "interpreter (ssl has no server-side PSK API) — go "
+            "through Node.add_tls_listener, which selects "
+            "emqx_tpu.psk_tls automatically")
     if psk_only and opts.tls_version == "tlsv1.3":
         # PSK callbacks apply to TLS <= 1.2 only; min 1.3 + max 1.2
         # would build a context no handshake can satisfy

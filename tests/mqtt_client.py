@@ -39,8 +39,15 @@ class TestClient:
 
     async def connect(self, host="127.0.0.1", port=1883,
                       timeout=5.0, ssl=None) -> Connack:
-        self.reader, self.writer = await asyncio.open_connection(
+        reader, writer = await asyncio.open_connection(
             host, port, ssl=ssl)
+        return await self.connect_over(reader, writer, timeout=timeout)
+
+    async def connect_over(self, reader, writer,
+                           timeout=5.0) -> Connack:
+        """CONNECT over pre-established streams (a TLS-PSK pair, a
+        proxied socket, ...)."""
+        self.reader, self.writer = reader, writer
         self._task = asyncio.get_event_loop().create_task(self._read_loop())
         await self.send(Connect(
             proto_ver=self.version,
