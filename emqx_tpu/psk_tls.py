@@ -420,12 +420,24 @@ class PskStreamWriter:
         return self._writer.get_extra_info(name, default)
 
 
+#: decrypt-pump high-water mark: above this much un-consumed
+#: plaintext the pump stops reading the socket, re-engaging TCP
+#: backpressure (the plain-TCP path gets this for free by reading
+#: the socket directly; the zone's rate limiter then works again)
+_PUMP_HIGH_WATER = 1 << 20
+
+
 async def _pump(engine: PskTlsEngine, sock_reader,
                 plain: asyncio.StreamReader, writer) -> None:
     """Socket → engine → plaintext reader (and any engine-generated
     ciphertext — renegotiation, close_notify replies — back out)."""
     try:
         while True:
+            while len(plain._buffer) > _PUMP_HIGH_WATER:
+                # connection loop hasn't consumed the plaintext yet:
+                # stop pulling off the socket so the peer's TCP
+                # window closes instead of our memory growing
+                await asyncio.sleep(0.02)
             data = await sock_reader.read(65536)
             if not data:
                 plain.feed_eof()
@@ -469,15 +481,32 @@ async def handshake_streams(
     deadline = loop.time() + timeout
 
     while True:
-        done = engine.handshake()
+        try:
+            done = engine.handshake()
+        except PskTlsError:
+            # flush the alert OpenSSL queued (unknown_psk_identity /
+            # decrypt_error) so the peer can tell a bad key from a
+            # network failure, then re-raise
+            out = engine.outgoing()
+            if out:
+                try:
+                    writer.write(out)
+                    await writer.drain()
+                except Exception:
+                    pass
+            raise
         out = engine.outgoing()
         if out:
             writer.write(out)
             await writer.drain()
         if done:
             break
-        data = await asyncio.wait_for(
-            reader.read(65536), max(0.01, deadline - loop.time()))
+        remaining = deadline - loop.time()
+        if remaining <= 0:
+            # hard deadline: a drip-feeding client must not hold a
+            # handshake slot past the timeout (slow-loris)
+            raise asyncio.TimeoutError("TLS-PSK handshake deadline")
+        data = await asyncio.wait_for(reader.read(65536), remaining)
         if not data:
             raise PskTlsError("peer closed during TLS-PSK handshake")
         engine.feed(data)

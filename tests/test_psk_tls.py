@@ -189,3 +189,63 @@ def test_bad_cipher_string_fails_at_listener_build():
     with pytest.raises(PskTlsError):
         PskTlsListener(b, cm, psk=auth,
                        psk_ciphers="NO-SUCH-CIPHER-FAMILY")
+
+
+async def test_handshake_hard_deadline_drip_feed():
+    """A drip-feeding client cannot hold a handshake slot past the
+    timeout (slow-loris guard)."""
+    from emqx_tpu.node import Node
+    from emqx_tpu.tls import TlsOptions
+
+    n = Node(boot_listeners=False)
+    auth = PskAuth(n.hooks, keys={"d": b"k"})
+    lst = n.add_tls_listener(port=0, tls_options=TlsOptions(psk=auth))
+    lst.handshake_timeout = 0.5
+    await n.start()
+    try:
+        r, w = await asyncio.open_connection("127.0.0.1", lst.port)
+        t0 = asyncio.get_running_loop().time()
+        # a legal record header declaring a 16KB body keeps OpenSSL
+        # in WANT_READ; then drip filler forever
+        w.write(b"\x16\x03\x03\x40\x00")
+
+        async def drip():
+            try:
+                while True:
+                    w.write(b"\x00")
+                    await w.drain()
+                    await asyncio.sleep(0.05)
+            except (ConnectionError, OSError):
+                pass
+
+        task = asyncio.ensure_future(drip())
+        # server must close at its 0.5s deadline, not hang
+        await asyncio.wait_for(r.read(), 5)
+        elapsed = asyncio.get_running_loop().time() - t0
+        task.cancel()
+        assert 0.3 <= elapsed < 4.0
+        w.close()
+    finally:
+        await n.stop()
+
+
+async def test_bad_key_gets_tls_alert_not_bare_close():
+    """The failure alert reaches the wire so a client can distinguish
+    a key mismatch from a network failure."""
+    from emqx_tpu.node import Node
+    from emqx_tpu.tls import TlsOptions
+
+    n = Node(boot_listeners=False)
+    auth = PskAuth(n.hooks, keys={"d": b"right"})
+    lst = n.add_tls_listener(port=0, tls_options=TlsOptions(psk=auth))
+    await n.start()
+    try:
+        with pytest.raises(PskTlsError) as ei:
+            await open_psk_connection("127.0.0.1", lst.port,
+                                      "d", b"wrong")
+        # the client saw a TLS-level failure (alert), not a bare EOF
+        assert "handshake" in str(ei.value).lower() or \
+            "alert" in str(ei.value).lower() or \
+            "failed" in str(ei.value).lower()
+    finally:
+        await n.stop()

@@ -519,3 +519,163 @@ async def test_alias_overhead_falls_back_to_plain_topic():
         assert node.metrics.val("delivery.dropped.too_large") == 0
         await c.close()
         await pub.close()
+
+
+# -- CONNACK capability properties (t_connack_max_qos_allowed) --------------
+
+async def test_connack_maximum_qos_and_violation_disconnects():
+    """Zone caps QoS at 1: CONNACK carries Maximum-QoS=1 and a QoS2
+    PUBLISH is a protocol violation (MQTT-3.2.2-11: DISCONNECT 0x9B
+    or close)."""
+    from emqx_tpu.zone import Zone
+
+    async with broker_node(zone=Zone(max_qos_allowed=1)) as node:
+        c = TestClient("maxq", version=C.MQTT_V5)
+        ack = await c.connect(port=_port(node))
+        assert ack.properties.get("Maximum-QoS") == 1
+        await c.publish("mq/ok", b"x", qos=1)  # allowed
+        await c.send(Publish(topic="mq/bad", payload=b"x", qos=2,
+                             packet_id=9))
+        # server must refuse: either DISCONNECT 0x9B or socket close
+        got = None
+        with contextlib.suppress(asyncio.TimeoutError):
+            got = await asyncio.wait_for(c.acks.get(), 3)
+        if got is not None and isinstance(got, Disconnect):
+            assert got.reason_code == 0x9B
+        else:
+            # socket close: the client read loop exits on EOF
+            await asyncio.wait_for(c._task, 3)
+        await c.close()
+
+
+async def test_connack_server_keepalive_override():
+    from emqx_tpu.zone import Zone
+
+    async with broker_node(zone=Zone(server_keepalive=5)) as node:
+        c = TestClient("ska", version=C.MQTT_V5, keepalive=300)
+        ack = await c.connect(port=_port(node))
+        assert ack.properties.get("Server-Keep-Alive") == 5
+        await c.close()
+
+
+# -- publish properties passthrough (t_publish_properties / _payload_ -------
+# format_indicator / _response_topic)
+
+async def test_publish_properties_passthrough():
+    """v5 application properties travel intact broker→subscriber:
+    payload format, content type, user properties, response topic,
+    correlation data (MQTT-3.3.2)."""
+    async with broker_node() as node:
+        sub = TestClient("pp-sub", version=C.MQTT_V5)
+        await sub.connect(port=_port(node))
+        await sub.subscribe("pp/t", qos=1)
+        pub = TestClient("pp-pub", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        props = {
+            "Payload-Format-Indicator": 1,
+            "Content-Type": "application/json",
+            "Response-Topic": "pp/replies",
+            "Correlation-Data": b"\x01\x02",
+            "User-Property": [("k1", "v1"), ("k2", "v2")],
+        }
+        await pub.publish("pp/t", b'{"a":1}', qos=1, props=props,
+                          timeout=60)
+        m = await sub.recv(10)
+        assert m.properties.get("Payload-Format-Indicator") == 1
+        assert m.properties.get("Content-Type") == "application/json"
+        assert m.properties.get("Response-Topic") == "pp/replies"
+        assert m.properties.get("Correlation-Data") == b"\x01\x02"
+        assert m.properties.get("User-Property") == [("k1", "v1"),
+                                                     ("k2", "v2")]
+        await sub.close()
+        await pub.close()
+
+
+# -- will flags + properties (t_connect_will_message / _will_retain) --------
+
+async def test_will_message_flags_and_properties():
+    async with broker_node() as node:
+        watcher = TestClient("will-w", version=C.MQTT_V5)
+        await watcher.connect(port=_port(node))
+        # RAP so the will's retain flag is observable (MQTT-3.3.1-12)
+        await watcher.subscribe(("wl/t", {"qos": 1, "rap": 1,
+                                          "nl": 0, "rh": 0}), qos=1)
+        dying = TestClient(
+            "will-d", version=C.MQTT_V5,
+            will_flag=True, will_topic="wl/t", will_payload=b"gone",
+            will_qos=1, will_retain=True,
+            will_props={"Content-Type": "text/plain"})
+        await dying.connect(port=_port(node))
+        dying.writer.close()  # abnormal close → will fires
+        m = await watcher.recv(10)
+        assert m.topic == "wl/t" and m.payload == b"gone"
+        assert m.retain  # will retain flag preserved (RAP)
+        assert m.properties.get("Content-Type") == "text/plain"
+        await watcher.close()
+
+
+# -- subscription option updates (t_subscribe_actions) ----------------------
+
+async def test_resubscribe_updates_subscription_options():
+    async with broker_node() as node:
+        sub = TestClient("resub", version=C.MQTT_V5)
+        await sub.connect(port=_port(node))
+        ack = await sub.subscribe("ra/t", qos=2)
+        assert ack.reason_codes == [2]
+        pub = TestClient("resub-p", version=C.MQTT_V5)
+        await pub.connect(port=_port(node))
+        await pub.publish("ra/t", b"1", qos=2, timeout=60)
+        m = await sub.recv(10)
+        assert m.qos == 2
+        # drain the inbound-QoS2 PUBREL the auto-ack flow queued
+        await asyncio.sleep(0.2)
+        while not sub.acks.empty():
+            sub.acks.get_nowait()
+        # resubscribe same filter at qos0: options replaced, not added
+        ack = await sub.subscribe("ra/t", qos=0)
+        assert ack.reason_codes == [0]
+        await pub.publish("ra/t", b"2", qos=2)
+        m = await sub.recv(10)
+        assert m.qos == 0  # delivered at the NEW max qos
+        # still exactly one subscription: one delivery per publish
+        with contextlib.suppress(asyncio.TimeoutError):
+            extra = await sub.recv(0.3)
+            raise AssertionError(f"duplicate delivery {extra!r}")
+        await sub.close()
+        await pub.close()
+
+
+async def test_unsubscribe_reason_codes():
+    """UNSUBACK per-filter codes: 0x00 success, 0x11 no subscription
+    existed (MQTT-3.11.3)."""
+    async with broker_node() as node:
+        c = TestClient("unsub", version=C.MQTT_V5)
+        await c.connect(port=_port(node))
+        await c.subscribe("un/t", qos=0)
+        ack = await c.unsubscribe("un/t", "never/was")
+        assert ack.reason_codes == [0x00, 0x11]
+        await c.close()
+
+
+# -- keepalive enforcement (t_connect_keepalive_timeout) --------------------
+
+async def test_keepalive_timeout_closes_connection():
+    """No control packets for 1.5× keepalive → server closes the
+    network connection (MQTT-3.1.2-22)."""
+    async with broker_node() as node:
+        c = TestClient("ka1", version=C.MQTT_V5, keepalive=1)
+        await c.connect(port=_port(node))
+        t0 = time.monotonic()
+        # the client read loop exits when the server closes on us
+        await asyncio.wait_for(c._task, 10)
+        elapsed = time.monotonic() - t0
+        assert 0.9 <= elapsed <= 6.0
+        # a PINGing client at the same keepalive stays up
+        c2 = TestClient("ka2", version=C.MQTT_V5, keepalive=1)
+        await c2.connect(port=_port(node))
+        from emqx_tpu.mqtt.packet import Pingreq
+        for _ in range(4):
+            await asyncio.sleep(0.5)
+            await c2.send(Pingreq())
+        assert not c2.writer.is_closing()
+        await c2.close()
