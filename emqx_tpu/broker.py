@@ -37,6 +37,7 @@ from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_rows,
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.shared_sub import SharedSub
 from emqx_tpu.types import Message, SubOpts
+from emqx_tpu.utils.batch import dedup_topics
 
 log = logging.getLogger("emqx_tpu.broker")
 
@@ -55,8 +56,8 @@ class PendingBatch:
     ``publish_fetch`` — possibly on an executor thread)."""
 
     __slots__ = (
-        "done", "results", "live", "host_topics", "id_map", "epoch",
-        "st", "ids_dev", "ovf_dev", "pm", "pq",
+        "done", "results", "live", "host_topics", "inv", "id_map",
+        "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
         "dovf_d", "f_ptr_d", "subs_packed_d", "src_packed_d",
         "bovf_d", "sel_d", "rows_packed_d", "bm_total_d",
@@ -70,6 +71,7 @@ class PendingBatch:
         self.results: List[int] = []
         self.live: List[Tuple[int, Message]] = []
         self.host_topics: Optional[List[str]] = None
+        self.inv: Optional[List[int]] = None
         self.st = None
         self.ids_dev = self.ovf_dev = None
         self.m_ptr_d = self.ids_packed_d = None
@@ -293,13 +295,16 @@ class Broker:
             return pb
 
         # device match (HOT LOOP 1) → device fan-out (HOT LOOP 2)
-        # → pack (transfer compaction); all async-dispatched
+        # → pack (transfer compaction); all async-dispatched.
+        # Duplicate topics in the batch (hot topics arrive many times
+        # per tick) collapse to one device row; the delivery tail
+        # expands per message via the inverse index.
+        uniq, pb.inv = dedup_topics(topics)
         pb.ids_dev, pb.ovf_dev, pb.id_map, pb.epoch = \
-            self.router.match_dispatch(topics)
+            self.router.match_dispatch(uniq)
         # phantom pad-row matches (wildcards match the pad topic) must
         # not reach the fan-out/pack kernels or the learned budgets
-        pb.ids_dev = mask_pad_rows(pb.ids_dev,
-                                   np.int32(len(topics)))
+        pb.ids_dev = mask_pad_rows(pb.ids_dev, np.int32(len(uniq)))
         pb.st = self.helper.state(pb.epoch, pb.id_map)
         bucket = pb.ids_dev.shape[0]
         budgets = self._pack_budgets.setdefault(
@@ -453,7 +458,8 @@ class Broker:
             return pb.results
         m_ptr = pb.m_ptr
         for row, (i, msg) in enumerate(pb.live):
-            if pb.ovf[row]:
+            urow = pb.inv[row]  # packed results are per UNIQUE topic
+            if pb.ovf[urow]:
                 # match overflow: this topic's result is unknown —
                 # full host path for it (exact parity, no truncation)
                 filters = self.router.host_match(msg.topic)
@@ -462,13 +468,13 @@ class Broker:
                     continue
                 pb.results[i] = self._route(filters, msg)
                 continue
-            row_ids = pb.ids_packed[m_ptr[row]:m_ptr[row + 1]]
+            row_ids = pb.ids_packed[m_ptr[urow]:m_ptr[urow + 1]]
             filters = [pb.id_map[j] for j in row_ids]
             filters = [f for f in filters if f is not None]
             if not filters:
                 self._drop_no_subs(msg)
                 continue
-            pb.results[i] = self._route_packed(row, row_ids, filters,
+            pb.results[i] = self._route_packed(urow, row_ids, filters,
                                                msg, pb)
         return pb.results
 

@@ -60,6 +60,12 @@ class Automaton(NamedTuple):
     ht_word: np.ndarray | None = None   # int32[NB, 4]
     ht_child: np.ndarray | None = None  # int32[NB, 4]
     ht_seed: np.ndarray | None = None   # uint32[1] — the mix seed used
+    # packed mirrors for the match kernel: TPU gather cost is per ROW
+    # (~flat up to width ≥24), so one wide gather replaces three
+    # narrow ones — the walk drops from 9 to 3 gathers per
+    # (state, level)
+    ht_packed: np.ndarray | None = None    # int32[NB, 12] = s0..3|w0..3|c0..3
+    node_packed: np.ndarray | None = None  # int32[S_cap, 4] = plus|hash|end|-1
 
 
 def capacity_for(n: int, cap: int | None = None) -> int:
@@ -289,6 +295,22 @@ def build_edge_hash(
     raise RuntimeError("edge-hash build failed for all seeds")
 
 
+def pack_tables(auto: Automaton) -> Automaton:
+    """Build the wide packed mirrors the match kernel gathers from
+    (see the field comments on :class:`Automaton`)."""
+    ht_packed = None
+    if auto.ht_state is not None:
+        ht_packed = np.concatenate(
+            [np.asarray(auto.ht_state), np.asarray(auto.ht_word),
+             np.asarray(auto.ht_child)], axis=1).astype(np.int32)
+    node_packed = np.stack(
+        [np.asarray(auto.plus_child), np.asarray(auto.hash_filter),
+         np.asarray(auto.end_filter),
+         np.full_like(np.asarray(auto.plus_child), -1)],
+        axis=1).astype(np.int32)
+    return auto._replace(ht_packed=ht_packed, node_packed=node_packed)
+
+
 def attach_edge_hash(auto: Automaton, n_buckets: int | None = None) -> Automaton:
     """Return ``auto`` with hash tables built (bucket count derived
     from edge capacity unless given — sharded builds pass a shared
@@ -299,5 +321,5 @@ def attach_edge_hash(auto: Automaton, n_buckets: int | None = None) -> Automaton
         np.asarray(auto.row_ptr), np.asarray(auto.edge_word),
         np.asarray(auto.edge_child), auto.n_states, auto.n_edges,
         n_buckets)
-    return auto._replace(ht_state=ht_s, ht_word=ht_w, ht_child=ht_c,
-                         ht_seed=seed)
+    return pack_tables(auto._replace(
+        ht_state=ht_s, ht_word=ht_w, ht_child=ht_c, ht_seed=seed))

@@ -29,6 +29,9 @@ class FanoutTable(NamedTuple):
     sub_ids: np.ndarray  # int32[N_cap]
     n_filters: int
     n_entries: int
+    # packed (start, end) pairs: ONE row gather per matched filter
+    # instead of two row_ptr lookups (TPU gather cost is per row)
+    row_pairs: np.ndarray | None = None  # int32[F_cap, 2]
 
 
 def build_fanout(
@@ -52,7 +55,8 @@ def build_fanout(
             sub_ids[pos] = s
             pos += 1
     row_ptr[num_filters:] = pos
-    return FanoutTable(row_ptr, sub_ids, num_filters, total)
+    pairs = np.stack([row_ptr[:-1], row_ptr[1:]], axis=1)
+    return FanoutTable(row_ptr, sub_ids, num_filters, total, pairs)
 
 
 @jax.jit
@@ -101,25 +105,40 @@ def gather_subscribers_src(
     Returns ``(subs[B, d], src[B, d], count[B], overflow[B])``; both
     ``subs`` and ``src`` are -1 padded.
     """
+    M = match_ids.shape[1]
+
     def one(ids):
         # out-of-capacity ids (automaton patched past this table's
         # build) contribute zero length — never clamp into a row
         in_range = (ids >= 0) & (ids < fan.row_ptr.shape[0] - 1)
         safe = jnp.where(in_range, ids, 0)
-        lens = jnp.where(
-            in_range, fan.row_ptr[safe + 1] - fan.row_ptr[safe], 0)
+        if fan.row_pairs is not None:
+            pairs = fan.row_pairs[safe]          # ONE [M, 2] gather
+            starts = pairs[:, 0]
+            lens = jnp.where(in_range, pairs[:, 1] - pairs[:, 0], 0)
+        else:
+            starts = fan.row_ptr[safe]
+            lens = jnp.where(
+                in_range, fan.row_ptr[safe + 1] - starts, 0)
         cum = jnp.cumsum(lens)
         total = cum[-1]
-        starts = fan.row_ptr[safe]
         slots = jnp.arange(d, dtype=jnp.int32)
-        row = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
-        row_c = jnp.minimum(row, ids.shape[0] - 1)
-        base = cum[row_c] - lens[row_c]
-        idx = starts[row_c] + (slots - base)
+        # row assignment by compare-sum, NOT searchsorted: the
+        # binary-search lowering emits log(M) gathers per slot, while
+        # a [d, M] compare + row-sum is pure vector work
+        row = jnp.sum(cum[None, :] <= slots[:, None],
+                      axis=1, dtype=jnp.int32)
+        row_c = jnp.minimum(row, M - 1)
+        # the four per-row values each slot needs, packed into ONE
+        # [M, 4] local table: one [d]-row gather instead of four
+        local = jnp.stack([cum, lens, starts, ids], axis=1)
+        g = local[row_c]                       # [d, 4]
+        base = g[:, 0] - g[:, 1]
+        idx = g[:, 2] + (slots - base)
         idx = jnp.clip(idx, 0, fan.sub_ids.shape[0] - 1)
         valid = slots < jnp.minimum(total, d)
         subs = jnp.where(valid, fan.sub_ids[idx], -1)
-        src = jnp.where(valid, ids[row_c], -1)
+        src = jnp.where(valid, g[:, 3], -1)
         return subs, src, total, total > d
 
     return jax.vmap(one)(match_ids)

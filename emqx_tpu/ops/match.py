@@ -71,26 +71,33 @@ def _edge_lookup(auto: Automaton, iters: int, state: jax.Array, word: jax.Array)
 
 def _edge_lookup_hash(auto: Automaton, states: jax.Array, word: jax.Array) -> jax.Array:
     """Child states for the whole active set via the bucketed 2-choice
-    hash table: two 4-wide row gathers per table (size-independent),
-    vs ~2·log2(E) scalar gathers for the CSR binary search.
+    hash table — vs ~2·log2(E) scalar gathers for the CSR binary
+    search. With the packed mirror present each choice is ONE
+    [K, 12]-row gather of (state|word|child) triples (TPU gather cost
+    is per row, nearly independent of width — measured flat to width
+    ≥24); otherwise two 4-wide gathers per table.
 
     ``states`` is the active set [K] (-1 = inactive); ``word`` a scalar
     (may be UNKNOWN/PAD < 0). Returns [K] child ids, -1 = no edge.
     """
     from emqx_tpu.ops.csr import hash_mix
 
-    nb = auto.ht_state.shape[0]
+    packed = auto.ht_packed is not None
+    nb = (auto.ht_packed if packed else auto.ht_state).shape[0]
     seed = auto.ht_seed[0]
     h1, h2 = hash_mix(states, jnp.broadcast_to(word, states.shape), seed)
     b1 = (h1 & jnp.uint32(nb - 1)).astype(jnp.int32)
     b2 = (h2 & jnp.uint32(nb - 1)).astype(jnp.int32)
 
     def probe(b):
-        rs = auto.ht_state[b]          # [K, 4]
-        rw = auto.ht_word[b]
+        if packed:
+            row = auto.ht_packed[b]    # [K, 12]
+            rs, rw, rc = row[:, 0:4], row[:, 4:8], row[:, 8:12]
+        else:
+            rs, rw, rc = (auto.ht_state[b], auto.ht_word[b],
+                          auto.ht_child[b])
         hit = (rs == states[:, None]) & (rw == word)
-        child = jnp.max(jnp.where(hit, auto.ht_child[b], -1), axis=1)
-        return child
+        return jnp.max(jnp.where(hit, rc, -1), axis=1)
 
     child = jnp.maximum(probe(b1), probe(b2))
     live = (states >= 0) & (word >= 0)
@@ -170,21 +177,29 @@ def match_batch(
             walking = l < n
             ending = l == n
 
+            if auto.node_packed is not None:
+                # one [K, 4]-row gather: plus | hash_filter | end_filter
+                node = auto.node_packed[jnp.maximum(active, 0)]
+                plus_col = node[:, 0]
+                hashf_col = node[:, 1]
+                endf_col = node[:, 2]
+            else:
+                plus_col = auto.plus_child[jnp.maximum(active, 0)]
+                hashf_col = auto.hash_filter[jnp.maximum(active, 0)]
+                endf_col = auto.end_filter[jnp.maximum(active, 0)]
+
             # '#'-child terminals at every live level (match_# semantics)
             emit_h = jnp.where(
-                alive & (walking | ending) & ~at_root_sys,
-                auto.hash_filter[jnp.maximum(active, 0)], -1)
+                alive & (walking | ending) & ~at_root_sys, hashf_col, -1)
             # exact terminals at end-of-topic
-            emit_e = jnp.where(
-                alive & ending, auto.end_filter[jnp.maximum(active, 0)], -1)
+            emit_e = jnp.where(alive & ending, endf_col, -1)
 
-            if auto.ht_state is not None:
+            if auto.ht_packed is not None or auto.ht_state is not None:
                 lit = _edge_lookup_hash(auto, active, word)
             else:
                 lit = jax.vmap(
                     lambda s: _edge_lookup(auto, iters, s, word))(active)
-            plus = jnp.where(
-                alive & ~at_root_sys, auto.plus_child[jnp.maximum(active, 0)], -1)
+            plus = jnp.where(alive & ~at_root_sys, plus_col, -1)
             cands = jnp.where(walking, jnp.concatenate([lit, plus]), -1)
             nxt, over = _compact(cands, k)
             return (nxt, ovf | over), jnp.concatenate([emit_h, emit_e])
@@ -193,12 +208,15 @@ def match_batch(
         (_, ovf), emits = lax.scan(
             step, (active0, jnp.asarray(False)), (words_ext, levels))
         flat = emits.reshape(-1)
-        cnt = jnp.sum(flat >= 0)
-        # Final emit-packing stays a sort: one descending sort of the
-        # [(L+1)·2K] emit buffer beats a same-size scatter here
-        # (measured on v5e; the per-level scatter in _compact wins
-        # because it runs L+1 times on a hotter loop).
-        ids = -jnp.sort(-flat)[:m]
+        valid = flat >= 0
+        cnt = jnp.sum(valid)
+        # final emit-packing: cumsum + drop-mode scatter into the m
+        # output slots (same packing as _compact; the old descending
+        # sort re-measured ~L·K·log² slower once timings forced true
+        # device completion)
+        pos = jnp.cumsum(valid) - 1
+        ids = jnp.full((m,), -1, dtype=flat.dtype).at[
+            jnp.where(valid, pos, m)].set(flat, mode="drop")
         too_long = n < 0
         return MatchResult(
             ids=jnp.where(too_long, -1, ids),

@@ -296,7 +296,7 @@ _CHUNKS = (32768, 4096, 128)
 
 @jax.jit
 def _apply_jit(auto: Automaton, ci, cv, hb, hs, hsv, hwv, hcv):
-    return auto._replace(
+    upd = dict(
         plus_child=auto.plus_child.at[ci[0]].set(cv[0], mode="drop"),
         hash_filter=auto.hash_filter.at[ci[1]].set(cv[1], mode="drop"),
         end_filter=auto.end_filter.at[ci[2]].set(cv[2], mode="drop"),
@@ -304,3 +304,17 @@ def _apply_jit(auto: Automaton, ci, cv, hb, hs, hsv, hwv, hcv):
         ht_word=auto.ht_word.at[hb, hs].set(hwv, mode="drop"),
         ht_child=auto.ht_child.at[hb, hs].set(hcv, mode="drop"),
     )
+    # the packed mirrors the match kernel actually gathers from must
+    # see the same mutations (layout: see csr.pack_tables)
+    if auto.ht_packed is not None:
+        upd["ht_packed"] = (
+            auto.ht_packed
+            .at[hb, hs].set(hsv, mode="drop")
+            .at[hb, hs + 4].set(hwv, mode="drop")
+            .at[hb, hs + 8].set(hcv, mode="drop"))
+    if auto.node_packed is not None:
+        npk = auto.node_packed
+        for c in range(3):
+            npk = npk.at[ci[c], c].set(cv[c], mode="drop")
+        upd["node_packed"] = npk
+    return auto._replace(**upd)

@@ -52,11 +52,14 @@ class ShardedAutomaton(NamedTuple):
     ht_word: jax.Array      # [T, NB, 4]
     ht_child: jax.Array     # [T, NB, 4]
     ht_seed: jax.Array      # [T, 1]
+    ht_packed: jax.Array    # [T, NB, 12]
+    node_packed: jax.Array  # [T, S_cap, 4]
 
 
 class ShardedFanout(NamedTuple):
     row_ptr: jax.Array  # [T, F_cap+1] — filter-id -> local sub rows
     sub_ids: jax.Array  # [T, N_cap]
+    row_pairs: jax.Array | None = None  # [T, F_cap, 2] packed pairs
 
 
 def shard_filters(filters: Sequence[str], n_shards: int) -> List[List[str]]:
@@ -100,6 +103,8 @@ def build_sharded(
         ht_word=np.stack([a.ht_word for a in padded]),
         ht_child=np.stack([a.ht_child for a in padded]),
         ht_seed=np.stack([a.ht_seed for a in padded]),
+        ht_packed=np.stack([a.ht_packed for a in padded]),
+        node_packed=np.stack([a.node_packed for a in padded]),
     )
 
 
@@ -142,6 +147,7 @@ def build_sharded_fanout(
     return ShardedFanout(
         row_ptr=np.stack([f.row_ptr for f in fans]),
         sub_ids=np.stack([f.sub_ids for f in fans]),
+        row_pairs=np.stack([f.row_pairs for f in fans]),
     )
 
 
@@ -192,10 +198,14 @@ def publish_step(
             hash_filter=auto_t.hash_filter[0], end_filter=auto_t.end_filter[0],
             n_states=0, n_edges=0, ht_state=auto_t.ht_state[0],
             ht_word=auto_t.ht_word[0], ht_child=auto_t.ht_child[0],
-            ht_seed=auto_t.ht_seed[0])
+            ht_seed=auto_t.ht_seed[0], ht_packed=auto_t.ht_packed[0],
+            node_packed=auto_t.node_packed[0])
         res = match_batch(a, ids, n, sysm, k=k, m=m)
         if with_fanout:
-            f = FanoutTable(fan_t.row_ptr[0], fan_t.sub_ids[0], 0, 0)
+            f = FanoutTable(
+                fan_t.row_ptr[0], fan_t.sub_ids[0], 0, 0,
+                row_pairs=(None if fan_t.row_pairs is None
+                           else fan_t.row_pairs[0]))
             subs, dcount, dovf = gather_subscribers(f, res.ids, d=d)
         else:
             subs = jnp.zeros((ids.shape[0], d), jnp.int32)

@@ -44,8 +44,11 @@ from emqx_tpu.types import Route
 @dataclass
 class MatcherConfig:
     max_levels: int = 16    # L — deeper topics fall back to the oracle
-    active_k: int = 64      # NFA active-set capacity
-    max_matches: int = 128  # match output capacity
+    # NFA active-set capacity: the walk's cost is ~linear in K (3
+    # packed gathers per state-level), and real active sets are tiny
+    # (≤ matching prefix paths). Overflow → exact host fallback.
+    active_k: int = 16
+    max_matches: int = 64   # match output capacity
     min_batch: int = 8      # batch padding bucket floor (pow2 buckets)
     use_device: bool = True
     use_native: bool = True  # C++ trie/encoder when the .so is present
@@ -58,7 +61,10 @@ class MatcherConfig:
     # than the threshold move from the CSR gather to bitmap rows
     # (the reference's ?SHARD=1024, src/emqx_broker_helper.erl:55)
     fanout_threshold: int = 1024
-    fanout_d: int = 1024     # per-message small-filter delivery slots
+    # per-message small-filter delivery slots: gather cost is ~linear
+    # in d; a message exceeding it host-dispatches (and >threshold
+    # filters ride the bitmap path, so d only covers the small tail)
+    fanout_d: int = 128
     fanout_mb: int = 16      # per-message big(bitmap)-filter slots
     # below this many live filters the broker matches on HOST (the
     # C++ trie): a device dispatch + result transfer costs fixed
@@ -390,7 +396,8 @@ class Router:
             # (with_fanout=False): minimal, never read
             self._dummy_fan = place_sharded(mesh, ShardedFanout(
                 row_ptr=np.zeros((n_trie, 2), np.int32),
-                sub_ids=np.full((n_trie, 1), -1, np.int32)))
+                sub_ids=np.full((n_trie, 1), -1, np.int32),
+                row_pairs=np.zeros((n_trie, 1, 2), np.int32)))
         self._auto = auto
         self._auto_map = list(self._id_to_filter)
         self._free_ids.extend(self._pending_free)

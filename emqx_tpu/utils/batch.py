@@ -93,3 +93,22 @@ class AsyncBatcher:
                 # task — that would silently stall partial batches
                 logging.getLogger("emqx_tpu.batch").exception(
                     "batch commit failed")
+
+
+def dedup_topics(topics):
+    """Collapse duplicate topics, first occurrence wins: returns
+    ``(unique_topics, inverse_index)`` with
+    ``unique_topics[inverse_index[i]] == topics[i]``. The publish
+    path collapses hot topics to one device row per tick and expands
+    results per message (broker.publish_begin / bench pipeline)."""
+    seen = {}
+    uniq = []
+    inv = []
+    for t in topics:
+        j = seen.get(t)
+        if j is None:
+            j = len(uniq)
+            seen[t] = j
+            uniq.append(t)
+        inv.append(j)
+    return uniq, inv

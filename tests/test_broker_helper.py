@@ -254,3 +254,21 @@ def test_pack_rows_zero_does_not_hang():
         b.subscribe(s, "bm/zero")
     n = b.publish(Message(topic="bm/zero"))
     assert n == 8
+
+
+def test_duplicate_topics_in_batch_each_deliver():
+    """Hot topics collapse to one device row; every logical message
+    still delivers (the inverse index expands per message)."""
+    b = _dev_broker()
+    s = Rec("dup")
+    b.subscribe(s, "hot/+")
+    msgs = [Message(topic="hot/a") for _ in range(5)] + \
+        [Message(topic="hot/b")] + \
+        [Message(topic="hot/a")]
+    pb = b.publish_begin(msgs)
+    assert not pb.done
+    assert pb.inv == [0, 0, 0, 0, 0, 1, 0]
+    b.publish_fetch(pb)
+    assert b.publish_finish(pb) == [1] * 7
+    assert s.got.count(("hot/+", "hot/a")) == 6
+    assert s.got.count(("hot/+", "hot/b")) == 1
