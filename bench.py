@@ -145,13 +145,7 @@ def _throughput_windows(step, batches, windows, iters):
     return float(np.median(rates)), rates, outs
 
 
-def dedup_topics(topics):
-    """Collapse duplicate topics (the ingress sees hot topics many
-    times per tick) — the library's helper, re-exported for the bench
-    pipeline."""
-    from emqx_tpu.utils.batch import dedup_topics as _dd
-
-    return _dd(topics)
+from emqx_tpu.utils.batch import dedup_topics  # noqa: E402
 
 
 def build_filters(rng, n_subs, words_per_level, levels=5):

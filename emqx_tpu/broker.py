@@ -56,7 +56,8 @@ class PendingBatch:
     ``publish_fetch`` — possibly on an executor thread)."""
 
     __slots__ = (
-        "done", "results", "live", "host_topics", "inv", "id_map",
+        "done", "results", "live", "host_topics", "inv", "n_uniq",
+        "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
         "dovf_d", "f_ptr_d", "subs_packed_d", "src_packed_d",
@@ -72,6 +73,7 @@ class PendingBatch:
         self.live: List[Tuple[int, Message]] = []
         self.host_topics: Optional[List[str]] = None
         self.inv: Optional[List[int]] = None
+        self.n_uniq = 0
         self.st = None
         self.ids_dev = self.ovf_dev = None
         self.m_ptr_d = self.ids_packed_d = None
@@ -300,6 +302,7 @@ class Broker:
         # per tick) collapse to one device row; the delivery tail
         # expands per message via the inverse index.
         uniq, pb.inv = dedup_topics(topics)
+        pb.n_uniq = len(uniq)
         pb.ids_dev, pb.ovf_dev, pb.id_map, pb.epoch = \
             self.router.match_dispatch(uniq)
         # phantom pad-row matches (wildcards match the pad topic) must
@@ -310,13 +313,13 @@ class Broker:
         budgets = self._pack_budgets.setdefault(
             bucket, [budget_for(bucket, cfg.pack_m),
                      budget_for(bucket, cfg.pack_q),
-                     max(1, cfg.pack_rows)])
+                     max(1, cfg.pack_rows), cfg.fanout_d])
         pb.pm = budgets[0]
         pb.m_ptr_d, pb.ids_packed_d = pack_matches(pb.ids_dev, pm=pb.pm)
         st = pb.st
         if st is not None and st.fan is not None:
             subs_d, src_d, _cnt, pb.dovf_d = gather_subscribers_src(
-                st.fan, pb.ids_dev, d=cfg.fanout_d)
+                st.fan, pb.ids_dev, d=budgets[3])
             pb.pq = budgets[1]
             pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
                 pack_fanout(subs_d, src_d, pq=pb.pq)
@@ -429,6 +432,17 @@ class Broker:
                 retry = True
             if retry:
                 continue
+            # adaptive capacity: a batch where >1/8 of the unique
+            # topics overflowed a bound means the bound undersizes
+            # the live workload — grow for the NEXT batch (this one
+            # already has its exact host fallback)
+            n_u = max(1, pb.n_uniq)
+            if dovf is not None and budgets is not None and \
+                    int(dovf[:n_u].sum()) * 8 > n_u and \
+                    budgets[3] < cfg.fanout_threshold:
+                budgets[3] = min(budgets[3] * 2, cfg.fanout_threshold)
+            if int(ovf[:n_u].sum()) * 8 > n_u:
+                self.router.boost_k()
             pb.m_ptr = m_ptr
             # slice to true occupancy before the per-element list
             # conversion — the budget tail is dead -1 padding

@@ -137,6 +137,11 @@ class Router:
         self._grow = {"state": 1, "edge": 1}  # rebuild growth factors
         self._compacting = False  # background compaction in flight
         self._dummy_fan = None    # sharded publish_step filler fan
+        # learned active-set boost: an overflow-storm batch (many
+        # topics exceeding active_k) doubles the effective K (bounded)
+        # instead of host-matching that workload forever — one extra
+        # compile per growth step, exact fallback in the meantime
+        self._k_boost = 0
         # device stat accumulators (sharded publish_step psums),
         # drained asynchronously by the stats flush — appending the
         # jax scalars defers the host transfer to drain time
@@ -533,9 +538,25 @@ class Router:
         with self._wt_lock:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n = depth_bucket(ids, n)
-        res = match_batch(auto, ids, n, sysm, k=cfg.active_k,
+        res = match_batch(auto, ids, n, sysm, k=self.effective_k(),
                           m=cfg.max_matches)
         return res.ids, res.overflow, id_map, epoch
+
+    def effective_k(self) -> int:
+        """Configured active-set capacity plus any learned boost."""
+        return max(self.config.active_k, self._k_boost)
+
+    def boost_k(self, cap: int = 64) -> bool:
+        """Double the effective active-set capacity (≤ ``cap``);
+        called by the publish path when a batch's overflow rate shows
+        the configured K undersizes the live workload. Returns
+        whether a grow happened."""
+        with self._lock:
+            k = self.effective_k()
+            if k >= cap:
+                return False
+            self._k_boost = k * 2
+            return True
 
     def match_ids(self, topics: Sequence[str]):
         """Device match of a topic batch in snapshot-id space.

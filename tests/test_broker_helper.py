@@ -214,18 +214,18 @@ def test_pack_budget_overflow_remembered_across_batches():
     """A grown packed budget persists per batch bucket: the second
     batch starts at the grown budget and needs no re-pack round."""
     b = _dev_broker(pack_q=1)
-    subs = [Rec(f"c{i}") for i in range(300)]
+    subs = [Rec(f"c{i}") for i in range(100)]
     for s in subs:
         b.subscribe(s, "o/mem")
     pb1 = b.publish_begin([Message(topic="o/mem")])
     b.publish_fetch(pb1)
     grown = pb1.pq
-    assert b.publish_finish(pb1) == [300]
+    assert b.publish_finish(pb1) == [100]
     pb2 = b.publish_begin([Message(topic="o/mem")])
     assert pb2.pq == grown  # learned, no overflow round this time
     b.publish_fetch(pb2)
     assert pb2.pq == grown
-    assert b.publish_finish(pb2) == [300]
+    assert b.publish_finish(pb2) == [100]
 
 
 def test_pad_rows_do_not_inflate_packed_totals():
@@ -272,3 +272,40 @@ def test_duplicate_topics_in_batch_each_deliver():
     assert b.publish_finish(pb) == [1] * 7
     assert s.got.count(("hot/+", "hot/a")) == 6
     assert s.got.count(("hot/+", "hot/b")) == 1
+
+
+def test_fanout_d_learned_growth():
+    """A workload whose fan-out routinely exceeds the configured
+    per-message slots grows the learned d (bounded by the bitmap
+    threshold) instead of host-dispatching forever."""
+    b = _dev_broker(fanout_d=2, fanout_threshold=1024)
+    subs = [Rec(f"c{i}") for i in range(20)]
+    for s in subs:
+        b.subscribe(s, "grow/d")
+    for _ in range(6):
+        assert b.publish(Message(topic="grow/d")) == 20  # always right
+    bucket = next(iter(b._pack_budgets))
+    assert b._pack_budgets[bucket][3] >= 20  # d grew past the need
+
+
+def test_active_k_learned_boost():
+    """An overflow-storm batch (active set > K for most topics)
+    doubles the router's effective K; matching stays exact via host
+    fallback meanwhile."""
+    from emqx_tpu.router import MatcherConfig, Router
+
+    r = Router(MatcherConfig(active_k=2, device_min_filters=0),
+               node="n")
+    b = Broker(router=r)
+    recs = []
+    # '+'-heavy filters: the active set fans out past K=2 by level 2
+    for flt in ("+/+/x", "a/+/x", "+/b/x", "a/b/x", "+/+/+", "a/+/+"):
+        rec = Rec(flt)
+        recs.append(rec)
+        b.subscribe(rec, flt)
+    assert r.effective_k() == 2
+    n = b.publish(Message(topic="a/b/x"))
+    assert n == 6  # exact despite overflow (host fallback)
+    assert r.effective_k() > 2  # boosted for the next batch
+    n = b.publish(Message(topic="a/b/x"))
+    assert n == 6

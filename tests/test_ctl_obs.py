@@ -1,0 +1,162 @@
+"""CLI + observability suites: ctl command registry/builtins
+(emqx_ctl_SUITE), metrics catalog (emqx_metrics_SUITE), logger
+metadata/formatter (emqx_logger_SUITE), host/runtime introspection
+(emqx_vm_SUITE)."""
+
+import asyncio
+import logging
+
+from emqx_tpu import logger as L
+from emqx_tpu import vm
+from emqx_tpu.metrics import Metrics
+from emqx_tpu.node import Node
+from emqx_tpu.types import Message
+
+
+# -- emqx_ctl ---------------------------------------------------------------
+
+async def test_ctl_registry_and_builtins():
+    n = Node(boot_listeners=False)
+    await n.start()
+    try:
+        ctl = n.ctl
+        # custom command registration (emqx_ctl:register_command)
+        ctl.register_command("hello", lambda args: f"hi {args}")
+        assert "hi ['x']" == ctl.run(["hello", "x"])
+        ctl.unregister_command("hello")
+        out = ctl.run(["hello"])
+        assert "unknown" in out.lower() or "usage" in out.lower()
+        # builtins respond with real state
+        assert "node:" in ctl.run(["status"])
+        assert "emqx_tpu" in ctl.run(["broker"]) or ctl.run(["broker"])
+        s = Sub()
+        n.broker.subscribe(s, "ctl/t")
+        assert "ctl/t" in ctl.run(["topics"])
+        assert "ctl/t" in ctl.run(["routes"])
+        n.metrics.inc("messages.received")
+        metrics_out = ctl.run(["metrics"])
+        assert "messages.received" in metrics_out
+        assert ctl.run(["vm"])  # introspection renders
+        assert "usage" in ctl.usage().lower() or ctl.usage()
+    finally:
+        await n.stop()
+
+
+class Sub:
+    client_id = "ctl-sub"
+
+    def deliver(self, f, m):
+        pass
+
+
+async def test_ctl_log_level_runtime():
+    n = Node(boot_listeners=False)
+    await n.start()
+    try:
+        out = n.ctl.run(["log", "set-level", "debug"])
+        assert "debug" in out.lower()
+        assert logging.getLogger("emqx_tpu").level == logging.DEBUG
+        n.ctl.run(["log", "set-level", "warning"])
+        assert logging.getLogger("emqx_tpu").level == logging.WARNING
+    finally:
+        n.ctl.run(["log", "set-level", "info"])
+        await n.stop()
+
+
+# -- emqx_metrics -----------------------------------------------------------
+
+def test_metrics_catalog_and_qos_counters():
+    m = Metrics()
+    # the standard catalog is pre-registered (emqx_metrics.erl:82-183)
+    names = m.names()
+    for expected in ("messages.received", "messages.sent",
+                     "messages.dropped", "delivery.dropped.queue_full",
+                     "packets.connect.received"):
+        assert expected in names, expected
+    m.inc_msg(Message(topic="t", qos=1))
+    m.inc_msg(Message(topic="t", qos=2))
+    m.inc_sent(Message(topic="t", qos=0))
+    assert m.val("messages.received") == 2
+    assert m.val("messages.qos1.received") == 1
+    assert m.val("messages.qos2.received") == 1
+    assert m.val("messages.sent") == 1
+    assert m.val("messages.qos0.sent") == 1
+    m.inc("messages.dropped", 5)
+    m.dec("messages.dropped", 2)
+    assert m.val("messages.dropped") == 3
+    assert m.all()["messages.dropped"] == 3
+
+
+def test_metrics_device_fold():
+    m = Metrics()
+    m.fold_device_stats({"matches": 10, "deliveries": 30,
+                         "overflows": 1})
+    m.fold_device_stats({"matches": 5, "deliveries": 5, "overflows": 0})
+    assert m.val("device.matches") == 15
+    assert m.val("device.deliveries") == 35
+    assert m.val("device.overflows") == 1
+
+
+def test_metrics_dynamic_registration():
+    m = Metrics()
+    m.new("custom.counter")
+    m.inc("custom.counter")
+    assert m.val("custom.counter") == 1
+
+
+# -- emqx_logger ------------------------------------------------------------
+
+def test_logger_metadata_injection():
+    L.clear_metadata()
+    L.set_metadata_clientid("c-42")
+    L.set_metadata_peername(("10.0.0.9", 1883))
+    md = L.get_metadata()
+    assert md["clientid"] == "c-42"
+    assert "10.0.0.9" in str(md["peername"])
+    rec = logging.LogRecord("emqx_tpu.test", logging.INFO, "f", 1,
+                            "connected", (), None)
+    f = L.MetadataFilter()
+    f.filter(rec)
+    out = L.BrokerFormatter().format(rec)
+    assert "c-42" in out and "connected" in out
+    L.clear_metadata()
+    rec2 = logging.LogRecord("emqx_tpu.test", logging.INFO, "f", 1,
+                             "anon", (), None)
+    f.filter(rec2)
+    assert "c-42" not in L.BrokerFormatter().format(rec2)
+
+
+def test_logger_setup_idempotent():
+    lg = logging.getLogger("emqx_tpu")
+    before = list(lg.handlers)
+    try:
+        L.setup()
+        n1 = len(lg.handlers)
+        L.setup()
+        assert len(lg.handlers) == n1  # no duplicate handlers
+        # explicit-handler path dedupes too (ADVICE round-1 item)
+        h = logging.StreamHandler()
+        h.setFormatter(L.BrokerFormatter())
+        L.setup(handler=h)
+        n2 = len(lg.handlers)
+        L.setup(handler=h)
+        assert len(lg.handlers) == n2
+    finally:
+        lg.handlers = before
+
+
+# -- emqx_vm ----------------------------------------------------------------
+
+def test_vm_introspection_shapes():
+    mem = vm.get_memory()
+    assert mem.get("rss", 0) > 0 and mem.get("vms", 0) > 0
+    pi = vm.get_process_info()
+    assert pi.get("threads", 0) >= 1
+    assert vm.cpu_count() >= 1
+    assert len(vm.loads()) == 3
+    gc = vm.get_gc_info()
+    assert "collections" in gc or gc
+    sysinfo = vm.get_system_info()
+    assert sysinfo.get("python") and sysinfo.get("cpu_count")
+    devs = vm.get_device_info()
+    assert isinstance(devs, list)  # device list renders (may be CPU)
