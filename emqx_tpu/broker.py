@@ -57,7 +57,7 @@ class PendingBatch:
 
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
-        "id_map",
+        "fan_d", "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
         "dovf_d", "f_ptr_d", "subs_packed_d", "src_packed_d",
@@ -74,6 +74,7 @@ class PendingBatch:
         self.host_topics: Optional[List[str]] = None
         self.inv: Optional[List[int]] = None
         self.n_uniq = 0
+        self.fan_d = 0
         self.st = None
         self.ids_dev = self.ovf_dev = None
         self.m_ptr_d = self.ids_packed_d = None
@@ -318,8 +319,9 @@ class Broker:
         pb.m_ptr_d, pb.ids_packed_d = pack_matches(pb.ids_dev, pm=pb.pm)
         st = pb.st
         if st is not None and st.fan is not None:
+            pb.fan_d = budgets[3]
             subs_d, src_d, _cnt, pb.dovf_d = gather_subscribers_src(
-                st.fan, pb.ids_dev, d=budgets[3])
+                st.fan, pb.ids_dev, d=pb.fan_d)
             pb.pq = budgets[1]
             pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
                 pack_fanout(subs_d, src_d, pq=pb.pq)
@@ -413,7 +415,7 @@ class Broker:
                 if budgets is not None:
                     budgets[1] = max(budgets[1], pb.pq)
                 subs_d, src_d, _c, pb.dovf_d = gather_subscribers_src(
-                    pb.st.fan, pb.ids_dev, d=cfg.fanout_d)
+                    pb.st.fan, pb.ids_dev, d=pb.fan_d)
                 pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
                     pack_fanout(subs_d, src_d, pq=pb.pq)
                 retry = True

@@ -1,0 +1,150 @@
+"""Device-state checkpoint / restore for the routing plane.
+
+The reference has no disk persistence — durability is Mnesia ram
+replication and session takeover (SURVEY §5 "Checkpoint/resume",
+src/emqx_mqueue.erl:20-25 disclaims storage). The TPU build gains a
+genuinely new capability instead: the compiled routing state (route
+log + flattened CSR automaton tables) snapshots to one file and
+restores without re-flattening — a node rejoining after a restart
+puts the saved tables straight back into HBM and is matching
+immediately, with the route log as the always-sufficient fallback
+(orbax-style array checkpointing, kept dependency-free via
+``np.savez``).
+
+What is NOT here by design: session/in-flight state (live per-client
+state machines hand over via takeover, the reference's model) and
+fan-out tables (rebuilt from live subscriptions — a restored node has
+no live subscribers yet).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+FORMAT = 1
+
+
+def save(router, path: str) -> dict:
+    """Snapshot ``router``'s route log + automaton tables to ``path``
+    (.npz). Returns a summary dict."""
+    with router._lock:
+        routes = []
+        for flt, dests in router._routes.items():
+            for dest, refs in dests.items():
+                if isinstance(dest, tuple):  # (group, node) shared route
+                    routes.append([flt, "s", dest[0], dest[1], refs])
+                else:
+                    routes.append([flt, "n", "", dest, refs])
+        arrays = {}
+        p = router._patcher
+        if p is not None and not router._dirty:
+            # the host patch mirrors ARE the automaton authority —
+            # no device→host readback needed for the snapshot
+            arrays = {
+                "plus_child": p.plus_child, "hash_filter": p.hash_filter,
+                "end_filter": p.end_filter, "ht_state": p.ht_state,
+                "ht_word": p.ht_word, "ht_child": p.ht_child,
+                "seed": np.asarray([p.seed], dtype=np.uint32),
+                "row_ptr": np.asarray(router._auto.row_ptr),
+                "edge_word": np.asarray(router._auto.edge_word),
+                "edge_child": np.asarray(router._auto.edge_child),
+                "dims": np.asarray([p.n_states, p.n_edges],
+                                   dtype=np.int64),
+            }
+        vocab = (router._native.words() if router._native is not None
+                 else router._table.words())
+        meta = {
+            "format": FORMAT,
+            "node": str(router.node),
+            "filter_ids": router._filter_ids,
+            "vocab": vocab,
+            "has_tables": bool(arrays),
+        }
+        # copy the live mirrors under the lock; compress + write
+        # OUTSIDE it (a large snapshot must not stop the route plane)
+        arrays = {k: np.array(v) for k, v in arrays.items()}
+    np.savez_compressed(
+        path,
+        meta=np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8),
+        routes=np.frombuffer(
+            json.dumps(routes).encode("utf-8"), dtype=np.uint8),
+        **arrays)
+    return {"routes": len(routes), "tables": bool(arrays)}
+
+
+def load(router, path: str, device: Optional[bool] = None) -> dict:
+    """Restore a snapshot into a FRESH router (no routes yet).
+
+    The route log replays into the host trie (authoritative); if the
+    snapshot carries automaton tables and the filter-id assignment
+    replays identically, they are installed directly (device_put, no
+    re-flatten) — otherwise the next match re-flattens from the log.
+    """
+    import jax
+
+    from emqx_tpu.ops.csr import Automaton, pack_tables
+    from emqx_tpu.ops.patch import AutoPatcher
+
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["meta"]).decode("utf-8"))
+        routes = json.loads(bytes(data["routes"]).decode("utf-8"))
+        tables_data = ({k: np.array(data[k]) for k in data.files
+                        if k not in ("meta", "routes")}
+                       if meta.get("has_tables") else {})
+    if meta.get("format") != FORMAT:
+        raise ValueError(f"unknown checkpoint format {meta.get('format')}")
+    with router._lock:
+        if router._routes:
+            raise ValueError("checkpoint restore needs a fresh router")
+        # re-intern the saved vocabulary FIRST so word ids match the
+        # saved edge tables exactly (replaying routes alone can
+        # assign different ids after historical deletions)
+        intern = (router._native.intern if router._native is not None
+                  else router._table.intern)
+        vocab_ok = all(intern(w) == i
+                       for i, w in enumerate(meta.get("vocab", [])))
+        # pre-seed the saved filter-id assignment: deletion history
+        # leaves holes a naive replay would compact, shifting every
+        # later id out from under the saved tables. Holes join the
+        # free list exactly as the original router held them.
+        restored_ids = {k: int(v) for k, v in meta["filter_ids"].items()}
+        max_id = max(restored_ids.values(), default=-1)
+        router._id_to_filter = [None] * (max_id + 1)
+        for f, i in restored_ids.items():
+            router._id_to_filter[i] = f
+        router._filter_ids = dict(restored_ids)
+        router._free_ids = [i for i, f
+                            in enumerate(router._id_to_filter)
+                            if f is None]
+        for flt, kind, group, node, refs in routes:
+            dest = (group, node) if kind == "s" else node
+            for _ in range(int(refs)):
+                router.add_route(flt, dest=dest)
+        ids_match = router._filter_ids == restored_ids
+        use_dev = router.config.use_device if device is None else device
+        tables = meta.get("has_tables") and ids_match and vocab_ok
+        if tables:
+            d_ = tables_data
+            dims = d_["dims"]
+            host_auto = Automaton(
+                row_ptr=d_["row_ptr"], edge_word=d_["edge_word"],
+                edge_child=d_["edge_child"],
+                plus_child=d_["plus_child"],
+                hash_filter=d_["hash_filter"],
+                end_filter=d_["end_filter"],
+                n_states=int(dims[0]), n_edges=int(dims[1]),
+                ht_state=d_["ht_state"], ht_word=d_["ht_word"],
+                ht_child=d_["ht_child"], ht_seed=d_["seed"])
+            host_auto = pack_tables(host_auto)
+            auto = jax.device_put(host_auto) if use_dev else host_auto
+            router._patcher = AutoPatcher(host_auto, intern)
+            router._auto = auto
+            router._auto_map = list(router._id_to_filter)
+            router._dirty = False
+            router._published = (auto, router._auto_map,
+                                 router._rebuilds)
+        return {"routes": len(routes), "tables_restored": bool(tables)}

@@ -63,6 +63,8 @@ class Ctl:
                               "list | load <name> | unload <name>")
         self.register_command("banned", self._banned,
                               "list | add <kind> <value> [secs] | del <kind> <value>")
+        self.register_command("checkpoint", self._checkpoint,
+                              "save|load <path>")
         self.register_command("trace", self._trace,
                               "list | start client|topic <v> | stop client|topic <v>")
         self.register_command("vm", self._vm,
@@ -232,6 +234,18 @@ class Ctl:
             b.delete(args[1], args[2])
             return "ok"
         return "usage: banned list | add <kind> <value> [secs] | del <kind> <value>"
+
+    def _checkpoint(self, args) -> str:
+        from emqx_tpu import checkpoint
+        if len(args) != 2 or args[0] not in ("save", "load"):
+            return "usage: checkpoint save|load <path>"
+        if args[0] == "save":
+            info = checkpoint.save(self.node.router, args[1])
+            return (f"saved {info['routes']} routes"
+                    f"{' + tables' if info['tables'] else ''}")
+        info = checkpoint.load(self.node.router, args[1])
+        return (f"restored {info['routes']} routes"
+                f"{' + tables' if info['tables_restored'] else ''}")
 
     def _trace(self, args) -> str:
         tr = self.node.tracer

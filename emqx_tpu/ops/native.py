@@ -66,6 +66,9 @@ def load_library():
         lib.wt_intern.restype = C.c_int32
         lib.wt_lookup.argtypes = [C.c_void_p, C.c_char_p, C.c_int32]
         lib.wt_lookup.restype = C.c_int32
+        lib.wt_word_at.argtypes = [C.c_void_p, C.c_int32, C.c_char_p,
+                                   C.c_int32]
+        lib.wt_word_at.restype = C.c_int32
         lib.encode_topics.argtypes = [
             C.c_void_p, C.c_char_p, _i64p, C.c_int32, C.c_int32,
             _i32p, _i32p, _u8p]
@@ -130,6 +133,23 @@ class NativeEngine:
     def lookup(self, word: str) -> int:
         b = word.encode()
         return self._lib.wt_lookup(self._wt, b, len(b))
+
+    def words(self):
+        """All interned words in id order (checkpoint export)."""
+        import ctypes as C
+        out = []
+        buf = C.create_string_buffer(4096)
+        for i in range(self.vocab_size()):
+            n = self._lib.wt_word_at(self._wt, i, buf, len(buf))
+            if n < 0:
+                break
+            if n > len(buf):
+                big = C.create_string_buffer(n)
+                self._lib.wt_word_at(self._wt, i, big, n)
+                out.append(big.raw[:n].decode())
+            else:
+                out.append(buf.raw[:n].decode())
+        return out
 
     def vocab_size(self) -> int:
         return self._lib.wt_size(self._wt)

@@ -39,6 +39,10 @@ class WordTable:
     def __len__(self) -> int:
         return len(self._words)
 
+    def words(self):
+        """All interned words in id order (checkpoint export)."""
+        return list(self._words)
+
     def intern(self, word: str) -> int:
         wid = self._ids.get(word)
         if wid is None:

@@ -1,0 +1,80 @@
+"""Multi-host bring-up: the jax.distributed control plane.
+
+The reference's cluster substrate is ekka membership + gen_rpc data
+plane (SURVEY §2.3). On TPU pods the equivalent split is:
+
+  - **host control plane** — ``emqx_tpu.cluster`` (membership,
+    replication, takeover) over its socket transport, exactly as on
+    one host;
+  - **device data plane** — a global mesh spanning every host's
+    chips: ICI inside a slice, DCN between slices, with XLA inserting
+    the collectives. ``jax.distributed.initialize`` is the
+    coordination service that makes ``jax.devices()`` global.
+
+This module is the thin, test-friendly seam over that bring-up: a
+single-process call is a no-op (the common single-host case, and what
+CI exercises), a multi-process call wires the coordinator and returns
+the global mesh. The GSPMD partitioner then treats DCN like slow ICI
+— the sharded publish step (parallel/sharded.py) runs unchanged, with
+the ``data`` axis preferred across slices (publish batches shard
+cleanly over DCN; the ``trie`` axis all-gathers match ids every step,
+so it belongs inside a slice's ICI domain).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from emqx_tpu.parallel.mesh import default_mesh, make_mesh
+
+log = logging.getLogger("emqx_tpu.distributed")
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: int = 1,
+               process_id: int = 0) -> bool:
+    """Join the jax.distributed coordination service.
+
+    Single-process (``num_processes == 1``) is a no-op returning
+    False — local ``jax.devices()`` is already the whole world.
+    Multi-process: process 0 serves as coordinator; every process
+    must call this before any other JAX API touches the backend.
+    """
+    if num_processes <= 1:
+        return False
+    if coordinator_address is None:
+        raise ValueError("multi-process init needs coordinator_address")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    log.info("joined jax.distributed: process %d/%d via %s",
+             process_id, num_processes, coordinator_address)
+    return True
+
+
+def global_mesh(n_data: Optional[int] = None,
+                n_trie: Optional[int] = None):
+    """The broker mesh over every visible device (all hosts after
+    :func:`initialize`). With explicit factors the product must cover
+    the device count; default puts the whole DCN-crossing factor on
+    ``data`` (batch sharding tolerates slow links; the trie axis
+    all-gathers every step and should stay inside one slice)."""
+    import jax
+
+    devs = jax.devices()
+    if n_data is None and n_trie is None:
+        return default_mesh(len(devs))
+    if n_data is None:
+        n_data = len(devs) // int(n_trie)
+    if n_trie is None:
+        n_trie = len(devs) // int(n_data)
+    if int(n_data) * int(n_trie) != len(devs):
+        # silently dropping devices would desynchronize collectives
+        # across hosts (some processes' chips outside the mesh)
+        raise ValueError(
+            f"mesh {n_data}x{n_trie} does not cover {len(devs)} devices")
+    return make_mesh(int(n_data), int(n_trie), devices=devs)

@@ -555,7 +555,7 @@ class Router:
             k = self.effective_k()
             if k >= cap:
                 return False
-            self._k_boost = k * 2
+            self._k_boost = min(k * 2, cap)
             return True
 
     def match_ids(self, topics: Sequence[str]):

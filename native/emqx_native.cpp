@@ -38,6 +38,19 @@ WordTable* wt_new() { return new WordTable(); }
 void wt_free(WordTable* wt) { delete wt; }
 int32_t wt_size(WordTable* wt) { return (int32_t)wt->words.size(); }
 
+// word string by intern id (checkpoint export): copies up to cap
+// bytes into out, returns the word's byte length (-1 = bad id)
+int32_t wt_word_at(WordTable* wt, int32_t idx, char* out, int32_t cap) {
+    if (idx < 0 || (size_t)idx >= wt->words.size()) return -1;
+    const std::string& w = wt->words[(size_t)idx];
+    int32_t n = (int32_t)w.size();
+    if (out && cap > 0) {
+        int32_t c = n < cap ? n : cap;
+        memcpy(out, w.data(), (size_t)c);
+    }
+    return n;
+}
+
 int32_t wt_intern(WordTable* wt, const char* word, int32_t len) {
     std::string w(word, len);
     auto it = wt->ids.find(w);

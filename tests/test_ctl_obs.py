@@ -28,7 +28,7 @@ async def test_ctl_registry_and_builtins():
         assert "unknown" in out.lower() or "usage" in out.lower()
         # builtins respond with real state
         assert "node:" in ctl.run(["status"])
-        assert "emqx_tpu" in ctl.run(["broker"]) or ctl.run(["broker"])
+        assert "MQTT broker" in ctl.run(["broker"])
         s = Sub()
         n.broker.subscribe(s, "ctl/t")
         assert "ctl/t" in ctl.run(["topics"])
@@ -37,7 +37,7 @@ async def test_ctl_registry_and_builtins():
         metrics_out = ctl.run(["metrics"])
         assert "messages.received" in metrics_out
         assert ctl.run(["vm"])  # introspection renders
-        assert "usage" in ctl.usage().lower() or ctl.usage()
+        assert "commands:" in ctl.usage()
     finally:
         await n.stop()
 

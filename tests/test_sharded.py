@@ -182,3 +182,24 @@ def test_mesh_use_device_false_is_honored():
     assert not r.use_device_now()
     assert r.match_filters(["esc/x"]) == [["esc/+"]]
     assert r.stats()["rebuilds"] == 0  # never flattened for a device
+
+
+def test_distributed_init_single_process_noop():
+    from emqx_tpu.parallel import distributed
+
+    assert distributed.initialize() is False
+    assert distributed.initialize(num_processes=1, process_id=0) is False
+    import pytest
+    with pytest.raises(ValueError):
+        distributed.initialize(num_processes=2, process_id=0)
+
+
+def test_distributed_global_mesh_factors():
+    from emqx_tpu.parallel import distributed
+
+    m = distributed.global_mesh()          # 8 virtual CPU devices
+    assert m.shape["data"] * m.shape["trie"] == 8
+    m2 = distributed.global_mesh(n_trie=4)
+    assert m2.shape == {"data": 2, "trie": 4}
+    m3 = distributed.global_mesh(n_data=8)
+    assert m3.shape == {"data": 8, "trie": 1}
