@@ -344,8 +344,9 @@ def main():
     jax = _jax_with_retry()
 
     from emqx_tpu.ops import native
-    from emqx_tpu.ops.fanout import build_fanout, gather_subscribers
+    from emqx_tpu.ops.fanout import build_fanout, expand_packed
     from emqx_tpu.ops.match import match_batch
+    from emqx_tpu.ops.pack import budget_for, pack_matches
 
     rng = random.Random(0)
     t0 = time.time()
@@ -405,10 +406,19 @@ def main():
         ids_, n_ = depth_bucket(ids_, n_)
         batches.append(jax.device_put((ids_, n_, sysm_)))
 
+    # the PRODUCT pipeline: match → pack → fused sparse expansion
+    # (broker.publish_begin runs exactly this); budgets sized off the
+    # batch like the broker's learned buckets
+    bucket_rows = max(b[0].shape[0] for b in batches)
+    PM = budget_for(bucket_rows, max(8, k))
+    Q = budget_for(bucket_rows, int(os.environ.get("BENCH_PACKQ", "16")))
+
     def step(ids, n, sysm):
         res = match_batch(auto, ids, n, sysm, k=k, m=m)
-        subs, dcount, dovf = gather_subscribers(fan, res.ids, d=d)
-        return res.count, dcount, res.overflow | dovf
+        m_ptr, packed = pack_matches(res.ids, pm=PM)
+        f_ptr, subs, src, total = expand_packed(fan, m_ptr, packed,
+                                                q=Q)
+        return res.count, f_ptr, res.overflow, total, m_ptr[-1]
 
     for b_ in batches:  # one compile per distinct unpadded shape
         jax.block_until_ready(step(*b_))
@@ -424,8 +434,12 @@ def main():
     throughput = batches_per_s * batch
     p50, p99 = _latency_pass(step, batches)
     counts = np.asarray(outs[0][0])[:uniques[0]]
-    deliv = np.asarray(outs[0][1])[:uniques[0]]
+    deliv = np.diff(np.asarray(outs[0][1]))[:uniques[0]]
     ovf = sum(int(np.asarray(o[2]).sum()) for o in outs)
+    # budget truncation counts as overflow too (silent undercount
+    # otherwise): packed matches past PM, deliveries past Q
+    ovf += sum(int(np.asarray(o[3]) > Q) for o in outs)
+    ovf += sum(int(np.asarray(o[4]) > PM) for o in outs)
     avg_unique = float(np.mean(uniques))
     info = {
         "subs": len(filters),

@@ -30,10 +30,9 @@ from emqx_tpu.broker_helper import FanoutManager, unpack_sids
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
-from emqx_tpu.ops.fanout import gather_subscribers_src
+from emqx_tpu.ops.fanout import expand_packed
 from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_rows,
-                               pack_fanout, pack_matches,
-                               pack_union_rows)
+                               pack_matches, pack_union_rows)
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.shared_sub import SharedSub
 from emqx_tpu.types import Message, SubOpts
@@ -57,13 +56,13 @@ class PendingBatch:
 
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
-        "fan_d", "id_map",
+        "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
-        "dovf_d", "f_ptr_d", "subs_packed_d", "src_packed_d",
+        "f_ptr_d", "subs_packed_d", "src_packed_d",
         "bovf_d", "sel_d", "rows_packed_d", "bm_total_d",
         "m_ptr", "ids_packed", "ovf",
-        "dovf", "f_ptr", "subs_packed", "src_packed",
+        "f_ptr", "subs_packed", "src_packed",
         "bovf", "sel", "rows_packed",
     )
 
@@ -74,15 +73,14 @@ class PendingBatch:
         self.host_topics: Optional[List[str]] = None
         self.inv: Optional[List[int]] = None
         self.n_uniq = 0
-        self.fan_d = 0
         self.st = None
         self.ids_dev = self.ovf_dev = None
         self.m_ptr_d = self.ids_packed_d = None
-        self.dovf_d = self.f_ptr_d = None
+        self.f_ptr_d = None
         self.subs_packed_d = self.src_packed_d = None
         self.bovf_d = self.sel_d = self.rows_packed_d = None
         self.bm_total_d = None
-        self.dovf = self.f_ptr = self.subs_packed = None
+        self.f_ptr = self.subs_packed = None
         self.src_packed = None
         self.bovf = self.sel = self.rows_packed = None
 
@@ -314,17 +312,17 @@ class Broker:
         budgets = self._pack_budgets.setdefault(
             bucket, [budget_for(bucket, cfg.pack_m),
                      budget_for(bucket, cfg.pack_q),
-                     max(1, cfg.pack_rows), cfg.fanout_d])
+                     max(1, cfg.pack_rows)])
         pb.pm = budgets[0]
         pb.m_ptr_d, pb.ids_packed_d = pack_matches(pb.ids_dev, pm=pb.pm)
         st = pb.st
         if st is not None and st.fan is not None:
-            pb.fan_d = budgets[3]
-            subs_d, src_d, _cnt, pb.dovf_d = gather_subscribers_src(
-                st.fan, pb.ids_dev, d=pb.fan_d)
+            # fused sparse expansion: packed matches → packed
+            # deliveries, gather work proportional to actual traffic
             pb.pq = budgets[1]
-            pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
-                pack_fanout(subs_d, src_d, pq=pb.pq)
+            pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d, _tot = \
+                expand_packed(st.fan, pb.m_ptr_d, pb.ids_packed_d,
+                              q=pb.pq)
         if st is not None and st.bm is not None:
             rows_d, pb.bovf_d = rows_for_matches(
                 st.bm, pb.ids_dev, mb=cfg.fanout_mb)
@@ -366,8 +364,8 @@ class Broker:
             # per-buffer round-trip latency; see ops/pack.bundle_i32)
             fetch = [pb.m_ptr_d, pb.ids_packed_d, pb.ovf_dev]
             if pb.f_ptr_d is not None:
-                fetch += [pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d,
-                          pb.dovf_d]
+                fetch += [pb.f_ptr_d, pb.subs_packed_d,
+                          pb.src_packed_d]
             if pb.sel_d is not None:
                 fetch += [pb.sel_d, pb.rows_packed_d, pb.bm_total_d,
                           pb.bovf_d]
@@ -387,9 +385,8 @@ class Broker:
                 f_ptr = take(Bp + 1)
                 subs_p = take(pb.pq)
                 src_p = take(pb.pq)
-                dovf = take(Bp).astype(bool)
             else:
-                f_ptr = subs_p = src_p = dovf = None
+                f_ptr = subs_p = src_p = None
             if pb.sel_d is not None:
                 pr, W = pb.rows_packed_d.shape
                 sel = take(Bp)
@@ -401,6 +398,7 @@ class Broker:
             # budget overflow → re-pack with the next bucket; rare
             # (budgets start at cfg.pack_* × batch) and self-corrects
             retry = False
+            m_repacked = False
             if int(m_ptr[-1]) > pb.pm:
                 while pb.pm < int(m_ptr[-1]):
                     pb.pm *= 2
@@ -408,16 +406,18 @@ class Broker:
                     budgets[0] = max(budgets[0], pb.pm)
                 pb.m_ptr_d, pb.ids_packed_d = pack_matches(
                     pb.ids_dev, pm=pb.pm)
+                m_repacked = True
                 retry = True
-            if f_ptr is not None and int(f_ptr[-1]) > pb.pq:
+            if f_ptr is not None and (m_repacked
+                                      or int(f_ptr[-1]) > pb.pq):
+                # a truncated match pack also truncates the expansion
                 while pb.pq < int(f_ptr[-1]):
                     pb.pq *= 2
                 if budgets is not None:
                     budgets[1] = max(budgets[1], pb.pq)
-                subs_d, src_d, _c, pb.dovf_d = gather_subscribers_src(
-                    pb.st.fan, pb.ids_dev, d=pb.fan_d)
-                pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
-                    pack_fanout(subs_d, src_d, pq=pb.pq)
+                pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d, _t = \
+                    expand_packed(pb.st.fan, pb.m_ptr_d,
+                                  pb.ids_packed_d, q=pb.pq)
                 retry = True
             if bm_total is not None and int(bm_total) > pb.rows_packed_d.shape[0]:
                 rows_d, pb.bovf_d = rows_for_matches(
@@ -439,10 +439,6 @@ class Broker:
             # the live workload — grow for the NEXT batch (this one
             # already has its exact host fallback)
             n_u = max(1, pb.n_uniq)
-            if dovf is not None and budgets is not None and \
-                    int(dovf[:n_u].sum()) * 8 > n_u and \
-                    budgets[3] < cfg.fanout_threshold:
-                budgets[3] = min(budgets[3] * 2, cfg.fanout_threshold)
             if int(ovf[:n_u].sum()) * 8 > n_u:
                 self.router.boost_k()
             pb.m_ptr = m_ptr
@@ -457,7 +453,6 @@ class Broker:
                 pb.src_packed = src_p[:occ].tolist()
             else:
                 pb.subs_packed = pb.src_packed = None
-            pb.dovf = dovf
             pb.sel = sel
             pb.rows_packed = rows_p
             pb.bovf = bovf
@@ -550,8 +545,8 @@ class Broker:
         packed device fan-out results (gathered sub-id slots + bitmap
         union rows) instead of the ``_subscribers`` dicts."""
         def local_deliver(local_filters: List[str]) -> int:
-            overflowed = (pb.dovf is not None and pb.dovf[row]) or \
-                (pb.bovf is not None and pb.bovf[row]) or pb.st is None
+            overflowed = (pb.bovf is not None and pb.bovf[row]) \
+                or pb.st is None
             if overflowed:
                 # per-message capacity exceeded: host dispatch loop
                 return sum(self.dispatch(flt, msg)

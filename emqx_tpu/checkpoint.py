@@ -39,21 +39,23 @@ def save(router, path: str) -> dict:
                 else:
                     routes.append([flt, "n", "", dest, refs])
         arrays = {}
+        csr_refs = None
         p = router._patcher
         if p is not None and not router._dirty:
-            # the host patch mirrors ARE the automaton authority —
-            # no device→host readback needed for the snapshot
+            # the host patch mirrors ARE the automaton authority; the
+            # CSR arrays are immutable between rebuilds, so only their
+            # REFERENCES are taken under the lock — any device→host
+            # transfer happens after release
             arrays = {
                 "plus_child": p.plus_child, "hash_filter": p.hash_filter,
                 "end_filter": p.end_filter, "ht_state": p.ht_state,
                 "ht_word": p.ht_word, "ht_child": p.ht_child,
                 "seed": np.asarray([p.seed], dtype=np.uint32),
-                "row_ptr": np.asarray(router._auto.row_ptr),
-                "edge_word": np.asarray(router._auto.edge_word),
-                "edge_child": np.asarray(router._auto.edge_child),
                 "dims": np.asarray([p.n_states, p.n_edges],
                                    dtype=np.int64),
             }
+            csr_refs = (router._auto.row_ptr, router._auto.edge_word,
+                        router._auto.edge_child)
         vocab = (router._native.words() if router._native is not None
                  else router._table.words())
         meta = {
@@ -66,6 +68,10 @@ def save(router, path: str) -> dict:
         # copy the live mirrors under the lock; compress + write
         # OUTSIDE it (a large snapshot must not stop the route plane)
         arrays = {k: np.array(v) for k, v in arrays.items()}
+    if csr_refs is not None:
+        arrays["row_ptr"] = np.asarray(csr_refs[0])
+        arrays["edge_word"] = np.asarray(csr_refs[1])
+        arrays["edge_child"] = np.asarray(csr_refs[2])
     np.savez_compressed(
         path,
         meta=np.frombuffer(
@@ -126,7 +132,11 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
                 router.add_route(flt, dest=dest)
         ids_match = router._filter_ids == restored_ids
         use_dev = router.config.use_device if device is None else device
-        tables = meta.get("has_tables") and ids_match and vocab_ok
+        # a mesh-configured router matches through stacked shard
+        # tables — a flat snapshot cannot install there; the route
+        # log replay (sharded re-flatten on first match) covers it
+        tables = (meta.get("has_tables") and ids_match and vocab_ok
+                  and router.config.mesh is None)
         if tables:
             d_ = tables_data
             dims = d_["dims"]

@@ -144,6 +144,75 @@ def gather_subscribers_src(
     return jax.vmap(one)(match_ids)
 
 
+@functools.partial(jax.jit, static_argnames=("q",))
+def expand_packed(
+    fan: FanoutTable,
+    m_ptr: jax.Array,       # int32[B+1] row pointers (pack_matches)
+    packed_ids: jax.Array,  # int32[P] matched filter ids, -1 padded
+    *,
+    q: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sparse CSR expansion: packed matched ids → packed deliveries.
+
+    The dense per-topic gather materializes ``B×d`` slots that are
+    mostly ``-1`` padding; this fused form works entirely in packed
+    space — its gather count is proportional to ACTUAL matches (P)
+    and deliveries (q budget), not to capacity:
+
+      1. per-match (start, len) from the pairs table (P rows);
+      2. parallel CSR expansion via marker-scatter + running max: the
+         slot→match assignment comes from scattering each match's
+         exclusive offset and taking ``cummax`` — no per-slot search;
+      3. one packed local gather (q rows) resolves each slot's
+         (start, base, source id), one more (q rows) the subscriber.
+
+    Returns ``(f_ptr[B+1], subs[q], src[q], total)`` — the exact
+    output contract of ``pack_fanout``; ``total`` > q means the
+    budget overflowed (re-expand with the next bucket).
+    """
+    B = m_ptr.shape[0] - 1
+    P = packed_ids.shape[0]
+    in_range = (packed_ids >= 0) & \
+        (packed_ids < fan.row_ptr.shape[0] - 1)
+    safe = jnp.where(in_range, packed_ids, 0)
+    if fan.row_pairs is not None:
+        pairs = fan.row_pairs[safe]               # [P, 2]
+        starts = pairs[:, 0]
+        lens = jnp.where(in_range, pairs[:, 1] - pairs[:, 0], 0)
+    else:
+        starts = fan.row_ptr[safe]
+        lens = jnp.where(in_range, fan.row_ptr[safe + 1] - starts, 0)
+    cume = jnp.cumsum(lens)
+    total = cume[-1]
+    cums = cume - lens                            # exclusive offsets
+    pidx = jnp.arange(P, dtype=jnp.int32)
+    # slot → match assignment: scatter each non-empty match's index at
+    # its first output slot, then running-max fills the runs
+    marker = jnp.zeros((q,), jnp.int32).at[
+        jnp.where(lens > 0, cums, q)].max(pidx, mode="drop")
+    row = jax.lax.cummax(marker)
+    local = jnp.stack([starts, cums, packed_ids], axis=1)  # [P, 3]
+    g = local[row]                                # [q, 3]
+    slots = jnp.arange(q, dtype=jnp.int32)
+    idx = jnp.clip(g[:, 0] + (slots - g[:, 1]), 0,
+                   fan.sub_ids.shape[0] - 1)
+    valid = slots < jnp.minimum(total, q)
+    subs = jnp.where(valid, fan.sub_ids[idx], -1)
+    src = jnp.where(valid, g[:, 2], -1)
+    # per-topic delivery counts → f_ptr: match→topic via the same
+    # marker trick over m_ptr, then a segment add
+    tmarker = jnp.zeros((P,), jnp.int32).at[
+        jnp.clip(m_ptr[:B], 0, P)].max(
+        jnp.arange(B, dtype=jnp.int32), mode="drop")
+    t_of_p = jax.lax.cummax(tmarker)              # topic row per match
+    counts = jnp.zeros((B,), jnp.int32).at[t_of_p].add(
+        lens, mode="drop")
+    f_ptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(counts, dtype=jnp.int32)])
+    return f_ptr, subs, src, total
+
+
 @functools.partial(jax.jit, static_argnames=("d",))
 def gather_subscribers(
     fan: FanoutTable,

@@ -102,3 +102,53 @@ def test_pick_shared_out_of_capacity_fid_drops():
     picks = np.asarray(pick_shared(fan, ids, seed))[0]
     assert picks[0] == -1           # dropped, not clamped
     assert picks[1] in (5, 6, 7)
+
+
+def test_expand_packed_parity_vs_dense():
+    """The fused sparse expansion must produce exactly the dense
+    gather's (subs, src) multiset per topic."""
+    import numpy as np
+
+    from emqx_tpu.ops.fanout import (build_fanout, expand_packed,
+                                     gather_subscribers_src)
+    from emqx_tpu.ops.pack import pack_matches
+
+    rng = np.random.default_rng(7)
+    F = 50
+    rows = {i: list(rng.integers(0, 10_000,
+                                 size=rng.integers(0, 9)))
+            for i in range(F)}
+    fan = build_fanout(rows, F)
+    B, M = 16, 8
+    ids = np.full((B, M), -1, dtype=np.int32)
+    for b in range(B):
+        k = rng.integers(0, M + 1)
+        ids[b, :k] = rng.choice(F, size=k, replace=False)
+    m_ptr, packed = pack_matches(ids, pm=256)
+    f_ptr, subs, src, total = expand_packed(fan, m_ptr, packed, q=512)
+    f_ptr, subs, src = map(np.asarray, (f_ptr, subs, src))
+    dsubs, dsrc, _cnt, _ovf = map(
+        np.asarray, gather_subscribers_src(fan, ids, d=128))
+    want_total = 0
+    for b in range(B):
+        got = sorted(zip(subs[f_ptr[b]:f_ptr[b + 1]].tolist(),
+                         src[f_ptr[b]:f_ptr[b + 1]].tolist()))
+        want = sorted((int(s), int(c))
+                      for s, c in zip(dsubs[b], dsrc[b]) if s >= 0)
+        assert got == want, b
+        want_total += len(want)
+    assert int(total) == want_total
+
+
+def test_expand_packed_overflow_detectable():
+    import numpy as np
+
+    from emqx_tpu.ops.fanout import build_fanout, expand_packed
+    from emqx_tpu.ops.pack import pack_matches
+
+    fan = build_fanout({0: list(range(100))}, 1)
+    ids = np.zeros((4, 2), dtype=np.int32)  # every row matches f0
+    ids[:, 1] = -1
+    m_ptr, packed = pack_matches(ids, pm=64)
+    f_ptr, subs, src, total = expand_packed(fan, m_ptr, packed, q=64)
+    assert int(total) == 400 > 64  # caller re-expands bigger
