@@ -366,3 +366,48 @@ def test_ban_apply_expired_overwrite_deletes():
     # originator's table has expired it too), not no-op
     b.apply("clientid", "q", "op", "", _t.time() - 1, overwrite=True)
     assert b.look_up("clientid", "q") is None
+
+
+def test_partition_heal_rejoin_resyncs_routes():
+    """A false nodedown (partition) purges the peer's routes; a
+    re-join resyncs BOTH directions and forwarding resumes — the
+    reference's mnesia-down → ekka re-join recovery (SURVEY §3.5)."""
+    (n0, n1), (c0, c1) = _mk_cluster(2)
+    s0, s1 = Q(), Q()
+    n0.broker.subscribe(s0, "part/a")
+    n1.broker.subscribe(s1, "part/b")
+    # partition observed from n1's side only (asymmetric, the nasty
+    # case): n1 purges n0's routes, n0 still has n1's
+    c1.handle_nodedown("n0")
+    assert not n1.router.has_route("part/a")
+    assert n0.router.has_route("part/b")
+    # subscriptions made DURING the partition miss the other side
+    s0b = Q()
+    n0.broker.subscribe(s0b, "part/during")
+    # heal: n1 re-joins n0
+    c1.join(c0)
+    assert n1.router.has_route("part/a")
+    assert n1.router.has_route("part/during")
+    assert n0.router.has_route("part/b")
+    n1.broker.publish(Message(topic="part/a"))
+    n1.broker.publish(Message(topic="part/during"))
+    n0.broker.publish(Message(topic="part/b"))
+    assert len(s0.inbox) == 1
+    assert len(s0b.inbox) == 1
+    assert len(s1.inbox) == 1
+
+
+def test_nodedown_mid_forward_no_crash():
+    """Publishing to a route whose node died between match and
+    forward must not raise — the forwarder seam swallows a dead
+    destination (gen_rpc cast semantics: best-effort async)."""
+    (n0, n1), (c0, c1) = _mk_cluster(2)
+    s1 = Q()
+    n1.broker.subscribe(s1, "dying/+")
+    # kill n1 from the transport's perspective AFTER n0 learned the
+    # route: n0 still forwards at match time and must survive the
+    # ConnectionError the dead peer raises
+    del c0.transport._peers["n1"]
+    n = n0.broker.publish(Message(topic="dying/x"))
+    assert n == 0          # no local subscribers
+    assert s1.inbox == []  # and the dead peer got nothing
