@@ -369,32 +369,46 @@ def test_ban_apply_expired_overwrite_deletes():
 
 
 def test_partition_heal_rejoin_resyncs_routes():
-    """A false nodedown (partition) purges the peer's routes; a
-    re-join resyncs BOTH directions and forwarding resumes — the
-    reference's mnesia-down → ekka re-join recovery (SURVEY §3.5)."""
+    """A real partition (transport severed) makes each side's next
+    replication cast fail → local nodedown purge; a re-join resyncs
+    BOTH directions, including subscriptions made during the
+    partition — the reference's mnesia-down → ekka re-join recovery
+    (SURVEY §3.5)."""
     (n0, n1), (c0, c1) = _mk_cluster(2)
+    transport = c0.transport
     s0, s1 = Q(), Q()
     n0.broker.subscribe(s0, "part/a")
     n1.broker.subscribe(s1, "part/b")
-    # partition observed from n1's side only (asymmetric, the nasty
-    # case): n1 purges n0's routes, n0 still has n1's
-    c1.handle_nodedown("n0")
-    assert not n1.router.has_route("part/a")
-    assert n0.router.has_route("part/b")
-    # subscriptions made DURING the partition miss the other side
-    s0b = Q()
-    n0.broker.subscribe(s0b, "part/during")
-    # heal: n1 re-joins n0
+    # sever the link: the shared in-process transport drops both
+    # handlers, so every cast now raises ConnectionError
+    transport.unregister("n0")
+    transport.unregister("n1")
+    # subscriptions DURING the partition fail to replicate; each
+    # side's failed cast triggers its local nodedown purge
+    s0b, s1b = Q(), Q()
+    n0.broker.subscribe(s0b, "part/during0")
+    n1.broker.subscribe(s1b, "part/during1")
+    assert not n1.router.has_route("part/a")       # n1 purged n0
+    assert not n0.router.has_route("part/b")       # n0 purged n1
+    assert not n1.router.has_route("part/during0")
+    assert not n0.router.has_route("part/during1")
+    # heal: transport restored, n1 re-joins n0
+    transport.register("n0", c0)
+    transport.register("n1", c1)
     c1.join(c0)
-    assert n1.router.has_route("part/a")
-    assert n1.router.has_route("part/during")
-    assert n0.router.has_route("part/b")
+    for router, flt in [(n1.router, "part/a"),
+                        (n1.router, "part/during0"),
+                        (n0.router, "part/b"),
+                        (n0.router, "part/during1")]:
+        assert router.has_route(flt), flt
     n1.broker.publish(Message(topic="part/a"))
-    n1.broker.publish(Message(topic="part/during"))
+    n1.broker.publish(Message(topic="part/during0"))
     n0.broker.publish(Message(topic="part/b"))
+    n0.broker.publish(Message(topic="part/during1"))
     assert len(s0.inbox) == 1
     assert len(s0b.inbox) == 1
     assert len(s1.inbox) == 1
+    assert len(s1b.inbox) == 1
 
 
 def test_nodedown_mid_forward_no_crash():
@@ -407,7 +421,7 @@ def test_nodedown_mid_forward_no_crash():
     # kill n1 from the transport's perspective AFTER n0 learned the
     # route: n0 still forwards at match time and must survive the
     # ConnectionError the dead peer raises
-    del c0.transport._peers["n1"]
+    c0.transport.unregister("n1")
     n = n0.broker.publish(Message(topic="dying/x"))
     assert n == 0          # no local subscribers
     assert s1.inbox == []  # and the dead peer got nothing

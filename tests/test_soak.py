@@ -213,10 +213,14 @@ async def test_soak_device_regime_pipeline_no_loss():
             asyncio.ensure_future(publish(i)) for i in range(N_PUBS)]
         got = []
         want = N_PUBS * MSGS_PER_PUB
-        while len(got) < want:
-            m = await asyncio.wait_for(sub.recv(), 30)
-            got.append(m.payload.decode())
-        await asyncio.gather(*tasks)
+        try:
+            while len(got) < want:
+                m = await sub.recv(timeout=30)
+                got.append(m.payload.decode())
+            await asyncio.gather(*tasks)
+        finally:
+            for t in tasks:
+                t.cancel()
         assert sorted(got) == sorted(
             f"{p}:{i}" for p in range(N_PUBS)
             for i in range(MSGS_PER_PUB))
