@@ -279,3 +279,59 @@ async def test_ws_error_after_valid_packet_still_answered():
         assert got_suback, "response to pre-error packet was dropped"
         assert got_close, "server did not send a WS CLOSE frame"
         await c.close()
+
+
+# -- WS frame fuzz: corruption never crashes the server ---------------------
+
+async def test_ws_frame_fuzz_never_crashes_listener():
+    """Random garbage and truncated/flag-corrupted WS frames after a
+    valid upgrade must close the socket cleanly, never wedge or kill
+    the listener (mirror of the MQTT frame fuzz, applied to the
+    RFC 6455 layer)."""
+    import os
+    import random as _r
+
+    from emqx_tpu.node import Node
+
+    rng = _r.Random(99)
+    n = Node(boot_listeners=False)
+    lst = n.add_ws_listener(port=0)
+    await n.start()
+    try:
+        for trial in range(30):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", lst.port)
+            writer.write(
+                b"GET /mqtt HTTP/1.1\r\n"
+                b"Host: x\r\nUpgrade: websocket\r\n"
+                b"Connection: Upgrade\r\n"
+                b"Sec-WebSocket-Key: dGhlIHNhbXBsZSBub25jZQ==\r\n"
+                b"Sec-WebSocket-Version: 13\r\n"
+                b"Sec-WebSocket-Protocol: mqtt\r\n\r\n")
+            await writer.drain()
+            await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 5)
+            # garbage after the upgrade: random bytes, or a valid
+            # binary frame header with corrupted length/flags
+            kind = trial % 3
+            if kind == 0:
+                junk = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 64)))
+            elif kind == 1:
+                junk = bytes([0x82 | rng.randrange(0x40),
+                              rng.randrange(256)]) + os.urandom(8)
+            else:  # unmasked client frame (protocol violation)
+                junk = b"\x82\x05hello"
+            writer.write(junk)
+            await writer.drain()
+            with contextlib.suppress(
+                    asyncio.TimeoutError, ConnectionError,
+                    asyncio.IncompleteReadError):
+                await asyncio.wait_for(reader.read(256), 2)
+            writer.close()
+        # the listener survived: a normal client still works
+        c = WsTestClient("post-fuzz")
+        ack = await c.connect(lst.port)
+        assert ack.reason_code == 0
+        await c.close()
+    finally:
+        await n.stop()
