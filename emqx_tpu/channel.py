@@ -550,13 +550,15 @@ class Channel:
                           rap=opts.get("rap", 0), rh=opts.get("rh", 0),
                           subid=subid)
         mflt = self._mount_filter(flt, bare, popts)
+        resub = mflt in self.session.subscriptions
         try:
             self.session.subscribe(mflt, subopts)
         except SessionError as e:
             return e.rc
         self.broker.hooks.run(
             "session.subscribed",
-            (dict(self.clientinfo), mflt, subopts.to_dict()))
+            (dict(self.clientinfo), mflt,
+             {**subopts.to_dict(), "resub": resub}))
         return qos  # granted qos == RC 0/1/2
 
     def _mount_filter(self, flt: str, bare: str, popts: dict) -> str:

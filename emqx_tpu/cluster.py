@@ -124,6 +124,11 @@ class Cluster:
             banned.create = self._ban_create_replicated
             banned.delete = self._ban_delete_replicated
             banned.create_unless_outlasted = self._ban_auto_replicated
+        # retained-store replication seam: the retainer module (if
+        # loaded, now or later) broadcasts its stores/deletes (the
+        # reference plugin replicates via Mnesia)
+        node.retain_replicate = (
+            lambda topic, msg: self._broadcast("retain_set", topic, msg))
         if isinstance(self.transport, LocalTransport):
             self.transport.register(self.name, self)
         elif hasattr(self.transport, "cluster"):
@@ -209,6 +214,15 @@ class Cluster:
                 # sync push: merge (longest wins), never overwrite
                 self._broadcast("ban_add", rule.who[0], rule.who[1],
                                 rule.by, rule.reason, rule.until, False)
+        # ...and the retained store (idempotent last-writer-wins)
+        ret = self._retainer()
+        if ret is not None:
+            for topic, msg in ret.entries():
+                self._broadcast("retain_set", topic, msg)
+
+    def _retainer(self):
+        mods = getattr(self.node, "modules", None)
+        return mods._loaded.get("retainer") if mods is not None else None
 
     @staticmethod
     def _owned(dest, name: str) -> bool:
@@ -478,6 +492,11 @@ class Cluster:
             return self._set_members(args[0])
         if op == "ping":
             return "pong"
+        if op == "retain_set":
+            ret = self._retainer()
+            if ret is not None:
+                ret.apply_remote(args[0], args[1])
+            return None
         if op == "ban_add":
             kind, value, by, reason, until, overwrite = args
             banned = self.node.broker.banned

@@ -1,0 +1,133 @@
+"""Retained-message store and delivery.
+
+The reference core delegates retained messages to the separate
+``emqx_retainer`` plugin application (the core only carries the
+``retain`` flag and the v5 Retain-Handling/Retain-As-Published
+subscription options); a broker users can actually switch to needs
+the behavior in the box, so it ships here as a built-in module wired
+through the same two hookpoints the reference plugin uses:
+
+  - ``'message.publish'``: a retained PUBLISH stores its message
+    under the topic (an empty retained payload deletes — MQTT
+    3.3.1-6/-7); the message still routes normally.
+  - ``'session.subscribed'``: a new subscription receives every
+    stored message matching its filter, with the retain flag SET
+    (MQTT 3.3.1-8) regardless of RAP, honoring Retain-Handling
+    (0 = always send, 1 = only if the subscription did not exist,
+    2 = never — MQTT 3.8.3.1) and skipping shared subscriptions
+    (retained messages are never sent to ``$share`` groups) and
+    expired messages (Message-Expiry-Interval).
+
+Bounded: ``max_retained`` topics (new stores beyond it are dropped
+with a counter, like the plugin's ``max_retained_messages``) and
+``max_payload`` bytes per message.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from emqx_tpu import topic as T
+from emqx_tpu.modules import Module
+from emqx_tpu.types import Message
+
+
+class RetainerModule(Module):
+    name = "retainer"
+
+    def __init__(self, node) -> None:
+        super().__init__(node)
+        self._store: Dict[str, Message] = {}
+        self.max_retained = 0
+        self.max_payload = 0
+        # cluster seam: Cluster sets node.retain_replicate so stores/
+        # deletes broadcast (the reference plugin replicates via
+        # Mnesia); applied remotely through apply_remote (no re-fan)
+
+    def load(self, env: dict) -> None:
+        self.max_retained = int(env.get("max_retained", 1_000_000))
+        self.max_payload = int(env.get("max_payload", 1 << 20))
+        self.node.metrics.new("retained.count")
+        self.node.metrics.new("retained.dropped")
+        self.node.hooks.add("message.publish", self.on_publish,
+                            priority=50)
+        self.node.hooks.add("session.subscribed", self.on_subscribed,
+                            priority=50)
+
+    def unload(self) -> None:
+        self.node.hooks.delete("message.publish", self.on_publish)
+        self.node.hooks.delete("session.subscribed", self.on_subscribed)
+        self._store.clear()
+
+    # -- store maintenance -------------------------------------------------
+
+    def on_publish(self, msg: Message):
+        if not msg.flags.get("retain") or msg.topic.startswith("$SYS/"):
+            return None
+        if not msg.payload:
+            if self._store.pop(msg.topic, None) is not None:
+                self.node.metrics.dec("retained.count")
+                self._replicate(msg.topic, None)
+            return None
+        if len(msg.payload) > self.max_payload or (
+                msg.topic not in self._store
+                and len(self._store) >= self.max_retained):
+            self.node.metrics.inc("retained.dropped")
+            return None
+        if msg.topic not in self._store:
+            self.node.metrics.inc("retained.count")
+        self._store[msg.topic] = msg.copy()
+        self._replicate(msg.topic, self._store[msg.topic])
+        return None  # the message still routes normally
+
+    def _replicate(self, topic: str, msg) -> None:
+        fn = getattr(self.node, "retain_replicate", None)
+        if fn is not None:
+            fn(topic, msg)
+
+    def apply_remote(self, topic: str, msg) -> None:
+        """A peer's store/delete (idempotent, never re-broadcast)."""
+        if msg is None:
+            if self._store.pop(topic, None) is not None:
+                self.node.metrics.dec("retained.count")
+            return
+        if topic not in self._store:
+            if len(self._store) >= self.max_retained:
+                self.node.metrics.inc("retained.dropped")
+                return
+            self.node.metrics.inc("retained.count")
+        self._store[topic] = msg
+
+    def entries(self):
+        """Snapshot for cluster join sync."""
+        return list(self._store.items())
+
+    # -- delivery on subscribe ---------------------------------------------
+
+    def on_subscribed(self, clientinfo: dict, flt: str,
+                      subopts: dict) -> None:
+        if flt.startswith(("$share/", "$queue/")):
+            return  # never to shared subscriptions
+        rh = subopts.get("rh", 0)
+        if rh == 2 or (rh == 1 and subopts.get("resub")):
+            return
+        chan = self.node.cm.lookup_channel(
+            clientinfo.get("clientid", ""))
+        session = getattr(chan, "session", None)
+        if session is None:
+            return
+        for topic in [t for t in self._store if T.match(t, flt)]:
+            msg = self._store[topic]
+            if msg.is_expired():
+                self._store.pop(topic, None)
+                self.node.metrics.dec("retained.count")
+                continue
+            out = msg.copy()
+            # retained-delivery keeps retain=1 (MQTT-3.3.1-8); the
+            # 'retained' header tells the session's RAP logic this
+            # flag is not subject to clearing
+            out.set_header("retained", True)
+            session.deliver(flt, out)
+
+    def info(self) -> dict:
+        return {"retained": len(self._store)}

@@ -215,10 +215,15 @@ class Node:
 
     def load_default_modules(self) -> None:
         """The reference's default loaded modules
-        (data/loaded_modules): delayed + internal ACL."""
+        (data/loaded_modules): delayed + internal ACL — plus the
+        retainer (the reference ships it as a separate plugin app;
+        users expect retained messages in the box)."""
+        from emqx_tpu.modules.retainer import RetainerModule
+
         self.modules.load(DelayedModule)
         self.broker.delayed = self.modules._loaded["delayed"]
         self.modules.load(AclFileModule)
+        self.modules.load(RetainerModule)
 
     async def stop(self) -> None:
         for t in self._bg_tasks:

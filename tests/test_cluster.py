@@ -425,3 +425,30 @@ def test_nodedown_mid_forward_no_crash():
     n = n0.broker.publish(Message(topic="dying/x"))
     assert n == 0          # no local subscribers
     assert s1.inbox == []  # and the dead peer got nothing
+
+
+def test_retained_store_replicates_cluster_wide():
+    """Retained messages behave like the reference plugin's Mnesia
+    store: a retain on one node is visible to subscribers joining on
+    any node; empty-payload delete replicates; a joiner syncs the
+    existing store."""
+    from emqx_tpu.modules.retainer import RetainerModule
+
+    (n0, n1), (c0, c1) = _mk_cluster(2)
+    r0 = n0.modules.load(RetainerModule)
+    r1 = n1.modules.load(RetainerModule)
+    n0.broker.publish(Message(topic="ret/x", payload=b"v",
+                              flags={"retain": True}))
+    assert r1._store["ret/x"].payload == b"v"   # replicated
+    # delete replicates
+    n0.broker.publish(Message(topic="ret/x", payload=b"",
+                              flags={"retain": True}))
+    assert "ret/x" not in r1._store
+    # join sync: a third node gets the current store
+    n0.broker.publish(Message(topic="ret/y", payload=b"w",
+                              flags={"retain": True}))
+    n2 = Node(name="n2", boot_listeners=False)
+    c2 = Cluster(n2, c0.transport)
+    r2 = n2.modules.load(RetainerModule)
+    c2.join(c0)
+    assert r2._store["ret/y"].payload == b"w"

@@ -1,0 +1,185 @@
+"""Retained messages (built-in module; the reference delegates to the
+separate emqx_retainer plugin app): store/replace/delete, delivery on
+subscribe with Retain-Handling 0/1/2, retain flag semantics
+(MQTT 3.3.1-6/-7/-8), wildcard matching, shared-sub exclusion,
+message expiry."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.modules.retainer import RetainerModule
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.node import Node
+from tests.helpers import broker_node, node_port as _port
+from tests.mqtt_client import TestClient
+
+
+async def _node():
+    n = Node(boot_listeners=False)
+    n.modules.load(RetainerModule)
+    lst = n.add_listener(port=0)
+    await n.start()
+    return n, lst.port
+
+
+async def test_retained_delivered_on_subscribe_with_flag():
+    n, port = await _node()
+    try:
+        pub = TestClient("rpub", version=C.MQTT_V5)
+        await pub.connect(port=port)
+        await pub.publish("ret/a", b"v1", qos=1, retain=True)
+        await pub.publish("ret/b/c", b"v2", qos=1, retain=True)
+
+        sub = TestClient("rsub", version=C.MQTT_V5)
+        await sub.connect(port=port)
+        await sub.subscribe("ret/#", qos=1)
+        got = {}
+        for _ in range(2):
+            m = await sub.recv(5)
+            got[m.topic] = (m.payload, m.retain)
+        # retained delivery keeps retain=1 even without RAP
+        assert got == {"ret/a": (b"v1", True),
+                       "ret/b/c": (b"v2", True)}
+        await pub.close()
+        await sub.close()
+    finally:
+        await n.stop()
+
+
+async def test_retained_replace_and_delete():
+    n, port = await _node()
+    try:
+        pub = TestClient("rpub", version=C.MQTT_V5)
+        await pub.connect(port=port)
+        await pub.publish("ret/x", b"old", qos=1, retain=True)
+        await pub.publish("ret/x", b"new", qos=1, retain=True)
+
+        s1 = TestClient("rs1", version=C.MQTT_V5)
+        await s1.connect(port=port)
+        await s1.subscribe("ret/x")
+        assert (await s1.recv(5)).payload == b"new"
+
+        # empty retained payload deletes (MQTT-3.3.1-6)
+        await pub.publish("ret/x", b"", qos=1, retain=True)
+        s2 = TestClient("rs2", version=C.MQTT_V5)
+        await s2.connect(port=port)
+        await s2.subscribe("ret/x")
+        with pytest.raises(asyncio.TimeoutError):
+            await s2.recv(0.4)
+        assert n.metrics.val("retained.count") == 0
+        for c in (pub, s1, s2):
+            await c.close()
+    finally:
+        await n.stop()
+
+
+async def test_retain_handling_options():
+    """rh=2 never sends; rh=1 sends only for NEW subscriptions
+    (MQTT 3.8.3.1)."""
+    n, port = await _node()
+    try:
+        pub = TestClient("rpub", version=C.MQTT_V5)
+        await pub.connect(port=port)
+        await pub.publish("rh/t", b"r", qos=1, retain=True)
+
+        sub = TestClient("rsub", version=C.MQTT_V5)
+        await sub.connect(port=port)
+        await sub.subscribe(("rh/t", {"qos": 1, "nl": 0, "rap": 0,
+                                      "rh": 2}))
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(0.4)
+        # rh=1, first subscribe (it exists already → resub) …
+        await sub.subscribe(("rh/t", {"qos": 1, "nl": 0, "rap": 0,
+                                      "rh": 1}))
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(0.4)  # resub: not sent
+        # rh=0 always sends
+        await sub.subscribe(("rh/t", {"qos": 1, "nl": 0, "rap": 0,
+                                      "rh": 0}))
+        assert (await sub.recv(5)).payload == b"r"
+        # rh=1 on a genuinely new subscription sends
+        fresh = TestClient("rfresh", version=C.MQTT_V5)
+        await fresh.connect(port=port)
+        await fresh.subscribe(("rh/t", {"qos": 1, "nl": 0, "rap": 0,
+                                        "rh": 1}))
+        assert (await fresh.recv(5)).payload == b"r"
+        for c in (pub, sub, fresh):
+            await c.close()
+    finally:
+        await n.stop()
+
+
+async def test_retained_not_sent_to_shared_subscription():
+    n, port = await _node()
+    try:
+        pub = TestClient("rpub", version=C.MQTT_V5)
+        await pub.connect(port=port)
+        await pub.publish("sh/t", b"r", qos=1, retain=True)
+        sub = TestClient("rshare", version=C.MQTT_V5)
+        await sub.connect(port=port)
+        await sub.subscribe("$share/g/sh/t", qos=1)
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(0.4)
+        await pub.close()
+        await sub.close()
+    finally:
+        await n.stop()
+
+
+async def test_retained_normal_routing_unaffected():
+    """A retained PUBLISH still routes to live subscribers (with
+    retain cleared for rap=0 — it is a live delivery, not a retained
+    one)."""
+    n, port = await _node()
+    try:
+        sub = TestClient("live", version=C.MQTT_V5)
+        await sub.connect(port=port)
+        await sub.subscribe("lv/t", qos=1)
+        pub = TestClient("rpub", version=C.MQTT_V5)
+        await pub.connect(port=port)
+        await pub.publish("lv/t", b"now", qos=1, retain=True)
+        m = await sub.recv(5)
+        assert m.payload == b"now" and not m.retain
+        await pub.close()
+        await sub.close()
+    finally:
+        await n.stop()
+
+
+async def test_retained_expiry_not_delivered():
+    n, port = await _node()
+    try:
+        pub = TestClient("rpub", version=C.MQTT_V5)
+        await pub.connect(port=port)
+        await pub.publish("exp/t", b"shortlived", qos=1, retain=True,
+                          props={"Message-Expiry-Interval": 1})
+        await asyncio.sleep(1.2)
+        sub = TestClient("rsub", version=C.MQTT_V5)
+        await sub.connect(port=port)
+        await sub.subscribe("exp/t")
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(0.4)
+        await pub.close()
+        await sub.close()
+    finally:
+        await n.stop()
+
+
+async def test_store_bounds():
+    n = Node(boot_listeners=False)
+    mod = n.modules.load(RetainerModule, env={"max_retained": 2})
+    lst = n.add_listener(port=0)
+    await n.start()
+    try:
+        pub = TestClient("rpub", version=C.MQTT_V5)
+        await pub.connect(port=lst.port)
+        await pub.publish("b/1", b"x", qos=1, retain=True)
+        await pub.publish("b/2", b"x", qos=1, retain=True)
+        await pub.publish("b/3", b"x", qos=1, retain=True)  # dropped
+        assert n.metrics.val("retained.count") == 2
+        assert n.metrics.val("retained.dropped") == 1
+        assert mod.info() == {"retained": 2}
+        await pub.close()
+    finally:
+        await n.stop()

@@ -288,10 +288,7 @@ async def test_ws_frame_fuzz_never_crashes_listener():
     valid upgrade must close the socket cleanly, never wedge or kill
     the listener (mirror of the MQTT frame fuzz, applied to the
     RFC 6455 layer)."""
-    import os
     import random as _r
-
-    from emqx_tpu.node import Node
 
     rng = _r.Random(99)
     n = Node(boot_listeners=False)
