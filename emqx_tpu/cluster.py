@@ -214,11 +214,14 @@ class Cluster:
                 # sync push: merge (longest wins), never overwrite
                 self._broadcast("ban_add", rule.who[0], rule.who[1],
                                 rule.by, rule.reason, rule.until, False)
-        # ...and the retained store (idempotent last-writer-wins)
+        # ...and the retained store: ONE batched cast per peer
+        # (idempotent timestamp-LWW on the receiver; entry-per-cast
+        # would pickle a Message per entry per peer)
         ret = self._retainer()
         if ret is not None:
-            for topic, msg in ret.entries():
-                self._broadcast("retain_set", topic, msg)
+            entries = ret.entries()
+            if entries:
+                self._broadcast("retain_sync", entries)
 
     def _retainer(self):
         mods = getattr(self.node, "modules", None)
@@ -496,6 +499,12 @@ class Cluster:
             ret = self._retainer()
             if ret is not None:
                 ret.apply_remote(args[0], args[1])
+            return None
+        if op == "retain_sync":
+            ret = self._retainer()
+            if ret is not None:
+                for topic, msg in args[0]:
+                    ret.apply_remote(topic, msg)
             return None
         if op == "ban_add":
             kind, value, by, reason, until, overwrite = args

@@ -84,6 +84,10 @@ class NodeConfig:
     listeners: List[ListenerConfig] = dataclasses.field(
         default_factory=list)
     load_default_modules: bool = False
+    # [modules.<name>] sections: module env dicts by name (the
+    # reference's data/loaded_modules + per-module cuttlefish config)
+    modules: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
 
 def _build_zone(name: str, raw: Dict[str, Any]) -> Zone:
@@ -156,7 +160,29 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
                 f"listeners[{i}].zone {lc.zone!r} is not defined "
                 f"(zones: {sorted(cfg.zones) or ['default']})")
         cfg.listeners.append(lc)
+    for name, env in raw.get("modules", {}).items():
+        if name not in _module_classes():
+            raise ConfigError(
+                f"unknown module: modules.{name} "
+                f"(available: {sorted(_module_classes())})")
+        if not isinstance(env, dict):
+            raise ConfigError(f"modules.{name} must be a table")
+        cfg.modules[name] = env
     return cfg
+
+
+def _module_classes() -> Dict[str, type]:
+    from emqx_tpu.modules.acl_file import AclFileModule
+    from emqx_tpu.modules.delayed import DelayedModule
+    from emqx_tpu.modules.presence import PresenceModule
+    from emqx_tpu.modules.retainer import RetainerModule
+    from emqx_tpu.modules.rewrite import RewriteModule
+    from emqx_tpu.modules.subscription import SubscriptionModule
+    from emqx_tpu.modules.topic_metrics import TopicMetricsModule
+
+    return {cls.name: cls for cls in (
+        AclFileModule, DelayedModule, PresenceModule, RetainerModule,
+        RewriteModule, SubscriptionModule, TopicMetricsModule)}
 
 
 def build_node(cfg: NodeConfig):
@@ -187,6 +213,11 @@ def build_node(cfg: NodeConfig):
         else:  # wss
             node.add_wss_listener(path=lc.path,
                                   tls_options=TlsOptions(**lc.tls), **kw)
+    classes = _module_classes()
+    for name, env in cfg.modules.items():
+        mod = node.modules.load(classes[name], env=env)
+        if name == "delayed":
+            node.broker.delayed = mod
     if cfg.cluster_port is not None:
         # socket transport + cluster agent come up inside
         # node.start() (the transport needs the serving loop)

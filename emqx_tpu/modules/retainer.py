@@ -86,20 +86,39 @@ class RetainerModule(Module):
             fn(topic, msg)
 
     def apply_remote(self, topic: str, msg) -> None:
-        """A peer's store/delete (idempotent, never re-broadcast)."""
+        """A peer's store/delete (idempotent, never re-broadcast).
+        Last-WRITER-wins by message timestamp, not arrival order: a
+        rejoining node's stale sync must not clobber a newer value."""
         if msg is None:
             if self._store.pop(topic, None) is not None:
                 self.node.metrics.dec("retained.count")
             return
-        if topic not in self._store:
-            if len(self._store) >= self.max_retained:
-                self.node.metrics.inc("retained.dropped")
-                return
-            self.node.metrics.inc("retained.count")
+        if msg.is_expired():
+            return
+        cur = self._store.get(topic)
+        if cur is not None:
+            if msg.timestamp > cur.timestamp:
+                self._store[topic] = msg
+            return
+        if len(self._store) >= self.max_retained:
+            self.node.metrics.inc("retained.dropped")
+            return
+        self.node.metrics.inc("retained.count")
         self._store[topic] = msg
 
+    def sweep_expired(self) -> int:
+        """Drop expired entries (lazy pruning otherwise happens only
+        on a matching subscribe)."""
+        dead = [t for t, m in self._store.items() if m.is_expired()]
+        for t in dead:
+            self._store.pop(t, None)
+            self.node.metrics.dec("retained.count")
+        return len(dead)
+
     def entries(self):
-        """Snapshot for cluster join sync."""
+        """Live snapshot for cluster join sync (expired swept
+        first — a join must not resurrect dead entries)."""
+        self.sweep_expired()
         return list(self._store.items())
 
     # -- delivery on subscribe ---------------------------------------------
@@ -114,9 +133,14 @@ class RetainerModule(Module):
         chan = self.node.cm.lookup_channel(
             clientinfo.get("clientid", ""))
         session = getattr(chan, "session", None)
-        if session is None:
+        if session is None or not self._store:
             return
-        for topic in [t for t in self._store if T.match(t, flt)]:
+        if not T.wildcard(flt):
+            # exact filter: one dict probe, not a store scan
+            matches = [flt] if flt in self._store else []
+        else:
+            matches = [t for t in self._store if T.match(t, flt)]
+        for topic in matches:
             msg = self._store[topic]
             if msg.is_expired():
                 self._store.pop(topic, None)

@@ -254,3 +254,29 @@ port = 0
 
     asyncio.get_event_loop_policy().new_event_loop() \
         .run_until_complete(main())
+
+
+def test_modules_section_loads_and_validates():
+    from emqx_tpu.config import ConfigError, build_node, parse_config
+
+    cfg = parse_config({"modules": {
+        "retainer": {"max_retained": 7},
+        "delayed": {},
+    }})
+    n = build_node(cfg)
+    assert sorted(n.modules.loaded()) == ["delayed", "retainer"]
+    assert n.broker.delayed is n.modules._loaded["delayed"]
+    assert n.modules._loaded["retainer"].max_retained == 7
+    import pytest
+    with pytest.raises(ConfigError):
+        parse_config({"modules": {"no_such_module": {}}})
+    with pytest.raises(ConfigError):
+        parse_config({"modules": {"retainer": 3}})
+
+
+def test_example_config_file_boots_modules(tmp_path):
+    from emqx_tpu.config import boot_from_file
+
+    node = boot_from_file("etc/emqx_tpu.toml")
+    assert "retainer" in node.modules.loaded()
+    assert "delayed" in node.modules.loaded()

@@ -183,3 +183,32 @@ async def test_store_bounds():
         await pub.close()
     finally:
         await n.stop()
+
+
+def test_apply_remote_timestamp_lww_and_expiry():
+    """Stale sync values never clobber newer ones; expired entries
+    never enter the store remotely."""
+    import time as _t
+
+    from emqx_tpu.types import Message as M
+
+    n = Node(boot_listeners=False)
+    mod = n.modules.load(RetainerModule)
+    newer = M(topic="t", payload=b"new", flags={"retain": True})
+    older = M(topic="t", payload=b"old", flags={"retain": True},
+              timestamp=newer.timestamp - 60)
+    mod.apply_remote("t", newer)
+    mod.apply_remote("t", older)     # stale: must not overwrite
+    assert mod._store["t"].payload == b"new"
+    mod.apply_remote("t", M(topic="t", payload=b"newest",
+                            flags={"retain": True},
+                            timestamp=newer.timestamp + 60))
+    assert mod._store["t"].payload == b"newest"
+    expired = M(topic="e", payload=b"x", flags={"retain": True},
+                timestamp=_t.time() - 100,
+                headers={"properties": {"Message-Expiry-Interval": 1}})
+    mod.apply_remote("e", expired)
+    assert "e" not in mod._store
+    mod.apply_remote("t", None)
+    assert mod._store == {}
+    assert n.metrics.val("retained.count") == 0
