@@ -220,8 +220,9 @@ class Cluster:
         ret = self._retainer()
         if ret is not None:
             entries = ret.entries()
-            if entries:
-                self._broadcast("retain_sync", entries)
+            tombs = ret.tombstones()
+            if entries or tombs:
+                self._broadcast("retain_sync", entries, tombs)
 
     def _retainer(self):
         mods = getattr(self.node, "modules", None)
@@ -504,7 +505,9 @@ class Cluster:
             ret = self._retainer()
             if ret is not None:
                 for topic, msg in args[0]:
-                    ret.apply_remote(topic, msg)
+                    ret.apply_remote(topic, msg, sync=True)
+                for topic, ts in (args[1] if len(args) > 1 else []):
+                    ret.apply_tombstone(topic, ts)
             return None
         if op == "ban_add":
             kind, value, by, reason, until, overwrite = args

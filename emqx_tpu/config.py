@@ -88,6 +88,9 @@ class NodeConfig:
     # reference's data/loaded_modules + per-module cuttlefish config)
     modules: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    # directory of the config file: relative paths inside it (module
+    # files, certs) resolve against this, not the process cwd
+    base_dir: Optional[str] = None
 
 
 def _build_zone(name: str, raw: Dict[str, Any]) -> Zone:
@@ -131,9 +134,13 @@ def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
 
 def load_config(path: str) -> NodeConfig:
     """Parse + validate a TOML config file into a NodeConfig."""
+    import os
+
     with open(path, "rb") as f:
         raw = tomllib.load(f)
-    return parse_config(raw)
+    cfg = parse_config(raw)
+    cfg.base_dir = os.path.dirname(os.path.abspath(path))
+    return cfg
 
 
 def parse_config(raw: Dict[str, Any]) -> NodeConfig:
@@ -213,11 +220,19 @@ def build_node(cfg: NodeConfig):
         else:  # wss
             node.add_wss_listener(path=lc.path,
                                   tls_options=TlsOptions(**lc.tls), **kw)
+    import os
+
     classes = _module_classes()
     for name, env in cfg.modules.items():
-        mod = node.modules.load(classes[name], env=env)
-        if name == "delayed":
-            node.broker.delayed = mod
+        env = dict(env)
+        f = env.get("file")
+        if isinstance(f, str) and not os.path.isabs(f) and cfg.base_dir:
+            env["file"] = os.path.join(cfg.base_dir, f)
+        if isinstance(env.get("file"), str) and \
+                not os.path.exists(env["file"]):
+            raise ConfigError(
+                f"modules.{name}.file not found: {env['file']}")
+        node.modules.load(classes[name], env=env)
     if cfg.cluster_port is not None:
         # socket transport + cluster agent come up inside
         # node.start() (the transport needs the serving loop)

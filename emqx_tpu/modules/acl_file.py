@@ -39,6 +39,92 @@ DEFAULT_RULES: List[tuple] = [
 ]
 
 
+def parse_acl_file(text: str) -> List[tuple]:
+    """Parse the reference's ``etc/acl.conf`` format (a subset of
+    Erlang terms — ``src/emqx_mod_acl_internal.erl`` consults the
+    file the same way):
+
+        {allow, {user, "dashboard"}, subscribe, ["$SYS/#"]}.
+        {deny, all, subscribe, ["$SYS/#", {eq, "#"}]}.
+        {allow, all}.
+
+    ``%%`` comments out the rest of a line. Returns rule tuples in
+    this module's native shape; a 2-tuple ``{allow|deny, all}``
+    becomes a catch-all over every access and topic.
+    """
+    import re
+
+    # strip %-comments (the reference's files use %%), keep strings
+    lines = []
+    for line in text.splitlines():
+        out, i, in_str = [], 0, False
+        while i < len(line):
+            ch = line[i]
+            if ch == '"':
+                in_str = not in_str
+            if ch == "%" and not in_str:
+                break
+            out.append(ch)
+            i += 1
+        lines.append("".join(out))
+    src = "\n".join(lines)
+    toks = re.findall(r'"(?:[^"\\]|\\.)*"|[{}\[\],.]|[A-Za-z0-9_/$#+%.-]+',
+                      src)
+    pos = 0
+
+    def peek():
+        return toks[pos] if pos < len(toks) else None
+
+    def take(expect=None):
+        nonlocal pos
+        t = toks[pos]
+        if expect is not None and t != expect:
+            raise ValueError(f"acl.conf: expected {expect!r}, got {t!r}")
+        pos += 1
+        return t
+
+    def term():
+        t = peek()
+        if t == "{":
+            take()
+            items = []
+            while peek() != "}":
+                items.append(term())
+                if peek() == ",":
+                    take()
+            take("}")
+            return tuple(items)
+        if t == "[":
+            take()
+            items = []
+            while peek() != "]":
+                items.append(term())
+                if peek() == ",":
+                    take()
+            take("]")
+            return items
+        t = take()
+        if t.startswith('"'):
+            return t[1:-1].replace('\\"', '"')
+        return t
+
+    rules: List[tuple] = []
+    while pos < len(toks):
+        r = term()
+        take(".")
+        if not isinstance(r, tuple) or r[0] not in ("allow", "deny"):
+            raise ValueError(f"acl.conf: bad rule {r!r}")
+        if len(r) == 2:
+            # {allow|deny, all} catch-all: matches EVERY topic,
+            # including $-prefixed ones '#' would exclude
+            rules.append((r[0], r[1], "pubsub", None))
+        elif len(r) == 4:
+            rules.append((r[0], r[1], r[2], list(r[3])))
+        else:
+            raise ValueError(f"acl.conf: bad rule arity {r!r}")
+    return rules
+
+
 class AclFileModule(Module):
     name = "acl_internal"
 
@@ -47,7 +133,11 @@ class AclFileModule(Module):
         self.rules: List[tuple] = []
 
     def load(self, env: dict) -> None:
-        self.rules = list(env.get("rules", DEFAULT_RULES))
+        if "file" in env:
+            with open(env["file"], "r", encoding="utf-8") as f:
+                self.rules = parse_acl_file(f.read())
+        else:
+            self.rules = list(env.get("rules", DEFAULT_RULES))
         self.node.hooks.add("client.check_acl", self.check_acl,
                             priority=-10)
 
@@ -91,9 +181,14 @@ class AclFileModule(Module):
         return False
 
     @staticmethod
-    def _match_topics(topics: List[TopicSpec], topic: str,
+    def _match_topics(topics, topic: str,
                       clientinfo: dict) -> bool:
         from emqx_tpu.mountpoint import replvar
+
+        if topics is None:
+            # {allow|deny, all} catch-all: every topic, including
+            # $-prefixed names that '#' would exclude
+            return True
 
         for spec in topics:
             if isinstance(spec, tuple):  # ("eq", literal)

@@ -27,6 +27,7 @@ class DelayedModule(Module):
         self._task: Optional[asyncio.Task] = None
 
     def load(self, env: dict) -> None:
+        self.node.broker.delayed = self  # the channel consults this
         self.node.hooks.add("message.publish", self.on_publish,
                             priority=100)
         try:
@@ -36,6 +37,8 @@ class DelayedModule(Module):
             self._task = None  # sync context: call tick() manually
 
     def unload(self) -> None:
+        if getattr(self.node.broker, 'delayed', None) is self:
+            self.node.broker.delayed = None
         self.node.hooks.delete("message.publish", self.on_publish)
         if self._task is not None:
             self._task.cancel()

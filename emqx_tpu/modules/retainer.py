@@ -38,6 +38,9 @@ class RetainerModule(Module):
     def __init__(self, node) -> None:
         super().__init__(node)
         self._store: Dict[str, Message] = {}
+        # delete tombstones (topic -> delete time): a stale
+        # rejoiner's sync must not resurrect a deleted message
+        self._tombstones: Dict[str, float] = {}
         self.max_retained = 0
         self.max_payload = 0
         # cluster seam: Cluster sets node.retain_replicate so stores/
@@ -67,6 +70,7 @@ class RetainerModule(Module):
         if not msg.payload:
             if self._store.pop(msg.topic, None) is not None:
                 self.node.metrics.dec("retained.count")
+                self._tombstones[msg.topic] = msg.timestamp
                 self._replicate(msg.topic, None)
             return None
         if len(msg.payload) > self.max_payload or (
@@ -85,19 +89,33 @@ class RetainerModule(Module):
         if fn is not None:
             fn(topic, msg)
 
-    def apply_remote(self, topic: str, msg) -> None:
+    def apply_remote(self, topic: str, msg, sync: bool = False) -> None:
         """A peer's store/delete (idempotent, never re-broadcast).
-        Last-WRITER-wins by message timestamp, not arrival order: a
-        rejoining node's stale sync must not clobber a newer value."""
+
+        LIVE replication (``sync=False``) applies in arrival order —
+        concurrent publishes race exactly as the reference's Mnesia
+        writes do, and a node with a lagging clock must not have its
+        updates silently dropped cluster-wide. JOIN sync
+        (``sync=True``) is the anti-entropy path: it applies
+        last-WRITER-wins by message timestamp and respects delete
+        tombstones, so a rejoiner's stale snapshot can neither
+        clobber newer values nor resurrect deletions."""
         if msg is None:
+            import time as _time
+
             if self._store.pop(topic, None) is not None:
                 self.node.metrics.dec("retained.count")
+            self._tombstones[topic] = _time.time()
             return
         if msg.is_expired():
             return
+        if sync:
+            tomb = self._tombstones.get(topic)
+            if tomb is not None and tomb >= msg.timestamp:
+                return
         cur = self._store.get(topic)
         if cur is not None:
-            if msg.timestamp > cur.timestamp:
+            if not sync or msg.timestamp > cur.timestamp:
                 self._store[topic] = msg
             return
         if len(self._store) >= self.max_retained:
@@ -113,6 +131,7 @@ class RetainerModule(Module):
         for t in dead:
             self._store.pop(t, None)
             self.node.metrics.dec("retained.count")
+        self._sweep_tombstones()
         return len(dead)
 
     def entries(self):
@@ -120,6 +139,29 @@ class RetainerModule(Module):
         first — a join must not resurrect dead entries)."""
         self.sweep_expired()
         return list(self._store.items())
+
+    def tombstones(self):
+        return list(self._tombstones.items())
+
+    def apply_tombstone(self, topic: str, ts: float) -> None:
+        """A peer's delete record (join sync): drop any locally
+        stored message older than the deletion."""
+        cur = self._store.get(topic)
+        if cur is not None and cur.timestamp <= ts:
+            self._store.pop(topic, None)
+            self.node.metrics.dec("retained.count")
+        prev = self._tombstones.get(topic, 0.0)
+        self._tombstones[topic] = max(prev, ts)
+
+    _TOMBSTONE_TTL = 3600.0
+
+    def _sweep_tombstones(self) -> None:
+        import time as _time
+
+        cutoff = _time.time() - self._TOMBSTONE_TTL
+        for t in [t for t, ts in self._tombstones.items()
+                  if ts < cutoff]:
+            self._tombstones.pop(t, None)
 
     # -- delivery on subscribe ---------------------------------------------
 

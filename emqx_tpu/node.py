@@ -221,7 +221,6 @@ class Node:
         from emqx_tpu.modules.retainer import RetainerModule
 
         self.modules.load(DelayedModule)
-        self.broker.delayed = self.modules._loaded["delayed"]
         self.modules.load(AclFileModule)
         self.modules.load(RetainerModule)
 

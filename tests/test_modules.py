@@ -347,3 +347,49 @@ def test_ctl_log_level():
     # profile registration survives (regression: inserting a command
     # mid-_register_builtins once orphaned it)
     assert "profile" in n.ctl.run(["help"])
+
+
+def test_acl_conf_file_parsing_reference_fixtures():
+    """The acl.conf parser handles the reference's own files
+    verbatim (test fixture + shipped etc/acl.conf)."""
+    import os
+
+    import pytest
+
+    from emqx_tpu.modules.acl_file import parse_acl_file
+
+    ref = "/root/reference/test/emqx_access_SUITE_data/acl.conf"
+    if not os.path.exists(ref):
+        pytest.skip("reference checkout not present")
+    rules = parse_acl_file(open(ref).read())
+    assert ("allow", ("user", "testuser"), "subscribe",
+            ["a/b/c", "d/e/f/#"]) in rules
+    assert rules[-1] == ("deny", "all", "pubsub", None)
+
+    ours = parse_acl_file(open("etc/acl.conf").read())
+    assert ("deny", "all", "subscribe",
+            ["$SYS/#", ("eq", "#")]) in ours
+    assert ours[-1][0] == "allow"
+
+
+def test_acl_file_module_loads_from_file(tmp_path):
+    from emqx_tpu.modules.acl_file import AclFileModule
+    from emqx_tpu.node import Node
+
+    path = tmp_path / "acl.conf"
+    path.write_text(
+        '{deny, {user, "evil"}, publish, ["secret/#"]}.\n'
+        '{allow, all}.\n')
+    n = Node(boot_listeners=False)
+    mod = n.modules.load(AclFileModule, env={"file": str(path)})
+    deny = mod.check_acl({"username": "evil", "clientid": "c",
+                          "peerhost": "10.0.0.1"},
+                         "publish", "secret/x", None)
+    from emqx_tpu.access_control import DENY
+    from emqx_tpu.hooks import STOP
+    assert deny == (STOP, DENY)
+    ok = mod.check_acl({"username": "good", "clientid": "c",
+                        "peerhost": "10.0.0.1"},
+                       "publish", "secret/x", None)
+    from emqx_tpu.access_control import ALLOW
+    assert ok == (STOP, ALLOW)

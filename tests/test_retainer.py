@@ -186,8 +186,10 @@ async def test_store_bounds():
 
 
 def test_apply_remote_timestamp_lww_and_expiry():
-    """Stale sync values never clobber newer ones; expired entries
-    never enter the store remotely."""
+    """JOIN sync is timestamp-LWW (a stale snapshot never clobbers a
+    newer value); LIVE replication applies in arrival order (a
+    lagging clock must not get its updates dropped cluster-wide);
+    expired entries never enter the store remotely."""
     import time as _t
 
     from emqx_tpu.types import Message as M
@@ -197,12 +199,16 @@ def test_apply_remote_timestamp_lww_and_expiry():
     newer = M(topic="t", payload=b"new", flags={"retain": True})
     older = M(topic="t", payload=b"old", flags={"retain": True},
               timestamp=newer.timestamp - 60)
-    mod.apply_remote("t", newer)
-    mod.apply_remote("t", older)     # stale: must not overwrite
+    mod.apply_remote("t", newer, sync=True)
+    mod.apply_remote("t", older, sync=True)  # stale sync: ignored
     assert mod._store["t"].payload == b"new"
+    # live replication: arrival order wins even with an older clock
+    mod.apply_remote("t", older)
+    assert mod._store["t"].payload == b"old"
     mod.apply_remote("t", M(topic="t", payload=b"newest",
                             flags={"retain": True},
-                            timestamp=newer.timestamp + 60))
+                            timestamp=newer.timestamp + 60),
+                     sync=True)
     assert mod._store["t"].payload == b"newest"
     expired = M(topic="e", payload=b"x", flags={"retain": True},
                 timestamp=_t.time() - 100,
@@ -212,3 +218,13 @@ def test_apply_remote_timestamp_lww_and_expiry():
     mod.apply_remote("t", None)
     assert mod._store == {}
     assert n.metrics.val("retained.count") == 0
+    # tombstone: a later stale sync cannot resurrect the deletion
+    mod.apply_remote("t", older, sync=True)
+    assert "t" not in mod._store
+    # sync tombstone drops an older stored value
+    mod.apply_remote("z", older.copy(), sync=True) or None
+    mod._store["z2"] = M(topic="z2", payload=b"x",
+                         flags={"retain": True},
+                         timestamp=_t.time() - 50)
+    mod.apply_tombstone("z2", _t.time())
+    assert "z2" not in mod._store
