@@ -1,0 +1,164 @@
+"""Hypothesis property tests — the reference's PropEr tier
+(test/props/), generator-driven instead of hand-rolled randomness:
+
+  - frame codec: serialize∘parse identity over generated packets ×
+    protocol versions (prop_emqx_frame.erl:26-55);
+  - topic algebra: match/words/join laws over generated topics;
+  - matcher parity: device automaton ≡ host oracle over generated
+    filter sets and topics (the emqx_trie_SUITE semantics, fuzzed);
+  - base62: roundtrip over arbitrary ints (prop_emqx_base62).
+"""
+
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu import topic as T
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import Parser, serialize
+from emqx_tpu.mqtt.packet import Publish, Subscribe
+
+# -- strategies -------------------------------------------------------------
+
+word = st.text(alphabet="abcdefg01", min_size=1, max_size=4)
+topic_name = st.lists(word, min_size=1, max_size=6).map("/".join)
+
+
+@st.composite
+def topic_filter(draw):
+    words = draw(st.lists(
+        st.one_of(word, st.just("+")), min_size=1, max_size=6))
+    if draw(st.booleans()):
+        words = words[: draw(st.integers(1, len(words)))] + ["#"]
+    return "/".join(words)
+
+
+@st.composite
+def publish_packet(draw):
+    qos = draw(st.integers(0, 2))
+    props = {}
+    if draw(st.booleans()):
+        props["Message-Expiry-Interval"] = draw(st.integers(1, 2**31 - 1))
+    if draw(st.booleans()):
+        props["User-Property"] = [
+            (draw(st.text(max_size=8)), draw(st.text(max_size=8)))]
+    return Publish(
+        topic=draw(topic_name),
+        payload=draw(st.binary(max_size=64)),
+        qos=qos,
+        retain=draw(st.booleans()),
+        dup=draw(st.booleans()) if qos else False,
+        packet_id=draw(st.integers(1, 0xFFFF)) if qos else None,
+        properties=props,
+    )
+
+
+# -- frame codec ------------------------------------------------------------
+
+@settings(max_examples=200, deadline=None)
+@given(pkt=publish_packet(),
+       ver=st.sampled_from([C.MQTT_V3, C.MQTT_V4, C.MQTT_V5]))
+def test_publish_serialize_parse_identity(pkt, ver):
+    if ver != C.MQTT_V5:
+        pkt.properties = {}
+    data = serialize(pkt, ver)
+    [out] = Parser(version=ver).feed(data)
+    assert isinstance(out, Publish)
+    assert (out.topic, out.payload, out.qos, out.retain, out.dup) == \
+        (pkt.topic, pkt.payload, pkt.qos, pkt.retain, pkt.dup)
+    if pkt.qos:
+        assert out.packet_id == pkt.packet_id
+    if ver == C.MQTT_V5:
+        for k, v in pkt.properties.items():
+            assert out.properties.get(k) == v
+
+
+@settings(max_examples=100, deadline=None)
+@given(pkt=publish_packet(), cut=st.integers(1, 8),
+       ver=st.sampled_from([C.MQTT_V4, C.MQTT_V5]))
+def test_parser_incremental_feed_identity(pkt, cut, ver):
+    """Byte-at-a-time / chunked feeding yields the same packet."""
+    if ver != C.MQTT_V5:
+        pkt.properties = {}
+    data = serialize(pkt, ver)
+    p = Parser(version=ver)
+    outs = []
+    for i in range(0, len(data), cut):
+        outs.extend(p.feed(data[i:i + cut]))
+    assert len(outs) == 1 and outs[0].topic == pkt.topic
+    assert outs[0].payload == pkt.payload
+
+
+@settings(max_examples=100, deadline=None)
+@given(filters=st.lists(topic_filter(), min_size=1, max_size=5))
+def test_subscribe_roundtrip(filters):
+    pkt = Subscribe(packet_id=7, topic_filters=[
+        (f, {"qos": 1, "nl": 0, "rap": 0, "rh": 0}) for f in filters])
+    [out] = Parser(version=C.MQTT_V5).feed(serialize(pkt, C.MQTT_V5))
+    assert [f for f, _ in out.topic_filters] == filters
+
+
+# -- topic algebra ----------------------------------------------------------
+
+@settings(max_examples=300, deadline=None)
+@given(t=topic_name)
+def test_topic_matches_itself_and_hash(t):
+    assert T.match(t, t)
+    assert T.match(t, "#")
+    assert T.match(t, "/".join(["+"] * len(T.words(t))))
+
+
+@settings(max_examples=300, deadline=None)
+@given(t=topic_name, f=topic_filter())
+def test_match_agrees_with_word_semantics(t, f):
+    """T.match ≡ the word-by-word reference semantics."""
+    def ref_match(tw, fw):
+        i = 0
+        for j, w in enumerate(fw):
+            if w == "#":
+                return True
+            if i >= len(tw):
+                return False
+            if w != "+" and w != tw[i]:
+                return False
+            i += 1
+        return i == len(tw)
+
+    assert T.match(t, f) == ref_match(T.words(t), T.words(f))
+
+
+@settings(max_examples=200, deadline=None)
+@given(t=topic_name)
+def test_sys_topics_never_match_root_wildcards(t):
+    sys_t = "$SYS/" + t
+    assert not T.match(sys_t, "#")
+    assert not T.match(sys_t, "+/" + t)
+
+
+# -- matcher parity: device automaton ≡ oracle ------------------------------
+
+@settings(max_examples=30, deadline=None)
+@given(filters=st.lists(topic_filter(), min_size=1, max_size=40,
+                        unique=True),
+       topics=st.lists(topic_name, min_size=1, max_size=20))
+def test_router_device_matches_oracle(filters, topics):
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.router import MatcherConfig, Router
+
+    r = Router(MatcherConfig(device_min_filters=0, use_native=False),
+               node="prop")
+    oracle = TrieOracle()
+    for f in filters:
+        r.add_route(f)
+        oracle.insert(f)
+    got = r.match_filters(topics)
+    for t, g in zip(topics, got):
+        assert sorted(g) == sorted(oracle.match(t)), t
+
+
+# -- base62 -----------------------------------------------------------------
+
+@settings(max_examples=300, deadline=None)
+@given(n=st.integers(0, 2**128))
+def test_base62_roundtrip(n):
+    from emqx_tpu.utils.base62 import decode, encode
+
+    assert decode(encode(n)) == n
