@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Full benchmark matrix (run on the real chip; each mode prints one
+# JSON line). Usage: bash scripts/bench_all.sh [outfile]
+set -u
+OUT="${1:-BENCH_MATRIX.jsonl}"
+cd "$(dirname "$0")/.."
+: > "$OUT"
+
+run() {
+  echo "== $* " >&2
+  local log line
+  log=$(mktemp)
+  line=$(env "$@" timeout 1200 python bench.py 2>"$log" | tail -1)
+  if [ -n "$line" ] && printf '%s' "$line" | grep -q '"metric"'; then
+    printf '%s\n' "$line" | tee -a "$OUT"
+  else
+    # a crashed/timed-out mode leaves a diagnostic row, not a gap
+    printf '{"metric": "FAILED", "mode": "%s", "stderr_tail": "%s"}\n' \
+      "$*" "$(tail -3 "$log" | tr '\n"' ' .')" | tee -a "$OUT"
+  fi
+  rm -f "$log"
+}
+
+run BENCH_MODE=default
+run BENCH_MODE=default BENCH_SUBS=10000000 BENCH_ITERS=10 BENCH_WINDOWS=3
+run BENCH_MODE=bigfan
+run BENCH_MODE=shared
+run BENCH_MODE=churn BENCH_SUBS=50000 BENCH_CHURN_RATE=5000
+run BENCH_MODE=live LIVE_RATE=400
+run BENCH_MODE=live
+run BENCH_MODE=live LIVE_FILTERS=2000
+echo "matrix written to $OUT" >&2

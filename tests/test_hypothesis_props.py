@@ -83,8 +83,15 @@ def test_parser_incremental_feed_identity(pkt, cut, ver):
     outs = []
     for i in range(0, len(data), cut):
         outs.extend(p.feed(data[i:i + cut]))
-    assert len(outs) == 1 and outs[0].topic == pkt.topic
-    assert outs[0].payload == pkt.payload
+    assert len(outs) == 1
+    out = outs[0]
+    assert (out.topic, out.payload, out.qos, out.retain, out.dup) == \
+        (pkt.topic, pkt.payload, pkt.qos, pkt.retain, pkt.dup)
+    if pkt.qos:
+        assert out.packet_id == pkt.packet_id
+    if ver == C.MQTT_V5:
+        for k, v in pkt.properties.items():
+            assert out.properties.get(k) == v
 
 
 @settings(max_examples=100, deadline=None)
@@ -112,7 +119,7 @@ def test_match_agrees_with_word_semantics(t, f):
     """T.match ≡ the word-by-word reference semantics."""
     def ref_match(tw, fw):
         i = 0
-        for j, w in enumerate(fw):
+        for w in fw:
             if w == "#":
                 return True
             if i >= len(tw):
@@ -150,6 +157,7 @@ def test_router_device_matches_oracle(filters, topics):
         r.add_route(f)
         oracle.insert(f)
     got = r.match_filters(topics)
+    assert len(got) == len(topics)
     for t, g in zip(topics, got):
         assert sorted(g) == sorted(oracle.match(t)), t
 
