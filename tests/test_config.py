@@ -280,3 +280,44 @@ def test_example_config_file_boots_modules(tmp_path):
     node = boot_from_file("etc/emqx_tpu.toml")
     assert "retainer" in node.modules.loaded()
     assert "delayed" in node.modules.loaded()
+
+
+async def test_python_m_emqx_tpu_boot_and_sigterm(tmp_path):
+    """`python -m emqx_tpu` boots a real broker process from a config
+    file, serves MQTT, and shuts down cleanly on SIGTERM."""
+    import asyncio
+    import os
+    import signal
+    import sys
+
+    cfg = tmp_path / "n.toml"
+    cfg.write_text(
+        '[node]\nname = "main-test@127.0.0.1"\n\n'
+        '[[listeners]]\ntype = "tcp"\nport = 0\n')
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "emqx_tpu", "--config", str(cfg),
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT, env=env, cwd="/root/repo")
+    try:
+        port = None
+        while port is None:
+            line = await asyncio.wait_for(proc.stdout.readline(), 60)
+            assert line, "process exited before listening"
+            if b"listening:" in line:
+                port = int(line.rsplit(b":", 1)[1])
+        from tests.mqtt_client import TestClient
+        c = TestClient("m-boot")
+        await c.connect(port=port)
+        await c.subscribe("m/t")
+        await c.publish("m/t", b"via-module", qos=1)
+        m = await c.recv(10)
+        assert m.payload == b"via-module"
+        c.writer.close()
+        proc.send_signal(signal.SIGTERM)
+        rc = await asyncio.wait_for(proc.wait(), 20)
+        assert rc == 0
+    finally:
+        if proc.returncode is None:
+            proc.kill()
+            await proc.wait()
