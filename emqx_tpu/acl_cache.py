@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import Optional
 
 
 class AclCache:

@@ -21,7 +21,7 @@ time; here ingress batches per tick, SURVEY §2.2).
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -35,7 +35,7 @@ array shapes stable across rebuilds (no recompilation churn).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set
 
 import jax
 import numpy as np

@@ -25,7 +25,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from emqx_tpu import topic as T
-from emqx_tpu.access_control import (ALLOW, DENY, PUB, SUB, AccessControl,
+from emqx_tpu.access_control import (DENY, PUB, SUB, AccessControl,
                                      ClientInfo)
 from emqx_tpu.acl_cache import AclCache
 from emqx_tpu.keepalive import Keepalive

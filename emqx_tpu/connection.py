@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Optional, Tuple
+from typing import Optional
 
 from emqx_tpu.channel import Channel
 from emqx_tpu.gc import GcPolicy

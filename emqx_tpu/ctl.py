@@ -8,7 +8,7 @@ is."""
 from __future__ import annotations
 
 import json
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 
 class Ctl:

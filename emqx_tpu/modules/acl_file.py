@@ -18,7 +18,7 @@ acl_nomatch default (handled by AccessControl).
 from __future__ import annotations
 
 import ipaddress
-from typing import List, Optional, Tuple, Union
+from typing import List, Tuple, Union
 
 from emqx_tpu import topic as T
 from emqx_tpu.access_control import ALLOW, DENY

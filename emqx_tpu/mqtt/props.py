@@ -7,7 +7,7 @@ filter, validation). Properties travel as ``{Name: value}`` dicts;
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, Tuple
 
 from emqx_tpu.mqtt import constants as C
 

@@ -10,7 +10,7 @@ same, emqx_mqueue.erl:20-25).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from emqx_tpu.pqueue import PQueue
 from emqx_tpu.types import Message, QOS_0

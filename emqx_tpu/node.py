@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from emqx_tpu.alarm import AlarmManager
 from emqx_tpu.banned import Banned
@@ -19,7 +19,7 @@ from emqx_tpu.broker import Broker
 from emqx_tpu.cm import ConnectionManager
 from emqx_tpu.connection import Listener
 from emqx_tpu.ctl import Ctl
-from emqx_tpu.flapping import Flapping, FlappingConfig
+from emqx_tpu.flapping import Flapping
 from emqx_tpu.gc import GlobalGc
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.ingress import IngressBatcher

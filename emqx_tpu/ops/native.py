@@ -15,7 +15,7 @@ import logging
 import os
 import subprocess
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 

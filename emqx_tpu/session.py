@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from emqx_tpu.inflight import Inflight
 from emqx_tpu.mqueue import MQueue
-from emqx_tpu.types import Message, QOS_0, QOS_1, QOS_2, SubOpts
+from emqx_tpu.types import Message, QOS_0, QOS_2, SubOpts
 
 # reason codes used at the session boundary (mqtt/reason_codes has
 # the full table)

@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import json
 import time
-from typing import List, Optional
 
 from emqx_tpu import __version__
 from emqx_tpu.types import Message

@@ -11,7 +11,7 @@ the owner drains finished items and erases them in one pass — the
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+from typing import Any, Dict, Hashable, List, Optional, Tuple
 
 _MISSING = object()
 

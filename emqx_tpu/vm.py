@@ -19,7 +19,7 @@ import os
 import resource
 import sys
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 def get_memory() -> Dict[str, int]:

@@ -7,7 +7,7 @@ dataclass). Defaults follow etc/emqx.conf:698-907.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional
 
 
