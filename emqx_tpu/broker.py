@@ -334,9 +334,12 @@ class Broker:
 
     def _publish_host(self, pb: PendingBatch, topics: List[str]) -> None:
         """Host-path matching + routing for a begun batch (below the
-        device threshold, device off, or empty route table)."""
-        for (i, msg), filters in zip(
-                pb.live, self.router.match_filters(topics)):
+        device threshold, device off, or empty route table). Hot
+        topics dedup here too — one trie walk per unique topic."""
+        uniq, inv = dedup_topics(topics)
+        matched = self.router.match_filters(uniq)
+        for row, (i, msg) in enumerate(pb.live):
+            filters = matched[inv[row]]
             if not filters:
                 self._drop_no_subs(msg)
                 continue
