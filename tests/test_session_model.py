@@ -1,0 +1,138 @@
+"""Model-based stateful test of the outbound QoS window:
+random interleavings of deliver / puback / pubrec / pubcomp / retry /
+bad-acks against a reference model of the MQTT server->client flow
+(the reference pins these semantics across emqx_session_SUITE +
+emqx_inflight_SUITE; this explores the interleavings those example
+tests cannot).
+
+Invariants checked after every step:
+  - inflight occupancy == model, never exceeds the window;
+  - a packet id is never reused while in flight;
+  - queued messages refill the window strictly FIFO;
+  - acks for unknown ids / wrong phase raise SessionError;
+  - retry re-emits exactly the in-flight set, DUP where applicable.
+"""
+
+import time
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu.session import PUBREL_MARKER, Session, SessionError
+from emqx_tpu.types import Message, SubOpts
+
+WINDOW = 4
+
+op = st.sampled_from(
+    ["deliver1", "deliver2", "puback", "pubrec", "pubcomp", "retry",
+     "bad_puback", "bad_pubcomp"])
+
+
+@settings(max_examples=150, deadline=None)
+@given(ops=st.lists(op, min_size=1, max_size=60),
+       picks=st.lists(st.integers(0, 10**9), min_size=60, max_size=60))
+def test_session_qos_window_model(ops, picks):
+    s = Session("model", max_inflight=WINDOW, max_mqueue_len=100,
+                retry_interval=30.0)
+    s.subscriptions["t/#"] = SubOpts(qos=2)
+    model = {}          # pid -> (phase, serial)
+    fifo = []           # serials queued behind a full window
+    serial = 0
+    clock = time.time()  # logical time: each retry advances past the
+    # interval so every in-flight entry is due again
+
+    def drain(expect_serials=None):
+        got = []
+        for pid, msg in s.drain_outbox():
+            if pid == PUBREL_MARKER or pid is None:
+                continue
+            sr = int(msg.payload)
+            assert pid not in model, f"pid {pid} reused while in flight"
+            model[pid] = ("pub1" if msg.qos == 1 else "pub2", sr)
+            got.append(sr)
+        if expect_serials is not None:
+            assert got == expect_serials  # FIFO refill order
+        return got
+
+    def pick(seq, i):
+        seq = sorted(seq)
+        return seq[picks[i % len(picks)] % len(seq)] if seq else None
+
+    for i, o in enumerate(ops):
+        if o in ("deliver1", "deliver2"):
+            serial += 1
+            qos = 1 if o == "deliver1" else 2
+            s.deliver("t/#", Message(topic="t/x",
+                                     payload=str(serial).encode(),
+                                     qos=qos))
+            if len(model) < WINDOW:
+                drain(expect_serials=[serial])
+            else:
+                drain(expect_serials=[])
+                fifo.append(serial)
+        elif o == "puback":
+            pid = pick([p for p, (ph, _) in model.items()
+                        if ph == "pub1"], i)
+            if pid is None:
+                continue
+            s.puback(pid)
+            del model[pid]
+            refill = fifo[: WINDOW - len(model)]
+            del fifo[: len(refill)]
+            drain(expect_serials=refill)
+        elif o == "pubrec":
+            pid = pick([p for p, (ph, _) in model.items()
+                        if ph == "pub2"], i)
+            if pid is None:
+                continue
+            s.pubrec(pid)
+            ph, sr = model[pid]
+            model[pid] = ("rel", sr)
+        elif o == "pubcomp":
+            pid = pick([p for p, (ph, _) in model.items()
+                        if ph == "rel"], i)
+            if pid is None:
+                continue
+            s.pubcomp(pid)
+            del model[pid]
+            refill = fifo[: WINDOW - len(model)]
+            del fifo[: len(refill)]
+            drain(expect_serials=refill)
+        elif o == "retry":
+            clock += 60
+            s.retry(now=clock)
+            # re-emissions only: every pub-phase message comes back
+            # with DUP, RELs as markers; nothing NEW may appear
+            redone = []
+            for pid, msg in s.drain_outbox():
+                if pid == PUBREL_MARKER:
+                    assert model[msg][0] == "rel"
+                    continue
+                assert msg.flags.get("dup"), "retry must set DUP"
+                assert model[pid][0] in ("pub1", "pub2")
+                redone.append(pid)
+            assert sorted(redone) == sorted(
+                p for p, (ph, _) in model.items() if ph != "rel")
+        elif o == "bad_puback":
+            free = next(p for p in range(1, 70000)
+                        if p not in model)
+            with pytest.raises(SessionError):
+                s.puback(free)
+            rel = [p for p, (ph, _) in model.items() if ph == "rel"]
+            if rel:
+                with pytest.raises(SessionError):
+                    s.puback(rel[0])  # wrong phase
+        elif o == "bad_pubcomp":
+            pub = [p for p, (ph, _) in model.items()
+                   if ph in ("pub1", "pub2")]
+            if pub:
+                with pytest.raises(SessionError):
+                    s.pubcomp(pub[0])  # not in REL phase
+
+        # global invariants
+        assert len(s.inflight) == len(model) <= WINDOW
+        assert len(s.mqueue) == len(fifo)
+        assert sorted(s.inflight.keys()) == sorted(model)
+
+
+
