@@ -86,8 +86,7 @@ def test_session_qos_window_model(ops, picks):
             if pid is None:
                 continue
             s.pubrec(pid)
-            ph, sr = model[pid]
-            model[pid] = ("rel", sr)
+            model[pid] = ("rel", model[pid][1])
         elif o == "pubcomp":
             pid = pick([p for p, (ph, _) in model.items()
                         if ph == "rel"], i)
@@ -104,15 +103,18 @@ def test_session_qos_window_model(ops, picks):
             # re-emissions only: every pub-phase message comes back
             # with DUP, RELs as markers; nothing NEW may appear
             redone = []
+            rels = []
             for pid, msg in s.drain_outbox():
                 if pid == PUBREL_MARKER:
-                    assert model[msg][0] == "rel"
+                    rels.append(msg)  # payload slot carries the pid
                     continue
                 assert msg.flags.get("dup"), "retry must set DUP"
                 assert model[pid][0] in ("pub1", "pub2")
                 redone.append(pid)
             assert sorted(redone) == sorted(
                 p for p, (ph, _) in model.items() if ph != "rel")
+            assert sorted(rels) == sorted(
+                p for p, (ph, _) in model.items() if ph == "rel")
         elif o == "bad_puback":
             free = next(p for p in range(1, 70000)
                         if p not in model)
