@@ -27,6 +27,7 @@ jax.config.update("jax_platforms", "cpu")
 from emqx_tpu.node import Node
 from emqx_tpu.cluster import Cluster
 from emqx_tpu.cluster_net import SocketTransport
+from emqx_tpu.modules.retainer import RetainerModule
 from emqx_tpu.types import Message
 
 
@@ -39,6 +40,7 @@ async def main():
     cookie = sys.argv[1]
     n = Node(name="nodeB", boot_listeners=False)
     await n.start()
+    ret = n.modules.load(RetainerModule)
     tr = SocketTransport("nodeB", cookie=cookie)
     tr.serve()
     cl = Cluster(n, transport=tr)
@@ -56,6 +58,9 @@ async def main():
         if parts[0] == "PUB":
             n.broker.publish(
                 Message(topic=parts[1], payload=parts[2].encode()))
+        elif parts[0] == "RETAINED?":
+            keys = ",".join(sorted(t for t, _ in ret.entries()))
+            print(f"RETAINED {keys or '-'}", flush=True)
         elif parts[0] == "QUIT":
             break
     await n.stop()
@@ -265,6 +270,9 @@ async def main():
         if parts[0] == "PUB":
             n.broker.publish(
                 Message(topic=parts[1], payload=parts[2].encode()))
+        elif parts[0] == "RETAINED?":
+            keys = ",".join(sorted(t for t, _ in ret.entries()))
+            print(f"RETAINED {keys or '-'}", flush=True)
         elif parts[0] == "QUIT":
             break
     await n.stop()
@@ -282,3 +290,50 @@ def _spawn_child2(cookie):
         [sys.executable, "-c", CHILD2, cookie],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL, env=env, cwd=REPO)
+
+
+async def test_retained_replicates_over_socket_transport():
+    """Retained store replication crosses the real wire: a retain on
+    the parent lands in the subprocess node's store (pickled Message
+    over the length-prefixed frame protocol), and a delete clears it."""
+    from emqx_tpu.modules.retainer import RetainerModule
+    from emqx_tpu.node import Node
+
+    cookie = "retain-net"
+    proc = _spawn_child(cookie)
+    try:
+        ready = await _read_line(proc, "READY")
+        child_port = int(ready.split()[1])
+
+        n = Node(name="nodeA", boot_listeners=False)
+        await n.start()
+        n.modules.load(RetainerModule)
+        tr = SocketTransport("nodeA", cookie=cookie)
+        tr.serve()
+        cl = Cluster(n, transport=tr)
+        cl.join_remote("127.0.0.1", child_port)
+
+        n.broker.publish(Message(topic="keep/me", payload=b"v",
+                                 flags={"retain": True}))
+        await asyncio.sleep(0.5)
+        proc.stdin.write(b"RETAINED?\n")
+        proc.stdin.flush()
+        line = await _read_line(proc, "RETAINED")
+        assert line == "RETAINED keep/me"
+
+        n.broker.publish(Message(topic="keep/me", payload=b"",
+                                 flags={"retain": True}))
+        await asyncio.sleep(0.5)
+        proc.stdin.write(b"RETAINED?\n")
+        proc.stdin.flush()
+        line = await _read_line(proc, "RETAINED")
+        assert line == "RETAINED -"
+
+        proc.stdin.write(b"QUIT\n")
+        proc.stdin.flush()
+        await n.stop()
+        tr.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
