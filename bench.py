@@ -334,7 +334,7 @@ def shared():
 
 def main():
     n_subs = int(os.environ.get("BENCH_SUBS", "1000000"))
-    batch = int(os.environ.get("BENCH_BATCH", "65536"))
+    batch = int(os.environ.get("BENCH_BATCH", "131072"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     k = int(os.environ.get("BENCH_K", "8"))
     m = int(os.environ.get("BENCH_M", "64"))
