@@ -241,6 +241,38 @@ def build_node(cfg: NodeConfig):
     return node
 
 
+def reload_zones(path: str, node=None) -> dict:
+    """Runtime zone reload (the reference's emqx_zone:force_reload:
+    re-copy config into the lock-free snapshot registry). Re-parses
+    the file, validates it in full, republishes every zone, and —
+    given a node — REBINDS running listeners to the new Zone objects
+    by name, so connections accepted from now on get the new limits.
+    Existing connections keep the snapshot they were built with (the
+    reference's semantics). Listener/cluster/module topology changes
+    require a restart and are ignored here.
+
+    Returns ``{"zones": [...], "listeners": [rebound...],
+    "stale": [...]}`` — ``stale`` lists previously published zones
+    the new file no longer defines (kept: a listener may still hold
+    them; the report makes the drift visible)."""
+    from emqx_tpu.zone import _zones
+
+    cfg = load_config(path)
+    for zone in cfg.zones.values():
+        set_zone(zone)
+    rebound = []
+    if node is not None:
+        for lst in node.listeners:
+            nz = cfg.zones.get(lst.zone.name)
+            if nz is not None and lst.zone is not nz:
+                lst.zone = nz
+                rebound.append(lst.name)
+    stale = sorted(n for n in _zones
+                   if n != "default" and n not in cfg.zones)
+    return {"zones": sorted(cfg.zones), "listeners": rebound,
+            "stale": stale}
+
+
 def boot_from_file(path: str):
     """Build a Node from a config file (listeners attached, not yet
     started): ``node = boot_from_file(path); await node.start()``."""

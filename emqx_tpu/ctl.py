@@ -65,6 +65,8 @@ class Ctl:
                               "list | add <kind> <value> [secs] | del <kind> <value>")
         self.register_command("checkpoint", self._checkpoint,
                               "save|load <path>")
+        self.register_command("reload", self._reload,
+                              "<config.toml> — re-publish zones")
         self.register_command("trace", self._trace,
                               "list | start client|topic <v> | stop client|topic <v>")
         self.register_command("vm", self._vm,
@@ -234,6 +236,19 @@ class Ctl:
             b.delete(args[1], args[2])
             return "ok"
         return "usage: banned list | add <kind> <value> [secs] | del <kind> <value>"
+
+    def _reload(self, args) -> str:
+        from emqx_tpu.config import reload_zones
+        if len(args) != 1:
+            return "usage: reload <config.toml>"
+        info = reload_zones(args[0], node=self.node)
+        out = f"zones reloaded: {', '.join(info['zones']) or '(none)'}"
+        if info["listeners"]:
+            out += f"; listeners rebound: {', '.join(info['listeners'])}"
+        if info["stale"]:
+            out += (f"; stale (no longer in config, kept): "
+                    f"{', '.join(info['stale'])}")
+        return out
 
     def _checkpoint(self, args) -> str:
         from emqx_tpu import checkpoint

@@ -321,3 +321,54 @@ async def test_python_m_emqx_tpu_boot_and_sigterm(tmp_path):
         if proc.returncode is None:
             proc.kill()
             await proc.wait()
+
+
+async def test_runtime_zone_reload_rebinds_listeners(tmp_path):
+    """`ctl reload <file>` republishes zones AND rebinds running
+    listeners: connections accepted after the reload get the new
+    limits; existing connections keep their snapshot (the reference's
+    emqx_zone:force_reload semantics)."""
+    from emqx_tpu.config import build_node, load_config
+    from emqx_tpu.zone import get_zone
+
+    cfg = tmp_path / "z.toml"
+    cfg.write_text(
+        '[zones.hot]\nmax_packet_size = 1024\n\n'
+        '[[listeners]]\ntype = "tcp"\nport = 0\nzone = "hot"\n')
+    node = build_node(load_config(str(cfg)))
+    await node.start()
+    try:
+        lst = node.listeners[0]
+        assert lst.zone.max_packet_size == 1024
+        from tests.mqtt_client import TestClient
+        old_conn = TestClient("old")
+        await old_conn.connect(port=lst.port)
+
+        cfg.write_text(
+            '[zones.hot]\nmax_packet_size = 2048\n\n'
+            '[[listeners]]\ntype = "tcp"\nport = 0\nzone = "hot"\n')
+        out = node.ctl.run(["reload", str(cfg)])
+        assert "hot" in out and "rebound" in out
+        assert lst.zone.max_packet_size == 2048
+        assert get_zone("hot").max_packet_size == 2048
+        # a NEW connection is built against the new zone
+        new_conn = TestClient("new")
+        await new_conn.connect(port=lst.port)
+        assert new_conn.connack.reason_code == 0
+        # the old connection kept its original snapshot
+        assert old_conn.connack is not None
+        # a broken file is rejected whole, zones untouched
+        cfg.write_text('[zones.hot]\nno_such_setting = 1\n')
+        out = node.ctl.run(["reload", str(cfg)])
+        assert "error" in out.lower()
+        assert get_zone("hot").max_packet_size == 2048
+        # a zone removed from the file is reported stale
+        cfg.write_text(
+            '[zones.other]\nmax_inflight = 5\n\n'
+            '[[listeners]]\ntype = "tcp"\nport = 0\nzone = "other"\n')
+        out = node.ctl.run(["reload", str(cfg)])
+        assert "stale" in out and "hot" in out
+        old_conn.writer.close()
+        new_conn.writer.close()
+    finally:
+        await node.stop()
