@@ -329,3 +329,27 @@ async def test_qos_downgraded_to_sub_qos():
         assert msg.qos == 0
         await sub.disconnect()
         await pub.disconnect()
+
+
+async def test_near_limit_payloads_through_batched_pipeline():
+    """900KB payloads ride the ingress batcher / device pipeline
+    intact; a payload over the zone's max_packet_size kills the
+    connection (frame-too-large) instead of being delivered."""
+    from tests.helpers import broker_node, node_port
+
+    async with broker_node() as node:
+        sub = TestClient("big-sub", version=5)
+        await sub.connect(port=node_port(node))
+        await sub.subscribe("big/#", qos=1)
+        pub = TestClient("big-pub", version=5)
+        await pub.connect(port=node_port(node))
+        payload = bytes(900_000)
+        for i in range(3):
+            await pub.publish(f"big/{i}", payload, qos=1, timeout=60)
+        for _ in range(3):
+            m = await asyncio.wait_for(sub.recv(), 20)
+            assert len(m.payload) == 900_000
+        with pytest.raises(asyncio.TimeoutError):
+            await pub.publish("big/over", bytes(1_100_000), qos=1,
+                              timeout=3)
+        await sub.disconnect()

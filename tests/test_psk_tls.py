@@ -249,3 +249,40 @@ async def test_bad_key_gets_tls_alert_not_bare_close():
             "failed" in str(ei.value).lower()
     finally:
         await n.stop()
+
+
+async def test_concurrent_psk_handshakes_and_traffic():
+    """Many simultaneous PSK handshakes against one shared SSL_CTX,
+    then a fan-out delivery across all of them."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from mqtt_client import TestClient
+
+    from emqx_tpu.mqtt import constants as C
+    from emqx_tpu.node import Node
+    from emqx_tpu.tls import TlsOptions
+
+    n = Node(boot_listeners=False)
+    auth = PskAuth(n.hooks, keys={
+        f"d{i}": f"k{i}".encode() for i in range(20)})
+    lst = n.add_tls_listener(port=0, tls_options=TlsOptions(psk=auth))
+    await n.start()
+    try:
+        async def one(i):
+            r, w = await open_psk_connection(
+                "127.0.0.1", lst.port, f"d{i}", f"k{i}".encode())
+            c = TestClient(f"c{i}", version=C.MQTT_V4)
+            await c.connect_over(r, w)
+            await c.subscribe("st/all")
+            return c, w
+
+        clients = await asyncio.gather(*(one(i) for i in range(20)))
+        await clients[0][0].publish("st/all", b"fanout", qos=1)
+        for c, _ in clients:
+            m = await asyncio.wait_for(c.recv(), 10)
+            assert m.payload == b"fanout"
+        for _, w in clients:
+            w.close()
+    finally:
+        await n.stop()
