@@ -111,6 +111,48 @@ def _first_leaf(out):
     return _jax.tree_util.tree_leaves(out)[0]
 
 
+TPU_LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_TPU_LAST.json")
+
+
+def _emit(rec: dict) -> None:
+    """Print the headline JSON line; when the run executed on a real
+    accelerator (not the CPU fallback), persist it into the last-good
+    TPU artifact so a chip that wedges later can't erase the
+    evidence (VERDICT r2: a CPU fallback once impersonated a TPU
+    number because nothing staged successful runs)."""
+    try:
+        import jax as _jax
+
+        plat = _jax.default_backend()
+    except Exception:
+        plat = "unknown"
+    rec["platform"] = plat
+    if plat not in ("cpu", "unknown"):
+        try:
+            existing = {}
+            if os.path.exists(TPU_LAST_PATH):
+                with open(TPU_LAST_PATH) as f:
+                    existing = json.load(f)
+            existing[rec["metric"]] = dict(
+                rec, ts=time.strftime("%Y-%m-%dT%H:%M:%S%z"))
+            tmp = TPU_LAST_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(existing, f, indent=1, sort_keys=True)
+            os.replace(tmp, TPU_LAST_PATH)
+        except Exception:
+            pass  # persistence must never break the bench line
+    print(json.dumps(rec), flush=True)
+
+
+def _last_good_tpu(metric: str):
+    try:
+        with open(TPU_LAST_PATH) as f:
+            return json.load(f).get(metric)
+    except Exception:
+        return None
+
+
 def _latency_pass(step, batches, iters: int = 20):
     """p50/p99 per-batch latency (ms): run ``step`` synchronously,
     forcing completion with a result READBACK per call. On this
@@ -235,7 +277,7 @@ def bigfan():
         "device": str(jax.devices()[0]),
         "window_batches": [round(r, 1) for r in rates],
     }), file=sys.stderr, flush=True)
-    print(json.dumps({
+    _emit({
         "metric": "bigfan_bitmap_deliveries",
         "value": round(deliveries_per_s, 1),
         "unit": "deliveries/sec",
@@ -243,7 +285,7 @@ def bigfan():
         "vs_baseline": round(deliveries_per_s / 1_000_000, 3),
         "p50_batch_ms": round(p50, 3),
         "p99_batch_ms": round(p99, 3),
-    }), flush=True)
+    })
 
 
 def shared():
@@ -322,14 +364,14 @@ def shared():
         "device": str(jax.devices()[0]),
         "window_mmsgs": [round(r / 1e6, 2) for r in rates],
     }), file=sys.stderr, flush=True)
-    print(json.dumps({
+    _emit({
         "metric": "shared_dispatch_throughput",
         "value": round(throughput, 1),
         "unit": "msgs/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
         "p50_batch_ms": round(p50, 3),
         "p99_batch_ms": round(p99, 3),
-    }), flush=True)
+    })
 
 
 def main():
@@ -456,21 +498,21 @@ def main():
     }
     import sys
     print(json.dumps(info), file=sys.stderr, flush=True)
-    print(json.dumps({
+    _emit({
         "metric": "publish_match_fanout_throughput",
         "value": round(throughput, 1),
         "unit": "msgs/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
         "p50_batch_ms": round(p50, 3),
         "p99_batch_ms": round(p99, 3),
-    }), flush=True)
+    })
 
 
 def live():
     """BENCH_MODE=live — socket-to-deliver over loopback TCP through
     the full broker stack (see emqx_tpu/bench_live.py)."""
     from emqx_tpu.bench_live import live as _live
-    _live()
+    _live(emit=_emit)
 
 
 def sharded():
@@ -531,14 +573,14 @@ def sharded():
         "window_mmsgs": [round(w / 1e6, 2) for w in windows],
     }
     print(json.dumps(info), file=sys.stderr, flush=True)
-    print(json.dumps({
+    _emit({
         "metric": "sharded_match_throughput",
         "value": round(thr, 1),
         "unit": "msgs/sec",
         "vs_baseline": round(thr / 1e6, 3),
         "p50_batch_ms": round(p50, 3),
         "p99_batch_ms": round(p99, 3),
-    }), flush=True)
+    })
 
 
 def churn():
@@ -623,7 +665,7 @@ def churn():
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(info), file=sys.stderr, flush=True)
-    print(json.dumps({
+    _emit({
         "metric": "churn_match_p99_ms",
         "value": round(p99_churn, 3),
         "unit": "ms",
@@ -631,7 +673,7 @@ def churn():
         if p99_churn > 0 else 0.0,
         "p50_batch_ms": round(p50_churn, 3),
         "p99_batch_ms": round(p99_churn, 3),
-    }), flush=True)
+    })
 
 
 # mode -> (entry fn name, success-path metric name, unit); the
@@ -671,6 +713,17 @@ def _cpu_fallback_record(metric: str, tpu_error: str):
         rec = json.loads(line)
         if rec.get("metric") != metric or "error" in rec:
             return None
+        # the CPU figure must not impersonate a TPU result: `value`
+        # nulls out, the measurement moves to cpu_* fields, and the
+        # last driver-witnessed TPU record (if any) rides along
+        rec["cpu_value"] = rec.pop("value", None)
+        rec["cpu_vs_baseline"] = rec.pop("vs_baseline", None)
+        if "p50_batch_ms" in rec:
+            rec["cpu_p50_batch_ms"] = rec.pop("p50_batch_ms")
+        if "p99_batch_ms" in rec:
+            rec["cpu_p99_batch_ms"] = rec.pop("p99_batch_ms")
+        rec["value"] = None
+        rec["vs_baseline"] = None
         rec["platform_fallback"] = "cpu"
         rec["tpu_error"] = tpu_error[:300]
         return rec
@@ -695,11 +748,14 @@ if __name__ == "__main__":
         if _rec is None:
             _rec = {
                 "metric": _metric,
-                "value": 0.0,
+                "value": None,
                 "unit": _unit,
-                "vs_baseline": 0.0,
+                "vs_baseline": None,
                 "error": repr(_e)[:300],
             }
+        _last = _last_good_tpu(_metric)
+        if _last is not None:
+            _rec["last_good_tpu"] = _last
         print(json.dumps(_rec), flush=True)
         sys.stdout.flush()
         sys.stderr.flush()

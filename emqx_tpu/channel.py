@@ -459,13 +459,19 @@ class Channel:
         try:
             if t == C.PUBACK:
                 self.broker.metrics.inc("packets.puback.received")
-                self.session.puback(pkt.packet_id)
+                msg = self.session.puback(pkt.packet_id)
                 self.broker.metrics.inc("messages.acked")
+                # reference: emqx_channel.erl:300-323
+                # (after_message_acked on PUBACK/PUBREC)
+                self.broker.hooks.run(
+                    "message.acked", (dict(self.clientinfo), msg))
             elif t == C.PUBREC:
                 self.broker.metrics.inc("packets.pubrec.received")
                 try:
-                    self.session.pubrec(pkt.packet_id)
+                    msg = self.session.pubrec(pkt.packet_id)
                     rc = RC.SUCCESS
+                    self.broker.hooks.run(
+                        "message.acked", (dict(self.clientinfo), msg))
                 except SessionError as e:
                     self.broker.metrics.inc(
                         "packets.pubrec.inuse"

@@ -126,7 +126,15 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
         router._free_ids = [i for i, f
                             in enumerate(router._id_to_filter)
                             if f is None]
+        # a snapshot taken under a different node name must not
+        # replay that name as a remote dest (everything would forward
+        # to a nonexistent peer): dests equal to the SAVED node remap
+        # to the restoring router's own name
+        saved_node = meta.get("node")
+        self_node = str(router.node)
         for flt, kind, group, node, refs in routes:
+            if node == saved_node:
+                node = self_node
             dest = (group, node) if kind == "s" else node
             for _ in range(int(refs)):
                 router.add_route(flt, dest=dest)

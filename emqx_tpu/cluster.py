@@ -128,7 +128,8 @@ class Cluster:
         # loaded, now or later) broadcasts its stores/deletes (the
         # reference plugin replicates via Mnesia)
         node.retain_replicate = (
-            lambda topic, msg: self._broadcast("retain_set", topic, msg))
+            lambda topic, msg, ts=None: self._broadcast(
+                "retain_set", topic, msg, ts))
         if isinstance(self.transport, LocalTransport):
             self.transport.register(self.name, self)
         elif hasattr(self.transport, "cluster"):
@@ -499,7 +500,8 @@ class Cluster:
         if op == "retain_set":
             ret = self._retainer()
             if ret is not None:
-                ret.apply_remote(args[0], args[1])
+                ret.apply_remote(args[0], args[1],
+                                 ts=args[2] if len(args) > 2 else None)
             return None
         if op == "retain_sync":
             ret = self._retainer()

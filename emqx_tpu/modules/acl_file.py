@@ -60,6 +60,13 @@ def parse_acl_file(text: str) -> List[tuple]:
         out, i, in_str = [], 0, False
         while i < len(line):
             ch = line[i]
+            if in_str and ch == "\\" and i + 1 < len(line):
+                # escaped char inside a string (e.g. \") must not
+                # toggle string tracking or start a comment
+                out.append(ch)
+                out.append(line[i + 1])
+                i += 2
+                continue
             if ch == '"':
                 in_str = not in_str
             if ch == "%" and not in_str:

@@ -70,8 +70,12 @@ class RetainerModule(Module):
         if not msg.payload:
             if self._store.pop(msg.topic, None) is not None:
                 self.node.metrics.dec("retained.count")
-                self._tombstones[msg.topic] = msg.timestamp
-                self._replicate(msg.topic, None)
+                # monotone like apply_remote/apply_tombstone: a local
+                # delete must not move an (ahead-clock) peer's
+                # tombstone backwards
+                self._tombstones[msg.topic] = max(
+                    self._tombstones.get(msg.topic, 0.0), msg.timestamp)
+                self._replicate(msg.topic, None, msg.timestamp)
             return None
         if len(msg.payload) > self.max_payload or (
                 msg.topic not in self._store
@@ -84,12 +88,13 @@ class RetainerModule(Module):
         self._replicate(msg.topic, self._store[msg.topic])
         return None  # the message still routes normally
 
-    def _replicate(self, topic: str, msg) -> None:
+    def _replicate(self, topic: str, msg, ts: float = None) -> None:
         fn = getattr(self.node, "retain_replicate", None)
         if fn is not None:
-            fn(topic, msg)
+            fn(topic, msg, ts)
 
-    def apply_remote(self, topic: str, msg, sync: bool = False) -> None:
+    def apply_remote(self, topic: str, msg, sync: bool = False,
+                     ts: float = None) -> None:
         """A peer's store/delete (idempotent, never re-broadcast).
 
         LIVE replication (``sync=False``) applies in arrival order —
@@ -101,13 +106,24 @@ class RetainerModule(Module):
         tombstones, so a rejoiner's stale snapshot can neither
         clobber newer values nor resurrect deletions."""
         if msg is None:
-            import time as _time
-
             if self._store.pop(topic, None) is not None:
                 self.node.metrics.dec("retained.count")
-            self._tombstones[topic] = _time.time()
+            # tombstone carries the DELETING message's origin
+            # timestamp (not local wall-clock) so join-sync LWW stays
+            # consistent under clock skew; monotone like apply_tombstone
+            if ts is None:
+                import time as _time
+
+                ts = _time.time()
+            self._tombstones[topic] = max(
+                self._tombstones.get(topic, 0.0), ts)
             return
         if msg.is_expired():
+            return
+        if len(msg.payload) > self.max_payload:
+            # same bound on_publish enforces — a peer with a larger
+            # limit must not replicate oversize payloads into ours
+            self.node.metrics.inc("retained.dropped")
             return
         if sync:
             tomb = self._tombstones.get(topic)

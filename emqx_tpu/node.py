@@ -47,7 +47,8 @@ class Node:
                  load_default_modules: bool = False,
                  batch_ingress: bool = True,
                  batch_size: int = 256,
-                 batch_linger_ms: float = 0.0) -> None:
+                 batch_linger_ms: float = 0.0,
+                 plugin_config_dir: Optional[str] = None) -> None:
         self.name = name
         self.zone = zone or get_zone()
         # kernel services (emqx_kernel_sup)
@@ -84,7 +85,7 @@ class Node:
         self.global_gc = GlobalGc()
         # extension system
         self.modules = ModuleRegistry(self)
-        self.plugins = Plugins(self)
+        self.plugins = Plugins(self, config_dir=plugin_config_dir)
         self.ctl = Ctl(self)
         self.listeners: List[Listener] = []
         self.boot_listeners = boot_listeners

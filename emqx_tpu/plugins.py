@@ -5,13 +5,20 @@ Mirrors ``src/emqx_plugins.erl``: a reference plugin is an OTP app
 carrying an ``-emqx_plugin`` attribute (:133); here a plugin is any
 Python object/class exposing ``name``, ``load(node, env)`` and
 ``unload(node)`` — registered programmatically or discovered from a
-module path string ("pkg.mod:PluginClass")."""
+module path string ("pkg.mod:PluginClass").
+
+Per-plugin config (emqx_plugins.erl:51-59,180-191 renders each
+plugin's own ``etc/<name>.conf`` into its app env before load): with
+a ``config_dir`` set, ``load(name)`` reads ``<config_dir>/<name>.toml``
+and passes it as the plugin's env, with any explicitly passed env
+keys overriding the file's."""
 
 from __future__ import annotations
 
 import importlib
 import json
 import os
+import tomllib
 from typing import Dict, List, Optional
 
 
@@ -26,9 +33,11 @@ class Plugin:
 
 
 class Plugins:
-    def __init__(self, node, state_file: Optional[str] = None) -> None:
+    def __init__(self, node, state_file: Optional[str] = None,
+                 config_dir: Optional[str] = None) -> None:
         self.node = node
         self.state_file = state_file
+        self.config_dir = config_dir
         self._known: Dict[str, Plugin] = {}
         self._loaded: Dict[str, Plugin] = {}
 
@@ -47,13 +56,26 @@ class Plugins:
 
     # -- lifecycle (emqx_plugins:load/unload/list) ------------------------
 
+    def plugin_config(self, name: str) -> dict:
+        """The plugin's own config file (``<config_dir>/<name>.toml``),
+        or {} when absent."""
+        if not self.config_dir:
+            return {}
+        path = os.path.join(self.config_dir, f"{name}.toml")
+        if not os.path.exists(path):
+            return {}
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+
     def load(self, name: str, env: Optional[dict] = None) -> bool:
         if name in self._loaded:
             return False  # already_started
         plugin = self._known.get(name)
         if plugin is None:
             raise KeyError(f"plugin not found: {name}")
-        plugin.load(self.node, env or {})
+        merged = self.plugin_config(name)
+        merged.update(env or {})
+        plugin.load(self.node, merged)
         self._loaded[name] = plugin
         self._persist()
         return True

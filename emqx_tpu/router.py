@@ -598,7 +598,8 @@ class Router:
         ids, n, sysm = place_batch(mesh, ids, n, sysm)
         all_ids, _subs, ovf, stats = publish_step(
             mesh, auto, self._dummy_fan, ids, n, sysm,
-            k=cfg.active_k, m=cfg.max_matches, d=8, with_fanout=False)
+            k=self.effective_k(), m=cfg.max_matches, d=8,
+            with_fanout=False)
         self._dev_stats.append(stats)
         return all_ids, ovf, id_map, epoch
 

@@ -141,3 +141,22 @@ def test_broker_end_to_end_after_restore(tmp_path):
     b2.subscribe(s2, "e2e/+")  # refcount bumps on the restored route
     assert b2.publish(Message(topic="e2e/y")) == 1
     assert s2.got == [("e2e/+", "e2e/y")]
+
+
+def test_restore_remaps_saved_node_name(tmp_path):
+    """A snapshot restored under a DIFFERENT node name must not
+    replay the saved name as a remote dest (everything would forward
+    to a nonexistent peer): saved-node dests remap to the restoring
+    router's own name."""
+    r1 = _mk()
+    _fill(r1)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(r1, path)
+    r2 = Router(MatcherConfig(device_min_filters=0), node="renamed")
+    checkpoint.load(r2, path)
+    for rt in r2.match_routes("a/b"):
+        if not isinstance(rt.dest, tuple):
+            assert rt.dest == "renamed"
+    # the shared route's node remaps too; its group is untouched
+    dests = {rt.dest for rt in r2.lookup_routes("a/+")}
+    assert ("g1", "n2") in dests and "renamed" in dests and "n1" not in dests

@@ -393,3 +393,85 @@ def test_acl_file_module_loads_from_file(tmp_path):
                        "publish", "secret/x", None)
     from emqx_tpu.access_control import ALLOW
     assert ok == (STOP, ALLOW)
+
+
+def test_acl_conf_escaped_quote_in_string():
+    """An escaped quote inside a string must not desync the comment
+    stripper — a later '%' inside the same string is content, not a
+    comment (regression: advisor round-2 finding)."""
+    from emqx_tpu.modules.acl_file import parse_acl_file
+
+    rules = parse_acl_file(
+        '{allow, {user, "a\\"b%c"}, publish, ["t/1"]}.\n')
+    assert rules == [("allow", ("user", 'a"b%c'), "publish", ["t/1"])]
+    # and %% after a closed string still comments
+    rules = parse_acl_file(
+        '{allow, {user, "u"}, publish, ["t/2"]}. %% tail comment\n')
+    assert rules == [("allow", ("user", "u"), "publish", ["t/2"])]
+
+
+def test_plugin_config_file_merged(tmp_path):
+    """With a config_dir, load(name) reads <name>.toml as the
+    plugin's env; explicitly passed env keys override the file's
+    (emqx_plugins.erl:51-59 renders per-plugin config before load)."""
+    from emqx_tpu.plugins import Plugin
+
+    class P(Plugin):
+        name = "demo"
+
+        def load(self, node, env):
+            self.env = env
+
+        def unload(self, node):
+            pass
+
+    (tmp_path / "demo.toml").write_text(
+        'answer = 42\nlabel = "from-file"\n')
+    n = Node(boot_listeners=False)
+    n.plugins.config_dir = str(tmp_path)
+    p = P()
+    n.plugins.register(p)
+    n.plugins.load("demo", env={"label": "override"})
+    assert p.env == {"answer": 42, "label": "override"}
+    # absent file: env passes through untouched
+    n.plugins.unload("demo")
+    n.plugins.config_dir = str(tmp_path / "nope")
+    n.plugins.load("demo", env={"k": 1})
+    assert p.env == {"k": 1}
+
+
+async def test_message_acked_hook_fires_on_puback_and_pubrec():
+    """'message.acked' fires once per QoS1 PUBACK and QoS2 PUBREC
+    with (clientinfo, message) — emqx_channel.erl:300-323."""
+    from emqx_tpu.mqtt import constants as C
+    from tests.mqtt_client import TestClient
+
+    n = Node(boot_listeners=False)
+    lst = n.add_listener(port=0)
+    await n.start()
+    acked = []
+    n.hooks.add("message.acked",
+                lambda ci, msg: acked.append((ci["clientid"],
+                                              msg.topic, msg.qos)))
+    try:
+        sub = TestClient("ack-sub", version=C.MQTT_V5)
+        await sub.connect(port=lst.port)
+        await sub.subscribe("ack/q1", qos=1)
+        await sub.subscribe("ack/q2", qos=2)
+        pub = TestClient("ack-pub", version=C.MQTT_V5)
+        await pub.connect(port=lst.port)
+        await pub.publish("ack/q1", b"x", qos=1)
+        await pub.publish("ack/q2", b"y", qos=2)
+        for _ in range(2):
+            await sub.recv(5)  # auto-acks (PUBACK / PUBREC+PUBCOMP)
+        for _ in range(100):
+            if len(acked) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert ("ack-sub", "ack/q1", 1) in acked
+        assert ("ack-sub", "ack/q2", 2) in acked
+        assert len(acked) == 2
+        await pub.close()
+        await sub.close()
+    finally:
+        await n.stop()

@@ -228,3 +228,42 @@ def test_apply_remote_timestamp_lww_and_expiry():
                          timestamp=_t.time() - 50)
     mod.apply_tombstone("z2", _t.time())
     assert "z2" not in mod._store
+
+
+def test_remote_delete_uses_origin_timestamp():
+    """A replicated delete carries the DELETING message's timestamp;
+    the receiver's tombstone must use it (not local wall-clock), so
+    join-sync LWW stays consistent under clock skew."""
+    import time as _t
+
+    from emqx_tpu.types import Message as M
+
+    n = Node(boot_listeners=False)
+    mod = n.modules.load(RetainerModule)
+    t_del = _t.time() - 300  # deleting node's clock is 5 min behind
+    mod.apply_remote("t", None, ts=t_del)
+    assert mod._tombstones["t"] == t_del
+    # a value newer than the (old-clock) delete survives join sync
+    newer = M(topic="t", payload=b"survives", flags={"retain": True})
+    mod.apply_remote("t", newer, sync=True)
+    assert mod._store["t"].payload == b"survives"
+    # tombstones stay monotone: an older delete ts can't move it back
+    mod.apply_remote("t2", None, ts=100.0)
+    mod.apply_remote("t2", None, ts=50.0)
+    assert mod._tombstones["t2"] == 100.0
+
+
+def test_apply_remote_enforces_max_payload():
+    """A peer with a larger payload limit must not replicate
+    oversize messages into this node's store."""
+    from emqx_tpu.types import Message as M
+
+    n = Node(boot_listeners=False)
+    mod = n.modules.load(RetainerModule, env={"max_payload": 8})
+    big = M(topic="big", payload=b"x" * 9, flags={"retain": True})
+    mod.apply_remote("big", big)
+    assert "big" not in mod._store
+    assert n.metrics.val("retained.dropped") == 1
+    ok = M(topic="ok", payload=b"x" * 8, flags={"retain": True})
+    mod.apply_remote("ok", ok)
+    assert mod._store["ok"].payload == b"x" * 8
