@@ -32,7 +32,7 @@ from emqx_tpu.metrics import Metrics
 from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
 from emqx_tpu.ops.fanout import expand_packed
 from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_rows,
-                               pack_matches, pack_union_rows)
+                               pack_fanout, pack_matches, pack_union_rows)
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.shared_sub import SharedSub
 from emqx_tpu.types import Message, SubOpts
@@ -61,6 +61,7 @@ class PendingBatch:
         "m_ptr_d", "ids_packed_d",
         "f_ptr_d", "subs_packed_d", "src_packed_d",
         "bovf_d", "sel_d", "rows_packed_d", "bm_total_d",
+        "subs_dense_d", "src_dense_d", "sh_big", "movf_d", "movf",
         "m_ptr", "ids_packed", "ovf",
         "f_ptr", "subs_packed", "src_packed",
         "bovf", "sel", "rows_packed",
@@ -80,6 +81,13 @@ class PendingBatch:
         self.subs_packed_d = self.src_packed_d = None
         self.bovf_d = self.sel_d = self.rows_packed_d = None
         self.bm_total_d = None
+        # mesh path: dense gathered (subs, src) kept for re-pack, the
+        # big-filter ids the device gather excluded (host tail), and
+        # the match-only overflow (the boost_k signal — fan overflow
+        # must not grow k)
+        self.subs_dense_d = self.src_dense_d = None
+        self.sh_big: frozenset = frozenset()
+        self.movf_d = self.movf = None
         self.f_ptr = self.subs_packed = None
         self.src_packed = None
         self.bovf = self.sel = self.rows_packed = None
@@ -302,6 +310,8 @@ class Broker:
         # expands per message via the inverse index.
         uniq, pb.inv = dedup_topics(topics)
         pb.n_uniq = len(uniq)
+        if cfg.mesh is not None:
+            return self._publish_begin_mesh(pb, uniq, cfg)
         pb.ids_dev, pb.ovf_dev, pb.id_map, pb.epoch = \
             self.router.match_dispatch(uniq)
         # phantom pad-row matches (wildcards match the pad topic) must
@@ -330,6 +340,42 @@ class Broker:
             has_big = (rows_d >= 0).any(axis=1)
             pb.sel_d, pb.rows_packed_d, pb.bm_total_d = pack_union_rows(
                 union_d, has_big, pr=budgets[2])
+        return pb
+
+    def _publish_begin_mesh(self, pb: PendingBatch, uniq: List[str],
+                            cfg) -> PendingBatch:
+        """Mesh publish dispatch: ONE collective step does match +
+        per-shard subscriber gather + ICI all-gather
+        (``publish_step(with_fanout=True)`` with the FanoutManager's
+        per-shard tables); the dense gathered (subs, src) then pack
+        on device for the coalesced fetch. Filters too big for the
+        ``d`` bound deliver host-side from ``pb.sh_big``."""
+        def fan_provider(epoch, id_map):
+            st = self.helper.sharded_state(epoch, id_map, cfg.mesh,
+                                           cfg.fanout_d)
+            if st is None:
+                return None, frozenset()
+            return st.fan, st.big_fids
+
+        (pb.ids_dev, subs_d, src_d, pb.ovf_dev, pb.movf_d, pb.id_map,
+         pb.epoch, pb.sh_big) = self.router.publish_dispatch_sharded(
+            uniq, fan_provider)
+        n_uniq = np.int32(pb.n_uniq)
+        pb.ids_dev = mask_pad_rows(pb.ids_dev, n_uniq)
+        bucket = pb.ids_dev.shape[0]
+        budgets = self._pack_budgets.setdefault(
+            bucket, [budget_for(bucket, self.router.config.pack_m),
+                     budget_for(bucket, self.router.config.pack_q),
+                     max(1, self.router.config.pack_rows)])
+        pb.pm = budgets[0]
+        pb.m_ptr_d, pb.ids_packed_d = pack_matches(pb.ids_dev, pm=pb.pm)
+        if subs_d is not None:
+            # phantom pad-row deliveries masked like the match ids
+            pb.subs_dense_d = mask_pad_rows(subs_d, n_uniq)
+            pb.src_dense_d = mask_pad_rows(src_d, n_uniq)
+            pb.pq = budgets[1]
+            pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
+                pack_fanout(pb.subs_dense_d, pb.src_dense_d, pq=pb.pq)
         return pb
 
     def _publish_host(self, pb: PendingBatch, topics: List[str]) -> None:
@@ -366,6 +412,8 @@ class Broker:
             # ONE device buffer → ONE transfer (the host link charges
             # per-buffer round-trip latency; see ops/pack.bundle_i32)
             fetch = [pb.m_ptr_d, pb.ids_packed_d, pb.ovf_dev]
+            if pb.movf_d is not None:
+                fetch += [pb.movf_d]
             if pb.f_ptr_d is not None:
                 fetch += [pb.f_ptr_d, pb.subs_packed_d,
                           pb.src_packed_d]
@@ -384,6 +432,8 @@ class Broker:
             m_ptr = take(Bp + 1)
             ids_packed = take(pb.pm)
             ovf = take(Bp).astype(bool)
+            movf = take(Bp).astype(bool) if pb.movf_d is not None \
+                else None
             if pb.f_ptr_d is not None:
                 f_ptr = take(Bp + 1)
                 subs_p = take(pb.pq)
@@ -411,16 +461,25 @@ class Broker:
                     pb.ids_dev, pm=pb.pm)
                 m_repacked = True
                 retry = True
-            if f_ptr is not None and (m_repacked
-                                      or int(f_ptr[-1]) > pb.pq):
+            mesh_fan = pb.subs_dense_d is not None
+            if f_ptr is not None and (
+                    (m_repacked and not mesh_fan)
+                    or int(f_ptr[-1]) > pb.pq):
                 # a truncated match pack also truncates the expansion
+                # (single-chip only: the mesh fan packs from the dense
+                # gathered arrays, independent of the match pack)
                 while pb.pq < int(f_ptr[-1]):
                     pb.pq *= 2
                 if budgets is not None:
                     budgets[1] = max(budgets[1], pb.pq)
-                pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d, _t = \
-                    expand_packed(pb.st.fan, pb.m_ptr_d,
-                                  pb.ids_packed_d, q=pb.pq)
+                if mesh_fan:
+                    pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
+                        pack_fanout(pb.subs_dense_d, pb.src_dense_d,
+                                    pq=pb.pq)
+                else:
+                    pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d, _t = \
+                        expand_packed(pb.st.fan, pb.m_ptr_d,
+                                      pb.ids_packed_d, q=pb.pq)
                 retry = True
             if bm_total is not None and int(bm_total) > pb.rows_packed_d.shape[0]:
                 rows_d, pb.bovf_d = rows_for_matches(
@@ -438,12 +497,16 @@ class Broker:
             if retry:
                 continue
             # adaptive capacity: a batch where >1/8 of the unique
-            # topics overflowed a bound means the bound undersizes
+            # topics overflowed the MATCH bound means K undersizes
             # the live workload — grow for the NEXT batch (this one
-            # already has its exact host fallback)
+            # already has its exact host fallback). On the mesh the
+            # combined ovf includes fan-out d overflow, which k
+            # cannot fix — only the match-only flag may boost
             n_u = max(1, pb.n_uniq)
-            if int(ovf[:n_u].sum()) * 8 > n_u:
+            k_ovf = movf if movf is not None else ovf
+            if int(k_ovf[:n_u].sum()) * 8 > n_u:
                 self.router.boost_k()
+            pb.movf = movf
             pb.m_ptr = m_ptr
             # slice to true occupancy before the per-element list
             # conversion — the budget tail is dead -1 padding
@@ -549,7 +612,7 @@ class Broker:
         union rows) instead of the ``_subscribers`` dicts."""
         def local_deliver(local_filters: List[str]) -> int:
             overflowed = (pb.bovf is not None and pb.bovf[row]) \
-                or pb.st is None
+                or (pb.st is None and pb.f_ptr is None)
             if overflowed:
                 # per-message capacity exceeded: host dispatch loop
                 return sum(self.dispatch(flt, msg)
@@ -573,6 +636,14 @@ class Broker:
                 n += cnt
                 self.metrics.inc("messages.delivered", cnt)
                 self.hooks.run("message.delivered", (msg, cnt))
+            if pb.sh_big:
+                # mesh path: filters too big for the device gather's
+                # d bound deliver through the host dispatch loop
+                for j in row_ids:
+                    if j in pb.sh_big:
+                        flt = id_map[j]
+                        if flt is not None:
+                            n += self.dispatch(flt, msg)
             return n
 
         return self._route(filters, msg, local_deliver=local_deliver)

@@ -119,6 +119,26 @@ class FanoutState:
         self.big_fids = big_fids  # snapshot fids on the bitmap path
 
 
+class ShardedFanoutState:
+    """Per-trie-shard fan tables for the mesh publish step: the
+    device half is a stacked ``ShardedFanout`` (shard t's CSR holds
+    only the filters :func:`~emqx_tpu.parallel.sharded.shard_of`
+    assigns to t — the same stable assignment the sharded automaton
+    uses, so each trie shard gathers exactly its own matches'
+    subscribers); ``big_fids`` are the filters excluded from the
+    device gather (membership larger than the per-topic ``d`` bound),
+    delivered host-side by the broker's tail."""
+
+    __slots__ = ("epoch", "version", "fan", "big_fids")
+
+    def __init__(self, epoch: int, version: int, fan,
+                 big_fids: frozenset) -> None:
+        self.epoch = epoch
+        self.version = version
+        self.fan = fan
+        self.big_fids = big_fids
+
+
 class FanoutManager:
     """Host truth for local subscriber sets + lazy device tables.
 
@@ -136,9 +156,12 @@ class FanoutManager:
         self._lock = threading.RLock()
         self._version = 0
         self._state: Optional[FanoutState] = None
+        self._sharded: Optional[ShardedFanoutState] = None
         # capacity retention (pow2, never shrinks → stable jit shapes)
         self._caps: Dict[str, Optional[int]] = {
             "filter": None, "entry": None, "row": None, "nsub": 1}
+        self._sh_caps: Dict[str, Optional[int]] = {
+            "filter": None, "entry": None}
 
     # -- membership (called from Broker.subscribe/unsubscribe) ------------
 
@@ -232,6 +255,56 @@ class FanoutManager:
             self._state = st
             # the previous state (the last table referencing any
             # quarantined sid) is gone; freed ids may recycle now
+            self.registry.flush_free()
+            return st
+
+    def sharded_state(self, epoch: int,
+                      id_map: Sequence[Optional[str]],
+                      mesh, d: int) -> Optional[ShardedFanoutState]:
+        """Per-shard device fan tables consistent with the automaton
+        snapshot, for ``publish_step(with_fanout=True)`` (the mesh
+        analogue of :meth:`state`). Filters whose membership exceeds
+        ``min(threshold, d)`` go to ``big_fids`` — materializing them
+        in the ``d``-bounded gather would overflow every batch."""
+        from emqx_tpu.parallel.sharded import (build_sharded_fanout,
+                                               place_sharded, shard_of)
+
+        n_shards = mesh.shape["trie"]
+        with self._lock:
+            st = self._sharded
+            if (st is not None and st.epoch == epoch
+                    and st.version == self._version):
+                return st
+            if not self.rows:
+                self._sharded = None
+                self.registry.flush_free()
+                return None
+            limit = min(self.threshold, d)
+            rows_per_shard: List[Dict[int, List[int]]] = [
+                {} for _ in range(n_shards)]
+            big_fids = set()
+            for fid, f in enumerate(id_map):
+                if f is None:
+                    continue
+                row = self.rows.get(f)
+                if not row:
+                    continue
+                if len(row) > limit:
+                    big_fids.add(fid)
+                else:
+                    rows_per_shard[shard_of(f, n_shards)][fid] = \
+                        sorted(row)
+            fan = build_sharded_fanout(
+                rows_per_shard, len(id_map),
+                filter_capacity=self._sh_caps["filter"],
+                entry_capacity=self._sh_caps["entry"])
+            self._sh_caps["filter"] = fan.row_ptr.shape[1] - 1
+            self._sh_caps["entry"] = fan.sub_ids.shape[1]
+            if self.use_device:
+                fan = place_sharded(mesh, fan)
+            st = ShardedFanoutState(epoch, self._version, fan,
+                                    frozenset(big_fids))
+            self._sharded = st
             self.registry.flush_free()
             return st
 

@@ -247,16 +247,37 @@ class AutoPatcher:
             "partial mutations must not reach the device (re-flatten)"
         if not self.dirty:
             return auto
+        for chunk in self._drain_chunks():
+            auto = _apply_jit(auto, *chunk)
+        return auto._replace(n_states=self.n_states,
+                             n_edges=self.n_edges)
+
+    def apply_updates_stacked(self, stacked, t: int):
+        """Replay queued mutations onto shard row ``t`` of a STACKED
+        sharded automaton (``[T, ...]`` leading shard axis — see
+        ``parallel.sharded.ShardedAutomaton``). Same double-buffering
+        contract as :meth:`apply_updates`; this is what makes
+        mesh-mode route churn O(delta) instead of a re-flatten of
+        every shard."""
+        return apply_stacked_multi([(t, self)], stacked)
+
+    def _drain_deduped(self):
+        """Consume + dedup the raw queues by index, last write wins:
+        repeated indices inside one ``.at[].set`` chunk apply in
+        implementation-defined order (a delete+re-add of the same
+        filter, or a cuckoo slot written twice, could otherwise
+        resurrect the stale value on device)."""
         col, self._col = self._col, []
         ht, self._ht = self._ht, []
-        # dedup by index, last write wins: repeated indices inside one
-        # .at[].set chunk apply in implementation-defined order (a
-        # delete+re-add of the same filter, or a cuckoo slot written
-        # twice, could otherwise resurrect the stale value on device)
         col_d = {(c, idx): val for c, idx, val in col}
-        col = [(c, i, v) for (c, i), v in col_d.items()]
         ht_d = {(b, s): (st, w, ch) for b, s, st, w, ch in ht}
-        ht = [(b, s, st, w, ch) for (b, s), (st, w, ch) in ht_d.items()]
+        return ([(c, i, v) for (c, i), v in col_d.items()],
+                [(b, s, st, w, ch) for (b, s), (st, w, ch)
+                 in ht_d.items()])
+
+    def _drain_chunks(self):
+        """Consume the update queues as fixed-size padded chunks."""
+        col, ht = self._drain_deduped()
         while col or ht:
             # largest ladder rung the remaining backlog fills: a big
             # idle-accumulated queue drains in few passes instead of
@@ -283,9 +304,7 @@ class AutoPatcher:
             hcv = np.zeros((n,), dtype=np.int32)
             for i, (b, s, st, w, ch) in enumerate(h_part):
                 hb[i], hs[i], hsv[i], hwv[i], hcv[i] = b, s, st, w, ch
-            auto = _apply_jit(auto, ci, cv, hb, hs, hsv, hwv, hcv)
-        return auto._replace(n_states=self.n_states,
-                             n_edges=self.n_edges)
+            yield ci, cv, hb, hs, hsv, hwv, hcv
 
 
 # drain chunk ladder, largest first: bounded compile count (one
@@ -318,3 +337,79 @@ def _apply_jit(auto: Automaton, ci, cv, hb, hs, hsv, hwv, hcv):
             npk = npk.at[ci[c], c].set(cv[c], mode="drop")
         upd["node_packed"] = npk
     return auto._replace(**upd)
+
+
+def apply_stacked_multi(patchers, stacked):
+    """Drain EVERY listed ``(shard_row, patcher)``'s queue into the
+    stacked sharded automaton with SHARED chunks — one scatter pass
+    per chunk regardless of how many shards are dirty (each
+    ``.at[].set`` copy-on-writes the whole stacked buffer, so a
+    per-shard loop would pay T full copies for a T-shard storm).
+    Entries carry their shard row as an extra index column."""
+    col = []  # (t, col, idx, val)
+    ht = []   # (t, b, slot, state, word, child)
+    for t, p in patchers:
+        assert not p.broken, \
+            "partial mutations must not reach the device (re-flatten)"
+        c_, h_ = p._drain_deduped()
+        col.extend((t, c, i, v) for c, i, v in c_)
+        ht.extend((t, b, s, st, w, ch) for b, s, st, w, ch in h_)
+    while col or ht:
+        rem = max(len(col), len(ht))
+        n = _CHUNKS[-1]
+        for size in _CHUNKS:
+            if rem >= size:
+                n = size
+                break
+        c_part, col = col[:n], col[n:]
+        h_part, ht = ht[:n], ht[n:]
+        ti = np.zeros((3, n), dtype=np.int32)
+        ci = np.full((3, n), _OOB, dtype=np.int32)
+        cv = np.zeros((3, n), dtype=np.int32)
+        counts = [0, 0, 0]
+        for t, c, idx, val in c_part:
+            ti[c, counts[c]] = t
+            ci[c, counts[c]] = idx
+            cv[c, counts[c]] = val
+            counts[c] += 1
+        th = np.zeros((n,), dtype=np.int32)
+        hb = np.full((n,), _OOB, dtype=np.int32)
+        hs = np.zeros((n,), dtype=np.int32)
+        hsv = np.zeros((n,), dtype=np.int32)
+        hwv = np.zeros((n,), dtype=np.int32)
+        hcv = np.zeros((n,), dtype=np.int32)
+        for i, (t, b, s, st, w, ch) in enumerate(h_part):
+            th[i], hb[i], hs[i] = t, b, s
+            hsv[i], hwv[i], hcv[i] = st, w, ch
+        stacked = _apply_jit_stacked(stacked, ti, ci, cv, th, hb, hs,
+                                     hsv, hwv, hcv)
+    return stacked
+
+
+@jax.jit
+def _apply_jit_stacked(stacked, ti, ci, cv, th, hb, hs, hsv, hwv, hcv):
+    """The stacked-shard form of :func:`_apply_jit`: scatter one
+    chunk into ``[T, ...]`` arrays with a per-entry shard row (only
+    the columns the match kernel reads — the CSR edge arrays are
+    rebuild inputs, never patched). Pad entries keep the OOB index
+    convention (any out-of-bounds index drops the write)."""
+    upd = dict(
+        plus_child=stacked.plus_child.at[ti[0], ci[0]].set(
+            cv[0], mode="drop"),
+        hash_filter=stacked.hash_filter.at[ti[1], ci[1]].set(
+            cv[1], mode="drop"),
+        end_filter=stacked.end_filter.at[ti[2], ci[2]].set(
+            cv[2], mode="drop"),
+        ht_state=stacked.ht_state.at[th, hb, hs].set(hsv, mode="drop"),
+        ht_word=stacked.ht_word.at[th, hb, hs].set(hwv, mode="drop"),
+        ht_child=stacked.ht_child.at[th, hb, hs].set(hcv, mode="drop"),
+        ht_packed=(stacked.ht_packed
+                   .at[th, hb, hs].set(hsv, mode="drop")
+                   .at[th, hb, hs + 4].set(hwv, mode="drop")
+                   .at[th, hb, hs + 8].set(hcv, mode="drop")),
+    )
+    npk = stacked.node_packed
+    for c in range(3):
+        npk = npk.at[ti[c], ci[c], c].set(cv[c], mode="drop")
+    upd["node_packed"] = npk
+    return stacked._replace(**upd)

@@ -25,6 +25,7 @@ around :func:`emqx_tpu.ops.match.match_batch`.
 from __future__ import annotations
 
 import functools
+import zlib
 from typing import Dict, List, NamedTuple, Sequence
 
 import jax
@@ -35,7 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from emqx_tpu.oracle import TrieOracle
 from emqx_tpu.ops.csr import Automaton, build_automaton
 from emqx_tpu.ops.match import match_batch
-from emqx_tpu.ops.fanout import FanoutTable, build_fanout, gather_subscribers
+from emqx_tpu.ops.fanout import (FanoutTable, build_fanout,
+                                 gather_subscribers_src)
 from emqx_tpu.ops.tokenize import WordTable
 
 
@@ -62,11 +64,21 @@ class ShardedFanout(NamedTuple):
     row_pairs: jax.Array | None = None  # [T, F_cap, 2] packed pairs
 
 
+def shard_of(filter_: str, n_shards: int) -> int:
+    """STABLE filter→shard assignment (crc32, not Python's salted
+    hash): a filter keeps its shard across route churn and across
+    processes, so a mutation touches exactly one shard's automaton —
+    the precondition for per-shard O(delta) patching (round-robin
+    over the sorted set would reshuffle every assignment on insert)."""
+    return zlib.crc32(filter_.encode("utf-8")) % n_shards
+
+
 def shard_filters(filters: Sequence[str], n_shards: int) -> List[List[str]]:
-    """Round-robin partition (balances edge counts for uniform load)."""
+    """Partition by :func:`shard_of` (uniform in expectation; stable
+    under mutation)."""
     shards: List[List[str]] = [[] for _ in range(n_shards)]
-    for i, f in enumerate(filters):
-        shards[i % n_shards].append(f)
+    for f in filters:
+        shards[shard_of(f, n_shards)].append(f)
     return shards
 
 
@@ -74,9 +86,18 @@ def build_sharded(
     filter_shards: Sequence[Sequence[str]],
     filter_ids: Dict[str, int],
     table: WordTable,
+    state_capacity: int | None = None,
+    edge_capacity: int | None = None,
+    return_parts: bool = False,
 ) -> ShardedAutomaton:
     """Build one automaton per shard (global filter ids), pad to the
-    max capacity, and stack."""
+    max capacity, and stack.
+
+    ``state_capacity``/``edge_capacity`` are retention floors (the
+    router passes its previous caps so rebuilds keep device shapes —
+    and jit specializations — stable). ``return_parts=True`` also
+    returns the padded per-shard HOST automatons: they seed the
+    per-shard :class:`~emqx_tpu.ops.patch.AutoPatcher` mirrors."""
     from emqx_tpu.ops.csr import attach_edge_hash, buckets_for_capacity
 
     autos = []
@@ -87,11 +108,22 @@ def build_sharded(
         autos.append(build_automaton(trie, filter_ids, table, skip_hash=True))
     s_cap = max(a.row_ptr.shape[0] - 1 for a in autos)
     e_cap = max(a.edge_word.shape[0] for a in autos)
+    if state_capacity is not None:
+        s_cap = max(s_cap, state_capacity)
+    if edge_capacity is not None:
+        e_cap = max(e_cap, edge_capacity)
     nb = buckets_for_capacity(e_cap)
     padded = [
         attach_edge_hash(_pad_automaton(a, s_cap, e_cap), n_buckets=nb)
         for a in autos
     ]
+    stacked = _stack_sharded(padded)
+    if return_parts:
+        return stacked, padded
+    return stacked
+
+
+def _stack_sharded(padded: Sequence[Automaton]) -> ShardedAutomaton:
     return ShardedAutomaton(
         row_ptr=np.stack([a.row_ptr for a in padded]),
         edge_word=np.stack([a.edge_word for a in padded]),
@@ -135,10 +167,16 @@ def _pad_automaton(a: Automaton, s_cap: int, e_cap: int) -> Automaton:
 def build_sharded_fanout(
     rows_per_shard: Sequence[Dict[int, Sequence[int]]],
     num_filters: int,
+    filter_capacity: int | None = None,
+    entry_capacity: int | None = None,
 ) -> ShardedFanout:
     fans = [build_fanout(rows, num_filters) for rows in rows_per_shard]
     f_cap = max(f.row_ptr.shape[0] - 1 for f in fans)
     e_cap = max(f.sub_ids.shape[0] for f in fans)
+    if filter_capacity is not None:
+        f_cap = max(f_cap, filter_capacity)
+    if entry_capacity is not None:
+        e_cap = max(e_cap, entry_capacity)
     fans = [
         build_fanout(rows, num_filters, filter_capacity=f_cap,
                      entry_capacity=e_cap)
@@ -182,12 +220,19 @@ def publish_step(
 ):
     """The full multi-chip publish step.
 
-    Returns ``(match_ids [B, T*m], sub_ids [B, T*d], overflow [B],
-    stats)``: per-row overflow marks topics whose match or fan-out
-    exceeded a kernel bound on ANY trie shard (the caller resolves
-    those host-side — same contract as the single-chip
-    ``match_batch``), and stats is a dict of mesh-summed counters
-    (matches, deliveries, overflows) — the device metric accumulator.
+    Returns ``(match_ids [B, T*m], sub_ids [B, T*d], src_ids [B, T*d],
+    overflow [B], match_overflow [B], stats)``: ``src_ids`` carries
+    the source filter id per gathered subscriber slot (the delivery
+    tail resolves per-subscription options by matched filter, the
+    reference's ``{Topic, SubPid}`` dispatch pairs); per-row
+    ``overflow`` marks topics whose match OR fan-out exceeded a
+    kernel bound on ANY trie shard (the caller resolves those
+    host-side — same contract as the single-chip ``match_batch``),
+    while ``match_overflow`` isolates the match (active-set/m) bound —
+    the only overflow a ``boost_k`` grow can help with (a fan-out
+    ``d`` overflow must not trigger k recompiles). ``stats`` is a
+    dict of mesh-summed counters (matches, deliveries, overflows) —
+    the device metric accumulator.
     """
     T = mesh.shape["trie"]
 
@@ -206,30 +251,35 @@ def publish_step(
                 fan_t.row_ptr[0], fan_t.sub_ids[0], 0, 0,
                 row_pairs=(None if fan_t.row_pairs is None
                            else fan_t.row_pairs[0]))
-            subs, dcount, dovf = gather_subscribers(f, res.ids, d=d)
+            subs, src, dcount, dovf = gather_subscribers_src(
+                f, res.ids, d=d)
         else:
             subs = jnp.zeros((ids.shape[0], d), jnp.int32)
+            src = jnp.full((ids.shape[0], d), -1, jnp.int32)
             dcount = jnp.zeros((ids.shape[0],), jnp.int32)
             dovf = jnp.zeros((ids.shape[0],), bool)
         # exchange shard-local matches over ICI: every data shard gets
         # the union of all trie shards' match ids
         all_ids = jax.lax.all_gather(res.ids, "trie", axis=1, tiled=True)
         all_subs = jax.lax.all_gather(subs, "trie", axis=1, tiled=True)
+        all_src = jax.lax.all_gather(src, "trie", axis=1, tiled=True)
         # per-row overflow, OR-reduced over the trie axis: one shard
         # overflowing means the row's union is incomplete
-        row_ovf = jax.lax.psum(
-            (res.overflow | dovf).astype(jnp.int32), "trie") > 0
+        row_movf = jax.lax.psum(res.overflow.astype(jnp.int32), "trie") > 0
+        row_ovf = row_movf | (
+            jax.lax.psum(dovf.astype(jnp.int32), "trie") > 0)
         stats = {
             "matches": jax.lax.psum(jnp.sum(res.count), ("data", "trie")),
             "deliveries": jax.lax.psum(jnp.sum(dcount), ("data", "trie")),
             "overflows": jax.lax.psum(
                 jnp.sum(res.overflow | dovf), ("data", "trie")),
         }
-        return all_ids, all_subs, row_ovf, stats
+        return all_ids, all_subs, all_src, row_ovf, row_movf, stats
 
     return jax.shard_map(
         local, mesh=mesh,
         in_specs=(P("trie"), P("trie"), P("data"), P("data"), P("data")),
-        out_specs=(P("data"), P("data"), P("data"), P()),
+        out_specs=(P("data"), P("data"), P("data"), P("data"), P("data"),
+                   P()),
         check_vma=False,  # scan carries start replicated, become varying
     )(auto, fan, word_ids, n_words, sys_mask)

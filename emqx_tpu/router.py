@@ -132,8 +132,12 @@ class Router:
         self._rebuilds = 0
         self._patches = 0
         # O(delta) maintenance (ops/patch.py): host mirror of the live
-        # automaton; None until the first flatten
+        # automaton; None until the first flatten. Mesh mode keeps ONE
+        # PATCHER PER TRIE SHARD (stable hash assignment — a mutation
+        # patches exactly its shard's row of the stacked automaton)
         self._patcher: Optional[AutoPatcher] = None
+        self._shard_patchers: List[AutoPatcher] = []
+        self._sharded_caps = {"state": None, "edge": None}
         self._grow = {"state": 1, "edge": 1}  # rebuild growth factors
         self._compacting = False  # background compaction in flight
         self._dummy_fan = None    # sharded publish_step filler fan
@@ -213,15 +217,35 @@ class Router:
             dests[dest] = dests.get(dest, 0) + 1
             return fid
 
+    def _patcher_for(self, filter_: str) -> Optional[AutoPatcher]:
+        """The patcher owning ``filter_`` (per-shard on a mesh, the
+        single mirror otherwise); None = no live patcher."""
+        if self.config.mesh is not None:
+            if not self._shard_patchers:
+                return None
+            from emqx_tpu.parallel.sharded import shard_of
+
+            return self._shard_patchers[
+                shard_of(filter_, len(self._shard_patchers))]
+        return self._patcher
+
+    def _shard_live_estimate(self) -> int:
+        """Per-shard live-filter estimate (compaction thresholds on a
+        mesh must compare a shard's tombstones against ITS share of
+        the filter set, not the global count)."""
+        n = len(self._shard_patchers)
+        return len(self._filter_ids) // n if n else len(self._filter_ids)
+
     def _patch_insert(self, filter_: str, fid: int) -> None:
         """O(depth) patch of the live automaton; falls back to a full
         rebuild flag on capacity overflow (call under the lock)."""
-        if self._dirty or self._patcher is None:
+        p = None if self._dirty else self._patcher_for(filter_)
+        if p is None:
             self._dirty = True
             return
         try:
             with self._wt_lock:  # patcher.insert interns new words
-                self._patcher.insert(filter_, fid)
+                p.insert(filter_, fid)
             self._map_set(fid, filter_)
             self._patches += 1
         except PatchOverflow as e:
@@ -232,14 +256,18 @@ class Router:
             self._dirty = True
 
     def _patch_delete(self, filter_: str, fid: int) -> None:
-        if self._dirty or self._patcher is None:
+        p = None if self._dirty else self._patcher_for(filter_)
+        if p is None:
             self._dirty = True
             return
         with self._wt_lock:  # delete's word walk may intern
-            self._patcher.delete(filter_)
+            p.delete(filter_)
         self._map_set(fid, None)
         self._patches += 1
-        if self._patcher.needs_compaction(len(self._filter_ids)):
+        live = (self._shard_live_estimate()
+                if self.config.mesh is not None
+                else len(self._filter_ids))
+        if p.needs_compaction(live):
             # tombstones dominate. The tombstoned automaton is still
             # CORRECT (just wasteful), so compaction runs on a
             # background thread and swaps atomically — matchers never
@@ -383,10 +411,11 @@ class Router:
 
     def _rebuild_sharded_locked(self):
         """Flatten the filter set into per-shard automatons stacked
-        over the mesh's trie axis (parallel/sharded.py). Sharded mode
-        trades O(delta) patching for scale: mutations re-flatten
-        (the shard assignment is round-robin over the sorted filter
-        set, so a stable set keeps stable shards)."""
+        over the mesh's trie axis (parallel/sharded.py), and seed one
+        :class:`AutoPatcher` per shard so subsequent route churn
+        patches only the affected shard's row — O(delta) on the mesh,
+        same as single-chip (the shard assignment is a stable filter
+        hash, so a mutation never reshuffles other shards)."""
         from emqx_tpu.parallel.sharded import (
             ShardedFanout, build_sharded, place_sharded, shard_filters)
 
@@ -394,8 +423,20 @@ class Router:
         n_trie = mesh.shape["trie"]
         filters = sorted(self._routes)
         shards = shard_filters(filters, n_trie)
-        auto = build_sharded(shards, self._filter_ids, self._table)
-        auto = place_sharded(mesh, auto)
+        caps = self._sharded_caps
+        grow_s = caps["state"] * self._grow["state"] \
+            if caps["state"] else None
+        grow_e = caps["edge"] * self._grow["edge"] if caps["edge"] else None
+        host_auto, parts = build_sharded(
+            shards, self._filter_ids, self._table,
+            state_capacity=grow_s, edge_capacity=grow_e,
+            return_parts=True)
+        caps["state"] = parts[0].plus_child.shape[0]
+        caps["edge"] = parts[0].edge_word.shape[0]
+        auto = place_sharded(mesh, host_auto) \
+            if self.config.use_device else host_auto
+        self._shard_patchers = [
+            AutoPatcher(p, self._table.intern) for p in parts]
         if self._dummy_fan is None:
             # publish_step's fan input when the caller only matches
             # (with_fanout=False): minimal, never read
@@ -409,14 +450,40 @@ class Router:
         self._pending_free.clear()
         self._patcher = None
         self._dirty = False
+        self._grow = {"state": 1, "edge": 1}
         self._rebuilds += 1
         self._published = (auto, self._auto_map, self._rebuilds)
         return auto
 
+    def _patchers_dirty(self) -> bool:
+        """Any live patcher holding queued device updates?"""
+        if self._patcher is not None and self._patcher.dirty:
+            return True
+        return any(p.dirty for p in self._shard_patchers)
+
+    def _needs_compaction_locked(self) -> bool:
+        if self._patcher is not None:
+            return self._patcher.needs_compaction(len(self._filter_ids))
+        if self._shard_patchers:
+            per = self._shard_live_estimate()
+            return any(p.needs_compaction(per)
+                       for p in self._shard_patchers)
+        return False
+
     def _apply_patches_locked(self) -> None:
-        """Drain the patcher's update queue into a fresh device
-        automaton and publish it (call under the lock)."""
-        self._auto = self._patcher.apply_updates(self._auto)
+        """Drain every dirty patcher's update queue into a fresh
+        device automaton and publish it (call under the lock). On a
+        mesh each dirty shard scatters into its own row of the
+        stacked automaton."""
+        if self._patcher is not None:
+            self._auto = self._patcher.apply_updates(self._auto)
+        else:
+            from emqx_tpu.ops.patch import apply_stacked_multi
+
+            dirty = [(t, p) for t, p in enumerate(self._shard_patchers)
+                     if p.dirty]
+            if dirty:
+                self._auto = apply_stacked_multi(dirty, self._auto)
         self._published = (self._auto, self._auto_map, self._rebuilds)
 
     def _schedule_compaction(self) -> None:
@@ -430,16 +497,14 @@ class Router:
                     # a sync rebuild may have beaten us to it (fresh
                     # patcher, tombstones gone): re-check, don't
                     # re-flatten for nothing
-                    if (not self._dirty and self._patcher is not None
-                            and self._patcher.needs_compaction(
-                                len(self._filter_ids))):
+                    if not self._dirty and self._needs_compaction_locked():
                         # drain queued patches FIRST: with the queue
                         # clean, matchers arriving during the long
                         # flatten stay on the lock-free fast path
                         # (patcher.dirty would send them to the
                         # locked branch — stalling the whole match
                         # plane for the flatten)
-                        if self._patcher.dirty:
+                        if self._patchers_dirty():
                             self._apply_patches_locked()
                         self._rebuild_locked()
             finally:
@@ -461,8 +526,8 @@ class Router:
         insert after overflow) is discarded by the rebuild before its
         queue could ever reach the device."""
         pub = self._published
-        if pub is not None and not self._dirty and not (
-                self._patcher is not None and self._patcher.dirty):
+        if pub is not None and not self._dirty \
+                and not self._patchers_dirty():
             return pub
         with self._lock:
             return self._sync_locked()
@@ -474,7 +539,7 @@ class Router:
         rebuild before it could ever be applied."""
         if self._dirty or self._auto is None:
             self._rebuild_locked()
-        elif self._patcher is not None and self._patcher.dirty:
+        elif self._patchers_dirty():
             self._apply_patches_locked()
         return self._published
 
@@ -582,11 +647,41 @@ class Router:
         mesh's 'data' axis, each trie shard matches its slice, match
         ids are all-gathered over ICI; no device→host sync (same
         contract as :meth:`match_dispatch`, ids are [B_pad, T·m])."""
+        all_ids, _subs, _src, ovf, _movf, id_map, epoch = \
+            self._dispatch_sharded(topics, fan=None)
+        return all_ids, ovf, id_map, epoch
+
+    def publish_dispatch_sharded(self, topics: Sequence[str],
+                                 fan_provider):
+        """The PRODUCT multi-chip publish dispatch: match AND fan-out
+        in one collective step (``parallel.sharded.publish_step`` with
+        real per-shard fan tables, ``with_fanout=True``).
+
+        ``fan_provider(epoch, id_map) -> (ShardedFanout | None,
+        big_fids)`` supplies fan tables consistent with the automaton
+        snapshot (the broker's FanoutManager); ``big_fids`` are filter
+        ids excluded from the device gather (fan-out larger than the
+        ``d`` bound — delivered host-side). Returns ``(ids_dev
+        [B_pad, T·m], subs_dev [B_pad, T·d], src_dev [B_pad, T·d],
+        ovf_dev [B_pad], movf_dev [B_pad], id_map, epoch, big_fids)``
+        — ``movf_dev`` is the match-only overflow (the ``boost_k``
+        signal; fan overflow must not grow k); no device→host sync.
+        Reference: the dispatch fold src/emqx_broker.erl:283-309 run
+        as one compiled mesh program."""
+        return self._dispatch_sharded(topics, fan=fan_provider,
+                                      with_big=True)
+
+    def _dispatch_sharded(self, topics: Sequence[str], fan=None,
+                          with_big: bool = False):
         from emqx_tpu.parallel.sharded import place_batch, publish_step
 
         cfg = self.config
         mesh = cfg.mesh
         auto, id_map, epoch = self.automaton()
+        big_fids = frozenset()
+        fan_tables = None
+        if fan is not None:
+            fan_tables, big_fids = fan(epoch, id_map)
         B = len(topics)
         unit = cfg.min_batch * mesh.shape["data"]
         bucket = unit  # bucket must split evenly over the data axis
@@ -596,12 +691,17 @@ class Router:
         with self._wt_lock:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n, sysm = place_batch(mesh, ids, n, sysm)
-        all_ids, _subs, ovf, stats = publish_step(
-            mesh, auto, self._dummy_fan, ids, n, sysm,
-            k=self.effective_k(), m=cfg.max_matches, d=8,
-            with_fanout=False)
+        use_fan = fan_tables is not None
+        all_ids, subs, src, ovf, movf, stats = publish_step(
+            mesh, auto, fan_tables if use_fan else self._dummy_fan,
+            ids, n, sysm, k=self.effective_k(), m=cfg.max_matches,
+            d=cfg.fanout_d if use_fan else 8, with_fanout=use_fan)
         self._dev_stats.append(stats)
-        return all_ids, ovf, id_map, epoch
+        if with_big:
+            return (all_ids, subs if use_fan else None,
+                    src if use_fan else None, ovf, movf, id_map, epoch,
+                    big_fids)
+        return all_ids, subs, src, ovf, movf, id_map, epoch
 
     def _match_ids_sharded(self, topics: Sequence[str]):
         """Sharded :meth:`match_ids` (host copies synced)."""

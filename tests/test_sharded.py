@@ -65,10 +65,12 @@ def test_sharded_match_parity(n_data, n_trie):
     fan_d = place_sharded(mesh, fan)
     b = place_batch(mesh, ids_np, n_np, sys_np)
 
-    ids, subs, ovf, stats = publish_step(
+    ids, subs, src, ovf, movf, stats = publish_step(
         mesh, auto_d, fan_d, *b, k=32, m=32, d=64)
+    assert not np.asarray(movf).any()
     ids = np.asarray(ids)
     subs = np.asarray(subs)
+    src = np.asarray(src)
     inv = {v: k for k, v in fids.items()}
     total_matches = 0
     total_deliv = 0
@@ -80,6 +82,12 @@ def test_sharded_match_parity(n_data, n_trie):
         exp_subs = sorted(x for f in expect for x in rows_lookup(rows, fids[f]))
         assert sorted(x for x in subs[i] if x >= 0) == exp_subs
         total_deliv += len(exp_subs)
+        # src carries the matched filter id per gathered slot
+        exp_pairs = sorted((fids[f], x) for f in expect
+                           for x in rows_lookup(rows, fids[f]))
+        got_pairs = sorted((int(s), int(x))
+                           for s, x in zip(src[i], subs[i]) if x >= 0)
+        assert got_pairs == exp_pairs, (t, got_pairs, exp_pairs)
     assert int(stats["matches"]) == total_matches
     assert int(stats["deliveries"]) == total_deliv
     assert int(stats["overflows"]) == 0
@@ -126,7 +134,10 @@ def test_router_sharded_match_parity():
         assert sorted(g) == sorted(oracle.match(t)), t
 
 
-def test_router_sharded_mutation_rebuilds():
+def test_router_sharded_mutation_patches_not_rebuilds():
+    """Mesh-mode route churn is O(delta): a mutation patches its
+    shard's row of the stacked automaton (per-shard AutoPatcher) —
+    no re-flatten (VERDICT r2 weak #5)."""
     from emqx_tpu.parallel.mesh import default_mesh
     from emqx_tpu.router import MatcherConfig, Router
 
@@ -134,11 +145,61 @@ def test_router_sharded_mutation_rebuilds():
     r.add_route("a/+")
     assert [f for [f] in [r.match_filters(["a/x"])[0]]] == ["a/+"]
     base = r.stats()["rebuilds"]
+    patches = r.stats()["patches"]
     r.add_route("b/#")
     assert sorted(r.match_filters(["b/z/q"])[0]) == ["b/#"]
-    assert r.stats()["rebuilds"] == base + 1  # sharded mode re-flattens
+    assert r.stats()["rebuilds"] == base  # patched, not re-flattened
+    assert r.stats()["patches"] > patches
     r.delete_route("a/+")
     assert r.match_filters(["a/x"])[0] == []
+    assert r.stats()["rebuilds"] == base
+
+
+def test_router_sharded_churn_parity_vs_oracle():
+    """Sustained mesh churn (inserts + deletes across many shards)
+    keeps exact oracle parity through the per-shard patch path."""
+    import random
+
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.parallel.mesh import default_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+
+    rng = random.Random(7)
+    words = ["a", "b", "c", "d", "e"]
+    r = Router(MatcherConfig(mesh=default_mesh(8)), node="n1")
+    oracle = TrieOracle()
+    live = set()
+    while len(live) < 40:
+        depth = rng.randint(1, 4)
+        ws = [rng.choice(words + ["+"]) for _ in range(depth)]
+        f = "/".join(ws)
+        if f not in live:
+            live.add(f)
+            r.add_route(f)
+            oracle.insert(f)
+    r.match_filters(["a/b"])  # initial flatten
+    base = r.stats()["rebuilds"]
+    for step in range(30):
+        if rng.random() < 0.5 and live:
+            f = rng.choice(sorted(live))
+            live.discard(f)
+            r.delete_route(f)
+            oracle.delete(f)
+        else:
+            f = "/".join(rng.choice(words + ["+"])
+                         for _ in range(rng.randint(1, 4)))
+            if f not in live:
+                live.add(f)
+                r.add_route(f)
+                oracle.insert(f)
+        if step % 5 == 4:
+            topics = ["/".join(rng.choice(words)
+                               for _ in range(rng.randint(1, 4)))
+                      for _ in range(16)]
+            got = r.match_filters(topics)
+            for t, g in zip(topics, got):
+                assert sorted(g) == sorted(oracle.match(t)), (step, t)
+    assert r.stats()["rebuilds"] == base  # zero re-flattens at churn
 
 
 def test_broker_on_mesh_end_to_end():
@@ -203,3 +264,67 @@ def test_distributed_global_mesh_factors():
     assert m2.shape == {"data": 2, "trie": 4}
     m3 = distributed.global_mesh(n_data=8)
     assert m3.shape == {"data": 8, "trie": 1}
+
+
+def test_broker_on_mesh_fanout_parity_with_big_filter():
+    """Mesh broker delivers through the device per-shard gather with
+    exact parity vs host expectations — including a filter whose
+    membership exceeds the d bound (excluded from the gather,
+    delivered via the host tail from sh_big)."""
+    import random
+
+    from emqx_tpu.broker import Broker
+    from emqx_tpu.parallel.mesh import default_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+    from emqx_tpu.types import Message
+
+    class Rec:
+        def __init__(self, i):
+            self.i = i
+            self.got = []
+
+        def deliver(self, topic, msg):
+            self.got.append((topic, msg.topic))
+
+    rng = random.Random(11)
+    mesh = default_mesh(8)
+    b = Broker(router=Router(
+        MatcherConfig(mesh=mesh, fanout_d=16), node="local"))
+    subs = [Rec(i) for i in range(40)]
+    words = ["u", "v", "w"]
+    filters = set()
+    while len(filters) < 25:
+        depth = rng.randint(1, 3)
+        ws = [rng.choice(words + ["+"]) for _ in range(depth)]
+        if rng.random() < 0.2:
+            ws[-1] = "#"
+        filters.add("/".join(ws))
+    for f in sorted(filters):
+        for s in rng.sample(subs, rng.randint(1, 4)):
+            b.subscribe(s, f)
+    # one BIG filter: 30 members > fanout_d=16 → host-tail delivery
+    for s in subs[:30]:
+        b.subscribe(s, "big/#")
+    from emqx_tpu.oracle import TrieOracle
+    oracle = TrieOracle()
+    for f in filters | {"big/#"}:
+        oracle.insert(f)
+    topics = ["/".join(rng.choice(words)
+                       for _ in range(rng.randint(1, 3)))
+              for _ in range(30)] + ["big/x", "big/y/z"]
+    for t in topics:
+        for s in subs:
+            s.got.clear()
+        n = b.publish(Message(topic=t, payload=b"p"))
+        matched = oracle.match(t)
+        exp_n = 0
+        for f in matched:
+            for s in subs:
+                if f in b.subscriptions(s):
+                    exp_n += 1
+        assert n == exp_n, (t, n, exp_n)
+        for s in subs:
+            got_filters = sorted(f for f, _ in s.got)
+            exp_filters = sorted(f for f in matched
+                                 if f in b.subscriptions(s))
+            assert got_filters == exp_filters, (t, s.i)
