@@ -290,9 +290,13 @@ def bigfan():
 
 def shared():
     """BENCH_MODE=shared — BASELINE config 4: $share/<group>
-    load-balanced dispatch at 1M shared subscribers. Match on device,
-    then the device-side hash-strategy group pick
-    (ops.fanout.pick_shared)."""
+    load-balanced dispatch at 1M shared subscribers, in ONE fused
+    device step: match over the batch's UNIQUE topics (hot topics
+    collapse exactly as the main publish path dedups), a device
+    inverse-index gather expands match ids back to per-message rows,
+    then the hash-strategy group pick draws per MESSAGE
+    (ops.fanout.pick_shared — per-message semantics preserved, the
+    reference picks per publish, src/emqx_shared_sub.erl:229-275)."""
     import time as _t
 
     jax = _jax_with_retry()
@@ -307,9 +311,6 @@ def shared():
     batch = int(os.environ.get("BENCH_BATCH", "65536"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     windows = max(1, int(os.environ.get("BENCH_WINDOWS", "5")))
-    # picks are per MESSAGE (each publish draws its own member), so
-    # shared mode does NOT dedup topics; lower k/m fit its tiny
-    # automaton (one filter per group)
     k = int(os.environ.get("BENCH_K", "8"))
     m = int(os.environ.get("BENCH_M", "16"))
     levels = 5
@@ -334,22 +335,31 @@ def shared():
     auto = jax.device_put(auto)
     fan = jax.device_put(fan)
     batches = []
+    uniques = []
+    seed_rng = np.random.default_rng(1)
     for _ in range(8):
         topics = ["/".join(zipf_choice(rng, vocab[i])
                            for i in range(rng.randint(2, levels)))
                   for _ in range(batch)]
-        ids_, n_, sysm_ = eng.encode_batch(topics, 16)
+        uniq, inv = dedup_topics(topics)
+        uniques.append(len(uniq))
+        ids_, n_, sysm_ = eng.encode_batch(uniq, 16)
         ids_, n_ = depth_bucket(ids_, n_)
-        seeds = np.random.default_rng(1).integers(
-            0, 2**31 - 1, size=batch, dtype=np.int32)
-        batches.append(jax.device_put((ids_, n_, sysm_, seeds)))
+        inv_ = np.asarray(inv, dtype=np.int32)
+        seeds = seed_rng.integers(0, 2**31 - 1, size=batch,
+                                  dtype=np.int32)
+        batches.append(jax.device_put((ids_, n_, sysm_, inv_, seeds)))
 
-    def step(ids, n, sysm, seeds):
+    def step(ids, n, sysm, inv, seeds):
         res = match_batch(auto, ids, n, sysm, k=k, m=m)
-        picks = pick_shared(fan, res.ids, seeds)
+        # unique-topic match ids -> per-message rows: ONE [B, M]
+        # gather, then the per-message member draw
+        ids_full = res.ids[inv]
+        picks = pick_shared(fan, ids_full, seeds)
         return jnp.sum(picks >= 0, dtype=jnp.int32), res.overflow
 
-    jax.block_until_ready(step(*batches[0]))  # compile
+    for b_ in batches:  # one compile per distinct unique-shape bucket
+        jax.block_until_ready(step(*b_))
     batches_per_s, rates_b, outs = _throughput_windows(
         step, batches, windows, iters)
     throughput = batches_per_s * batch
@@ -360,6 +370,7 @@ def shared():
     print(json.dumps({
         "mode": "shared", "subs": n_subs, "groups": n_groups,
         "batch": batch, "build_s": round(build_s, 1),
+        "avg_unique_topics": round(float(np.mean(uniques)), 1),
         "picks_per_batch": picked,
         "device": str(jax.devices()[0]),
         "window_mmsgs": [round(r / 1e6, 2) for r in rates],
@@ -556,12 +567,15 @@ def sharded():
     for f in filters:
         fid = r.filter_id(f)
         rows[shard_of(f, n_trie)][fid] = [fid]
+    from emqx_tpu.broker_helper import ShardedFanoutState
+
     fan = place_sharded(mesh, build_sharded_fanout(
         rows, len(r._id_to_filter)))
-    provider = (lambda epoch, id_map: (fan, frozenset()))
+    fan_state = ShardedFanoutState(0, 0, fan, None, frozenset(), d)
+    provider = (lambda epoch, id_map: fan_state)
 
     def step(batch):
-        all_ids, subs, src, ovf, _movf, _, _, _ = \
+        all_ids, subs, src, _bm, ovf, _movf, _, _, _ = \
             r.publish_dispatch_sharded(batch, provider)
         # tiny data-dependent views: reading them back forces the
         # whole step (match + gather + collectives) to completion

@@ -31,8 +31,9 @@ from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
 from emqx_tpu.ops.fanout import expand_packed
-from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_rows,
-                               pack_fanout, pack_matches, pack_union_rows)
+from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_flags,
+                               mask_pad_rows, pack_fanout, pack_matches,
+                               pack_union_rows)
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.shared_sub import SharedSub
 from emqx_tpu.types import Message, SubOpts
@@ -61,7 +62,8 @@ class PendingBatch:
         "m_ptr_d", "ids_packed_d",
         "f_ptr_d", "subs_packed_d", "src_packed_d",
         "bovf_d", "sel_d", "rows_packed_d", "bm_total_d",
-        "subs_dense_d", "src_dense_d", "sh_big", "movf_d", "movf",
+        "subs_dense_d", "src_dense_d", "union_dense_d", "has_big_d",
+        "sh_big", "movf_d", "movf",
         "m_ptr", "ids_packed", "ovf",
         "f_ptr", "subs_packed", "src_packed",
         "bovf", "sel", "rows_packed",
@@ -81,11 +83,12 @@ class PendingBatch:
         self.subs_packed_d = self.src_packed_d = None
         self.bovf_d = self.sel_d = self.rows_packed_d = None
         self.bm_total_d = None
-        # mesh path: dense gathered (subs, src) kept for re-pack, the
-        # big-filter ids the device gather excluded (host tail), and
-        # the match-only overflow (the boost_k signal — fan overflow
-        # must not grow k)
+        # mesh path: dense gathered (subs, src) and bitmap unions
+        # kept for re-pack, the big-filter ids the device CSR gather
+        # excluded (bitmap rows), and the match-only overflow (the
+        # boost_k signal — fan overflow must not grow k)
         self.subs_dense_d = self.src_dense_d = None
+        self.union_dense_d = self.has_big_d = None
         self.sh_big: frozenset = frozenset()
         self.movf_d = self.movf = None
         self.f_ptr = self.subs_packed = None
@@ -351,15 +354,12 @@ class Broker:
         on device for the coalesced fetch. Filters too big for the
         ``d`` bound deliver host-side from ``pb.sh_big``."""
         def fan_provider(epoch, id_map):
-            st = self.helper.sharded_state(epoch, id_map, cfg.mesh,
-                                           cfg.fanout_d)
-            if st is None:
-                return None, frozenset()
-            return st.fan, st.big_fids
+            return self.helper.sharded_state(
+                epoch, id_map, cfg.mesh, self.router.effective_d())
 
-        (pb.ids_dev, subs_d, src_d, pb.ovf_dev, pb.movf_d, pb.id_map,
-         pb.epoch, pb.sh_big) = self.router.publish_dispatch_sharded(
-            uniq, fan_provider)
+        (pb.ids_dev, subs_d, src_d, bm, pb.ovf_dev, pb.movf_d,
+         pb.id_map, pb.epoch, pb.sh_big) = \
+            self.router.publish_dispatch_sharded(uniq, fan_provider)
         n_uniq = np.int32(pb.n_uniq)
         pb.ids_dev = mask_pad_rows(pb.ids_dev, n_uniq)
         bucket = pb.ids_dev.shape[0]
@@ -376,6 +376,14 @@ class Broker:
             pb.pq = budgets[1]
             pb.f_ptr_d, pb.subs_packed_d, pb.src_packed_d = \
                 pack_fanout(pb.subs_dense_d, pb.src_dense_d, pq=pb.pq)
+        if bm is not None:
+            # big-filter bitmap unions (per-shard OR + ICI combine):
+            # pack only the rows that actually matched a big filter
+            union_d, has_big_d, pb.bovf_d = bm
+            pb.union_dense_d = union_d
+            pb.has_big_d = mask_pad_flags(has_big_d, n_uniq)
+            pb.sel_d, pb.rows_packed_d, pb.bm_total_d = pack_union_rows(
+                union_d, pb.has_big_d, pr=budgets[2])
         return pb
 
     def _publish_host(self, pb: PendingBatch, topics: List[str]) -> None:
@@ -482,17 +490,24 @@ class Broker:
                                       pb.ids_packed_d, q=pb.pq)
                 retry = True
             if bm_total is not None and int(bm_total) > pb.rows_packed_d.shape[0]:
-                rows_d, pb.bovf_d = rows_for_matches(
-                    pb.st.bm, pb.ids_dev, mb=cfg.fanout_mb)
-                union_d = or_bitmaps_auto(pb.st.bm.bitmaps, rows_d)
-                has_big = (rows_d >= 0).any(axis=1)
                 pr = pb.rows_packed_d.shape[0]
                 while pr < int(bm_total):
                     pr *= 2
                 if budgets is not None:
                     budgets[2] = max(budgets[2], pr)
-                pb.sel_d, pb.rows_packed_d, pb.bm_total_d = \
-                    pack_union_rows(union_d, has_big, pr=pr)
+                if pb.union_dense_d is not None:
+                    # mesh: the collective union is still live on
+                    # device — re-pack it with the grown budget
+                    pb.sel_d, pb.rows_packed_d, pb.bm_total_d = \
+                        pack_union_rows(pb.union_dense_d,
+                                        pb.has_big_d, pr=pr)
+                else:
+                    rows_d, pb.bovf_d = rows_for_matches(
+                        pb.st.bm, pb.ids_dev, mb=cfg.fanout_mb)
+                    union_d = or_bitmaps_auto(pb.st.bm.bitmaps, rows_d)
+                    has_big = (rows_d >= 0).any(axis=1)
+                    pb.sel_d, pb.rows_packed_d, pb.bm_total_d = \
+                        pack_union_rows(union_d, has_big, pr=pr)
                 retry = True
             if retry:
                 continue
@@ -506,6 +521,12 @@ class Broker:
             k_ovf = movf if movf is not None else ovf
             if int(k_ovf[:n_u].sum()) * 8 > n_u:
                 self.router.boost_k()
+            if movf is not None:
+                # fan-ONLY overflow (mesh): the d bound undersizes
+                # the live fan-out — grow d, not k
+                f_ovf = ovf[:n_u] & ~movf[:n_u]
+                if int(f_ovf.sum()) * 8 > n_u:
+                    self.router.boost_d()
             pb.movf = movf
             pb.m_ptr = m_ptr
             # slice to true occupancy before the per-element list
@@ -629,21 +650,13 @@ class Broker:
                         d = self._deliver_one(flt, sub, msg)
                         if d:
                             per_filter[flt] = per_filter.get(flt, 0) + d
-            if pb.sel is not None and pb.sel[row] >= 0 \
-                    and pb.st.big_fids:
+            big_set = pb.st.big_fids if pb.st is not None else pb.sh_big
+            if pb.sel is not None and pb.sel[row] >= 0 and big_set:
                 self._deliver_big(row, row_ids, msg, pb, per_filter)
             for flt, cnt in per_filter.items():
                 n += cnt
                 self.metrics.inc("messages.delivered", cnt)
                 self.hooks.run("message.delivered", (msg, cnt))
-            if pb.sh_big:
-                # mesh path: filters too big for the device gather's
-                # d bound deliver through the host dispatch loop
-                for j in row_ids:
-                    if j in pb.sh_big:
-                        flt = id_map[j]
-                        if flt is not None:
-                            n += self.dispatch(flt, msg)
             return n
 
         return self._route(filters, msg, local_deliver=local_deliver)
@@ -657,9 +670,11 @@ class Broker:
         tail walks its set bits, accumulating counts into
         ``per_filter``. With multiple matched big filters each
         (filter, member) pair delivers separately — per-subscription
-        semantics, as the reference's shard walk."""
-        st = pb.st
-        matched_big = [j for j in row_ids if j in st.big_fids]
+        semantics, as the reference's shard walk. On the mesh the
+        union rows come from the per-shard OR + ICI combine and the
+        big set is ``pb.sh_big``."""
+        big_set = pb.st.big_fids if pb.st is not None else pb.sh_big
+        matched_big = [j for j in row_ids if j in big_set]
         if not matched_big:
             return
         id_map = pb.id_map

@@ -125,18 +125,22 @@ class ShardedFanoutState:
     only the filters :func:`~emqx_tpu.parallel.sharded.shard_of`
     assigns to t — the same stable assignment the sharded automaton
     uses, so each trie shard gathers exactly its own matches'
-    subscribers); ``big_fids`` are the filters excluded from the
-    device gather (membership larger than the per-topic ``d`` bound),
-    delivered host-side by the broker's tail."""
+    subscribers) plus a stacked ``ShardedBitmaps`` for the big
+    filters (membership past the per-topic ``d`` bound): their
+    subscriber sets live as bitmap rows in THEIR shard's HBM and
+    fan out via the per-shard OR + ICI union. ``big_fids`` names
+    those filters for the broker's bitmap delivery tail."""
 
-    __slots__ = ("epoch", "version", "fan", "big_fids")
+    __slots__ = ("epoch", "version", "fan", "bm", "big_fids", "d")
 
-    def __init__(self, epoch: int, version: int, fan,
-                 big_fids: frozenset) -> None:
+    def __init__(self, epoch: int, version: int, fan, bm,
+                 big_fids: frozenset, d: int) -> None:
         self.epoch = epoch
         self.version = version
         self.fan = fan
+        self.bm = bm
         self.big_fids = big_fids
+        self.d = d
 
 
 class FanoutManager:
@@ -264,16 +268,18 @@ class FanoutManager:
         """Per-shard device fan tables consistent with the automaton
         snapshot, for ``publish_step(with_fanout=True)`` (the mesh
         analogue of :meth:`state`). Filters whose membership exceeds
-        ``min(threshold, d)`` go to ``big_fids`` — materializing them
-        in the ``d``-bounded gather would overflow every batch."""
-        from emqx_tpu.parallel.sharded import (build_sharded_fanout,
+        ``min(threshold, d)`` get bitmap rows in their shard instead
+        of CSR entries — materializing them in the ``d``-bounded
+        gather would overflow every batch."""
+        from emqx_tpu.parallel.sharded import (build_sharded_bitmaps,
+                                               build_sharded_fanout,
                                                place_sharded, shard_of)
 
         n_shards = mesh.shape["trie"]
         with self._lock:
             st = self._sharded
             if (st is not None and st.epoch == epoch
-                    and st.version == self._version):
+                    and st.version == self._version and st.d == d):
                 return st
             if not self.rows:
                 self._sharded = None
@@ -281,6 +287,8 @@ class FanoutManager:
                 return None
             limit = min(self.threshold, d)
             rows_per_shard: List[Dict[int, List[int]]] = [
+                {} for _ in range(n_shards)]
+            big_per_shard: List[Dict[int, List[int]]] = [
                 {} for _ in range(n_shards)]
             big_fids = set()
             for fid, f in enumerate(id_map):
@@ -291,6 +299,8 @@ class FanoutManager:
                     continue
                 if len(row) > limit:
                     big_fids.add(fid)
+                    big_per_shard[shard_of(f, n_shards)][fid] = \
+                        sorted(row)
                 else:
                     rows_per_shard[shard_of(f, n_shards)][fid] = \
                         sorted(row)
@@ -300,10 +310,20 @@ class FanoutManager:
                 entry_capacity=self._sh_caps["entry"])
             self._sh_caps["filter"] = fan.row_ptr.shape[1] - 1
             self._sh_caps["entry"] = fan.sub_ids.shape[1]
+            bm = None
+            if big_fids:
+                nsub = max(self._caps["nsub"], self.registry.capacity())
+                self._caps["nsub"] = nsub
+                bm = build_sharded_bitmaps(
+                    big_per_shard, len(id_map), nsub,
+                    row_capacity=self._sh_caps.get("row"))
+                self._sh_caps["row"] = bm.bitmaps.shape[1]
             if self.use_device:
                 fan = place_sharded(mesh, fan)
-            st = ShardedFanoutState(epoch, self._version, fan,
-                                    frozenset(big_fids))
+                if bm is not None:
+                    bm = place_sharded(mesh, bm)
+            st = ShardedFanoutState(epoch, self._version, fan, bm,
+                                    frozenset(big_fids), d)
             self._sharded = st
             self.registry.flush_free()
             return st

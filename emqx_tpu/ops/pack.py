@@ -50,6 +50,14 @@ def mask_pad_rows(ids: jax.Array, n_rows: jax.Array) -> jax.Array:
     return jnp.where((row < n_rows)[:, None], ids, -1)
 
 
+@jax.jit
+def mask_pad_flags(flags: jax.Array, n_rows: jax.Array) -> jax.Array:
+    """Clear per-row bool flags on the batch's padding rows (the
+    bool analogue of :func:`mask_pad_rows`)."""
+    row = jnp.arange(flags.shape[0], dtype=jnp.int32)
+    return flags & (row < n_rows)
+
+
 def budget_for(n_rows: int, per_row: int, floor: int = 64) -> int:
     """Power-of-two packed-buffer budget for ``n_rows`` rows at an
     expected ``per_row`` average occupancy."""

@@ -252,15 +252,6 @@ class AutoPatcher:
         return auto._replace(n_states=self.n_states,
                              n_edges=self.n_edges)
 
-    def apply_updates_stacked(self, stacked, t: int):
-        """Replay queued mutations onto shard row ``t`` of a STACKED
-        sharded automaton (``[T, ...]`` leading shard axis — see
-        ``parallel.sharded.ShardedAutomaton``). Same double-buffering
-        contract as :meth:`apply_updates`; this is what makes
-        mesh-mode route churn O(delta) instead of a re-flatten of
-        every shard."""
-        return apply_stacked_multi([(t, self)], stacked)
-
     def _drain_deduped(self):
         """Consume + dedup the raw queues by index, last write wins:
         repeated indices inside one ``.at[].set`` chunk apply in

@@ -64,6 +64,35 @@ class ShardedFanout(NamedTuple):
     row_pairs: jax.Array | None = None  # [T, F_cap, 2] packed pairs
 
 
+class ShardedBitmaps(NamedTuple):
+    """Per-trie-shard subscriber bitmaps for big (> d) filters: a
+    filter's bitmap row lives in ITS shard (same stable assignment as
+    the automaton), so HBM for huge subscriber sets scales with the
+    mesh instead of replicating (BASELINE config 5 at multi-chip)."""
+
+    bitmaps: jax.Array  # uint32[T, R_cap, W]
+    big_row: jax.Array  # int32[T, F_cap] — global fid -> local row | -1
+
+
+def build_sharded_bitmaps(
+    rows_per_shard: Sequence[Dict[int, Sequence[int]]],
+    num_filters: int,
+    n_subs: int,
+    row_capacity: int | None = None,
+) -> ShardedBitmaps:
+    from emqx_tpu.ops.bitmap import build_bitmaps
+
+    r_cap = max(1, max(len(r) for r in rows_per_shard))
+    if row_capacity is not None:
+        r_cap = max(r_cap, row_capacity)
+    tables = [build_bitmaps(rows, num_filters, n_subs,
+                            row_capacity=r_cap)
+              for rows in rows_per_shard]
+    return ShardedBitmaps(
+        bitmaps=np.stack([t.bitmaps for t in tables]),
+        big_row=np.stack([t.big_row for t in tables]))
+
+
 def shard_of(filter_: str, n_shards: int) -> int:
     """STABLE filter→shard assignment (crc32, not Python's salted
     hash): a filter keeps its shard across route churn and across
@@ -204,7 +233,8 @@ def place_batch(mesh: Mesh, word_ids, n_words, sys_mask):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "k", "m", "d", "with_fanout"))
+    jax.jit,
+    static_argnames=("mesh", "k", "m", "d", "mb", "with_fanout"))
 def publish_step(
     mesh: Mesh,
     auto: ShardedAutomaton,
@@ -212,31 +242,52 @@ def publish_step(
     word_ids: jax.Array,   # [B, L] sharded over 'data'
     n_words: jax.Array,    # [B]
     sys_mask: jax.Array,   # [B]
+    bmt: ShardedBitmaps | None = None,
     *,
     k: int = 64,
     m: int = 128,
     d: int = 128,
+    mb: int = 16,
     with_fanout: bool = True,
 ):
     """The full multi-chip publish step.
 
     Returns ``(match_ids [B, T*m], sub_ids [B, T*d], src_ids [B, T*d],
-    overflow [B], match_overflow [B], stats)``: ``src_ids`` carries
-    the source filter id per gathered subscriber slot (the delivery
-    tail resolves per-subscription options by matched filter, the
-    reference's ``{Topic, SubPid}`` dispatch pairs); per-row
-    ``overflow`` marks topics whose match OR fan-out exceeded a
-    kernel bound on ANY trie shard (the caller resolves those
-    host-side — same contract as the single-chip ``match_batch``),
-    while ``match_overflow`` isolates the match (active-set/m) bound —
-    the only overflow a ``boost_k`` grow can help with (a fan-out
-    ``d`` overflow must not trigger k recompiles). ``stats`` is a
-    dict of mesh-summed counters (matches, deliveries, overflows) —
-    the device metric accumulator.
-    """
-    T = mesh.shape["trie"]
+    bm [(union [B, W], has_big [B], bovf [B]) | None],
+    overflow [B], match_overflow [B], stats)``:
 
-    def local(auto_t, fan_t, ids, n, sysm):
+    - ``src_ids`` carries the source filter id per gathered subscriber
+      slot (the delivery tail resolves per-subscription options by
+      matched filter, the reference's ``{Topic, SubPid}`` dispatch
+      pairs);
+    - with a :class:`ShardedBitmaps` table, each trie shard ORs its
+      matched big filters' bitmap rows (the >d regime,
+      src/emqx_broker_helper.erl:82-92) and the per-topic unions
+      OR-combine over ICI — ``bovf`` flags topics matching more than
+      ``mb`` big filters on some shard (host fallback, like the
+      single-chip bitmap path);
+    - per-row ``overflow`` marks topics whose match or CSR fan-out
+      exceeded a kernel bound on ANY trie shard (the caller resolves
+      those host-side — same contract as the single-chip
+      ``match_batch``), while ``match_overflow`` isolates the match
+      (active-set/m) bound — the only overflow a ``boost_k`` grow can
+      help with (a fan-out ``d`` overflow must not trigger k
+      recompiles). ``stats`` is a dict of mesh-summed counters
+      (matches, deliveries, overflows) — the device metric
+      accumulator.
+    """
+    from emqx_tpu.ops.bitmap import (or_bitmaps_dma, or_bitmaps_xla,
+                                     rows_for_matches)
+    from emqx_tpu.ops.bitmap import BitmapTable
+
+    T = mesh.shape["trie"]
+    with_bitmap = bmt is not None
+    # Pallas manual-DMA on real accelerators; the scan fallback on the
+    # virtual CPU mesh (interpret-mode Pallas inside shard_map is not
+    # supported). Static at trace time.
+    use_dma = jax.default_backend() in ("tpu", "axon")
+
+    def local(auto_t, fan_t, ids, n, sysm, bmt_t=None):
         a = Automaton(
             row_ptr=auto_t.row_ptr[0], edge_word=auto_t.edge_word[0],
             edge_child=auto_t.edge_child[0], plus_child=auto_t.plus_child[0],
@@ -263,23 +314,108 @@ def publish_step(
         all_ids = jax.lax.all_gather(res.ids, "trie", axis=1, tiled=True)
         all_subs = jax.lax.all_gather(subs, "trie", axis=1, tiled=True)
         all_src = jax.lax.all_gather(src, "trie", axis=1, tiled=True)
+        bm_out = None
+        big_deliv = None
+        if with_bitmap:
+            bt = BitmapTable(bmt_t.bitmaps[0], bmt_t.big_row[0], 0, 0)
+            rows_b, b_ovf = rows_for_matches(bt, res.ids, mb=mb)
+            union = (or_bitmaps_dma(bt.bitmaps, rows_b) if use_dma
+                     else or_bitmaps_xla(bt.bitmaps, rows_b))
+            # per-topic union OR-combined over the trie axis (each
+            # shard contributes its own big filters' members)
+            ug = jax.lax.all_gather(union, "trie")       # [T, b, W]
+            union = jax.lax.reduce(
+                ug, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+            has_big = jax.lax.psum(
+                (rows_b >= 0).any(axis=1).astype(jnp.int32), "trie") > 0
+            bovf = jax.lax.psum(b_ovf.astype(jnp.int32), "trie") > 0
+            big_deliv = jnp.sum(
+                jax.lax.population_count(union), dtype=jnp.int32)
+            bm_out = (union, has_big, bovf)
         # per-row overflow, OR-reduced over the trie axis: one shard
         # overflowing means the row's union is incomplete
         row_movf = jax.lax.psum(res.overflow.astype(jnp.int32), "trie") > 0
         row_ovf = row_movf | (
             jax.lax.psum(dovf.astype(jnp.int32), "trie") > 0)
+        deliv = jax.lax.psum(jnp.sum(dcount), ("data", "trie"))
+        if big_deliv is not None:
+            # the OR-reduced union is IDENTICAL on every trie shard —
+            # sum it over 'data' only (a trie psum would count each
+            # big delivery T times)
+            deliv = deliv + jax.lax.psum(big_deliv, "data")
         stats = {
             "matches": jax.lax.psum(jnp.sum(res.count), ("data", "trie")),
-            "deliveries": jax.lax.psum(jnp.sum(dcount), ("data", "trie")),
+            "deliveries": deliv,
             "overflows": jax.lax.psum(
                 jnp.sum(res.overflow | dovf), ("data", "trie")),
         }
-        return all_ids, all_subs, all_src, row_ovf, row_movf, stats
+        return all_ids, all_subs, all_src, bm_out, row_ovf, row_movf, stats
+
+    in_specs = [P("trie"), P("trie"), P("data"), P("data"), P("data")]
+    args = [auto, fan, word_ids, n_words, sys_mask]
+    bm_spec = (P("data"), P("data"), P("data")) if with_bitmap else None
+    if with_bitmap:
+        in_specs.append(P("trie"))
+        args.append(bmt)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P("data"), P("data"), P("data"), bm_spec,
+                   P("data"), P("data"), P()),
+        check_vma=False,  # scan carries start replicated, become varying
+    )(*args)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "m"))
+def shared_pick_step(
+    mesh: Mesh,
+    auto: ShardedAutomaton,
+    gfan: ShardedFanout,     # per-shard GROUP membership CSR
+    word_ids: jax.Array,     # [B, L] sharded over 'data'
+    n_words: jax.Array,
+    sys_mask: jax.Array,
+    seeds: jax.Array,        # int32[B] per-message pick seed
+    *,
+    k: int = 16,
+    m: int = 32,
+):
+    """Multi-chip $share dispatch: match + the device hash-strategy
+    member pick (src/emqx_shared_sub.erl:229-275) in one collective
+    step. Each trie shard picks members for ITS groups' matches
+    (``gfan`` rows live with their filter's shard — same stable
+    assignment as the automaton); picks are all-gathered over ICI.
+
+    Returns ``(picks [B, T*m], match_ids [B, T*m], overflow [B])``;
+    picks are subscriber ids aligned with ``match_ids`` slots (-1 =
+    slot empty or group not on that shard). The pick is stateless
+    (hash strategy); round-robin/sticky keep host state and stay
+    host-side, exactly as on one chip."""
+    from emqx_tpu.ops.fanout import pick_shared
+
+    def local(auto_t, gfan_t, ids, n, sysm, s):
+        a = Automaton(
+            row_ptr=auto_t.row_ptr[0], edge_word=auto_t.edge_word[0],
+            edge_child=auto_t.edge_child[0], plus_child=auto_t.plus_child[0],
+            hash_filter=auto_t.hash_filter[0], end_filter=auto_t.end_filter[0],
+            n_states=0, n_edges=0, ht_state=auto_t.ht_state[0],
+            ht_word=auto_t.ht_word[0], ht_child=auto_t.ht_child[0],
+            ht_seed=auto_t.ht_seed[0], ht_packed=auto_t.ht_packed[0],
+            node_packed=auto_t.node_packed[0])
+        res = match_batch(a, ids, n, sysm, k=k, m=m)
+        f = FanoutTable(
+            gfan_t.row_ptr[0], gfan_t.sub_ids[0], 0, 0,
+            row_pairs=(None if gfan_t.row_pairs is None
+                       else gfan_t.row_pairs[0]))
+        picks = pick_shared(f, res.ids, s)
+        all_picks = jax.lax.all_gather(picks, "trie", axis=1, tiled=True)
+        all_ids = jax.lax.all_gather(res.ids, "trie", axis=1, tiled=True)
+        ovf = jax.lax.psum(res.overflow.astype(jnp.int32), "trie") > 0
+        return all_picks, all_ids, ovf
 
     return jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P("trie"), P("trie"), P("data"), P("data"), P("data")),
-        out_specs=(P("data"), P("data"), P("data"), P("data"), P("data"),
-                   P()),
-        check_vma=False,  # scan carries start replicated, become varying
-    )(auto, fan, word_ids, n_words, sys_mask)
+        in_specs=(P("trie"), P("trie"), P("data"), P("data"), P("data"),
+                  P("data")),
+        out_specs=(P("data"), P("data"), P("data")),
+        check_vma=False,
+    )(auto, gfan, word_ids, n_words, sys_mask, seeds)

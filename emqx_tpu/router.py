@@ -144,8 +144,11 @@ class Router:
         # learned active-set boost: an overflow-storm batch (many
         # topics exceeding active_k) doubles the effective K (bounded)
         # instead of host-matching that workload forever — one extra
-        # compile per growth step, exact fallback in the meantime
+        # compile per growth step, exact fallback in the meantime;
+        # _d_boost is the same mechanism for the mesh gather's
+        # per-topic delivery slots
         self._k_boost = 0
+        self._d_boost = 0
         # device stat accumulators (sharded publish_step psums),
         # drained asynchronously by the stats flush — appending the
         # jax scalars defers the host transfer to drain time
@@ -623,6 +626,24 @@ class Router:
             self._k_boost = min(k * 2, cap)
             return True
 
+    def effective_d(self) -> int:
+        """Configured per-topic fan-out slots plus any learned boost
+        (mesh publish step; learned like K, from fan-only overflow)."""
+        return max(self.config.fanout_d, self._d_boost)
+
+    def boost_d(self, cap: int = 1024) -> bool:
+        """Double the mesh gather's per-topic delivery slots (≤
+        ``cap``) when a batch's FAN-ONLY overflow rate shows ``d``
+        undersizes the live fan-out (one recompile per growth step,
+        exact host fallback in the meantime — same contract as
+        :meth:`boost_k`)."""
+        with self._lock:
+            d = self.effective_d()
+            if d >= cap:
+                return False
+            self._d_boost = min(d * 2, cap)
+            return True
+
     def match_ids(self, topics: Sequence[str]):
         """Device match of a topic batch in snapshot-id space.
 
@@ -657,12 +678,11 @@ class Router:
         in one collective step (``parallel.sharded.publish_step`` with
         real per-shard fan tables, ``with_fanout=True``).
 
-        ``fan_provider(epoch, id_map) -> (ShardedFanout | None,
-        big_fids)`` supplies fan tables consistent with the automaton
-        snapshot (the broker's FanoutManager); ``big_fids`` are filter
-        ids excluded from the device gather (fan-out larger than the
-        ``d`` bound — delivered host-side). Returns ``(ids_dev
-        [B_pad, T·m], subs_dev [B_pad, T·d], src_dev [B_pad, T·d],
+        ``fan_provider(epoch, id_map) -> ShardedFanoutState | None``
+        supplies fan tables (CSR + big-filter bitmaps) consistent
+        with the automaton snapshot (the broker's FanoutManager).
+        Returns ``(ids_dev [B_pad, T·m], subs_dev [B_pad, T·d],
+        src_dev [B_pad, T·d], bm [(union, has_big, bovf) | None],
         ovf_dev [B_pad], movf_dev [B_pad], id_map, epoch, big_fids)``
         — ``movf_dev`` is the match-only overflow (the ``boost_k``
         signal; fan overflow must not grow k); no device→host sync.
@@ -680,8 +700,13 @@ class Router:
         auto, id_map, epoch = self.automaton()
         big_fids = frozenset()
         fan_tables = None
+        bmt = None
         if fan is not None:
-            fan_tables, big_fids = fan(epoch, id_map)
+            st = fan(epoch, id_map)
+            if st is not None:
+                fan_tables = st.fan
+                bmt = st.bm
+                big_fids = st.big_fids
         B = len(topics)
         unit = cfg.min_batch * mesh.shape["data"]
         bucket = unit  # bucket must split evenly over the data axis
@@ -692,15 +717,16 @@ class Router:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n, sysm = place_batch(mesh, ids, n, sysm)
         use_fan = fan_tables is not None
-        all_ids, subs, src, ovf, movf, stats = publish_step(
+        all_ids, subs, src, bm, ovf, movf, stats = publish_step(
             mesh, auto, fan_tables if use_fan else self._dummy_fan,
-            ids, n, sysm, k=self.effective_k(), m=cfg.max_matches,
-            d=cfg.fanout_d if use_fan else 8, with_fanout=use_fan)
+            ids, n, sysm, bmt, k=self.effective_k(), m=cfg.max_matches,
+            d=self.effective_d() if use_fan else 8,
+            mb=cfg.fanout_mb, with_fanout=use_fan)
         self._dev_stats.append(stats)
         if with_big:
             return (all_ids, subs if use_fan else None,
-                    src if use_fan else None, ovf, movf, id_map, epoch,
-                    big_fids)
+                    src if use_fan else None, bm, ovf, movf, id_map,
+                    epoch, big_fids)
         return all_ids, subs, src, ovf, movf, id_map, epoch
 
     def _match_ids_sharded(self, topics: Sequence[str]):

@@ -65,8 +65,9 @@ def test_sharded_match_parity(n_data, n_trie):
     fan_d = place_sharded(mesh, fan)
     b = place_batch(mesh, ids_np, n_np, sys_np)
 
-    ids, subs, src, ovf, movf, stats = publish_step(
+    ids, subs, src, _bm, ovf, movf, stats = publish_step(
         mesh, auto_d, fan_d, *b, k=32, m=32, d=64)
+    assert _bm is None
     assert not np.asarray(movf).any()
     ids = np.asarray(ids)
     subs = np.asarray(subs)
@@ -328,3 +329,129 @@ def test_broker_on_mesh_fanout_parity_with_big_filter():
             exp_filters = sorted(f for f in matched
                                  if f in b.subscriptions(s))
             assert got_filters == exp_filters, (t, s.i)
+
+
+def test_mesh_fan_overflow_boosts_d_not_k():
+    """A fan-only overflow (per-topic deliveries past the d bound,
+    match within k) must grow the learned d — never k, whose
+    recompile could not reduce fan-out overflow."""
+    from emqx_tpu.broker import Broker
+    from emqx_tpu.parallel.mesh import make_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+    from emqx_tpu.types import Message
+
+    class S:
+        def deliver(self, flt, msg):
+            pass
+
+    mesh = make_mesh(8, 1)  # one trie shard: all fan rows sum per topic
+    b = Broker(router=Router(
+        MatcherConfig(mesh=mesh, fanout_d=2), node="local"))
+    for f in ("m/+", "m/#", "m/a"):
+        b.subscribe(S(), f)
+    k0 = b.router.effective_k()
+    assert b.router.effective_d() == 2
+    # 3 deliveries > d=2 -> fan overflow, host fallback, d boost
+    assert b.publish(Message(topic="m/a")) == 3
+    assert b.router.effective_d() > 2
+    assert b.router.effective_k() == k0  # k untouched
+    # the grown d fits the workload: delivered via the device gather
+    assert b.publish(Message(topic="m/a")) == 3
+
+
+def test_sharded_shared_pick_parity():
+    """shared_pick_step picks seed % group_size from each matched
+    group's member row — exact host parity across shard layouts."""
+    from emqx_tpu.parallel.mesh import make_mesh
+    from emqx_tpu.parallel.sharded import (build_sharded,
+                                           build_sharded_fanout,
+                                           place_batch, place_sharded,
+                                           shard_filters, shard_of,
+                                           shared_pick_step)
+
+    rng = random.Random(5)
+    words = ["g1", "g2", "g3", "q"]
+    filters = sorted({"/".join(rng.choice(words)
+                               for _ in range(rng.randint(1, 3)))
+                      for _ in range(30)})
+    fids = {f: i for i, f in enumerate(filters)}
+    table = WordTable()
+    oracle = TrieOracle()
+    for f in filters:
+        oracle.insert(f)
+        for w in f.split("/"):
+            table.intern(w)
+    for n_data, n_trie in [(4, 2), (2, 4)]:
+        mesh = make_mesh(n_data, n_trie)
+        shards = shard_filters(filters, n_trie)
+        auto = build_sharded(shards, fids, table)
+        members = {f: [fids[f] * 100 + j
+                       for j in range(rng.randint(1, 5))]
+                   for f in filters}
+        rows = [{} for _ in range(n_trie)]
+        for f in filters:
+            rows[shard_of(f, n_trie)][fids[f]] = members[f]
+        gfan = build_sharded_fanout(rows, len(filters))
+        B = 8 * n_data
+        topics = ["/".join(rng.choice(words)
+                           for _ in range(rng.randint(1, 3)))
+                  for _ in range(B)]
+        seeds = np.arange(B, dtype=np.int32) * 7 + 3
+        ids_np, n_np, sys_np = encode_batch(table, topics, 8)
+        auto_d = place_sharded(mesh, auto)
+        gfan_d = place_sharded(mesh, gfan)
+        b = place_batch(mesh, ids_np, n_np, sys_np)
+        seeds_d = jax.device_put(
+            seeds, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data")))
+        picks, mids, ovf = shared_pick_step(
+            mesh, auto_d, gfan_d, *b, seeds_d, k=16, m=16)
+        picks, mids = np.asarray(picks), np.asarray(mids)
+        assert not np.asarray(ovf).any()
+        for i, t in enumerate(topics):
+            got = sorted(int(p) for p in picks[i] if p >= 0)
+            expect = sorted(
+                members[f][seeds[i] % len(members[f])]
+                for f in oracle.match(t))
+            assert got == expect, (t, got, expect)
+
+
+def test_sharded_bitmap_multi_big_and_overflow():
+    """Mesh bitmap path with several big filters across shards: union
+    of members per topic delivers exactly; > mb big matches on one
+    shard flags bovf and falls back to the host loop."""
+    from emqx_tpu.broker import Broker
+    from emqx_tpu.parallel.mesh import make_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+    from emqx_tpu.types import Message
+
+    class S:
+        def __init__(self, i):
+            self.i = i
+            self.got = []
+
+        def deliver(self, flt, msg):
+            self.got.append(flt)
+
+    mesh = make_mesh(4, 2)
+    b = Broker(router=Router(
+        MatcherConfig(mesh=mesh, fanout_d=4, fanout_mb=2),
+        node="local"))
+    subs = [S(i) for i in range(30)]
+    # three big filters (>d=4 members) matching the same topic family
+    big_members = {"big/#": subs[:20], "big/+": subs[5:25],
+                   "big/x": subs[10:30]}
+    for f, ms in big_members.items():
+        for s in ms:
+            b.subscribe(s, f)
+    n = b.publish(Message(topic="big/x"))
+    assert n == 60  # per-subscription delivery: 20 per filter
+    for i, s in enumerate(subs):
+        exp = sorted(f for f, ms in big_members.items() if s in ms)
+        assert sorted(s.got) == exp, (i, s.got, exp)
+    # the metrics counted them as delivered
+    assert b.metrics.val("messages.delivered") == 60
+    # the device stat counts UNIQUE union members once (not once per
+    # trie shard — regression: the OR-reduced union is replicated)
+    st = b.router.drain_device_stats()
+    assert st["deliveries"] == 30, st
