@@ -652,7 +652,8 @@ class Broker:
                             per_filter[flt] = per_filter.get(flt, 0) + d
             big_set = pb.st.big_fids if pb.st is not None else pb.sh_big
             if pb.sel is not None and pb.sel[row] >= 0 and big_set:
-                self._deliver_big(row, row_ids, msg, pb, per_filter)
+                self._deliver_big(row, row_ids, msg, pb, per_filter,
+                                  big_set)
             for flt, cnt in per_filter.items():
                 n += cnt
                 self.metrics.inc("messages.delivered", cnt)
@@ -662,8 +663,8 @@ class Broker:
         return self._route(filters, msg, local_deliver=local_deliver)
 
     def _deliver_big(self, row: int, row_ids: List[int], msg: Message,
-                     pb: PendingBatch,
-                     per_filter: Dict[str, int]) -> None:
+                     pb: PendingBatch, per_filter: Dict[str, int],
+                     big_set: frozenset) -> None:
         """Deliver a message's bitmap-path (>threshold) fan-out: the
         device OR'd the matched big rows into one subscriber bitmap
         (transferred only for rows that had one, ops/pack.py); the
@@ -673,7 +674,6 @@ class Broker:
         semantics, as the reference's shard walk. On the mesh the
         union rows come from the per-shard OR + ICI combine and the
         big set is ``pb.sh_big``."""
-        big_set = pb.st.big_fids if pb.st is not None else pb.sh_big
         matched_big = [j for j in row_ids if j in big_set]
         if not matched_big:
             return

@@ -96,6 +96,10 @@ class Cluster:
         # Mnesia bag emqx_channel_registry); covers live and detached
         # sessions so cross-node takeover can find the owner
         self._registry: Dict[str, str] = {}
+        # distributed per-clientid lock (emqx_cm_locker / ekka_locker
+        # quorum) — taken by cm around open/discard/takeover
+        from emqx_tpu.cm_locker import ClusterLocker
+        self.locker = ClusterLocker(self)
         node.cm.cluster = self
         if hasattr(node, "cluster"):
             node.cluster = self  # node-level accessor (ctl, config)
@@ -258,6 +262,9 @@ class Cluster:
                 del self._registry[c]
             for k in [k for k in self._shared_weights if k[2] == name]:
                 del self._shared_weights[k]
+        # a dead node's clientid locks release NOW (ekka_locker's
+        # monitored-lock cleanup) — waiters unblock immediately
+        self.locker.drop_owner(name)
         self._purge_node_routes(name)
 
     # -- clientid registry + cross-node takeover (emqx_cm_registry) -------
@@ -490,9 +497,16 @@ class Cluster:
                     self._registry.pop(cid, None)
             return None
         if op == "discard_client":
-            return self.node.cm.discard_session(args[0])
+            # the REQUESTING node holds the cluster lock for this
+            # clientid — re-acquiring here would deadlock on it
+            return self.node.cm.discard_session(args[0],
+                                                cluster_lock=False)
         if op == "takeover_client":
             return self._local_takeover(args[0])
+        if op == "lock_acquire":
+            return self.locker.grant(args[0], args[1])
+        if op == "lock_release":
+            return self.locker.release_local(args[0], args[1])
         if op == "set_members":
             return self._set_members(args[0])
         if op == "ping":

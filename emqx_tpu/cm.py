@@ -2,11 +2,14 @@
 clean-start/resume, takeover, discard, kick.
 
 Mirrors ``src/emqx_cm.erl``: ``open_session/3`` under a per-clientid
-lock (:209-236 — here a per-clientid mutex; the reference's cluster
-lock arrives with the cluster layer), takeover protocol
-(:244-272), discard/kick (:274-326), and the clientid→channel
-registry (emqx_cm_registry). Detached persistent sessions are kept
-for ``session_expiry_interval`` and swept by :meth:`expire_sessions`.
+lock (:209-236) — a node-local mutex PLUS, when clustered, the
+distributed quorum lock (:mod:`emqx_tpu.cm_locker`, the
+emqx_cm_locker/ekka_locker role: two nodes racing the same clientid
+serialize cluster-wide, so exactly one session survives), takeover
+protocol (:244-272), discard/kick (:274-326), and the
+clientid→channel registry (emqx_cm_registry). Detached persistent
+sessions are kept for ``session_expiry_interval`` and swept by
+:meth:`expire_sessions`.
 """
 
 from __future__ import annotations
@@ -44,6 +47,10 @@ class ConnectionManager:
                 lk = threading.Lock()
                 self._locks[client_id] = lk
             return lk
+
+    def _cluster_locker(self):
+        return getattr(self.cluster, "locker", None) \
+            if self.cluster is not None else None
 
     # -- registry ---------------------------------------------------------
 
@@ -116,54 +123,68 @@ class ConnectionManager:
                      ) -> Tuple[Session, bool]:
         """Returns (session, session_present)."""
         with self._client_lock(client_id):
-            old_chan = self._channels.get(client_id)
-            if clean_start:
-                # old session ends now → a delay-held will fires now
-                self.cancel_will(client_id, fire=True)
-                if old_chan is not None and old_chan is not channel:
-                    self._kick(old_chan, discard=True)
-                elif self.cluster is not None:
-                    loc = self.cluster.locate_client(client_id)
-                    if loc is not None and loc != self.cluster.name:
-                        self.cluster.remote_discard(client_id, loc)
-                stale = self._detached.pop(client_id, None)
-                if stale is not None and self.broker is not None:
-                    self.broker.subscriber_down(stale[0])
-                sess = self._new_session(client_id, True, session_opts)
-                if self.broker is not None:
-                    self.broker.metrics.inc("session.created")
-                    self.broker.hooks.run(
-                        "session.created", (client_id, sess.info()))
-                self._register(client_id, channel)
-                return sess, False
-            # resume path: connection re-established → pending will
-            # MUST NOT be sent (MQTT5 3.1.3.2.2)
-            self.cancel_will(client_id)
-            sess: Optional[Session] = None
+            locker = self._cluster_locker()
+            if locker is not None:
+                locker.acquire(client_id)
+            try:
+                return self._open_session_locked(
+                    client_id, clean_start, channel, session_opts)
+            finally:
+                if locker is not None:
+                    locker.release(client_id)
+
+    def _open_session_locked(self, client_id: str, clean_start: bool,
+                             channel,
+                             session_opts: Optional[dict]
+                             ) -> Tuple[Session, bool]:
+        old_chan = self._channels.get(client_id)
+        if clean_start:
+            # old session ends now → a delay-held will fires now
+            self.cancel_will(client_id, fire=True)
             if old_chan is not None and old_chan is not channel:
-                sess = self._takeover(old_chan)
-            elif client_id in self._detached:
-                sess, _ts, _exp = self._detached.pop(client_id)
+                self._kick(old_chan, discard=True)
             elif self.cluster is not None:
-                # the session may live on another node: pull it over
-                # (emqx_cm:takeover_session RPC path)
                 loc = self.cluster.locate_client(client_id)
                 if loc is not None and loc != self.cluster.name:
-                    sess = self.cluster.remote_takeover(client_id, loc)
-                    if sess is not None:
-                        sess.client_id = client_id
-            if sess is not None:
-                self._register(client_id, channel)
-                if self.broker is not None:
-                    sess.resume(self.broker)
-                return sess, True
-            sess = self._new_session(client_id, False, session_opts)
+                    self.cluster.remote_discard(client_id, loc)
+            stale = self._detached.pop(client_id, None)
+            if stale is not None and self.broker is not None:
+                self.broker.subscriber_down(stale[0])
+            sess = self._new_session(client_id, True, session_opts)
             if self.broker is not None:
                 self.broker.metrics.inc("session.created")
                 self.broker.hooks.run(
                     "session.created", (client_id, sess.info()))
             self._register(client_id, channel)
             return sess, False
+        # resume path: connection re-established → pending will
+        # MUST NOT be sent (MQTT5 3.1.3.2.2)
+        self.cancel_will(client_id)
+        sess: Optional[Session] = None
+        if old_chan is not None and old_chan is not channel:
+            sess = self._takeover(old_chan)
+        elif client_id in self._detached:
+            sess, _ts, _exp = self._detached.pop(client_id)
+        elif self.cluster is not None:
+            # the session may live on another node: pull it over
+            # (emqx_cm:takeover_session RPC path)
+            loc = self.cluster.locate_client(client_id)
+            if loc is not None and loc != self.cluster.name:
+                sess = self.cluster.remote_takeover(client_id, loc)
+                if sess is not None:
+                    sess.client_id = client_id
+        if sess is not None:
+            self._register(client_id, channel)
+            if self.broker is not None:
+                sess.resume(self.broker)
+            return sess, True
+        sess = self._new_session(client_id, False, session_opts)
+        if self.broker is not None:
+            self.broker.metrics.inc("session.created")
+            self.broker.hooks.run(
+                "session.created", (client_id, sess.info()))
+        self._register(client_id, channel)
+        return sess, False
 
     def _register(self, client_id: str, channel) -> None:
         self._channels[client_id] = channel
@@ -190,18 +211,30 @@ class ConnectionManager:
             pass
         self.unregister_channel(getattr(chan, "client_id", ""), chan)
 
-    def discard_session(self, client_id: str) -> None:
-        self.cancel_will(client_id, fire=True)  # session ends now
-        chan = self._channels.get(client_id)
-        if chan is not None:
-            self._kick(chan, discard=True)
-        stale = self._detached.pop(client_id, None)
-        if stale is not None and self.broker is not None:
-            self.broker.subscriber_down(stale[0])
-        if self.cluster is not None:
-            self.cluster.client_down(client_id)
-        if self.broker is not None:
-            self.broker.metrics.inc("session.discarded")
+    def discard_session(self, client_id: str,
+                        cluster_lock: bool = True) -> None:
+        """``cluster_lock=False`` is the remote-RPC entry: the
+        REQUESTING node already holds this clientid's cluster lock
+        (emqx_cm.erl:274-282 — discard runs inside the caller's
+        locker transaction)."""
+        locker = self._cluster_locker() if cluster_lock else None
+        if locker is not None:
+            locker.acquire(client_id)
+        try:
+            self.cancel_will(client_id, fire=True)  # session ends now
+            chan = self._channels.get(client_id)
+            if chan is not None:
+                self._kick(chan, discard=True)
+            stale = self._detached.pop(client_id, None)
+            if stale is not None and self.broker is not None:
+                self.broker.subscriber_down(stale[0])
+            if self.cluster is not None:
+                self.cluster.client_down(client_id)
+            if self.broker is not None:
+                self.broker.metrics.inc("session.discarded")
+        finally:
+            if locker is not None:
+                locker.release(client_id)
 
     def kick_session(self, client_id: str) -> bool:
         chan = self._channels.get(client_id)

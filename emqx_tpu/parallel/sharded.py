@@ -94,12 +94,22 @@ def build_sharded_bitmaps(
 
 
 def shard_of(filter_: str, n_shards: int) -> int:
-    """STABLE filter→shard assignment (crc32, not Python's salted
-    hash): a filter keeps its shard across route churn and across
-    processes, so a mutation touches exactly one shard's automaton —
-    the precondition for per-shard O(delta) patching (round-robin
-    over the sorted set would reshuffle every assignment on insert)."""
-    return zlib.crc32(filter_.encode("utf-8")) % n_shards
+    """STABLE filter→shard assignment (crc32 + avalanche finalizer,
+    not Python's salted hash): a filter keeps its shard across route
+    churn and across processes, so a mutation touches exactly one
+    shard's automaton — the precondition for per-shard O(delta)
+    patching (round-robin over the sorted set would reshuffle every
+    assignment on insert). The murmur-style finalizer matters: CRC32
+    is LINEAR, so near-identical filter names (``a/x`` vs ``a/+``)
+    keep correlated low bits and ``crc % 2^k`` collapses structured
+    name families into one shard."""
+    h = zlib.crc32(filter_.encode("utf-8"))
+    h ^= h >> 16
+    h = (h * 0x7FEB352D) & 0xFFFFFFFF
+    h ^= h >> 15
+    h = (h * 0x846CA68B) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h % n_shards
 
 
 def shard_filters(filters: Sequence[str], n_shards: int) -> List[List[str]]:

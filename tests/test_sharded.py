@@ -416,10 +416,32 @@ def test_sharded_shared_pick_parity():
             assert got == expect, (t, got, expect)
 
 
-def test_sharded_bitmap_multi_big_and_overflow():
-    """Mesh bitmap path with several big filters across shards: union
-    of members per topic delivers exactly; > mb big matches on one
-    shard flags bovf and falls back to the host loop."""
+def _pick_family(n_trie, mb, want_spread):
+    """Find a topic family whose three matching filters (exact, +, #)
+    spread over >1 trie shard with ≤ mb per shard (want_spread=True),
+    or all collide in ONE shard with count > mb (False)."""
+    from emqx_tpu.parallel.sharded import shard_of
+
+    for i in range(1000):
+        fam = f"w{i}"
+        filters = [f"{fam}/x", f"{fam}/+", f"{fam}/#"]
+        shards = [shard_of(f, n_trie) for f in filters]
+        counts = [shards.count(t) for t in range(n_trie)]
+        if want_spread:
+            if max(counts) <= mb and len(set(shards)) > 1:
+                return fam, filters
+        else:
+            if max(counts) > mb:
+                return fam, [f for f, s in zip(filters, shards)
+                             if s == max(range(n_trie),
+                                         key=counts.__getitem__)]
+    raise AssertionError("no suitable family found")
+
+
+def test_sharded_bitmap_multi_big_union_across_shards():
+    """Mesh bitmap path with big filters spread over BOTH trie
+    shards: per-shard ORs combine over ICI into one union; the
+    multi-big tail delivers each (filter, member) pair exactly."""
     from emqx_tpu.broker import Broker
     from emqx_tpu.parallel.mesh import make_mesh
     from emqx_tpu.router import MatcherConfig, Router
@@ -433,25 +455,57 @@ def test_sharded_bitmap_multi_big_and_overflow():
         def deliver(self, flt, msg):
             self.got.append(flt)
 
+    fam, filters = _pick_family(2, mb=2, want_spread=True)
     mesh = make_mesh(4, 2)
     b = Broker(router=Router(
         MatcherConfig(mesh=mesh, fanout_d=4, fanout_mb=2),
         node="local"))
     subs = [S(i) for i in range(30)]
-    # three big filters (>d=4 members) matching the same topic family
-    big_members = {"big/#": subs[:20], "big/+": subs[5:25],
-                   "big/x": subs[10:30]}
+    slices = [subs[:20], subs[5:25], subs[10:30]]
+    big_members = dict(zip(filters, slices))
     for f, ms in big_members.items():
         for s in ms:
             b.subscribe(s, f)
-    n = b.publish(Message(topic="big/x"))
+    n = b.publish(Message(topic=f"{fam}/x"))
     assert n == 60  # per-subscription delivery: 20 per filter
     for i, s in enumerate(subs):
         exp = sorted(f for f, ms in big_members.items() if s in ms)
         assert sorted(s.got) == exp, (i, s.got, exp)
-    # the metrics counted them as delivered
     assert b.metrics.val("messages.delivered") == 60
     # the device stat counts UNIQUE union members once (not once per
-    # trie shard — regression: the OR-reduced union is replicated)
+    # trie shard — regression: the OR-reduced union is replicated);
+    # no truncation happened (≤ mb big rows per shard)
     st = b.router.drain_device_stats()
+    assert st["overflows"] == 0, st
     assert st["deliveries"] == 30, st
+
+
+def test_sharded_bitmap_mb_truncation_falls_back_exact():
+    """More big matches than mb on ONE shard: bovf flags the row and
+    the host loop delivers — exact despite the truncated union."""
+    from emqx_tpu.broker import Broker
+    from emqx_tpu.parallel.mesh import make_mesh
+    from emqx_tpu.router import MatcherConfig, Router
+    from emqx_tpu.types import Message
+
+    class S:
+        def __init__(self):
+            self.got = []
+
+        def deliver(self, flt, msg):
+            self.got.append(flt)
+
+    fam, colliding = _pick_family(2, mb=1, want_spread=False)
+    assert len(colliding) >= 2
+    mesh = make_mesh(4, 2)
+    b = Broker(router=Router(
+        MatcherConfig(mesh=mesh, fanout_d=2, fanout_mb=1),
+        node="local"))
+    subs = [S() for _ in range(8)]
+    for f in colliding:
+        for s in subs:
+            b.subscribe(s, f)  # 8 > d=2: all big, same shard, > mb=1
+    n = b.publish(Message(topic=f"{fam}/x"))
+    assert n == 8 * len(colliding)
+    for s in subs:
+        assert sorted(s.got) == sorted(colliding)
