@@ -19,10 +19,42 @@ def main(argv=None) -> int:
                     help="TCP listener port when no config file is given")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="SO_REUSEPORT worker processes sharing the "
+                         "port, clustered (0 = single process)")
     args = ap.parse_args(argv)
 
     from emqx_tpu.logger import setup as setup_logger
     setup_logger(level=getattr(logging, args.log_level.upper(), logging.INFO))
+
+    if args.workers > 1:
+        import time as _time
+
+        from emqx_tpu.workers import WorkerPool
+        pool = WorkerPool(args.workers, port=args.port, host=args.host)
+        port = pool.start()
+        print(f"listening: {args.workers} workers on "
+              f"{args.host}:{port}", flush=True)
+        rc = 0
+        try:
+            while True:
+                dead = [i for i, p in enumerate(pool.procs)
+                        if p.poll() is not None]
+                if dead:
+                    # a crashed worker is a FAILURE exit: process
+                    # supervisors must see it and restart the pool
+                    for i in dead:
+                        print(f"worker {i} exited "
+                              f"rc={pool.procs[i].returncode}",
+                              flush=True)
+                    rc = 1
+                    break
+                _time.sleep(1.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            pool.stop()
+        return rc
 
     if args.config:
         from emqx_tpu.config import boot_from_file

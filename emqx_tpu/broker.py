@@ -714,6 +714,16 @@ class Broker:
             self.metrics.inc("delivery.dropped")
             self.metrics.inc("delivery.dropped.no_local")
             return 0
+        if "_wire" not in msg.headers:
+            # shared wire-image cache: Session._enrich either returns
+            # this very object (fast path) or copies headers SHALLOWLY
+            # (dict(msg.headers)), so delivering sessions share this
+            # inner dict and reuse one serialized QoS0 frame
+            # (channel.handle_deliver broadcast fast path) instead of
+            # serializing per subscriber. Message.copy() deep-copies
+            # nested dicts — a copy() product gets a private cache,
+            # primed but unshared.
+            msg.headers["_wire"] = {}
         try:
             sub.deliver(topic_filter, msg)
             return 1

@@ -100,6 +100,10 @@ class Channel:
         self.on_close = None          # force-close the socket
         self.on_deliver = None        # new outbox items are ready
         self.send_oob = None          # out-of-band packet send (kick)
+        # broadcast fast path (set by the transport): handle_deliver
+        # may return raw WIRE BYTES for QoS0 deliveries, sharing one
+        # serialized frame across every subscriber of a message
+        self.wire_fast = False
         # publish futures whose acks are still pending at the ingress
         # batcher — error-path acks queue behind them to preserve
         # MQTT-4.6.0 ack ordering
@@ -324,6 +328,13 @@ class Channel:
                         return self._disconnect_with(
                             RC.PROTOCOL_ERROR)
                     pkt.topic = topic
+                # the alias is a PER-CONNECTION input artifact: once
+                # resolved it must not travel with the routed message
+                # (MQTT-3.3.2-6 — a subscriber that advertised no
+                # alias support must never see one; outbound aliasing
+                # is negotiated separately in handle_deliver)
+                pkt.properties = {k: v for k, v in pkt.properties.items()
+                                  if k != "Topic-Alias"}
         try:
             check(pkt)
         except PacketError:
@@ -651,6 +662,20 @@ class Channel:
                 self.broker.metrics.inc("delivery.dropped")
                 self.broker.metrics.inc("delivery.dropped.expired")
                 continue
+            if pid is None and self.wire_fast and not self.mountpoint \
+                    and not self.client_alias_max:
+                data = self._wire_cached(msg)
+                if data is not None:
+                    if self.client_max_packet and \
+                            len(data) > self.client_max_packet:
+                        self.broker.metrics.inc("delivery.dropped")
+                        self.broker.metrics.inc(
+                            "delivery.dropped.too_large")
+                        continue
+                    self.broker.metrics.inc("packets.publish.sent")
+                    self.broker.metrics.inc_sent(msg)
+                    out.append(data)
+                    continue
             # copy before wire-mutation: the same object stays in the
             # inflight window for retry/replay
             msg = msg.copy()
@@ -705,6 +730,36 @@ class Channel:
             self.broker.metrics.inc_sent(msg)
             out.append(pub)
         return out
+
+    def _wire_cached(self, msg) -> Optional[bytes]:
+        """One serialized QoS0 PUBLISH per (message, proto version),
+        shared by every subscriber session through the message's
+        ``_wire`` header dict (reference-shared across enrich/copy —
+        see Broker._deliver_one). None = not eligible, take the
+        per-delivery slow path."""
+        wire = msg.headers.get("_wire")
+        if wire is None:
+            return None
+        props = msg.headers.get("properties")
+        if props and ("Message-Expiry-Interval" in props
+                      or "Subscription-Identifier" in props):
+            # per-delivery rewrites (expiry countdown) or
+            # per-SESSION values (subid) must never enter the shared
+            # cache — another subscriber would replay them
+            return None
+        # enriched copies SHARE this dict but can differ in the
+        # byte-affecting flags (RAP keeps retain, shared redispatch
+        # sets dup) — they key separately
+        key = (self.proto_ver, msg.flags.get("retain", False),
+               msg.flags.get("dup", False))
+        data = wire.get(key)
+        if data is None:
+            pub = from_message(None, msg)
+            if self.proto_ver != C.MQTT_V5:
+                pub.properties = {}
+            data = wire_serialize(pub, self.proto_ver)
+            wire[key] = data
+        return data
 
     # -- timers -----------------------------------------------------------
 

@@ -373,6 +373,17 @@ class Cluster:
     # -- data plane (gen_rpc analogue) ------------------------------------
 
     def _forward(self, node: str, flt: str, msg: Message) -> None:
+        if "_wire" in msg.headers:
+            # the local-delivery wire cache must not be pickled onto
+            # the wire: rebuild with the headers minus the cache (a
+            # full msg.copy() would deep-copy the very bytes being
+            # discarded, once per destination node)
+            msg = Message(
+                topic=msg.topic, payload=msg.payload, qos=msg.qos,
+                from_=msg.from_, flags=dict(msg.flags),
+                headers={k: v for k, v in msg.headers.items()
+                         if k != "_wire"},
+                id=msg.id, timestamp=msg.timestamp)
         try:
             self.transport.cast(node, "forward", flt, msg)
         except ConnectionError:

@@ -54,6 +54,7 @@ class Connection:
         self.channel.on_close = self._close_transport
         self.channel.on_deliver = self._schedule_flush
         self.channel.send_oob = self._send_packets
+        self.channel.wire_fast = True  # shared-frame QoS0 broadcast
         self.parser = Parser(max_size=self.zone.max_packet_size)
         self.broker = broker
         self.recv_bytes = 0
@@ -70,6 +71,7 @@ class Connection:
                     if self.zone.force_gc_policy else None)
         self._timers: list = []
         self._loop = None  # serving loop, captured by run()
+        self._flush_scheduled = False  # coalesced delivery wakeups
 
     # -- IO ----------------------------------------------------------------
 
@@ -82,6 +84,16 @@ class Connection:
         from emqx_tpu.mqtt.packet import Publish
         max_out = self.channel.client_max_packet
         for pkt in pkts:
+            if type(pkt) is bytes:
+                # broadcast fast path: the channel already produced
+                # (and size-gated) the shared wire image
+                self.send_bytes += len(pkt)
+                self.send_pkts += 1
+                self.broker.metrics.inc("packets.sent")
+                self.broker.metrics.inc("bytes.sent", len(pkt))
+                if not self._closing:
+                    self.writer.write(self._wrap_out(pkt))
+                continue
             data = serialize(pkt, self.channel.proto_ver)
             if max_out and len(data) > max_out:
                 # MQTT-3.1.2-24 covers EVERY packet. PUBLISHes are
@@ -126,7 +138,15 @@ class Connection:
         """Wake the writer when the broker delivered into our session
         from another connection's task — or from another THREAD (the
         cluster IO thread delivering a forwarded publish): the wakeup
-        must land on this connection's own loop, never the caller's."""
+        must land on this connection's own loop, never the caller's.
+
+        Coalesced: a burst of deliveries into one session (a batch
+        tail fanning out) schedules ONE flush, which drains the whole
+        outbox — not one callback per message (the benign cross-thread
+        race costs at most one extra empty flush)."""
+        if self._flush_scheduled:
+            return
+        self._flush_scheduled = True
         loop = self._loop
         if loop is None:
             try:
@@ -144,6 +164,7 @@ class Connection:
             loop.call_soon_threadsafe(self._flush_deliver)
 
     def _flush_deliver(self) -> None:
+        self._flush_scheduled = False
         if self._closing:
             return
         self._send_packets(self.channel.handle_deliver())
@@ -285,7 +306,7 @@ class Listener:
                  port: int = 1883, zone: Optional[Zone] = None,
                  name: str = "tcp:default",
                  max_connections: int = 1024000,
-                 ssl_context=None) -> None:
+                 ssl_context=None, reuse_port: bool = False) -> None:
         self.broker = broker
         self.cm = cm
         self.host = host
@@ -293,6 +314,9 @@ class Listener:
         self.zone = zone or get_zone()
         self.name = name
         self.max_connections = max_connections
+        # SO_REUSEPORT: several worker processes bind the same port
+        # and the kernel load-balances accepts (emqx_tpu.workers)
+        self.reuse_port = reuse_port
         # ssl.SSLContext → TLS-terminating listener (mqtt:ssl / wss);
         # built from TlsOptions by emqx_tpu.tls.make_server_context
         self.ssl_context = ssl_context
@@ -343,7 +367,8 @@ class Listener:
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port,
-            ssl=self.ssl_context)
+            ssl=self.ssl_context,
+            reuse_port=self.reuse_port or None)
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
         log.info("listener %s on %s:%s", self.name, self.host, self.port)

@@ -84,8 +84,12 @@ class RetainerModule(Module):
             return None
         if msg.topic not in self._store:
             self.node.metrics.inc("retained.count")
-        self._store[msg.topic] = msg.copy()
-        self._replicate(msg.topic, self._store[msg.topic])
+        stored = msg.copy()
+        # the broadcast wire cache is per-live-delivery state, not
+        # part of the retained record
+        stored.headers.pop("_wire", None)
+        self._store[msg.topic] = stored
+        self._replicate(msg.topic, stored)
         return None  # the message still routes normally
 
     def _replicate(self, topic: str, msg, ts: float = None) -> None:

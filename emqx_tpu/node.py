@@ -110,10 +110,12 @@ class Node:
     def add_listener(self, host: str = "127.0.0.1", port: int = 1883,
                      zone: Optional[Zone] = None,
                      name: str = "tcp:default",
-                     max_connections: int = 1024000) -> Listener:
+                     max_connections: int = 1024000,
+                     reuse_port: bool = False) -> Listener:
         lst = Listener(self.broker, self.cm, host=host, port=port,
                        zone=zone or self.zone, name=name,
-                       max_connections=max_connections)
+                       max_connections=max_connections,
+                       reuse_port=reuse_port)
         self.listeners.append(lst)
         return lst
 

@@ -215,6 +215,17 @@ class Session:
 
     def _enrich(self, topic_filter: str, msg: Message) -> Message:
         opts = self.subscriptions.get(topic_filter)
+        if (opts is not None and msg.qos == 0
+                and not msg.flags.get("retain")
+                and opts.share is None and not opts.nl
+                and opts.subid is None
+                and (opts.qos == 0 or not self.upgrade_qos)):
+            # broadcast fast path: a QoS0, non-retained delivery with
+            # plain subopts has NOTHING to rewrite — every session
+            # shares the SAME message object (and its cached wire
+            # image, see Broker._deliver_one); downstream treats it
+            # as immutable
+            return msg
         # look up shared form too: session keys by full filter string
         if opts is None:
             for key, o in self.subscriptions.items():

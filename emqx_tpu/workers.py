@@ -1,0 +1,220 @@
+"""Multi-process front-door sharding: SO_REUSEPORT worker pool.
+
+The reference's front door scales inside ONE BEAM node — esockd
+acceptor pools fan accepted sockets over scheduler threads that own
+every core (src/emqx_listeners.erl:43-81, src/emqx_channel.erl one
+process per connection). CPython's GIL forces the process boundary
+instead, so the TPU build shards the LISTENER:
+
+- N worker processes each run a full Node (own event loop, own
+  ingress batcher, own device plane) and bind the SAME MQTT port with
+  ``SO_REUSEPORT`` — the kernel load-balances accepted connections
+  across the workers;
+- the workers join one broker cluster over the socket transport
+  (:mod:`emqx_tpu.cluster_net`), so the existing route replication,
+  cross-node forwarding, shared-group routing, clientid locking, and
+  takeover protocols make the shard split invisible: a subscriber
+  accepted by worker 2 receives publishes ingested by worker 0
+  through the cluster data plane, exactly like any two cluster nodes;
+- worker 0 is the cluster seed; later workers join through its
+  transport address (handed over the spawn pipe).
+
+This is the deployment shape for many-core hosts; on a single core
+the workers time-share and one process is the better configuration
+(``workers=1`` is exactly the plain Node).
+
+Used as a library (:class:`WorkerPool`) and as the ``--workers N``
+flag of ``python -m emqx_tpu``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+_WORKER_MAIN = r"""
+import asyncio, os, signal, sys
+
+import jax
+
+if os.environ.get("EMQX_TPU_WORKER_PLATFORM"):
+    jax.config.update("jax_platforms",
+                      os.environ["EMQX_TPU_WORKER_PLATFORM"])
+
+from emqx_tpu.cluster import Cluster
+from emqx_tpu.cluster_net import SocketTransport
+from emqx_tpu.node import Node
+
+
+async def main():
+    idx = int(sys.argv[1])
+    port = int(sys.argv[2])
+    host = sys.argv[3]
+    seed = sys.argv[4]          # "" for worker 0, else "host:port"
+    cookie = sys.argv[5]
+    name = f"worker{idx}@{os.getpid()}"
+    n = Node(name=name, boot_listeners=False)
+    tr = SocketTransport(name, cookie=cookie)
+    tr.serve()
+    cl = Cluster(n, transport=tr)
+    lst = n.add_listener(host=host, port=port, reuse_port=True)
+    await n.start()
+    if seed:
+        sh, sp = seed.rsplit(":", 1)
+        cl.join_remote(sh, int(sp))
+    # READY <listener-port> <transport-port>
+    print(f"READY {lst.port} {tr.port}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin)
+
+    async def stdin_cmds():
+        while True:
+            line = await reader.readline()
+            if not line:
+                stop.set()
+                return
+            parts = line.decode().split()
+            if not parts:
+                continue
+            if parts[0] == "STATS?":
+                print(f"STATS {n.cm.connection_count()} "
+                      f"{n.metrics.val('messages.delivered')}",
+                      flush=True)
+            elif parts[0] == "QUIT":
+                stop.set()
+                return
+
+    cmds = asyncio.create_task(stdin_cmds())
+    await stop.wait()
+    cmds.cancel()
+    cl.leave()
+    await n.stop()
+    tr.close()
+
+
+asyncio.run(main())
+"""
+
+
+class WorkerPool:
+    """Spawn + supervise N SO_REUSEPORT listener workers."""
+
+    def __init__(self, n_workers: int, port: int = 1883,
+                 host: str = "127.0.0.1", cookie: str = "emqx-workers",
+                 platform: Optional[str] = None) -> None:
+        self.n_workers = n_workers
+        self.port = port
+        self.host = host
+        self.cookie = cookie
+        self.platform = platform
+        self.procs: List[subprocess.Popen] = []
+        self._seed_addr = ""
+
+    def _spawn_one(self, idx: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        if self.platform:
+            env["EMQX_TPU_WORKER_PLATFORM"] = self.platform
+        return subprocess.Popen(
+            [sys.executable, "-c", _WORKER_MAIN, str(idx),
+             str(self.port), self.host, self._seed_addr, self.cookie],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+
+    def _await_ready(self, proc: subprocess.Popen,
+                     timeout: float = 120.0):
+        import select
+
+        deadline = time.monotonic() + timeout
+        buf = b""
+        while time.monotonic() < deadline:
+            # readline() would block forever on a wedged worker (the
+            # known hung-device-init mode); select enforces the budget
+            r, _, _ = select.select([proc.stdout],
+                                    [], [], min(1.0, deadline
+                                                - time.monotonic()))
+            if not r:
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096)
+            if not chunk:
+                raise RuntimeError("worker died before READY")
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                text = line.decode().strip()
+                if text.startswith("READY"):
+                    _, lport, tport = text.split()
+                    return int(lport), int(tport)
+        raise TimeoutError("worker did not become ready")
+
+    def start(self) -> int:
+        """Spawn all workers; returns the (shared) listener port.
+        A worker failing to come up tears the whole pool down — no
+        orphan may keep holding the SO_REUSEPORT port."""
+        try:
+            p0 = self._spawn_one(0)
+            self.procs.append(p0)
+            lport, tport = self._await_ready(p0)
+            self.port = lport
+            self._seed_addr = f"{self.host}:{tport}"
+            for i in range(1, self.n_workers):
+                p = self._spawn_one(i)
+                self.procs.append(p)
+                self._await_ready(p)
+        except BaseException:
+            self.stop()
+            raise
+        return self.port
+
+    def stats(self) -> List[tuple]:
+        """[(connections, delivered)] per worker."""
+        out = []
+        for p in self.procs:
+            if p.poll() is not None:
+                out.append((0, 0))
+                continue
+            p.stdin.write(b"STATS?\n")
+            p.stdin.flush()
+            while True:
+                line = p.stdout.readline()
+                if not line:
+                    out.append((0, 0))
+                    break
+                text = line.decode().strip()
+                if text.startswith("STATS"):
+                    _, conns, deliv = text.split()
+                    out.append((int(conns), int(deliv)))
+                    break
+        return out
+
+    def stop(self, timeout: float = 20.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.stdin.write(b"QUIT\n")
+                    p.stdin.flush()
+                except Exception:
+                    p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs.clear()
+
+    def __enter__(self) -> "WorkerPool":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

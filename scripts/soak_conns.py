@@ -1,0 +1,247 @@
+"""Connection-count soak: hold tens of thousands of live MQTT
+connections against the broker (the reference's identity is millions
+of concurrent connections, /root/reference/README.md:16; this records
+what one host of this build actually sustains).
+
+Server side runs in THIS process (or a worker pool with --workers);
+clients are spawned as separate OS processes so the ~20k fd rlimit
+bounds each side separately.
+
+Usage:
+    python scripts/soak_conns.py --conns 15000 [--workers 2]
+        [--clients 3] [--hold 20]
+
+Prints one JSON line: connections established, handshake rate, RSS,
+delivery spot-check through the full stack at peak connection count.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_CLIENT = r"""
+import asyncio, struct, sys, time
+
+HOST, PORT = sys.argv[1], int(sys.argv[2])
+N, OFFSET = int(sys.argv[3]), int(sys.argv[4])
+# per-client-process source IP inside 127/8: each source address has
+# its own ephemeral-port space, so total connections are not capped
+# by one ~28k ip_local_port_range
+LOCAL_IP = sys.argv[5] if len(sys.argv) > 5 else None
+
+
+def connect_bytes(cid: str) -> bytes:
+    body = (b"\x00\x04MQTT\x04\x02\x03\x84"  # v3.1.1, clean, ka=900
+            + struct.pack(">H", len(cid)) + cid.encode())
+    return bytes([0x10, len(body)]) + body
+
+
+def subscribe_bytes(flt: str) -> bytes:
+    body = (b"\x00\x01" + struct.pack(">H", len(flt)) + flt.encode()
+            + b"\x00")
+    return bytes([0x82, len(body)]) + body
+
+
+async def one(i, writers):
+    kw = {"local_addr": (LOCAL_IP, 0)} if LOCAL_IP else {}
+    r, w = await asyncio.open_connection(HOST, PORT, **kw)
+    w.write(connect_bytes(f"soak{OFFSET + i}"))
+    await w.drain()
+    await r.readexactly(4)          # CONNACK
+    w.write(subscribe_bytes(f"soak/all"))
+    await w.drain()
+    await r.readexactly(5)          # SUBACK
+    writers.append((r, w))
+
+
+async def main():
+    writers = []
+    t0 = time.perf_counter()
+    sem = asyncio.Semaphore(200)    # bounded connect concurrency
+
+    async def guarded(i):
+        async with sem:
+            await one(i, writers)
+
+    results = await asyncio.gather(
+        *(guarded(i) for i in range(N)), return_exceptions=True)
+    errs = [r for r in results if isinstance(r, Exception)]
+    dt = time.perf_counter() - t0
+    print(f"CONNECTED {len(writers)} {dt:.2f} {len(errs)}", flush=True)
+
+    # hold: drain any broadcast deliveries, count them
+    got = [0]
+
+    async def drain(r):
+        try:
+            while True:
+                d = await r.read(65536)
+                if not d:
+                    return
+                got[0] += d.count(0x30)  # PUBLISH headers (spot count)
+        except Exception:
+            return
+
+    tasks = [asyncio.create_task(drain(r)) for r, _ in writers]
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin)
+    while True:
+        line = await reader.readline()
+        if not line or line.startswith(b"QUIT"):
+            break
+        if line.startswith(b"COUNT?"):
+            print(f"COUNT {got[0]}", flush=True)
+    for t in tasks:
+        t.cancel()
+
+
+asyncio.run(main())
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--conns", type=int, default=15000)
+    ap.add_argument("--workers", type=int, default=0)
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--hold", type=float, default=10.0)
+    args = ap.parse_args()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if args.workers > 1:
+        from emqx_tpu.workers import WorkerPool
+
+        pool = WorkerPool(args.workers, port=0, platform="cpu",
+                          cookie="soak")
+        port = pool.start()
+        server_pids = [p.pid for p in pool.procs]
+    else:
+        # in-process server on a background thread's event loop
+        import asyncio
+        import threading
+
+        from emqx_tpu.node import Node
+
+        node = Node(boot_listeners=False)
+        lst = node.add_listener(port=0, max_connections=1_100_000)
+        ready = threading.Event()
+        loop_holder = {}
+
+        def serve():
+            async def run():
+                await node.start()
+                ready.set()
+                await asyncio.Event().wait()
+
+            loop = asyncio.new_event_loop()
+            loop_holder["loop"] = loop
+            try:
+                loop.run_until_complete(run())
+            except Exception:
+                pass
+
+        threading.Thread(target=serve, daemon=True).start()
+        ready.wait(60)
+        port = lst.port
+        pool = None
+        server_pids = [os.getpid()]
+
+    per = args.conns // args.clients
+    procs = []
+    t0 = time.perf_counter()
+    for c in range(args.clients):
+        n = per if c < args.clients - 1 else args.conns - per * (
+            args.clients - 1)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CLIENT, "127.0.0.1", str(port),
+             str(n), str(c * per), f"127.0.0.{10 + c}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env))
+    connected = 0
+    errors = 0
+    for p in procs:
+        line = p.stdout.readline().decode().strip()
+        _, n, dt, errs = line.split()
+        connected += int(n)
+        errors += int(errs)
+    setup_s = time.perf_counter() - t0
+
+    # spot-check the full stack AT PEAK: publish through a fresh
+    # socket, every soak connection (subscribed to soak/all) must
+    # receive it
+    time.sleep(args.hold)
+    import socket as _socket
+    import struct as _struct
+
+    s = _socket.create_connection(("127.0.0.1", port))
+    cid = b"soak-pub"
+    body = (b"\x00\x04MQTT\x04\x02\x00\x3c"
+            + _struct.pack(">H", len(cid)) + cid)
+    s.sendall(bytes([0x10, len(body)]) + body)
+    s.recv(4)
+    topic = b"soak/all"
+    pbody = _struct.pack(">H", len(topic)) + topic + b"ping"
+    s.sendall(bytes([0x30, len(pbody)]) + pbody)
+    deadline = time.time() + 120
+    delivered = 0
+    while time.time() < deadline:
+        time.sleep(2.0)
+        delivered = 0
+        for p in procs:
+            p.stdin.write(b"COUNT?\n")
+            p.stdin.flush()
+            line = p.stdout.readline().decode().strip()
+            delivered += int(line.split()[1])
+        if delivered >= connected:
+            break
+
+    rss_kb = 0
+    for pid in server_pids:
+        try:
+            with open(f"/proc/{pid}/status") as f:
+                for ln in f:
+                    if ln.startswith("VmRSS"):
+                        rss_kb += int(ln.split()[1])
+        except OSError:
+            pass
+
+    print(json.dumps({
+        "metric": "connection_soak",
+        "connections": connected,
+        "connect_errors": errors,
+        "setup_s": round(setup_s, 1),
+        "handshakes_per_s": round(connected / setup_s, 1),
+        "broadcast_delivered": delivered,
+        "workers": args.workers or 1,
+        "server_rss_mb": round(rss_kb / 1024, 1),
+    }), flush=True)
+
+    for p in procs:
+        try:
+            p.stdin.write(b"QUIT\n")
+            p.stdin.flush()
+        except Exception:
+            pass
+        p.wait(timeout=15)
+    if pool is not None:
+        pool.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -143,6 +143,13 @@ async def test_topic_alias_reuse_across_publishes():
         m2 = await sub.recv(10)
         assert (m1.payload, m2.payload) == (b"first", b"second")
         assert m1.topic == m2.topic == "al/t"
+        # MQTT-3.3.2-6: the PUBLISHER's alias is a per-connection
+        # input artifact — a subscriber that advertised NO alias
+        # support (Topic-Alias-Maximum absent -> 0) must never see a
+        # Topic-Alias property (regression: the shared broadcast
+        # frame once carried it through)
+        assert "Topic-Alias" not in (m1.properties or {})
+        assert "Topic-Alias" not in (m2.properties or {})
         await c.close()
         await sub.close()
 
