@@ -413,4 +413,95 @@ int32_t trie_match(Trie* t, const char* topic, int32_t len, int32_t* out,
     return cnt;
 }
 
+// ---------------------------------------------------------------------------
+// MQTT frame scanner — the wire-framing hot loop
+// ---------------------------------------------------------------------------
+// The reference frames packets in the BEAM's native binary machinery
+// (emqx_frame.erl pattern matches compile to BIF byte ops); the
+// Python port's per-byte varint/slice loop is the live path's single
+// biggest interpreter cost, so framing drops to C here. The scanner
+// only SPLITS frames and pre-slices the PUBLISH layout — packet-body
+// semantics (v5 properties, errors, every non-PUBLISH type) stay in
+// Python (emqx_tpu/mqtt/frame.py) so behavior/parity is pinned by the
+// existing fuzz suites.
+//
+// Output: 7 int32 per frame:
+//   [0] header byte   [1] body offset   [2] body length
+//   [3] topic offset (-1 = not a pre-sliced PUBLISH)
+//   [4] topic length  [5] packet id (-1 = QoS0)
+//   [6] post-topic/pid offset (v4: payload start; v5: properties)
+// Returns the frame count (>= 0), -1 on a malformed varint, -2 when a
+// frame exceeds max_size. state[0] = bytes consumed; state[1] = the
+// oversized frame's total size (for the -2 error message).
+
+int32_t mqtt_scan(const uint8_t* buf, int64_t len, int64_t max_size,
+                  int32_t max_frames, int32_t* out, int64_t* state) {
+    int64_t pos = 0;
+    int32_t nf = 0;
+    state[1] = 0;
+    while (nf < max_frames) {
+        if (len - pos < 2) break;
+        uint8_t header = buf[pos];
+        int64_t rl = 0, mult = 1, i = 1;
+        bool complete_varint = false, partial = false;
+        for (;;) {
+            if (pos + i >= len) {
+                if (i > 4) { state[0] = pos; return -1; }
+                partial = true;
+                break;
+            }
+            uint8_t b = buf[pos + i];
+            rl += (int64_t)(b & 0x7F) * mult;
+            i++;
+            if (!(b & 0x80)) { complete_varint = true; break; }
+            if (i > 4) { state[0] = pos; return -1; }
+            mult *= 128;
+        }
+        if (partial || !complete_varint) break;
+        if (i + rl > max_size) {
+            state[0] = pos;
+            state[1] = i + rl;
+            return -2;
+        }
+        if (len - pos < i + rl) break;
+        int32_t* row = out + (int64_t)nf * 7;
+        row[0] = header;
+        row[1] = (int32_t)(pos + i);
+        row[2] = (int32_t)rl;
+        row[3] = -1;
+        row[4] = 0;
+        row[5] = -1;
+        row[6] = -1;
+        if ((header >> 4) == 3) {  // PUBLISH
+            int32_t qos = (header >> 1) & 3;
+            if (qos <= 2 && rl >= 2) {
+                int64_t b0 = pos + i;
+                int64_t tl = ((int64_t)buf[b0] << 8) | buf[b0 + 1];
+                int64_t after = b0 + 2 + tl;
+                bool ok = after <= b0 + rl;
+                int32_t pid = -1;
+                int64_t pp = after;
+                if (ok && qos > 0) {
+                    if (pp + 2 <= b0 + rl) {
+                        pid = ((int32_t)buf[pp] << 8) | buf[pp + 1];
+                        pp += 2;
+                    } else {
+                        ok = false;
+                    }
+                }
+                if (ok) {
+                    row[3] = (int32_t)(b0 + 2);
+                    row[4] = (int32_t)tl;
+                    row[5] = pid;
+                    row[6] = (int32_t)pp;
+                }
+            }
+        }
+        pos += i + rl;
+        nf++;
+    }
+    state[0] = pos;
+    return nf;
+}
+
 }  // extern "C"
