@@ -29,6 +29,7 @@ from emqx_tpu.access_control import (DENY, PUB, SUB, AccessControl,
                                      ClientInfo)
 from emqx_tpu.acl_cache import AclCache
 from emqx_tpu.keepalive import Keepalive
+from emqx_tpu.limiter import TokenBucket
 from emqx_tpu.logger import set_metadata_clientid, set_metadata_peername
 from emqx_tpu.mountpoint import mount, replvar, unmount
 from emqx_tpu.mqtt import constants as C
@@ -108,6 +109,14 @@ class Channel:
         # batcher — error-path acks queue behind them to preserve
         # MQTT-4.6.0 ack ordering
         self._pending_pubs: List = []
+        # publish quota (reference: `quota` limiter field,
+        # src/emqx_channel.erl:77,193 init'd from the zone's quota
+        # policy): a token bucket drawn down by 1 + routed deliveries
+        # per publish; exhaustion blocks the PUBLISH pipeline until
+        # the refill instant (the reference's quota_timer)
+        self._quota = (TokenBucket(*self.zone.quota_conn_messages)
+                       if self.zone.quota_conn_messages else None)
+        self._quota_blocked_until = 0.0
 
     # -- helpers ----------------------------------------------------------
 
@@ -335,6 +344,21 @@ class Channel:
                 # is negotiated separately in handle_deliver)
                 pkt.properties = {k: v for k, v in pkt.properties.items()
                                   if k != "Topic-Alias"}
+        # quota gate — the head of the routing pipeline (reference
+        # check_quota_exceeded, src/emqx_channel.erl:458,1304-1310):
+        # while the bucket is in refill pause, QoS0 drops silently,
+        # QoS1 PUBACKs and QoS2 PUBRECs carry QUOTA_EXCEEDED (v5;
+        # v3/v4 clients get the plain ack, the reference's handle_out
+        # compat). Runs AFTER alias resolution — unlike the
+        # reference's pipeline order — so a quota drop cannot swallow
+        # an alias registration the client is entitled to rely on for
+        # its post-pause publishes.
+        if self._quota is not None and \
+                time.monotonic() < self._quota_blocked_until:
+            if pkt.qos == C.QOS_0:
+                self.broker.metrics.inc("packets.publish.dropped")
+                return []
+            return self._puback_for(pkt, RC.QUOTA_EXCEEDED)
         try:
             check(pkt)
         except PacketError:
@@ -369,11 +393,13 @@ class Channel:
                 return []
             if pkt.qos == C.QOS_2:
                 n = self.session.publish(pkt.packet_id, msg)
+                self._ensure_quota(n)
                 rc = RC.SUCCESS if n else RC.NO_MATCHING_SUBSCRIBERS
                 self.broker.metrics.inc("packets.pubrec.sent")
                 return [self._ack(C.PUBREC, pkt.packet_id,
                                   rc if self.proto_ver == C.MQTT_V5 else 0)]
             n = self.session.publish(pkt.packet_id, msg)
+            self._ensure_quota(n)
         except SessionError as e:
             if pkt.qos == C.QOS_2:
                 self.broker.metrics.inc("packets.pubrec.sent")
@@ -389,6 +415,17 @@ class Channel:
                               rc if self.proto_ver == C.MQTT_V5 else 0)]
         return []
 
+    def _ensure_quota(self, routed) -> None:
+        """Post-publish quota draw (reference ensure_quota,
+        src/emqx_channel.erl:545-558): 1 token for the publish plus
+        one per routed delivery; when the bucket runs dry the pipeline
+        blocks until the computed refill instant (quota_timer)."""
+        if self._quota is None:
+            return
+        pause = self._quota.consume(1 + (routed or 0))
+        if pause > 0:
+            self._quota_blocked_until = time.monotonic() + pause
+
     def _publish_batched(self, pkt: Publish, msg) -> bool:
         """Hand the message to the ingress batcher; the QoS1/2 ack is
         sent from the flush callback (SURVEY §2.2 row 1 — publishes
@@ -398,8 +435,22 @@ class Channel:
         if batcher is None or self.send_oob is None:
             return False
         if pkt.qos == C.QOS_0:
-            # fire-and-forget: no ack to defer, no future to consume
-            return batcher.submit(msg, want_result=False) is not None
+            if self._quota is None:
+                # fire-and-forget: no ack to defer, no future needed
+                return batcher.submit(msg, want_result=False) is not None
+            # with a quota configured the routed count matters (the
+            # draw is 1 + deliveries): take the result future just to
+            # feed the quota — QoS0 still sends no ack
+            fut = batcher.submit(msg)
+            if fut is None:
+                return False
+
+            def _quota_done(f) -> None:
+                if f.exception() is None:
+                    self._ensure_quota(f.result())
+
+            fut.add_done_callback(_quota_done)
+            return True
         fut = batcher.submit(msg)
         if fut is None:
             return False
@@ -424,6 +475,7 @@ class Channel:
                 # a lie the client can't recover from (at-least-once
                 # depends on its retransmit)
                 return
+            self._ensure_quota(f.result())
             rc = RC.SUCCESS if f.result() else RC.NO_MATCHING_SUBSCRIBERS
             self.broker.metrics.inc(f"packets.{name}.sent")
             self.send_oob([self._ack(

@@ -25,6 +25,7 @@ from emqx_tpu.gc import GcPolicy
 from emqx_tpu.limiter import TokenBucket
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.frame import FrameError, FrameTooLarge, Parser, serialize
+from emqx_tpu.mqtt.packet import Publish
 from emqx_tpu.zone import Zone, get_zone
 
 log = logging.getLogger("emqx_tpu.connection")
@@ -67,6 +68,17 @@ class Connection:
         self._finish_after_batch = False
         self._limiter = (TokenBucket(*self.zone.ratelimit_bytes_in)
                          if self.zone.ratelimit_bytes_in else None)
+        # msgs-in limiter: counts inbound PUBLISHes and pauses the
+        # read loop, the reference's conn_messages_in checker run by
+        # ensure_rate_limit (src/emqx_connection.erl:633-645,
+        # src/emqx_limiter.erl conn_messages_in)
+        self._msg_limiter = (TokenBucket(*self.zone.ratelimit_msg_in)
+                             if self.zone.ratelimit_msg_in else None)
+        # while a limiter pause blocks the read loop the client is
+        # unobservable, not dead: keepalive checks are deferred past
+        # this instant (the reference's `blocked` sockstate holds off
+        # idle shutdown the same way)
+        self._paused_until = 0.0
         self._gc = (GcPolicy(*self.zone.force_gc_policy)
                     if self.zone.force_gc_policy else None)
         self._timers: list = []
@@ -206,8 +218,9 @@ class Connection:
                 self.broker.metrics.inc("bytes.received", len(data))
                 if self._limiter is not None:
                     wait = self._limiter.consume(len(data))
-                    if wait > 0:
-                        await asyncio.sleep(wait)  # backpressure pause
+                    if wait > 0:  # backpressure pause
+                        self._paused_until = time.monotonic() + wait
+                        await asyncio.sleep(wait)
                 if self._gc is not None:
                     self._gc.inc(1, len(data))
                 pkts = await self._decode(data)
@@ -222,6 +235,19 @@ class Connection:
                     break
                 if not self._closing:
                     await self.writer.drain()
+                if self._msg_limiter is not None and pkts:
+                    # like the reference, the already-parsed batch is
+                    # processed first, then the socket pauses (state
+                    # `blocked` + limit_timeout timer there; a plain
+                    # sleep before the next read here)
+                    n_pubs = sum(1 for p in pkts
+                                 if isinstance(p, Publish))
+                    if n_pubs:
+                        wait = self._msg_limiter.consume(n_pubs)
+                        if wait > 0:
+                            self._paused_until = \
+                                time.monotonic() + wait
+                            await asyncio.sleep(wait)
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
@@ -271,6 +297,12 @@ class Connection:
             return
         while not self._closing:
             await asyncio.sleep(ka.check_interval())
+            if time.monotonic() < self._paused_until:
+                # rate-limit pause: the read loop isn't draining the
+                # socket, so a silent client proves nothing — a
+                # keepalive kill here would disconnect a live,
+                # merely-throttled client (and falsely fire its will)
+                continue
             out = self.channel.handle_timeout("keepalive", self.recv_bytes)
             self._send_packets(out)
             if self.channel.close_after_send:
