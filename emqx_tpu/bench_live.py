@@ -281,6 +281,11 @@ def live(emit=None) -> None:
         "vs_baseline": round(info["deliveries_per_s"] / 1_000_000, 3),
         "p50_batch_ms": round(info["p50_ms"], 3),
         "p99_batch_ms": round(info["p99_ms"], 3),
+        # per-message socket-to-deliver latency (BASELINE "p99 match
+        # latency tracked"): same samples, explicit name so the
+        # driver record carries it unambiguously
+        "p99_deliver_ms": round(info["p99_ms"], 3),
+        "p50_deliver_ms": round(info["p50_ms"], 3),
     }
     if emit is not None:
         # the repo-root bench entry passes its _emit so the record

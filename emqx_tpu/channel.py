@@ -344,21 +344,6 @@ class Channel:
                 # is negotiated separately in handle_deliver)
                 pkt.properties = {k: v for k, v in pkt.properties.items()
                                   if k != "Topic-Alias"}
-        # quota gate — the head of the routing pipeline (reference
-        # check_quota_exceeded, src/emqx_channel.erl:458,1304-1310):
-        # while the bucket is in refill pause, QoS0 drops silently,
-        # QoS1 PUBACKs and QoS2 PUBRECs carry QUOTA_EXCEEDED (v5;
-        # v3/v4 clients get the plain ack, the reference's handle_out
-        # compat). Runs AFTER alias resolution — unlike the
-        # reference's pipeline order — so a quota drop cannot swallow
-        # an alias registration the client is entitled to rely on for
-        # its post-pause publishes.
-        if self._quota is not None and \
-                time.monotonic() < self._quota_blocked_until:
-            if pkt.qos == C.QOS_0:
-                self.broker.metrics.inc("packets.publish.dropped")
-                return []
-            return self._puback_for(pkt, RC.QUOTA_EXCEEDED)
         try:
             check(pkt)
         except PacketError:
@@ -366,6 +351,22 @@ class Channel:
             # disconnect, as the reference does (t_publish_wildtopic)
             self.broker.metrics.inc("packets.publish.error")
             return self._disconnect_with(RC.TOPIC_NAME_INVALID)
+        # quota gate — the head of the routing pipeline (reference
+        # check_quota_exceeded, src/emqx_channel.erl:458,1304-1310):
+        # while the bucket is in refill pause, QoS0 drops silently,
+        # QoS1 PUBACKs and QoS2 PUBRECs carry QUOTA_EXCEEDED (v5;
+        # v3/v4 clients get the plain ack, the reference's handle_out
+        # compat). Runs AFTER alias resolution and validation — unlike
+        # the reference's pipeline order — so a quota drop can neither
+        # swallow an alias registration the client relies on for its
+        # post-pause publishes nor mask a protocol violation that must
+        # stay fatal regardless of quota state.
+        if self._quota is not None and \
+                time.monotonic() < self._quota_blocked_until:
+            if pkt.qos == C.QOS_0:
+                self.broker.metrics.inc("packets.publish.dropped")
+                return []
+            return self._puback_for(pkt, RC.QUOTA_EXCEEDED)
         # caps
         cap_rc = check_pub(self.zone, pkt.qos, pkt.retain, pkt.topic)
         if cap_rc is not None:

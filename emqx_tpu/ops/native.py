@@ -252,15 +252,140 @@ class NativeEngine:
     # -- batch encode -----------------------------------------------------
 
     def encode_batch(self, topics: Sequence[str], max_levels: int):
-        n = len(topics)
-        blobs = [t.encode() for t in topics]
-        offsets = np.zeros((n + 1,), dtype=np.int64)
-        for i, b in enumerate(blobs):
-            offsets[i + 1] = offsets[i] + len(b)
-        blob = b"".join(blobs)
-        ids = np.empty((n, max_levels), dtype=np.int32)
-        out_n = np.empty((n,), dtype=np.int32)
-        sysm = np.empty((n,), dtype=np.uint8)
-        self._lib.encode_topics(self._wt, blob, offsets, n, max_levels,
-                                ids.reshape(-1), out_n, sysm)
-        return ids, out_n, sysm.astype(bool)
+        return _encode_batch(self._lib, self._wt, topics, max_levels)
+
+
+class ShardedNativeEngine:
+    """The native engine for the MESH router: one shared word table,
+    one C++ trie per trie shard (the same stable ``shard_of``
+    assignment the Python builder uses), flattened into the stacked
+    :class:`~emqx_tpu.parallel.sharded.ShardedAutomaton` without ever
+    touching the Python TrieOracle. Round-3 left the mesh rebuild on
+    the Python builder (VERDICT r3 item 8); at 1M+ filters the C++
+    insert+flatten is the difference between a sub-second and a
+    multi-second shard rebuild."""
+
+    def __init__(self, n_shards: int) -> None:
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._wt = lib.wt_new()
+        self.n_shards = n_shards
+        self._tries = [lib.trie_new(self._wt) for _ in range(n_shards)]
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            for t in getattr(self, "_tries", []):
+                if t:
+                    lib.trie_free(t)
+            if getattr(self, "_wt", None):
+                lib.wt_free(self._wt)
+
+    def _shard(self, filter_: str) -> int:
+        from emqx_tpu.parallel.sharded import shard_of
+
+        return shard_of(filter_, self.n_shards)
+
+    # engine surface (same as NativeEngine) ------------------------------
+
+    def intern(self, word: str) -> int:
+        b = word.encode()
+        return self._lib.wt_intern(self._wt, b, len(b))
+
+    def words(self):
+        return NativeEngine.words(self)
+
+    def vocab_size(self) -> int:
+        return self._lib.wt_size(self._wt)
+
+    def insert(self, filter_: str, filter_id: int) -> bool:
+        b = filter_.encode()
+        return bool(self._lib.trie_insert(
+            self._tries[self._shard(filter_)], b, len(b), filter_id))
+
+    def delete(self, filter_: str) -> bool:
+        b = filter_.encode()
+        return bool(self._lib.trie_delete(
+            self._tries[self._shard(filter_)], b, len(b)))
+
+    def num_filters(self) -> int:
+        return sum(self._lib.trie_num_filters(t) for t in self._tries)
+
+    def match(self, topic: str, cap: int = 4096) -> np.ndarray:
+        """Union of every shard's matches (host fallback path)."""
+        b = topic.encode()
+        parts = []
+        for t in self._tries:
+            c = cap
+            while True:
+                out = np.empty((c,), dtype=np.int32)
+                n = self._lib.trie_match(t, b, len(b), out, c)
+                if n < c:
+                    parts.append(out[:n])
+                    break
+                c *= 4
+        return np.concatenate(parts) if parts else \
+            np.empty((0,), dtype=np.int32)
+
+    def encode_batch(self, topics: Sequence[str], max_levels: int):
+        return _encode_batch(self._lib, self._wt, topics, max_levels)
+
+    # -- sharded flatten --------------------------------------------------
+
+    def flatten_sharded(self, state_capacity: Optional[int] = None,
+                        edge_capacity: Optional[int] = None):
+        """All shards flattened at COMMON capacities and stacked —
+        the native analogue of ``parallel.sharded.build_sharded(...,
+        return_parts=True)``: returns ``(ShardedAutomaton, parts)``
+        where ``parts`` are the padded per-shard host Automatons that
+        seed the per-shard AutoPatcher mirrors."""
+        from emqx_tpu.ops.csr import (Automaton, attach_edge_hash,
+                                      buckets_for_capacity, capacity_for)
+        from emqx_tpu.parallel.sharded import _stack_sharded
+
+        counts = []
+        for t in self._tries:
+            s, e = C.c_int64(), C.c_int64()
+            self._lib.trie_counts(t, C.byref(s), C.byref(e))
+            counts.append((s.value, e.value))
+        s_cap = capacity_for(max(s for s, _ in counts), state_capacity)
+        e_cap = capacity_for(max(e for _, e in counts) + 1,
+                             edge_capacity)
+        nb = buckets_for_capacity(e_cap)
+        parts = []
+        for t, (_, n_e) in zip(self._tries, counts):
+            row_ptr = np.empty((s_cap + 1,), dtype=np.int32)
+            edge_word = np.empty((e_cap,), dtype=np.int32)
+            edge_child = np.empty((e_cap,), dtype=np.int32)
+            plus_child = np.empty((s_cap,), dtype=np.int32)
+            hash_filter = np.empty((s_cap,), dtype=np.int32)
+            end_filter = np.empty((s_cap,), dtype=np.int32)
+            n_states = self._lib.trie_flatten(
+                t, s_cap, e_cap, row_ptr, edge_word, edge_child,
+                plus_child, hash_filter, end_filter)
+            if n_states < 0:
+                raise RuntimeError("flatten capacity underestimated")
+            parts.append(attach_edge_hash(Automaton(
+                row_ptr=row_ptr, edge_word=edge_word,
+                edge_child=edge_child, plus_child=plus_child,
+                hash_filter=hash_filter, end_filter=end_filter,
+                n_states=int(n_states), n_edges=int(n_e)),
+                n_buckets=nb))
+        return _stack_sharded(parts), parts
+
+
+def _encode_batch(lib, wt, topics: Sequence[str], max_levels: int):
+    n = len(topics)
+    blobs = [t.encode() for t in topics]
+    offsets = np.zeros((n + 1,), dtype=np.int64)
+    for i, b in enumerate(blobs):
+        offsets[i + 1] = offsets[i] + len(b)
+    blob = b"".join(blobs)
+    ids = np.empty((n, max_levels), dtype=np.int32)
+    out_n = np.empty((n,), dtype=np.int32)
+    sysm = np.empty((n,), dtype=np.uint8)
+    lib.encode_topics(wt, blob, offsets, n, max_levels,
+                      ids.reshape(-1), out_n, sysm)
+    return ids, out_n, sysm.astype(bool)

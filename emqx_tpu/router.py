@@ -96,14 +96,18 @@ class Router:
         # stalls them. Order: _lock before _wt_lock, never the reverse.
         self._wt_lock = threading.RLock()
         self._native = None
-        # sharded (multi-chip) mode flattens per trie shard through
-        # the Python builder — the native engine owns one monolithic
-        # trie, so it stays off when a mesh is configured
-        if self.config.use_native and self.config.mesh is None:
+        # C++ engine on both layouts: one monolithic trie single-chip,
+        # one trie per trie shard on a mesh (ShardedNativeEngine —
+        # same stable shard_of assignment as the Python builder)
+        if self.config.use_native:
             try:
                 from emqx_tpu.ops import native as _native_mod
                 if _native_mod.available():
-                    self._native = _native_mod.NativeEngine()
+                    if self.config.mesh is None:
+                        self._native = _native_mod.NativeEngine()
+                    else:
+                        self._native = _native_mod.ShardedNativeEngine(
+                            self.config.mesh.shape["trie"])
             except Exception:
                 self._native = None
         # pure-Python structures double as the fallback path when the
@@ -424,22 +428,29 @@ class Router:
 
         mesh = self.config.mesh
         n_trie = mesh.shape["trie"]
-        filters = sorted(self._routes)
-        shards = shard_filters(filters, n_trie)
         caps = self._sharded_caps
         grow_s = caps["state"] * self._grow["state"] \
             if caps["state"] else None
         grow_e = caps["edge"] * self._grow["edge"] if caps["edge"] else None
-        host_auto, parts = build_sharded(
-            shards, self._filter_ids, self._table,
-            state_capacity=grow_s, edge_capacity=grow_e,
-            return_parts=True)
+        if self._native is not None:
+            # C++ per-shard tries flatten straight into the stacked
+            # device layout (VERDICT r3 item 8: the mesh rebuild was
+            # the last Python-builder path)
+            host_auto, parts = self._native.flatten_sharded(
+                state_capacity=grow_s, edge_capacity=grow_e)
+            intern = self._native.intern
+        else:
+            shards = shard_filters(sorted(self._routes), n_trie)
+            host_auto, parts = build_sharded(
+                shards, self._filter_ids, self._table,
+                state_capacity=grow_s, edge_capacity=grow_e,
+                return_parts=True)
+            intern = self._table.intern
         caps["state"] = parts[0].plus_child.shape[0]
         caps["edge"] = parts[0].edge_word.shape[0]
         auto = place_sharded(mesh, host_auto) \
             if self.config.use_device else host_auto
-        self._shard_patchers = [
-            AutoPatcher(p, self._table.intern) for p in parts]
+        self._shard_patchers = [AutoPatcher(p, intern) for p in parts]
         if self._dummy_fan is None:
             # publish_step's fan input when the caller only matches
             # (with_fanout=False): minimal, never read

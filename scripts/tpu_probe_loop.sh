@@ -33,14 +33,23 @@ os._exit(0)
 EOF
   then
     echo "$(date -Is) TPU healthy — running bench matrix" >> "$LOG"
+    ok=1
     for mode in "" bigfan shared sharded churn; do
-      echo "$(date -Is) bench mode='${mode:-main}'" >> "$LOG"
-      BENCH_MODE="$mode" BENCH_NO_FALLBACK=1 timeout 2400 \
+      # the default mode is the 8-row configs matrix (up to
+      # 8 x BENCH_CFG_TIMEOUT); named modes are single runs
+      if [ -z "$mode" ]; then budget=8100; else budget=2400; fi
+      echo "$(date -Is) bench mode='${mode:-configs}'" >> "$LOG"
+      BENCH_MODE="$mode" BENCH_NO_FALLBACK=1 timeout "$budget" \
         python bench.py >> "$LOG" 2>&1
-      echo "$(date -Is) mode='${mode:-main}' rc=$?" >> "$LOG"
+      rc=$?
+      [ "$rc" -ne 0 ] && ok=0
+      echo "$(date -Is) mode='${mode:-configs}' rc=$rc" >> "$LOG"
     done
-    echo "$(date -Is) bench matrix done — exiting probe loop" >> "$LOG"
-    exit 0
+    if [ "$ok" = 1 ]; then
+      echo "$(date -Is) bench matrix done — exiting probe loop" >> "$LOG"
+      exit 0
+    fi
+    echo "$(date -Is) matrix had failures — will retry next cycle" >> "$LOG"
   fi
   echo "$(date -Is) still wedged; sleeping ${INTERVAL}s" >> "$LOG"
   sleep "$INTERVAL"
