@@ -92,6 +92,17 @@ class SocketTransport(Transport):
         self._probing: set = set()      # peers with a probe in flight
         self._probe_tasks: set = set()  # cancelled at close()
         self._closing = False
+        # cast coalescing (round-4 front-door finding: one IO-loop
+        # wakeup + one drain() PER forwarded message serialized the
+        # cross-worker path): casts pickle in the caller's thread,
+        # buffer per peer, and one scheduled flush writes the whole
+        # burst with a single drain per peer
+        self._cast_buf: Dict[Tuple[str, int], bytearray] = {}
+        self._cast_lock = threading.Lock()
+        self._cast_flush_scheduled = False
+        self._cast_pending = 0  # inbound casts queued on owner loop
+
+    _CAST_BUF_MAX = 32 * 1024 * 1024  # per-peer outbound cast buffer
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -188,23 +199,81 @@ class SocketTransport(Transport):
     # -- outbound ----------------------------------------------------------
 
     def cast(self, node: str, op: str, *args) -> None:
-        """Fire-and-forget (gen_rpc async cast): enqueue on the IO
-        loop and return — the publish path must never block on a
-        peer. Raises only for an unknown node; a dead peer is
-        detected by the link monitor (EOF → probe → nodedown), not
-        by the sender."""
+        """Fire-and-forget (gen_rpc async cast): buffer and return —
+        the publish path must never block on a peer. A burst of casts
+        (a batch tail forwarding to a peer) coalesces into ONE loop
+        wakeup and one write+drain per peer; pickling happens in the
+        caller's thread so the IO loop only moves bytes. Raises only
+        for an unknown node; a dead peer is detected by the link
+        monitor (EOF → probe → nodedown), not by the sender."""
         addr = self._peers.get(node)
         if addr is None:
             raise ConnectionError(f"unknown node: {node}")
-        fut = asyncio.run_coroutine_threadsafe(
-            self._send(addr, (_CAST, 0, (op, args))), self._loop)
+        data = pickle.dumps((_CAST, 0, (op, args)),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        with self._cast_lock:
+            buf = self._cast_buf.setdefault(addr, bytearray())
+            if len(buf) >= self._CAST_BUF_MAX:
+                # the peer link is wedged and the flush can't drain:
+                # shed new casts instead of growing without bound
+                # (gen_rpc's async cast is at-most-once the same way;
+                # QoS1 recovers via client retransmit, and the link
+                # monitor will declare nodedown)
+                log.warning("cast buffer to %s full; dropping %s",
+                            addr, op)
+                return
+            buf.extend(_LEN.pack(len(data)) + data)
+            wake = not self._cast_flush_scheduled
+            self._cast_flush_scheduled = True
+        if wake:
+            self._loop.call_soon_threadsafe(self._spawn_cast_flush)
 
-        def _done(f):
-            exc = f.exception()
-            if exc is not None:
-                log.debug("cast %s to %s failed: %s", op, node, exc)
+    def _spawn_cast_flush(self) -> None:
+        # one INDEPENDENT task per peer: a backpressured peer parking
+        # in drain() must not head-of-line-block healthy peers. The
+        # bytes stay in _cast_buf until a writer holds the conn lock
+        # (see _flush_one / _request) so cast-before-call ordering
+        # has no claim window.
+        with self._cast_lock:
+            addrs = list(self._cast_buf.keys())
+            self._cast_flush_scheduled = False
+        for addr in addrs:
+            t = self._loop.create_task(self._flush_one(addr))
+            self._probe_tasks.add(t)
+            t.add_done_callback(self._probe_tasks.discard)
 
-        fut.add_done_callback(_done)
+    def _take_cast_buf(self, addr) -> bytes:
+        """Atomically claim any buffered casts for ``addr`` (a call
+        about to write on the same link drains them first, keeping
+        the pre-r4 cast-before-call ordering per peer)."""
+        with self._cast_lock:
+            buf = self._cast_buf.pop(addr, None)
+        return bytes(buf) if buf else b""
+
+    async def _flush_one(self, addr) -> None:
+        pending = b""
+        for attempt in (0, 1):
+            try:
+                reused = addr in self._conns
+                _, writer, lock = await self._connect(addr)
+                async with lock:
+                    pending += self._take_cast_buf(addr)
+                    if not pending:
+                        return  # a call on this link drained us
+                    writer.write(pending)
+                    await writer.drain()
+                return
+            except (ConnectionError, OSError) as e:
+                self._conns.pop(addr, None)
+                if attempt == 0 and reused:
+                    # stale cached link: redial once and resend (the
+                    # pre-r4 per-cast path lost only the in-flight
+                    # message and redialed for the rest; a dead cached
+                    # socket normally delivered nothing, so the dup
+                    # risk is confined to a rare mid-write failure)
+                    continue
+                log.debug("cast flush to %s failed: %s", addr, e)
+                return
 
     def call(self, node: str, op: str, *args):
         addr = self._peers.get(node)
@@ -252,6 +321,12 @@ class SocketTransport(Transport):
         reader, writer, lock = await self._connect(addr)
         try:
             async with lock:  # one in-flight call per link: serialize
+                pending = self._take_cast_buf(addr)
+                if pending:
+                    # casts issued before this call go first on the
+                    # wire (the locker's release-then-acquire pattern
+                    # depends on per-peer cast/call ordering)
+                    writer.write(pending)
                 await _send_frame(writer, (_CALL, 1, (op, args)))
                 while True:
                     kind, _, payload = await _recv_frame(reader)
@@ -286,7 +361,12 @@ class SocketTransport(Transport):
                 kind, req, (op, args) = await _recv_frame(reader)
                 if kind == _CAST:
                     try:
-                        await self._dispatch(op, args)
+                        if not self._dispatch_cast(op, args, peer):
+                            # cap reached (or loop-less node): the
+                            # AWAITED path — stalls only this link's
+                            # frame loop, so TCP backpressure reaches
+                            # the sender while other links stay live
+                            await self._dispatch(op, args)
                     except Exception:
                         log.exception("cast %s from %s failed", op, peer)
                 elif kind == _CALL:
@@ -374,6 +454,41 @@ class SocketTransport(Transport):
                     writer.close()
                 except Exception:
                     pass
+
+    # inbound casts in flight on the owner loop; past the cap the
+    # reader falls back to the awaited path, which stalls the frame
+    # loop and lets TCP backpressure reach the sender (the pre-r4
+    # behavior for EVERY cast — one owner-loop round-trip per frame
+    # serialized the whole inbound forward path)
+    _CAST_PENDING_MAX = 1024
+
+    def _dispatch_cast(self, op: str, args, peer) -> bool:
+        """Fire-and-forget inbound cast: schedule on the owner loop
+        WITHOUT awaiting the round-trip, so the frame loop keeps
+        reading the burst. call_soon_threadsafe is FIFO per loop —
+        forward ordering is preserved. Returns False when the caller
+        must take the awaited ``_dispatch`` path instead (pending cap
+        reached, control-plane op, or loop-less node)."""
+        if self.cluster is None:
+            raise RuntimeError("transport not attached to a cluster")
+        owner = self._owner_loop
+        if op not in _OWNER_OPS or owner is None or not owner.is_running():
+            return False
+        with self._cast_lock:
+            if self._cast_pending >= self._CAST_PENDING_MAX:
+                return False
+            self._cast_pending += 1
+
+        def _run(op=op, args=args):
+            with self._cast_lock:
+                self._cast_pending -= 1
+            try:
+                self.cluster.handle_rpc(op, *args)
+            except Exception:
+                log.exception("cast %s from %s failed", op, peer)
+
+        owner.call_soon_threadsafe(_run)
+        return True
 
     async def _dispatch(self, op: str, args):
         """Run one inbound RPC.

@@ -1,0 +1,130 @@
+"""Front-door scaling curve: msgs/s through the full wire path at
+1/2/4 SO_REUSEPORT workers (VERDICT r3 item 7).
+
+Load model: S subscriber connections spread over T topics, P
+publisher connections blasting QoS0 round-robin with a bounded
+pipeline. Delivered messages are counted SERVER-side (summed
+`messages.delivered` across workers via the STATS? pipe), so client
+slowness can't inflate the number. Per-worker connection counts are
+printed to show the kernel's SO_REUSEPORT balancing and the
+cross-worker forward fraction.
+
+On the single-core dev host the workers time-share one CPU with the
+load generator — the curve there measures process overhead, not
+scaling headroom; run on a many-core host for the real curve.
+
+Usage: python scripts/frontdoor_curve.py [workers...] (default 1 2 4)
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from emqx_tpu.mqtt.packet import Publish  # noqa: E402
+from emqx_tpu.workers import WorkerPool  # noqa: E402
+
+SUBS = int(os.environ.get("CURVE_SUBS", "16"))
+PUBS = int(os.environ.get("CURVE_PUBS", "8"))
+TOPICS = int(os.environ.get("CURVE_TOPICS", "8"))
+SECS = float(os.environ.get("CURVE_SECS", "6"))
+PIPELINE = int(os.environ.get("CURVE_PIPELINE", "32"))
+
+
+async def _run_load(port: int, pool: WorkerPool):
+    from tests.mqtt_client import TestClient
+
+    subs = []
+    for i in range(SUBS):
+        c = TestClient(f"cs{i}")
+        await c.connect(port=port)
+        await c.subscribe(f"load/t{i % TOPICS}", qos=0)
+        subs.append(c)
+    pubs = []
+    for i in range(PUBS):
+        c = TestClient(f"cp{i}")
+        await c.connect(port=port)
+        pubs.append(c)
+
+    async def drain(cli):
+        while True:
+            m = await cli.inbox.get()
+            del m
+
+    drains = [asyncio.create_task(drain(s)) for s in subs]
+
+    stop = asyncio.Event()
+
+    async def blast(cli, idx):
+        i = 0
+        sent = 0
+        payload = b"x" * 64
+        while not stop.is_set():
+            for _ in range(PIPELINE):
+                await cli.send(Publish(
+                    topic=f"load/t{(idx + i) % TOPICS}",
+                    payload=payload, qos=0))
+                i += 1
+                sent += 1
+            await cli.writer.drain()
+            await asyncio.sleep(0)
+        return sent
+
+    # warm: let compiles/caches settle
+    warm = [asyncio.create_task(blast(p, i)) for i, p in enumerate(pubs)]
+    await asyncio.sleep(1.5)
+    stop.set()
+    await asyncio.gather(*warm)
+    stop = asyncio.Event()
+    # settle before snapshotting: warm-phase deliveries still in
+    # flight server-side must not be attributed to the timed window
+    await asyncio.sleep(0.7)
+
+    base = sum(d for _, d in pool.stats())
+    t0 = time.perf_counter()
+    tasks = [asyncio.create_task(blast(p, i)) for i, p in enumerate(pubs)]
+    await asyncio.sleep(SECS)
+    stop.set()
+    sent = sum(await asyncio.gather(*tasks))
+    elapsed = time.perf_counter() - t0
+    await asyncio.sleep(0.5)  # let deliveries drain
+    stats = pool.stats()
+    delivered = sum(d for _, d in stats) - base
+
+    for d in drains:
+        d.cancel()
+    for c in subs + pubs:
+        c.close()
+    return {
+        "sent": sent,
+        "delivered": delivered,
+        "elapsed_s": round(elapsed, 2),
+        "delivered_per_s": round(delivered / elapsed, 1),
+        "sent_per_s": round(sent / elapsed, 1),
+        "conns_per_worker": [c for c, _ in stats],
+    }
+
+
+def main():
+    counts = [int(a) for a in sys.argv[1:]] or [1, 2, 4]
+    rows = []
+    for n in counts:
+        with WorkerPool(n, port=0, platform="cpu") as pool:
+            res = asyncio.run(_run_load(pool.port, pool))
+        res["workers"] = n
+        rows.append(res)
+        print(json.dumps(res), flush=True)
+    base = rows[0]["delivered_per_s"] or 1
+    print(json.dumps({
+        "curve": {r["workers"]: round(r["delivered_per_s"] / base, 2)
+                  for r in rows},
+        "host_cores": os.cpu_count(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
