@@ -749,6 +749,9 @@ def churn():
 # subs on TPU, subs on the CPU fallback — bounded so a fallback run
 # finishes inside the driver's patience).
 _CONFIG_MATRIX = [
+    # headline FIRST: if the driver's patience runs out mid-matrix,
+    # the round-over-round metric must already be in the row list
+    ("mixed_1m_zipf", {}, None, 1_000_000, 100_000),
     ("literal_100k", {"BENCH_MIX": "literal", "BENCH_LEVELS": "1",
                       "BENCH_WPL": "100000"}, None, 100_000, 100_000),
     ("plus_1m", {"BENCH_MIX": "plus"}, None, 1_000_000, 200_000),
@@ -756,7 +759,6 @@ _CONFIG_MATRIX = [
      None, 1_000_000, 200_000),
     ("share_1m", {}, "shared", 1_000_000, 200_000),
     ("mixed_10m", {}, None, 10_000_000, 500_000),
-    ("mixed_1m_zipf", {}, None, 1_000_000, 100_000),   # headline
     ("mixed_1m_uniform", {"BENCH_TRAFFIC": "uniform"}, None,
      1_000_000, 100_000),
     ("live_paced", {"LIVE_RATE": "400", "LIVE_SECS": "5",
@@ -806,8 +808,17 @@ def configs():
     if plat is None and os.environ.get("BENCH_NO_FALLBACK"):
         raise BenchInitError(
             f"backend probe failed (> {probe_timeout:.0f}s or error)")
+    # global wall budget: skip (and label) remaining rows rather than
+    # letting the driver's own timeout kill the process before the
+    # final JSON line prints
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_DEADLINE", "3000"))
     rows = []
     for name, extra, mode, subs_tpu, subs_cpu in _CONFIG_MATRIX:
+        if time.monotonic() > deadline:
+            rows.append({"name": name,
+                         "error": "skipped: BENCH_DEADLINE reached"})
+            continue
         env = dict(os.environ)
         env.update(extra)
         env["BENCH_NO_FALLBACK"] = "1"
@@ -828,9 +839,11 @@ def configs():
         t0 = time.time()
         row = {"name": name, "subs": subs or None}
         try:
+            budget = min(cfg_timeout,
+                         max(60.0, deadline - time.monotonic()))
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                capture_output=True, timeout=cfg_timeout, env=env,
+                capture_output=True, timeout=budget, env=env,
                 text=True)
             line = [l for l in out.stdout.strip().splitlines()
                     if l.startswith("{")][-1]
@@ -844,7 +857,7 @@ def configs():
                     if fld in rec:
                         row[fld] = rec[fld]
         except subprocess.TimeoutExpired:
-            row["error"] = f"config timed out > {cfg_timeout:.0f}s"
+            row["error"] = f"config timed out > {budget:.0f}s"
         except Exception as e:
             row["error"] = repr(e)[:200]
         row["wall_s"] = round(time.time() - t0, 1)

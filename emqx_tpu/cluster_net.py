@@ -100,6 +100,7 @@ class SocketTransport(Transport):
         self._cast_buf: Dict[Tuple[str, int], bytearray] = {}
         self._cast_lock = threading.Lock()
         self._cast_flush_scheduled = False
+        self._cast_flushing: set = set()  # addrs with a flush task
         self._cast_pending = 0  # inbound casts queued on owner loop
 
     _CAST_BUF_MAX = 32 * 1024 * 1024  # per-peer outbound cast buffer
@@ -231,14 +232,19 @@ class SocketTransport(Transport):
     def _spawn_cast_flush(self) -> None:
         # one INDEPENDENT task per peer: a backpressured peer parking
         # in drain() must not head-of-line-block healthy peers. The
-        # bytes stay in _cast_buf until a writer holds the conn lock
-        # (see _flush_one / _request) so cast-before-call ordering
-        # has no claim window.
+        # in-flight set guarantees at most ONE flush task per peer —
+        # a wedged peer parks one task, not one per wakeup. Bytes
+        # stay in _cast_buf until a writer holds the conn lock (see
+        # _flush_once / _request), and a failed write REQUEUES its
+        # claim at the front, so cast-before-call ordering has no
+        # claim window even across the redial retry.
         with self._cast_lock:
-            addrs = list(self._cast_buf.keys())
+            addrs = [a for a in self._cast_buf
+                     if a not in self._cast_flushing]
+            self._cast_flushing.update(addrs)
             self._cast_flush_scheduled = False
         for addr in addrs:
-            t = self._loop.create_task(self._flush_one(addr))
+            t = self._loop.create_task(self._flush_addr(addr))
             self._probe_tasks.add(t)
             t.add_done_callback(self._probe_tasks.discard)
 
@@ -250,20 +256,50 @@ class SocketTransport(Transport):
             buf = self._cast_buf.pop(addr, None)
         return bytes(buf) if buf else b""
 
-    async def _flush_one(self, addr) -> None:
-        pending = b""
+    def _requeue_cast_buf(self, addr, pending: bytes) -> None:
+        """Return a claimed-but-unsent burst to the FRONT of the
+        buffer so casts issued meanwhile stay behind it."""
+        with self._cast_lock:
+            buf = self._cast_buf.get(addr)
+            merged = bytearray(pending)
+            if buf:
+                merged += buf
+            self._cast_buf[addr] = merged
+
+    async def _flush_addr(self, addr) -> None:
+        try:
+            while True:
+                ok = await self._flush_once(addr)
+                with self._cast_lock:
+                    if not ok or not self._cast_buf.get(addr):
+                        self._cast_flushing.discard(addr)
+                        return
+                # more casts were buffered while we wrote: go again
+        except BaseException:
+            with self._cast_lock:
+                self._cast_flushing.discard(addr)
+            raise
+
+    async def _flush_once(self, addr) -> bool:
+        """One delivery attempt (+ one redial retry for a stale
+        cached link). IncompleteReadError from a half-open hello is
+        an EOFError, hence the broad catch."""
         for attempt in (0, 1):
             try:
                 reused = addr in self._conns
                 _, writer, lock = await self._connect(addr)
                 async with lock:
-                    pending += self._take_cast_buf(addr)
+                    pending = self._take_cast_buf(addr)
                     if not pending:
-                        return  # a call on this link drained us
-                    writer.write(pending)
-                    await writer.drain()
-                return
-            except (ConnectionError, OSError) as e:
+                        return True  # a call on this link drained us
+                    try:
+                        writer.write(pending)
+                        await writer.drain()
+                    except (ConnectionError, OSError, EOFError):
+                        self._requeue_cast_buf(addr, pending)
+                        raise
+                return True
+            except (ConnectionError, OSError, EOFError) as e:
                 self._conns.pop(addr, None)
                 if attempt == 0 and reused:
                     # stale cached link: redial once and resend (the
@@ -272,8 +308,12 @@ class SocketTransport(Transport):
                     # socket normally delivered nothing, so the dup
                     # risk is confined to a rare mid-write failure)
                     continue
+                # bytes stay buffered (bounded by the cap): the link
+                # monitor decides the peer's fate; a later cast or
+                # reconnect retries them in order
                 log.debug("cast flush to %s failed: %s", addr, e)
-                return
+                return False
+        return False
 
     def call(self, node: str, op: str, *args):
         addr = self._peers.get(node)

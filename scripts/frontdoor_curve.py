@@ -98,7 +98,10 @@ async def _run_load(port: int, pool: WorkerPool):
     for d in drains:
         d.cancel()
     for c in subs + pubs:
-        c.close()
+        try:
+            await c.close()
+        except Exception:
+            pass
     return {
         "sent": sent,
         "delivered": delivered,

@@ -1,0 +1,153 @@
+"""Cast-coalescing semantics of the socket transport (round 4).
+
+The data-plane rework batched outbound casts per peer and made
+inbound casts non-blocking; these tests pin the contracts the
+code-review pass flagged as easy to regress:
+
+- per-peer ORDER: casts arrive in issue order, and a call issued
+  after casts to the same peer is observed AFTER them (the clientid
+  locker's release-then-acquire pattern depends on this);
+- a wedged peer (accepts, then stops reading) must not head-of-line
+  block casts to healthy peers;
+- the per-peer outbound buffer is capped: a flood to a wedged peer
+  sheds instead of growing without bound.
+"""
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+
+from emqx_tpu.cluster_net import SocketTransport, _LEN
+
+
+class RecordingCluster:
+    """Stands in for Cluster: records inbound RPCs in arrival order."""
+
+    def __init__(self):
+        self.ops = []
+        self.lock = threading.Lock()
+
+    def handle_rpc(self, op, *args):
+        with self.lock:
+            self.ops.append((op, args))
+        return "ok"
+
+
+def _pair(name_a="A", name_b="B"):
+    ta = SocketTransport(name_a, cookie="ck")
+    tb = SocketTransport(name_b, cookie="ck")
+    ta.cluster = RecordingCluster()
+    tb.cluster = RecordingCluster()
+    ta.serve()
+    tb.serve()
+    ta._peers[name_b] = ("127.0.0.1", tb.port)
+    tb._peers[name_a] = ("127.0.0.1", ta.port)
+    return ta, tb
+
+
+def _wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_cast_burst_ordered_and_call_after_casts():
+    ta, tb = _pair()
+    try:
+        for i in range(200):
+            ta.cast("B", "op", i)
+        # the call must drain the same peer's buffered casts first
+        assert ta.call("B", "marker") == "ok"
+        assert _wait_for(lambda: len(tb.cluster.ops) == 201)
+        ops = tb.cluster.ops
+        assert ops[-1][0] == "marker", ops[-5:]
+        assert [a[0] for _, a in ops[:-1]] == list(range(200))
+    finally:
+        ta.close()
+        tb.close()
+
+
+class WedgedPeer:
+    """Accepts the hello handshake, replies OK, then stops reading —
+    the kernel eventually backpressures the sender's socket."""
+
+    def __init__(self):
+        import pickle
+
+        self._pickle = pickle
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self._conn = None
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        conn, _ = self.sock.accept()
+        self._conn = conn
+        head = b""
+        while len(head) < 4:
+            head += conn.recv(4 - len(head))
+        (n,) = _LEN.unpack(head)
+        body = b""
+        while len(body) < n:
+            body += conn.recv(n - len(body))
+        reply = self._pickle.dumps(("reply", 0, True))
+        conn.sendall(_LEN.pack(len(reply)) + reply)
+        # ... and never read again: outbound bytes to us now pile up
+
+    def close(self):
+        for s in (self._conn, self.sock):
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def test_wedged_peer_does_not_block_healthy_casts():
+    ta, tb = _pair()
+    wedged = WedgedPeer()
+    try:
+        ta._peers["W"] = ("127.0.0.1", wedged.port)
+        big = b"x" * (1 << 20)
+        # fill W's pipe far past the socket buffers: the flush task
+        # for W parks in drain()
+        for _ in range(8):
+            ta.cast("W", "blob", big)
+        time.sleep(0.3)
+        # healthy peer must still receive promptly
+        for i in range(20):
+            ta.cast("B", "op", i)
+        assert _wait_for(lambda: len(tb.cluster.ops) == 20, 10), \
+            f"healthy peer starved: {len(tb.cluster.ops)}/20"
+    finally:
+        wedged.close()
+        ta.close()
+        tb.close()
+
+
+def test_cast_buffer_cap_sheds_instead_of_growing():
+    ta, tb = _pair()
+    wedged = WedgedPeer()
+    try:
+        ta._peers["W"] = ("127.0.0.1", wedged.port)
+        ta._CAST_BUF_MAX = 256 * 1024  # instance override
+        big = b"x" * (64 * 1024)
+        for _ in range(64):  # 4MB issued at a 256KB cap
+            ta.cast("W", "blob", big)
+        with ta._cast_lock:
+            buffered = sum(len(b) for b in ta._cast_buf.values())
+        assert buffered <= ta._CAST_BUF_MAX + (1 << 17), buffered
+        # and the transport is still functional toward healthy peers
+        ta.cast("B", "op", 1)
+        assert _wait_for(lambda: len(tb.cluster.ops) == 1, 10)
+    finally:
+        wedged.close()
+        ta.close()
+        tb.close()
