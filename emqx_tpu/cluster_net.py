@@ -176,7 +176,13 @@ class SocketTransport(Transport):
     # -- address book ------------------------------------------------------
 
     def register_peer(self, node: str, host: str, port: int) -> None:
+        prev = self._peers.get(node)
         self._peers[node] = (host, port)
+        if prev is not None and prev != (host, port):
+            # a fresh incarnation at a new address must not inherit
+            # casts buffered for the old one
+            with self._cast_lock:
+                self._cast_buf.pop(prev, None)
 
     def addr_book(self) -> Dict[str, Tuple[str, int]]:
         book = dict(self._peers)
@@ -258,12 +264,22 @@ class SocketTransport(Transport):
 
     def _requeue_cast_buf(self, addr, pending: bytes) -> None:
         """Return a claimed-but-unsent burst to the FRONT of the
-        buffer so casts issued meanwhile stay behind it."""
+        buffer so casts issued meanwhile stay behind it. The cap is
+        re-enforced here: claimed bytes don't show in _cast_buf, so
+        a flapping peer could otherwise grow claimed+refilled by one
+        cap per failed write cycle. Both segments are whole frames —
+        dropping the NEWER segment (like cast()'s shed) keeps the
+        stream frame-aligned."""
         with self._cast_lock:
             buf = self._cast_buf.get(addr)
             merged = bytearray(pending)
             if buf:
-                merged += buf
+                if len(pending) + len(buf) <= self._CAST_BUF_MAX:
+                    merged += buf
+                else:
+                    log.warning(
+                        "cast requeue to %s over cap; dropping %d "
+                        "newer bytes", addr, len(buf))
             self._cast_buf[addr] = merged
 
     async def _flush_addr(self, addr) -> None:
@@ -451,6 +467,13 @@ class SocketTransport(Transport):
                 if await self._probe_once(addr):
                     return  # alive: the drop was transient
                 await asyncio.sleep(0.3 * (attempt + 1))
+            # the peer is dead: its buffered casts are state
+            # mutations from BEFORE the death — replaying them into
+            # a rejoined incarnation would resurrect exactly what
+            # handle_nodedown purges (and a never-returning peer
+            # would leak the buffer forever)
+            with self._cast_lock:
+                self._cast_buf.pop(addr, None)
             try:
                 await self._dispatch("nodedown", (name,))
             except Exception:
