@@ -231,6 +231,31 @@ def build_filters(rng, n_subs, words_per_level, levels=5, mix="mixed"):
     return list(filters), vocab
 
 
+def _python_engine():
+    """(insert, flatten, encode) on the pure-Python builder — the
+    toolchain-less fallback shared by main() and shared()."""
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.ops.csr import build_automaton
+    from emqx_tpu.ops.tokenize import WordTable
+    from emqx_tpu.ops.tokenize import encode_batch as _eb
+
+    trie, table, fids = TrieOracle(), WordTable(), {}
+
+    def insert(f, i):
+        trie.insert(f)
+        fids[f] = i
+        for w in f.split("/"):
+            table.intern(w)
+
+    def flatten():
+        return build_automaton(trie, fids, table)
+
+    def encode(topics, max_levels):
+        return _eb(table, topics, max_levels)
+
+    return insert, flatten, encode
+
+
 def zipf_choice(rng, items, a=1.3):
     # Zipf-ish publish mix (BASELINE config 2)
     n = len(items)
@@ -352,14 +377,20 @@ def shared():
     # reference stores {group, topic} -> member rows the same way)
     filters, vocab = build_filters(rng, n_groups, words_per_level=60,
                                    levels=levels)
-    assert native.available(), "shared bench expects the native engine"
-    eng = native.NativeEngine()
+    if native.available():
+        eng = native.NativeEngine()
+        insert, flatten, encode = eng.insert, eng.flatten, \
+            eng.encode_batch
+    else:
+        # toolchain-less host: the Python builder (slower build, same
+        # device program — the row must not error out of the matrix)
+        insert, flatten, encode = _python_engine()
     rows = {}
     per = n_subs // n_groups
     for i, f in enumerate(filters):
-        eng.insert(f, i)
+        insert(f, i)
         rows[i] = range(i * per, (i + 1) * per)
-    auto = eng.flatten()
+    auto = flatten()
     fan = build_fanout(rows, len(filters))
     build_s = time.time() - t0
 
@@ -374,7 +405,7 @@ def shared():
                   for _ in range(batch)]
         uniq, inv = dedup_topics(topics)
         uniques.append(len(uniq))
-        ids_, n_, sysm_ = eng.encode_batch(uniq, 16)
+        ids_, n_, sysm_ = encode(uniq, 16)
         ids_, n_ = depth_bucket(ids_, n_)
         inv_ = np.asarray(inv, dtype=np.int32)
         seeds = seed_rng.integers(0, 2**31 - 1, size=batch,
@@ -449,19 +480,10 @@ def main():
         auto = eng.flatten()
         encode = eng.encode_batch
     else:
-        from emqx_tpu.oracle import TrieOracle
-        from emqx_tpu.ops.csr import build_automaton
-        from emqx_tpu.ops.tokenize import WordTable, encode_batch as _eb
-        trie = TrieOracle()
-        table = WordTable()
-        fids = {}
-        for f in filters:
-            trie.insert(f)
-            fids[f] = len(fids)
-            for w in f.split("/"):
-                table.intern(w)
-        auto = build_automaton(trie, fids, table)
-        encode = lambda ts, L: _eb(table, ts, L)  # noqa: E731
+        insert, flatten, encode = _python_engine()
+        for i, f in enumerate(filters):
+            insert(f, i)
+        auto = flatten()
     # one subscriber per subscription (10M-sub scale is sub-id bitmaps
     # over the same CSR; bench config keeps 1:1)
     fan = build_fanout({i: [i] for i in range(len(filters))}, len(filters))

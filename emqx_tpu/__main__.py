@@ -22,6 +22,11 @@ def main(argv=None) -> int:
     ap.add_argument("--workers", type=int, default=0,
                     help="SO_REUSEPORT worker processes sharing the "
                          "port, clustered (0 = single process)")
+    ap.add_argument("--restart-intensity", type=int, default=5,
+                    help="max worker restarts per 60s window before "
+                         "the pool gives up with a failure exit "
+                         "(OTP supervisor intensity; 0 = never "
+                         "restart, fail on first death)")
     args = ap.parse_args(argv)
 
     from emqx_tpu.logger import setup as setup_logger
@@ -36,18 +41,35 @@ def main(argv=None) -> int:
         print(f"listening: {args.workers} workers on "
               f"{args.host}:{port}", flush=True)
         rc = 0
+        restarts: list = []  # timestamps, OTP-style intensity window
         try:
             while True:
                 dead = [i for i, p in enumerate(pool.procs)
                         if p.poll() is not None]
-                if dead:
-                    # a crashed worker is a FAILURE exit: process
-                    # supervisors must see it and restart the pool
-                    for i in dead:
-                        print(f"worker {i} exited "
-                              f"rc={pool.procs[i].returncode}",
+                for i in dead:
+                    print(f"worker {i} exited "
+                          f"rc={pool.procs[i].returncode}", flush=True)
+                    now = _time.monotonic()
+                    restarts[:] = [t for t in restarts if now - t < 60]
+                    if len(restarts) >= args.restart_intensity:
+                        # intensity exceeded: the reference supervisor
+                        # gives up the same way — a FAILURE exit so
+                        # process supervisors see it
+                        print("restart intensity exceeded "
+                              f"({args.restart_intensity}/60s); "
+                              "giving up", flush=True)
+                        rc = 1
+                        break
+                    try:
+                        pool.restart_worker(i)
+                        restarts.append(now)
+                        print(f"worker {i} restarted", flush=True)
+                    except Exception as e:
+                        print(f"worker {i} restart failed: {e}",
                               flush=True)
-                    rc = 1
+                        rc = 1
+                        break
+                if rc:
                     break
                 _time.sleep(1.0)
         except KeyboardInterrupt:

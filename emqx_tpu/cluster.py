@@ -180,19 +180,45 @@ class Cluster:
                          addrs: Optional[Dict] = None) -> None:
         """Tell every member the merged membership (and, over a
         socket transport, the address book), then sync routes all
-        around — shared by in-process join and join_remote."""
+        around — shared by in-process join and join_remote.
+
+        A member that died moments ago may still be in the book its
+        peers handed us (their probe hasn't declared nodedown yet):
+        an unreachable member must not abort the join — it is skipped
+        and the membership machinery reaps it (round-4 finding: a
+        restarted worker crashed joining through a survivor because
+        the book still listed its own dead predecessor)."""
+        unreachable: List[str] = []
         for m in union:
             if m == self.name:
                 self._set_members(union)
-            elif addrs is not None:
-                self.transport.call(m, "set_members_net", union, addrs)
-            else:
-                self.transport.call(m, "set_members", union)
+                continue
+            try:
+                if addrs is not None:
+                    self.transport.call(m, "set_members_net", union,
+                                        addrs)
+                else:
+                    self.transport.call(m, "set_members", union)
+            except ConnectionError as e:
+                log.warning("join: member %s unreachable (%s); "
+                            "skipping", m, e)
+                unreachable.append(m)
         for m in union:
             if m == self.name:
                 self._push_owned_routes()
-            else:
-                self.transport.call(m, "push_routes")
+            elif m not in unreachable:
+                try:
+                    self.transport.call(m, "push_routes")
+                except ConnectionError as e:
+                    log.warning("join: push_routes to %s failed (%s)",
+                                m, e)
+                    unreachable.append(m)
+        # reap what we just proved dead, the way every other
+        # ConnectionError site here does — the dead name must not
+        # linger as a member/broadcast target until some later cast
+        # happens to fail
+        for m in unreachable:
+            self.handle_nodedown(m)
 
     def _set_members(self, members: List[str]) -> None:
         with self._lock:

@@ -117,9 +117,11 @@ class WorkerPool:
         self.cookie = cookie
         self.platform = platform
         self.procs: List[subprocess.Popen] = []
+        self.tports: List[Optional[int]] = []  # per-worker transport
         self._seed_addr = ""
 
-    def _spawn_one(self, idx: int) -> subprocess.Popen:
+    def _spawn_one(self, idx: int,
+                   seed: Optional[str] = None) -> subprocess.Popen:
         env = dict(os.environ)
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
@@ -127,7 +129,8 @@ class WorkerPool:
             env["EMQX_TPU_WORKER_PLATFORM"] = self.platform
         return subprocess.Popen(
             [sys.executable, "-c", _WORKER_MAIN, str(idx),
-             str(self.port), self.host, self._seed_addr, self.cookie],
+             str(self.port), self.host,
+             self._seed_addr if seed is None else seed, self.cookie],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
 
     def _await_ready(self, proc: subprocess.Popen,
@@ -164,16 +167,39 @@ class WorkerPool:
             p0 = self._spawn_one(0)
             self.procs.append(p0)
             lport, tport = self._await_ready(p0)
+            self.tports.append(tport)
             self.port = lport
             self._seed_addr = f"{self.host}:{tport}"
             for i in range(1, self.n_workers):
                 p = self._spawn_one(i)
                 self.procs.append(p)
-                self._await_ready(p)
+                _, tp = self._await_ready(p)
+                self.tports.append(tp)
         except BaseException:
             self.stop()
             raise
         return self.port
+
+    def restart_worker(self, idx: int) -> None:
+        """Respawn a dead worker in place (the reference supervisor's
+        restart role). The replacement joins the cluster through any
+        LIVE worker's transport — membership is a mesh, so losing the
+        original seed (worker 0) doesn't strand the pool."""
+        seed = ""
+        for j, p in enumerate(self.procs):
+            if j != idx and p.poll() is None and self.tports[j]:
+                seed = f"{self.host}:{self.tports[j]}"
+                break
+        # the predecessor's transport port is dead the moment we
+        # respawn: invalidate BEFORE awaiting readiness so a wedged
+        # replacement can't leave a stale port for later restarts
+        self.tports[idx] = None
+        p = self._spawn_one(idx, seed=seed)
+        self.procs[idx] = p
+        _, tp = self._await_ready(p)
+        self.tports[idx] = tp
+        if idx == 0:
+            self._seed_addr = f"{self.host}:{tp}"
 
     def stats(self) -> List[tuple]:
         """[(connections, delivered)] per worker."""
@@ -211,6 +237,10 @@ class WorkerPool:
             except subprocess.TimeoutExpired:
                 p.kill()
         self.procs.clear()
+        # keep bookkeeping aligned for a retried start(): stale
+        # tports would otherwise misalign with the new procs list
+        self.tports.clear()
+        self._seed_addr = ""
 
     def __enter__(self) -> "WorkerPool":
         self.start()
