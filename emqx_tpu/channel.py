@@ -379,6 +379,10 @@ class Channel:
                                      self.acl_cache) == DENY:
                 self.broker.metrics.inc("packets.publish.auth_error")
                 self.broker.metrics.inc("client.acl.deny")
+                if self.zone.acl_deny_action == "disconnect":
+                    # src/emqx_channel.erl:470-478: deny escalates to
+                    # a disconnect when the zone says so
+                    return self._disconnect_with(RC.NOT_AUTHORIZED)
                 return self._puback_for(pkt, RC.NOT_AUTHORIZED)
         msg = to_message(pkt, self.client_id,
                          headers={"proto_ver": self.proto_ver,
@@ -588,6 +592,15 @@ class Channel:
             if self.proto_ver == C.MQTT_V5 else None
         for flt, opts in tf:
             rcs.append(self._do_subscribe(flt, opts, subid))
+        if self.zone.acl_deny_action == "disconnect" and \
+                RC.NOT_AUTHORIZED in rcs:
+            # src/emqx_channel.erl:371-377: process_subscribe has
+            # already subscribed the ALLOWED filters (the reference
+            # iterates and subscribes as it checks, then escalates),
+            # so disconnecting here — after _do_subscribe ran — is
+            # the reference's exact ordering, ghost subscriptions on
+            # a persistent session included
+            return self._disconnect_with(RC.NOT_AUTHORIZED)
         self.broker.metrics.inc("packets.suback.sent")
         if self.proto_ver != C.MQTT_V5:
             rcs = [RC.compat("suback", rc) for rc in rcs]

@@ -93,6 +93,15 @@ class NodeConfig:
     base_dir: Optional[str] = None
 
 
+#: zone fields with a closed value set — a typo must be a startup
+#: ConfigError, not a silently-permissive default (a misspelled
+#: acl_deny_action would disable a security knob without a trace)
+_ENUM_FIELDS = {
+    "acl_nomatch": ("allow", "deny"),
+    "acl_deny_action": ("ignore", "disconnect"),
+}
+
+
 def _build_zone(name: str, raw: Dict[str, Any]) -> Zone:
     known = {f.name for f in dataclasses.fields(Zone)}
     kwargs: Dict[str, Any] = {}
@@ -101,6 +110,10 @@ def _build_zone(name: str, raw: Dict[str, Any]) -> Zone:
             raise ConfigError(f"unknown zone setting: zones.{name}.{key}")
         if key in _TUPLE_FIELDS and isinstance(val, list):
             val = tuple(val)
+        if key in _ENUM_FIELDS and val not in _ENUM_FIELDS[key]:
+            raise ConfigError(
+                f"zones.{name}.{key} must be one of "
+                f"{_ENUM_FIELDS[key]}, got {val!r}")
         kwargs[key] = val
     return Zone(name=name, **kwargs)
 

@@ -41,6 +41,10 @@ class Zone:
     # auth/acl
     allow_anonymous: bool = True
     acl_nomatch: str = "allow"          # allow | deny
+    # what an ACL deny does to the connection: "ignore" answers with
+    # the reason code, "disconnect" drops the client
+    # (etc/emqx.conf:617, src/emqx_channel.erl:372,470)
+    acl_deny_action: str = "ignore"     # ignore | disconnect
     enable_acl: bool = True
     enable_ban: bool = True
     # flapping

@@ -1,0 +1,66 @@
+"""Zone knob ``acl_deny_action`` (etc/emqx.conf:617): "ignore"
+answers a denied PUBLISH/SUBSCRIBE with the reason code, "disconnect"
+drops the client (src/emqx_channel.erl:372-377, 470-478)."""
+
+from emqx_tpu.access_control import DENY
+from emqx_tpu.broker import Broker
+from emqx_tpu.channel import Channel
+from emqx_tpu.cm import ConnectionManager
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqtt.packet import (Connack, Connect, Disconnect, PubAck,
+                                  Publish, Suback, Subscribe)
+from emqx_tpu.zone import Zone
+
+
+def _chan(action, deny_topic="secret/t"):
+    broker = Broker()
+
+    def acl(clientinfo, pubsub, topic, acc):
+        return DENY if topic.startswith("secret/") else acc
+
+    broker.hooks.add("client.check_acl", acl)
+    zone = Zone(name=f"acl-{action}", acl_deny_action=action)
+    chan = Channel(broker, ConnectionManager(broker=broker), zone=zone)
+    out = chan.handle_in(Connect(
+        proto_ver=C.MQTT_V5, proto_name="MQTT", client_id="aclc",
+        clean_start=True))
+    assert isinstance(out[0], Connack) and out[0].reason_code == 0
+    return chan
+
+
+def test_publish_deny_ignore_acks_not_authorized():
+    chan = _chan("ignore")
+    out = chan.handle_in(Publish(topic="secret/t", qos=1, packet_id=1))
+    assert isinstance(out[0], PubAck)
+    assert out[0].reason_code == RC.NOT_AUTHORIZED
+    assert not chan.closed
+
+
+def test_publish_deny_disconnect_drops_client():
+    chan = _chan("disconnect")
+    out = chan.handle_in(Publish(topic="secret/t", qos=1, packet_id=1))
+    assert any(isinstance(p, Disconnect) and
+               p.reason_code == RC.NOT_AUTHORIZED for p in out), out
+    assert chan.close_after_send
+
+
+def test_subscribe_deny_ignore_suback_rc():
+    chan = _chan("ignore")
+    out = chan.handle_in(Subscribe(packet_id=1, topic_filters=[
+        ("secret/t", {"qos": 1, "nl": 0, "rap": 0, "rh": 0}),
+        ("open/t", {"qos": 1, "nl": 0, "rap": 0, "rh": 0})]))
+    assert isinstance(out[0], Suback)
+    assert out[0].reason_codes[0] == RC.NOT_AUTHORIZED
+    assert out[0].reason_codes[1] in (0, 1)
+    assert not chan.closed
+
+
+def test_subscribe_deny_disconnect_on_any_denied_filter():
+    chan = _chan("disconnect")
+    out = chan.handle_in(Subscribe(packet_id=1, topic_filters=[
+        ("open/t", {"qos": 1, "nl": 0, "rap": 0, "rh": 0}),
+        ("secret/t", {"qos": 1, "nl": 0, "rap": 0, "rh": 0})]))
+    assert any(isinstance(p, Disconnect) and
+               p.reason_code == RC.NOT_AUTHORIZED for p in out), out
+    assert chan.close_after_send

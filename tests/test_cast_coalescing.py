@@ -151,3 +151,26 @@ def test_cast_buffer_cap_sheds_instead_of_growing():
         wedged.close()
         ta.close()
         tb.close()
+
+
+def test_garbage_and_oversized_frames_do_not_kill_transport():
+    """A peer that speaks garbage (bad pickle, absurd length prefix)
+    gets dropped; the transport keeps serving legit peers."""
+    ta, tb = _pair()
+    try:
+        # garbage bytes straight at B's transport port
+        s1 = socket.create_connection(("127.0.0.1", tb.port))
+        s1.sendall(b"\xde\xad\xbe\xef" * 16)
+        s1.close()
+        # 4GB length prefix: must be refused, not allocated
+        s2 = socket.create_connection(("127.0.0.1", tb.port))
+        s2.sendall(struct.pack(">I", 0xFFFFFFF0))
+        time.sleep(0.2)
+        s2.close()
+        # transport still works for the real peer
+        ta.cast("B", "op", 1)
+        assert ta.call("B", "marker") == "ok"
+        assert _wait_for(lambda: len(tb.cluster.ops) == 2)
+    finally:
+        ta.close()
+        tb.close()
