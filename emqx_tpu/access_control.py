@@ -50,6 +50,10 @@ class AccessControl:
             else "not_authorized",
             "anonymous": True,
         }
+        if self.zone.bypass_auth_plugins:
+            # internal-listener zones skip the plugin chain and take
+            # the zone default (src/emqx_access_control.erl:37-41)
+            return default
         result = self.hooks.run_fold(
             "client.authenticate", (dict(clientinfo),), default)
         return result

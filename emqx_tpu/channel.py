@@ -196,11 +196,20 @@ class Channel:
         self.proto_ver = pkt.proto_ver
         client_id = pkt.client_id
         if client_id == "":
-            if not pkt.clean_start and pkt.proto_ver != C.MQTT_V5:
+            if not pkt.clean_start:
+                # zero-byte clientid with clean_start=0 is invalid on
+                # EVERY version — there is no session the client
+                # could possibly resume (src/emqx_packet.erl:317-320,
+                # issue#599; round-4 review: v5 was wrongly exempted)
                 return self._connack_error(RC.CLIENT_IDENTIFIER_NOT_VALID)
             client_id = "emqx_tpu_" + b62encode(new_guid())[:20]
             assigned = True
         else:
+            assigned = False
+        if self.zone.use_username_as_clientid and pkt.username:
+            # src/emqx_channel.erl:1383-1389 (before assignment so an
+            # over-long username still hits the length check)
+            client_id = pkt.username
             assigned = False
         if len(client_id) > self.zone.max_clientid_len:
             return self._connack_error(RC.CLIENT_IDENTIFIER_NOT_VALID)
@@ -217,6 +226,9 @@ class Channel:
             clean_start=pkt.clean_start, listener=self.listener,
             mountpoint=self.zone.mountpoint,
         )
+        if getattr(pkt, "is_bridge", False):
+            # src/emqx_channel.erl:1132-1133 set_bridge_mode
+            self.clientinfo["is_bridge"] = True
         self.broker.hooks.run("client.connect", (dict(self.clientinfo),))
         # banned?
         banned = getattr(self.broker, "banned", None)
@@ -308,6 +320,11 @@ class Channel:
                 props["Shared-Subscription-Available"] = 0
             if self.zone.max_packet_size:
                 props["Maximum-Packet-Size"] = self.zone.max_packet_size
+            if pkt.properties.get("Request-Response-Information") == 1 \
+                    and self.zone.response_information:
+                # src/emqx_channel.erl:1432-1437
+                props["Response-Information"] = \
+                    self.zone.response_information
         self.broker.metrics.inc("packets.connack.sent")
         self.broker.metrics.inc("client.connack")
         out: List[Packet] = [Connack(session_present=session_present,
@@ -629,8 +646,18 @@ class Channel:
                 self.broker.metrics.inc("client.acl.deny")
                 return RC.NOT_AUTHORIZED
         qos = min(opts.get("qos", 0), self.zone.max_qos_allowed)
-        subopts = SubOpts(qos=qos, nl=opts.get("nl", 0),
-                          rap=opts.get("rap", 0), rh=opts.get("rh", 0),
+        nl = opts.get("nl", 0)
+        rap = opts.get("rap", 0)
+        if self.proto_ver != C.MQTT_V5:
+            # v3/v4 has neither flag on the wire: the zone knob
+            # supplies nl and bridge mode supplies rap (reference
+            # enrich_subopts, src/emqx_channel.erl:1386-1390 —
+            # a bridge must re-publish retained flags as-is)
+            if self.zone.ignore_loop_deliver:
+                nl = 1
+            rap = 1 if self.clientinfo.get("is_bridge") else 0
+        subopts = SubOpts(qos=qos, nl=nl, rap=rap,
+                          rh=opts.get("rh", 0),
                           subid=subid)
         mflt = self._mount_filter(flt, bare, popts)
         resub = mflt in self.session.subscriptions

@@ -464,6 +464,10 @@ class Parser:
     def _parse_connect(self, b: bytes) -> Connect:
         name, i = _read_str(b, 0)
         ver, i = _read_u8(b, i)
+        # bridge mode rides the proto level's high bit
+        # (src/emqx_frame.erl:177-185 BridgeTag)
+        is_bridge = bool(ver & 0x80)
+        ver &= 0x7F
         if (ver, name) not in ((3, "MQIsdp"), (4, "MQTT"), (5, "MQTT")):
             raise FrameError("bad_protocol")
         flags, i = _read_u8(b, i)
@@ -494,7 +498,8 @@ class Parser:
         if has_password:
             password, i = _read_bin(b, i)
         return Connect(
-            proto_name=name, proto_ver=ver, clean_start=clean_start,
+            proto_name=name, proto_ver=ver, is_bridge=is_bridge,
+            clean_start=clean_start,
             keepalive=keepalive, client_id=client_id,
             will_flag=will_flag, will_qos=will_qos,
             will_retain=will_retain, will_topic=will_topic,
@@ -524,8 +529,10 @@ def serialize(pkt: Packet, version: int = C.MQTT_V4) -> bytes:
                    | (pkt.will_qos << 3)
                    | (0x04 if pkt.will_flag else 0)
                    | (0x02 if pkt.clean_start else 0))
+        ver_b = pkt.proto_ver | (0x80 if getattr(pkt, "is_bridge",
+                                                 False) else 0)
         body = (_w_str(C.PROTOCOL_NAMES[pkt.proto_ver])
-                + bytes([pkt.proto_ver, flags_b]) + _w_u16(pkt.keepalive))
+                + bytes([ver_b, flags_b]) + _w_u16(pkt.keepalive))
         if pkt.proto_ver == C.MQTT_V5:
             body += _ser_props(pkt.properties)
         body += _w_str(pkt.client_id)

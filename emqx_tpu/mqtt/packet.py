@@ -27,6 +27,10 @@ class Connect(Packet):
     type: int = C.CONNECT
     proto_name: str = "MQTT"
     proto_ver: int = C.MQTT_V4
+    # MQTT bridge mode: the CONNECT proto level's high bit
+    # (src/emqx_frame.erl:185 BridgeTag); bridges get rap=1 so
+    # retained flags survive re-publication across brokers
+    is_bridge: bool = False
     clean_start: bool = True
     keepalive: int = 60
     client_id: str = ""

@@ -46,6 +46,29 @@ class Zone:
     # (etc/emqx.conf:617, src/emqx_channel.erl:372,470)
     acl_deny_action: str = "ignore"     # ignore | disconnect
     enable_acl: bool = True
+    # skip the client.authenticate hook chain for this zone (internal
+    # listeners; src/emqx_access_control.erl:37-41)
+    bypass_auth_plugins: bool = False
+    # CONNECT enrichment: the username becomes the clientid
+    # (src/emqx_channel.erl:1385-1389)
+    use_username_as_clientid: bool = False
+    # v3/v4 subscriptions get nl=1 so a client never receives its own
+    # publishes (v5 clients set nl themselves;
+    # src/emqx_channel.erl:1386-1390 enrich_subopts)
+    ignore_loop_deliver: bool = False
+    # v5 Response-Information returned when the client CONNECTs with
+    # Request-Response-Information=1 (src/emqx_channel.erl:1432-1437)
+    response_information: str = ""
+    # Deliberately NOT knobs (the full emqx_zone accessor sweep,
+    # round 4): `strict_mode` — the wire codec here validates UTF-8,
+    # reserved header bits and packet ids UNCONDITIONALLY
+    # (mqtt/frame.py; the reference only does so when strict_mode is
+    # set, src/emqx_frame.erl:92-94,215), so a knob would only add a
+    # lax mode nothing wants; `force_shutdown_policy` — per-process
+    # queue/heap kill thresholds assume BEAM-style per-process heaps;
+    # the analogues here are the bounded per-session mqueue
+    # (max_mqueue_len), the bytes/msgs limiters above, and the
+    # host-level watermark alarms (monitors.py).
     enable_ban: bool = True
     # flapping
     enable_flapping_detect: bool = False

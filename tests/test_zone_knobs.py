@@ -1,0 +1,134 @@
+"""Round-4 zone-knob sweep: every knob the reference consumes via
+``emqx_zone:get_env`` must be consumed here too. These pin the last
+four that were config surface without behavior:
+use_username_as_clientid, bypass_auth_plugins, ignore_loop_deliver,
+response_information (src/emqx_channel.erl:1383-1437,
+src/emqx_access_control.erl:37-41)."""
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.channel import Channel
+from emqx_tpu.cm import ConnectionManager
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.packet import Connack, Connect, Publish, Subscribe
+from emqx_tpu.zone import Zone
+
+
+def _connect(zone, version=C.MQTT_V5, username=None, broker=None,
+             client_id="zc", props=None):
+    broker = broker or Broker()
+    chan = Channel(broker, ConnectionManager(broker=broker), zone=zone)
+    out = chan.handle_in(Connect(
+        proto_ver=version, proto_name=C.PROTOCOL_NAMES[version],
+        client_id=client_id, clean_start=True, username=username,
+        properties=props or {}))
+    return broker, chan, out[0]
+
+
+def test_use_username_as_clientid():
+    zone = Zone(name="zk-u", use_username_as_clientid=True)
+    _, chan, ack = _connect(zone, username="alice")
+    assert ack.reason_code == 0
+    assert chan.client_id == "alice"
+    # no username: the given clientid stands
+    _, chan2, _ = _connect(zone, client_id="keepme")
+    assert chan2.client_id == "keepme"
+
+
+def test_bypass_auth_plugins_skips_hook_chain():
+    broker = Broker()
+
+    def deny_all(clientinfo, acc):
+        return dict(acc, auth_result="not_authorized")
+
+    broker.hooks.add("client.authenticate", deny_all)
+    # hook denies: normal zone refuses the connect
+    _, _, ack = _connect(Zone(name="zk-a1"), broker=broker)
+    assert ack.reason_code != 0
+    # bypass zone never runs the hook: zone default (anonymous) wins
+    _, _, ack2 = _connect(Zone(name="zk-a2", bypass_auth_plugins=True),
+                          broker=broker)
+    assert ack2.reason_code == 0
+
+
+def test_ignore_loop_deliver_v4_suppresses_self_delivery():
+    zone = Zone(name="zk-nl", ignore_loop_deliver=True)
+    broker, chan, ack = _connect(zone, version=C.MQTT_V4,
+                                 client_id="looper")
+    assert ack.reason_code == 0
+    chan.handle_in(Subscribe(packet_id=1, topic_filters=[
+        ("loop/t", {"qos": 0, "nl": 0, "rap": 0, "rh": 0})]))
+    chan.handle_in(Publish(topic="loop/t", qos=0, payload=b"me"))
+    assert chan.handle_deliver() == []  # own publish suppressed
+    assert broker.metrics.val("delivery.dropped.no_local") == 1
+    # a v5 client in the same zone keeps its explicit nl=0
+    _, chan5, _ = _connect(zone, client_id="v5er", broker=broker)
+    chan5.handle_in(Subscribe(packet_id=1, topic_filters=[
+        ("loop/t", {"qos": 0, "nl": 0, "rap": 0, "rh": 0})]))
+    chan5.handle_in(Publish(topic="loop/t", qos=0, payload=b"me5"))
+    got = chan5.handle_deliver()
+    assert any(getattr(p, "payload", b"") == b"me5" for p in got)
+
+
+def test_response_information_on_request():
+    zone = Zone(name="zk-ri", response_information="rsp/base")
+    _, _, ack = _connect(zone, props={
+        "Request-Response-Information": 1})
+    assert isinstance(ack, Connack)
+    assert ack.properties.get("Response-Information") == "rsp/base"
+    # not requested -> not volunteered
+    _, _, ack2 = _connect(zone, client_id="zc2")
+    assert "Response-Information" not in ack2.properties
+
+
+def test_bridge_mode_wire_roundtrip_and_rap():
+    """Bridge CONNECT (proto level | 0x80, src/emqx_frame.erl:185):
+    parses to is_bridge, survives serialize∘parse, and a v4 bridge's
+    subscriptions keep the retain flag as published (rap=1) where a
+    plain v4 client has it cleared."""
+    from emqx_tpu.mqtt.frame import Parser, serialize
+    from emqx_tpu.types import Message
+
+    pkt = Connect(proto_ver=C.MQTT_V4, proto_name="MQTT",
+                  is_bridge=True, client_id="bridge1")
+    [back] = Parser().feed(serialize(pkt, C.MQTT_V4))
+    assert back.is_bridge and back.proto_ver == C.MQTT_V4
+
+    broker = Broker()
+    chan = Channel(broker, ConnectionManager(broker=broker),
+                   zone=Zone(name="zk-br"))
+    ack = chan.handle_in(back)[0]
+    assert ack.reason_code == 0
+    chan.handle_in(Subscribe(packet_id=1, topic_filters=[
+        ("br/t", {"qos": 0, "nl": 0, "rap": 0, "rh": 0})]))
+    broker.publish(Message(topic="br/t", payload=b"r",
+                           flags={"retain": True}))
+    out = chan.handle_deliver()
+    pubs = [p for p in out if isinstance(p, Publish)]
+    assert pubs and pubs[0].retain, "bridge must keep retain flag"
+
+    # control: a plain v4 client in the same broker gets retain=0
+    chan2 = Channel(broker, ConnectionManager(broker=broker),
+                    zone=Zone(name="zk-br2"))
+    chan2.handle_in(Connect(proto_ver=C.MQTT_V4, proto_name="MQTT",
+                            client_id="plain1"))
+    chan2.handle_in(Subscribe(packet_id=1, topic_filters=[
+        ("br/t", {"qos": 0, "nl": 0, "rap": 0, "rh": 0})]))
+    broker.publish(Message(topic="br/t", payload=b"r2",
+                           flags={"retain": True}))
+    out2 = chan2.handle_deliver()
+    pubs2 = [p for p in out2 if isinstance(p, Publish)]
+    assert pubs2 and not pubs2[0].retain
+
+
+def test_v5_empty_clientid_with_cs0_rejected():
+    """Zero-byte clientid + clean_start=0 is invalid on EVERY proto
+    version (src/emqx_packet.erl:317-320) — there is no session the
+    client could resume."""
+    broker = Broker()
+    chan = Channel(broker, ConnectionManager(broker=broker),
+                   zone=Zone(name="zk-e"))
+    ack = chan.handle_in(Connect(
+        proto_ver=C.MQTT_V5, proto_name="MQTT", client_id="",
+        clean_start=False))[0]
+    assert ack.reason_code != 0
+    assert chan.close_after_send
