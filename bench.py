@@ -231,6 +231,149 @@ def build_filters(rng, n_subs, words_per_level, levels=5, mix="mixed"):
     return list(filters), vocab
 
 
+def _build_cache_dir():
+    d = os.environ.get("BENCH_BUILD_CACHE", "/tmp/emqx_bench_cache")
+    return None if d == "0" else d
+
+
+def _build_cache_load(key: str):
+    """Host-array build cache: the big-subs builds (filters, trie
+    insert, flatten, batch encode) cost minutes of pure-host work
+    that is IDENTICAL run to run (seeded rng). Caching the device
+    inputs makes a TPU-recovery matrix far more likely to fit its
+    row budget. Returns the array dict or None. Opt-out:
+    BENCH_BUILD_CACHE=0 (=<dir> relocates)."""
+    d = _build_cache_dir()
+    if d is None:
+        return None
+    try:
+        return dict(np.load(os.path.join(d, key + ".npz"),
+                            allow_pickle=False))
+    except Exception:
+        return None
+
+
+def _build_cache_save(key: str, arrs: dict) -> None:
+    d = _build_cache_dir()
+    if d is None:
+        return
+    try:
+        os.makedirs(d, exist_ok=True)
+        # pid-unique tmp: a prewarm and a recovery bench may build
+        # the same key concurrently; sharing one tmp name would let
+        # them corrupt each other's half-written file
+        tmp = os.path.join(d, f"{key}.{os.getpid()}.tmp.npz")
+        np.savez(tmp, **arrs)
+        os.replace(tmp, os.path.join(d, key + ".npz"))
+    except Exception:
+        pass  # cache is best-effort
+
+
+def build_main_inputs(n_subs: int, batch: int, levels: int, mix: str,
+                      traffic: str, wpl: int, n_batches: int = 8):
+    """The main-mode host build — filters, automaton, fan table and
+    8 encoded publish batches — through the array cache (a pure
+    function of the seeded rng, so a cache hit is exact). JAX-free:
+    ``scripts/prewarm_bench_cache.py`` runs this without touching any
+    backend to pre-stage the TPU-recovery rows. Returns
+    ``(use_native, cached, auto, fan, host_batches, uniques,
+    n_filters)``."""
+    import random as _random
+
+    from emqx_tpu.ops import native
+    from emqx_tpu.ops.csr import Automaton
+    from emqx_tpu.ops.fanout import FanoutTable, build_fanout
+    from emqx_tpu.ops.match import depth_bucket
+
+    use_native = native.available()
+    # key carries a schema version + which engine built the arrays:
+    # a field added next round or a native/python provenance mix must
+    # miss, not crash or mislabel the measurement
+    cache_key = (f"mixed_v2_{'nat' if use_native else 'py'}"
+                 f"_s{n_subs}_b{batch}_l{levels}_{mix}_{traffic}"
+                 f"_w{wpl}_n{n_batches}")
+    cached = _build_cache_load(cache_key)
+    if cached is not None:
+        try:
+            auto = Automaton(**{
+                f: (cached[f"a_{f}"] if f"a_{f}" in cached
+                    else int(cached[f"s_{f}"]))
+                for f in Automaton._fields})
+            fan = FanoutTable(**{
+                f: (cached[f"f_{f}"] if f"f_{f}" in cached
+                    else (int(cached[f"fs_{f}"]) if f"fs_{f}" in cached
+                          else None))
+                for f in FanoutTable._fields})
+            host_batches = [
+                (cached[f"b{i}_ids"], cached[f"b{i}_n"],
+                 cached[f"b{i}_sysm"].astype(bool))
+                for i in range(n_batches)]
+            uniques = [int(u) for u in cached["uniques"]]
+            n_filters = int(cached["n_filters"])
+            return (use_native, True, auto, fan, host_batches,
+                    uniques, n_filters)
+        except Exception:
+            pass  # schema-drifted file: fall through to a rebuild
+
+    rng = _random.Random(0)
+    filters, vocab = build_filters(rng, n_subs, words_per_level=wpl,
+                                   levels=levels, mix=mix)
+    if use_native:
+        eng = native.NativeEngine()
+        for i, f in enumerate(filters):
+            eng.insert(f, i)
+        auto = eng.flatten()
+        encode = eng.encode_batch
+    else:
+        insert, flatten, encode = _python_engine()
+        for i, f in enumerate(filters):
+            insert(f, i)
+        auto = flatten()
+    # one subscriber per subscription (10M-sub scale is sub-id
+    # bitmaps over the same CSR; bench config keeps 1:1)
+    fan = build_fanout({i: [i] for i in range(len(filters))},
+                       len(filters))
+    n_filters = len(filters)
+
+    # publish batches: `batch` LOGICAL messages each, Zipf over the
+    # filter tree's own vocabulary, deduplicated to unique topics
+    # before the device (the product ingress does the same per tick —
+    # hot topics collapse; throughput counts logical messages, and
+    # per-unique rates are reported alongside)
+    host_batches = []
+    uniques = []
+    lo = 1 if levels == 1 else 2
+    pick = (zipf_choice if traffic == "zipf"
+            else lambda r, items: r.choice(items))
+    for _ in range(n_batches):
+        topics = [
+            "/".join(pick(rng, vocab[i])
+                     for i in range(rng.randint(lo, levels)))
+            for _ in range(batch)
+        ]
+        uniq, _inv = dedup_topics(topics)
+        uniques.append(len(uniq))
+        ids_, n_, sysm_ = encode(uniq, 16)
+        ids_, n_ = depth_bucket(ids_, n_)
+        host_batches.append((ids_, n_, sysm_))
+    arrs = {"uniques": np.asarray(uniques, np.int64),
+            "n_filters": np.int64(n_filters)}
+    for f, v in zip(Automaton._fields, auto):
+        arrs[f"a_{f}" if isinstance(v, np.ndarray) else f"s_{f}"] = v
+    for f, v in zip(FanoutTable._fields, fan):
+        if isinstance(v, np.ndarray):
+            arrs[f"f_{f}"] = v
+        elif v is not None:
+            arrs[f"fs_{f}"] = np.int64(v)
+    for i, (ids_, n_, sysm_) in enumerate(host_batches):
+        arrs[f"b{i}_ids"] = ids_
+        arrs[f"b{i}_n"] = n_
+        arrs[f"b{i}_sysm"] = sysm_
+    _build_cache_save(cache_key, arrs)
+    return (use_native, False, auto, fan, host_batches, uniques,
+            n_filters)
+
+
 def _python_engine():
     """(insert, flatten, encode) on the pure-Python builder — the
     toolchain-less fallback shared by main() and shared()."""
@@ -463,62 +606,21 @@ def main():
 
     jax = _jax_with_retry()
 
-    from emqx_tpu.ops import native
-    from emqx_tpu.ops.fanout import build_fanout, expand_packed
+    from emqx_tpu.ops.fanout import expand_packed
     from emqx_tpu.ops.match import match_batch
     from emqx_tpu.ops.pack import budget_for, pack_matches
 
-    rng = random.Random(0)
     t0 = time.time()
-    filters, vocab = build_filters(rng, n_subs, words_per_level=wpl,
-                                   levels=levels, mix=mix)
-    use_native = native.available()
-    if use_native:
-        eng = native.NativeEngine()
-        for i, f in enumerate(filters):
-            eng.insert(f, i)
-        auto = eng.flatten()
-        encode = eng.encode_batch
-    else:
-        insert, flatten, encode = _python_engine()
-        for i, f in enumerate(filters):
-            insert(f, i)
-        auto = flatten()
-    # one subscriber per subscription (10M-sub scale is sub-id bitmaps
-    # over the same CSR; bench config keeps 1:1)
-    fan = build_fanout({i: [i] for i in range(len(filters))}, len(filters))
+    use_native, cached, auto, fan, host_batches, uniques, n_filters = \
+        build_main_inputs(n_subs, batch, levels, mix, traffic, wpl)
     build_s = time.time() - t0
 
-    auto = jax.device_put(auto)
-    fan = jax.device_put(fan)
-
-    # publish batches: Zipf over the filter tree's own vocabulary.
     # device_put once — the steady-state path matches device-resident
     # arrays produced by the ingress batcher, and re-shipping numpy
     # per step would time the host link, not the kernel
-    from emqx_tpu.ops.match import depth_bucket
-
-    # publish batches: `batch` LOGICAL messages each, deduplicated to
-    # unique topics before the device (the product ingress does the
-    # same per tick — hot topics collapse; throughput counts logical
-    # messages, and per-unique rates are reported alongside)
-    n_batches = 8
-    batches = []
-    uniques = []
-    lo = 1 if levels == 1 else 2
-    pick = (zipf_choice if traffic == "zipf"
-            else lambda r, items: r.choice(items))
-    for _ in range(n_batches):
-        topics = [
-            "/".join(pick(rng, vocab[i])
-                     for i in range(rng.randint(lo, levels)))
-            for _ in range(batch)
-        ]
-        uniq, _inv = dedup_topics(topics)
-        uniques.append(len(uniq))
-        ids_, n_, sysm_ = encode(uniq, 16)
-        ids_, n_ = depth_bucket(ids_, n_)
-        batches.append(jax.device_put((ids_, n_, sysm_)))
+    auto = jax.device_put(auto)
+    fan = jax.device_put(fan)
+    batches = [jax.device_put(b) for b in host_batches]
 
     # the PRODUCT pipeline: match → pack → fused sparse expansion
     # (broker.publish_begin runs exactly this); budgets sized off the
@@ -557,10 +659,11 @@ def main():
     avg_unique = float(np.mean(uniques))
     info = {
         "mix": mix, "traffic": traffic, "levels": levels,
-        "subs": len(filters),
+        "subs": n_filters,
         "batch": batch,
         "avg_unique_topics": round(avg_unique, 1),
         "native": use_native,
+        "build_cached": bool(cached),
         "build_s": round(build_s, 1),
         "avg_matches_per_unique": round(float(counts.mean()), 2),
         "avg_deliveries_per_unique": round(float(deliv.mean()), 2),
@@ -851,7 +954,11 @@ def configs():
                          "error": "skipped: BENCH_DEADLINE reached"})
             continue
         env = dict(os.environ)
-        env.update(extra)
+        for k_, v_ in extra.items():
+            if k_ in ("BENCH_ITERS", "BENCH_WINDOWS") \
+                    and k_ in os.environ:
+                continue  # explicit operator effort override wins
+            env[k_] = v_
         env["BENCH_NO_FALLBACK"] = "1"
         # an unset BENCH_MODE means `configs` since r4 — the child
         # must run the CONCRETE mode or it would recurse into this

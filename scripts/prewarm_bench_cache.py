@@ -1,0 +1,54 @@
+"""Pre-stage the bench build cache for the TPU-recovery matrix.
+
+Entirely JAX-free (build_main_inputs touches no backend): run this on
+the idle CPU while the chip is wedged, and a recovery-window bench
+run spends its row budget MEASURING instead of rebuilding 1M/10M
+filter sets from scratch.
+
+Usage: python scripts/prewarm_bench_cache.py [--small]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+def _rows():
+    """Derive (name, subs, batch, levels, mix, traffic, wpl) from
+    bench._CONFIG_MATRIX + main()'s env defaults, so a matrix change
+    can't silently leave prewarm staging stale keys."""
+    batch = int(os.environ.get("BENCH_BATCH", "131072"))
+    out = []
+    for name, extra, mode, subs_tpu, _cpu in bench._CONFIG_MATRIX:
+        if mode is not None or not subs_tpu:
+            continue  # only main-mode rows build through the cache
+        out.append((
+            name, subs_tpu, batch,
+            int(extra.get("BENCH_LEVELS", "5")),
+            extra.get("BENCH_MIX", "mixed"),
+            extra.get("BENCH_TRAFFIC", "zipf"),
+            int(extra.get("BENCH_WPL", "60")),
+        ))
+    return out
+
+
+def main():
+    small = "--small" in sys.argv
+    for name, subs, batch, levels, mix, traffic, wpl in _rows():
+        if small and subs > 1_000_000:
+            continue
+        t0 = time.time()
+        _, cached, _, _, _, uniques, n_filters = bench.build_main_inputs(
+            subs, batch, levels, mix, traffic, wpl)
+        print(f"{name}: {'cache hit' if cached else 'built'} "
+              f"{n_filters} filters, avg_unique="
+              f"{sum(uniques) / len(uniques):.0f}, "
+              f"{time.time() - t0:.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
