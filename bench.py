@@ -231,7 +231,17 @@ def build_filters(rng, n_subs, words_per_level, levels=5, mix="mixed"):
     return list(filters), vocab
 
 
+#: bump when BUILD SEMANTICS change (build_filters mix ratios,
+#: zipf_choice shape, dedup, encode levels, depth_bucket) — the cache
+#: key only sees shapes, so an unbumped semantic change would silently
+#: replay the previous round's workload under the new label
+_BUILD_REV = 1
+
+
 def _build_cache_dir():
+    """Cache root (BENCH_BUILD_CACHE=0 disables, =<dir> relocates).
+    Footprint warning: the full matrix is ~2.7GB (the 10M row alone
+    >1GB) — point this at real disk, not a RAM-backed tmpfs."""
     d = os.environ.get("BENCH_BUILD_CACHE", "/tmp/emqx_bench_cache")
     return None if d == "0" else d
 
@@ -257,6 +267,7 @@ def _build_cache_save(key: str, arrs: dict) -> None:
     d = _build_cache_dir()
     if d is None:
         return
+    tmp = None
     try:
         os.makedirs(d, exist_ok=True)
         # pid-unique tmp: a prewarm and a recovery bench may build
@@ -266,7 +277,14 @@ def _build_cache_save(key: str, arrs: dict) -> None:
         np.savez(tmp, **arrs)
         os.replace(tmp, os.path.join(d, key + ".npz"))
     except Exception:
-        pass  # cache is best-effort
+        # cache is best-effort — but a half-written tmp must not
+        # squat multi-hundred-MB of the cache volume (ENOSPC is
+        # self-reinforcing otherwise)
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def build_main_inputs(n_subs: int, batch: int, levels: int, mix: str,
@@ -289,7 +307,8 @@ def build_main_inputs(n_subs: int, batch: int, levels: int, mix: str,
     # key carries a schema version + which engine built the arrays:
     # a field added next round or a native/python provenance mix must
     # miss, not crash or mislabel the measurement
-    cache_key = (f"mixed_v2_{'nat' if use_native else 'py'}"
+    cache_key = (f"mixed_v2r{_BUILD_REV}"
+                 f"_{'nat' if use_native else 'py'}"
                  f"_s{n_subs}_b{batch}_l{levels}_{mix}_{traffic}"
                  f"_w{wpl}_n{n_batches}")
     cached = _build_cache_load(cache_key)
@@ -902,6 +921,13 @@ _CONFIG_MATRIX = [
 _HEADLINE_ROW = "mixed_1m_zipf"
 
 
+def _last_json_line(text: str):
+    """Last '{'-opening line of a stream, parsed — the bench line /
+    info line extraction idiom shared by the orchestrator paths."""
+    lines = [l for l in text.strip().splitlines() if l.startswith("{")]
+    return json.loads(lines[-1]) if lines else None
+
+
 def _probe_platform(timeout: float):
     """Backend platform via a bounded SUBPROCESS probe (an in-process
     probe would wedge this orchestrator's backend lock forever on a
@@ -983,10 +1009,10 @@ def configs():
                 [sys.executable, os.path.abspath(__file__)],
                 capture_output=True, timeout=budget, env=env,
                 text=True)
-            line = [l for l in out.stdout.strip().splitlines()
-                    if l.startswith("{")][-1]
-            rec = json.loads(line)
-            if "error" in rec:
+            rec = _last_json_line(out.stdout)
+            if rec is None:
+                row["error"] = "no JSON line from child"
+            elif "error" in rec:
                 row["error"] = rec["error"]
             else:
                 for fld in ("metric", "value", "unit", "vs_baseline",
@@ -994,6 +1020,25 @@ def configs():
                             "p99_deliver_ms", "platform"):
                     if fld in rec:
                         row[fld] = rec[fld]
+                # the child's stderr info line carries the workload
+                # context that makes a logical-rate row honest — a
+                # Zipf batch can dedup 400x, and without the unique
+                # count alongside, the row would overstate itself
+                try:
+                    inf = _last_json_line(out.stderr) or {}
+                    for fld in ("avg_unique_topics", "batch",
+                                "build_s", "build_cached", "native",
+                                "unique_kmsgs_per_s",
+                                "avg_deliveries_per_unique"):
+                        if fld in inf:
+                            row[fld] = inf[fld]
+                except Exception:
+                    pass
+                # measurement effort, recorded per row: an operator
+                # override (BENCH_ITERS/WINDOWS) may change it, and a
+                # headline measured at reduced effort must say so
+                row["iters"] = int(env.get("BENCH_ITERS", "20"))
+                row["windows"] = int(env.get("BENCH_WINDOWS", "5"))
         except subprocess.TimeoutExpired:
             row["error"] = f"config timed out > {budget:.0f}s"
         except Exception as e:
