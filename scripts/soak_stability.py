@@ -1,0 +1,123 @@
+"""Long-run stability soak: sustained pub/sub + route churn +
+client reconnects against one live node, RSS sampled throughout.
+
+The 3-minute suite can't see slow leaks (retained wire caches,
+un-reaped subscriptions, patcher garbage, growing cast buffers);
+this drives the full socket path for SOAK_MINUTES and reports the
+RSS trend. A healthy broker plateaus after warmup; monotonic growth
+per cycle is a leak.
+
+Usage: SOAK_MINUTES=30 python scripts/soak_stability.py
+"""
+
+import asyncio
+import json
+import os
+import random
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from emqx_tpu.mqtt import constants as C  # noqa: E402
+
+MINUTES = float(os.environ.get("SOAK_MINUTES", "30"))
+CLIENTS = int(os.environ.get("SOAK_CLIENTS", "40"))
+SAMPLE_S = float(os.environ.get("SOAK_SAMPLE_S", "30"))
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _rss_now_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+async def _client_loop(idx: int, port: int, stop: asyncio.Event,
+                       stats: dict):
+    from tests.mqtt_client import TestClient
+
+    rng = random.Random(idx)
+    while not stop.is_set():
+        cli = TestClient(f"soak{idx}", version=C.MQTT_V5)
+        try:
+            await cli.connect(port=port, timeout=30)
+            for _round in range(rng.randint(3, 10)):
+                if stop.is_set():
+                    break
+                flt = f"soak/{rng.randrange(200)}/+"
+                await cli.subscribe(flt, qos=rng.randrange(2))
+                for _ in range(20):
+                    await cli.publish(
+                        f"soak/{rng.randrange(200)}/x",
+                        b"p" * rng.randrange(8, 200),
+                        qos=rng.randrange(2), timeout=30)
+                    stats["pubs"] += 1
+                # drain whatever arrived
+                try:
+                    while True:
+                        await asyncio.wait_for(cli.inbox.get(), 0.01)
+                        stats["recvs"] += 1
+                except asyncio.TimeoutError:
+                    pass
+                await cli.unsubscribe(flt)
+                stats["churns"] += 1
+            await cli.disconnect()
+        except Exception as e:
+            stats["errors"] += 1
+            stats["last_error"] = repr(e)[:120]
+        finally:
+            try:
+                await cli.close()
+            except Exception:
+                pass
+        stats["reconnects"] += 1
+
+
+async def main():
+    from emqx_tpu.node import Node
+
+    n = Node(batch_ingress=True)
+    n.add_listener(port=0)
+    await n.start()
+    port = n.listeners[0].port
+    stop = asyncio.Event()
+    stats = {"pubs": 0, "recvs": 0, "churns": 0, "reconnects": 0,
+             "errors": 0}
+    tasks = [asyncio.create_task(_client_loop(i, port, stop, stats))
+             for i in range(CLIENTS)]
+    samples = []
+    t_end = time.monotonic() + MINUTES * 60
+    while time.monotonic() < t_end:
+        await asyncio.sleep(SAMPLE_S)
+        samples.append(round(_rss_now_mb(), 1))
+        print(json.dumps({"t_min": round(
+            (time.monotonic() - (t_end - MINUTES * 60)) / 60, 1),
+            "rss_mb": samples[-1], **stats}), flush=True)
+    stop.set()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    await n.stop()
+
+    # trend over the second half (first half is warmup/jit)
+    half = samples[len(samples) // 2:]
+    growth = (half[-1] - half[0]) if len(half) >= 2 else 0.0
+    print(json.dumps({
+        "metric": "stability_soak",
+        "minutes": MINUTES, "clients": CLIENTS,
+        "rss_start_mb": samples[0] if samples else None,
+        "rss_end_mb": samples[-1] if samples else None,
+        "rss_secondhalf_growth_mb": round(growth, 1),
+        "verdict": ("leak-suspect" if growth > 50 else "stable"),
+        **stats,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
