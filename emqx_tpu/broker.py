@@ -299,6 +299,10 @@ class Broker:
         topics = [m.topic for _, m in pb.live]
         cfg = self.router.config
         if not self.router.use_device_now():
+            # host regime: stale device fan-out tables (from a past
+            # device phase) can never be used again before a fresh
+            # build — drop them so the sid quarantine drains
+            self.helper.drop_stale_state()
             if defer_host:
                 pb.host_topics = topics
             else:

@@ -192,6 +192,27 @@ class FanoutManager:
         """Drop the subscriber's id (after its last unsubscribe)."""
         with self._lock:
             self.registry.release(sub)
+            if self._state is None and self._sharded is None:
+                # no device fan-out snapshot holds sids: recycle now
+                # (host regime; otherwise quarantine drains when the
+                # next snapshot replaces the old tables — round-4
+                # soak found the quarantine growing unboundedly below
+                # the device threshold)
+                self.registry.flush_free()
+
+    def drop_stale_state(self) -> None:
+        """The publish path chose the HOST regime: any held device
+        snapshot is unreachable before a fresh build (state() always
+        rebuilds on version/epoch change), so release the tables and
+        drain the sid quarantine — a broker that crossed the device
+        threshold once and fell back must not pin ids forever (the
+        round-4 leak's second head)."""
+        if self._state is None and self._sharded is None:
+            return
+        with self._lock:
+            self._state = None
+            self._sharded = None
+            self.registry.flush_free()
 
     def members(self, filter_: str) -> Set[int]:
         return self.rows.get(filter_, set())

@@ -300,8 +300,23 @@ class Router:
                 self._t_delete(filter_)
                 fid = self._filter_ids.pop(filter_)
                 self._id_to_filter[fid] = None
-                self._pending_free.append(fid)
+                self._retire_id(fid)
                 self._patch_delete(filter_, fid)
+
+    def _retire_id(self, fid: int) -> None:
+        """Freed filter id → quarantine or immediate recycle.
+
+        Quarantine exists because published device snapshots hold the
+        id→filter map; the id may only recycle after the next flatten
+        replaces them. In the HOST regime no automaton was ever
+        built, so nothing references the id — recycle now. (Round-4
+        soak: below the device threshold nothing ever rebuilds, and
+        pending_free grew by ~200K ids/minute of subscribe churn,
+        a linear leak.)"""
+        if self._auto is None:
+            self._free_ids.append(fid)
+        else:
+            self._pending_free.append(fid)
 
     def has_route(self, filter_: str) -> bool:
         return filter_ in self._routes
@@ -350,7 +365,7 @@ class Router:
                     self._t_delete(f)
                     fid = self._filter_ids.pop(f)
                     self._id_to_filter[fid] = None
-                    self._pending_free.append(fid)
+                    self._retire_id(fid)
                     self._patch_delete(f, fid)
 
     def stats(self) -> Dict[str, int]:
@@ -584,10 +599,37 @@ class Router:
         debugging escape hatch)."""
         cfg = self.config
         if not cfg.use_device or not self._routes:
+            self._drop_stale_device_state()
             return False
         if cfg.mesh is not None:
             return True
-        return len(self._filter_ids) >= cfg.device_min_filters
+        if len(self._filter_ids) >= cfg.device_min_filters:
+            return True
+        self._drop_stale_device_state()
+        return False
+
+    def _drop_stale_device_state(self) -> None:
+        """The publish path just chose the HOST regime: a previously
+        published automaton is now unreachable by any future match
+        (the next device use re-flattens from scratch anyway), so
+        drop it and drain the id quarantine. Without this, a broker
+        that crossed the device threshold ONCE and fell back would
+        pin `_pending_free` forever — the round-4 leak's second head.
+        In-flight matchers are safe: they hold their own (auto, map)
+        snapshot references, and recycling only mutates the live
+        list."""
+        if self._auto is None and not self._pending_free:
+            return
+        with self._lock:
+            if self._auto is None and not self._pending_free:
+                return
+            self._auto = None
+            self._published = None
+            self._patcher = None
+            self._shard_patchers = []
+            self._dirty = True  # next device use must re-flatten
+            self._free_ids.extend(self._pending_free)
+            self._pending_free.clear()
 
     def match_dispatch(self, topics: Sequence[str]):
         """Dispatch-only device match: encode + enqueue the compiled

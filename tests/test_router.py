@@ -49,15 +49,28 @@ def test_match_after_mutation_rebuilds():
     assert r.stats()["topics.count"] == 1
 
 
-def test_filter_id_recycling():
+def test_filter_id_recycles_immediately_in_host_regime():
+    # no automaton was ever published: nothing holds an id map, so a
+    # freed id recycles at once (round-4 soak: the old unconditional
+    # quarantine grew ~200K ids/min under host-regime churn)
     r = _mk(use_device=False)
     r.add_route("a")
     fid = r.filter_id("a")
     r.delete_route("a")
     r.add_route("b")
-    # freed ids quarantine within a buffer generation: published id
-    # maps are append-only + tombstone-only, so a concurrent matcher
-    # can never see fid retranslate to a different filter
+    assert r.filter_id("b") == fid
+
+
+def test_filter_id_quarantines_within_published_generation():
+    # once an automaton generation is published, its id map is
+    # append-only + tombstone-only: a concurrent matcher must never
+    # see fid retranslate until the next flatten swaps the map
+    r = _mk(use_device=False)
+    r.add_route("a")
+    r.rebuild()  # publish a generation
+    fid = r.filter_id("a")
+    r.delete_route("a")
+    r.add_route("b")
     assert r.filter_id("b") != fid
     r.rebuild()  # generation swap releases the quarantine
     r.add_route("c")
@@ -172,3 +185,34 @@ def test_published_snapshot_is_stable_across_churn():
         r.add_route(f"more/{i}")  # appends, never rewrites fid_gone
     assert id_map[fid_gone] is None or id_map[fid_gone] == "gone/b"
     assert id_map[r.filter_id("keep/a")] == "keep/a"
+
+
+def test_quarantine_drains_when_falling_back_to_host_regime():
+    """A router that crossed the device threshold once and then
+    dropped below it must not pin freed ids forever: the publish
+    path's next use_device_now() check drops the stale automaton and
+    drains the quarantine (round-4 leak, second head)."""
+    r = _mk(device_min_filters=4)
+    for i in range(6):
+        r.add_route(f"fb/{i}")
+    assert r.use_device_now()
+    r.rebuild()  # device-regime generation published
+    for i in range(5):
+        r.delete_route(f"fb/{i}")  # below threshold, ids quarantined
+    assert len(r._pending_free) == 5
+    assert not r.use_device_now()  # host regime: drop + drain
+    assert r._pending_free == []
+    assert len(r._free_ids) == 5
+    assert r._auto is None
+    # churn in the host regime now recycles in place
+    cap = len(r._id_to_filter)
+    for i in range(50):
+        r.add_route(f"fb2/{i}")
+        r.delete_route(f"fb2/{i}")
+    assert len(r._id_to_filter) == cap
+    # and crossing back up re-flattens cleanly with exact matching
+    for i in range(6):
+        r.add_route(f"up/{i}/+")
+    assert r.use_device_now()
+    [m] = r.match_filters(["up/3/x"])
+    assert m == ["up/3/+"]
