@@ -299,10 +299,11 @@ class Broker:
         topics = [m.topic for _, m in pb.live]
         cfg = self.router.config
         if not self.router.use_device_now():
-            # host regime: stale device fan-out tables (from a past
-            # device phase) can never be used again before a fresh
-            # build — drop them so the sid quarantine drains
-            self.helper.drop_stale_state()
+            # host regime: let the router shed a stale automaton's id
+            # quarantine once it has grown past its bound (bounded
+            # hysteresis — an oscillating filter count must not pay a
+            # re-flatten per threshold crossing)
+            self.router.reclaim_host_regime()
             if defer_host:
                 pb.host_topics = topics
             else:

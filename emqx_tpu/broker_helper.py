@@ -35,6 +35,7 @@ array shapes stable across rebuilds (no recompilation churn).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Set
 
 import jax
@@ -66,6 +67,10 @@ class SubRegistry:
     def register(self, sub: object) -> int:
         sid = self._by_sub.get(sub)
         if sid is None:
+            if not self._free and self._quarantine:
+                # opportunistic aged reclaim keeps steady churn from
+                # growing the table (round-4 leak)
+                self.flush_free()
             if self._free:
                 sid = self._free.pop()
                 self._by_id[sid] = sub
@@ -83,17 +88,34 @@ class SubRegistry:
             return self._by_id[sid]
         return None
 
+    #: quarantine dwell before a sid may recycle. Freed sids are
+    #: resolved against the LIVE registry by the delivery tail, so a
+    #: sid referenced by an in-flight pipelined device batch must not
+    #: retranslate while that batch can still gather it — table swaps
+    #: alone don't prove safety (up to max_inflight batches hold old
+    #: tables). Batches live milliseconds; 5s is a hard upper bound
+    #: on any batch lifetime, and it also bounds the quarantine to
+    #: the last 5s of churn (the round-4 leak fix).
+    QUARANTINE_S = 5.0
+
     def release(self, sub: object) -> None:
         sid = self._by_sub.pop(sub, None)
         if sid is not None:
             self._by_id[sid] = None
-            self._quarantine.append(sid)
+            self._quarantine.append((sid, time.monotonic()))
 
     def flush_free(self) -> None:
-        """Move quarantined ids to the free list (no live device
-        table references them any more)."""
-        self._free.extend(self._quarantine)
-        self._quarantine.clear()
+        """Recycle quarantined ids older than :attr:`QUARANTINE_S`
+        (entries are in release order, so the aged prefix suffices)."""
+        cutoff = time.monotonic() - self.QUARANTINE_S
+        i = 0
+        for sid, ts in self._quarantine:
+            if ts > cutoff:
+                break
+            self._free.append(sid)
+            i += 1
+        if i:
+            del self._quarantine[:i]
 
     def count(self) -> int:
         return len(self._by_sub)
@@ -189,29 +211,14 @@ class FanoutManager:
             self._version += 1
 
     def release(self, sub: object) -> None:
-        """Drop the subscriber's id (after its last unsubscribe)."""
+        """Drop the subscriber's id (after its last unsubscribe).
+        Recycling is TIME-gated (SubRegistry.QUARANTINE_S), not
+        snapshot-gated: in-flight pipelined batches resolve sids
+        against the live registry, so table swaps alone never proved
+        reuse safe — and the host regime (no swaps at all) previously
+        leaked the quarantine unboundedly (round-4 soak)."""
         with self._lock:
             self.registry.release(sub)
-            if self._state is None and self._sharded is None:
-                # no device fan-out snapshot holds sids: recycle now
-                # (host regime; otherwise quarantine drains when the
-                # next snapshot replaces the old tables — round-4
-                # soak found the quarantine growing unboundedly below
-                # the device threshold)
-                self.registry.flush_free()
-
-    def drop_stale_state(self) -> None:
-        """The publish path chose the HOST regime: any held device
-        snapshot is unreachable before a fresh build (state() always
-        rebuilds on version/epoch change), so release the tables and
-        drain the sid quarantine — a broker that crossed the device
-        threshold once and fell back must not pin ids forever (the
-        round-4 leak's second head)."""
-        if self._state is None and self._sharded is None:
-            return
-        with self._lock:
-            self._state = None
-            self._sharded = None
             self.registry.flush_free()
 
     def members(self, filter_: str) -> Set[int]:

@@ -715,13 +715,19 @@ class Channel:
 
     def _in_disconnect(self, pkt: Disconnect) -> List[Packet]:
         self.broker.metrics.inc("packets.disconnect.received")
-        if pkt.reason_code == RC.NORMAL_DISCONNECTION:
-            self.will = None  # clean close: discard will
-        # v5: client may update session expiry on disconnect
+        # v5: client may update session expiry on disconnect — but
+        # raising it from a CONNECT-time 0 is a protocol error
+        # (MQTT-3.14.2.2.2; src/emqx_channel.erl:639-643). Validated
+        # BEFORE the will-discard: a protocol-error close is not a
+        # clean disconnect, so the will must still fire.
         if self.proto_ver == C.MQTT_V5:
             exp = pkt.properties.get("Session-Expiry-Interval")
             if exp is not None:
+                if self.expiry_interval == 0 and exp > 0:
+                    return self._disconnect_with(RC.PROTOCOL_ERROR)
                 self.expiry_interval = exp
+        if pkt.reason_code == RC.NORMAL_DISCONNECTION:
+            self.will = None  # clean close: discard will
         self.disconnect_reason = "normal"
         self._shutdown()
         return []

@@ -71,8 +71,14 @@ class MatcherConfig:
     # round-trip latency that only amortizes at scale, while the host
     # walk is O(depth) hash lookups. The device automaton still
     # maintains itself (patching/rebuilds) so crossing the threshold
-    # is just a branch flip, not a build.
+    # is usually just a branch flip — unless host-regime churn piled
+    # more than host_reclaim_pending freed ids, in which case the
+    # stale automaton is dropped (reclaim_host_regime) and the next
+    # device use re-flattens.
     device_min_filters: int = 1024
+    # host-regime quarantined-id bound before the stale automaton is
+    # dropped and ids recycle (bounded hysteresis; round-4 leak fix)
+    host_reclaim_pending: int = 1024
     # packed-transfer budgets (ops/pack.py): expected average matched
     # filters / deliveries per message and bitmap rows per batch; the
     # publish path re-packs with the next pow2 bucket on overflow
@@ -599,29 +605,32 @@ class Router:
         debugging escape hatch)."""
         cfg = self.config
         if not cfg.use_device or not self._routes:
-            self._drop_stale_device_state()
             return False
         if cfg.mesh is not None:
             return True
-        if len(self._filter_ids) >= cfg.device_min_filters:
-            return True
-        self._drop_stale_device_state()
-        return False
+        return len(self._filter_ids) >= cfg.device_min_filters
 
-    def _drop_stale_device_state(self) -> None:
-        """The publish path just chose the HOST regime: a previously
-        published automaton is now unreachable by any future match
-        (the next device use re-flattens from scratch anyway), so
-        drop it and drain the id quarantine. Without this, a broker
-        that crossed the device threshold ONCE and fell back would
-        pin `_pending_free` forever — the round-4 leak's second head.
-        In-flight matchers are safe: they hold their own (auto, map)
-        snapshot references, and recycling only mutates the live
-        list."""
-        if self._auto is None and not self._pending_free:
+    def reclaim_host_regime(self) -> None:
+        """Called by the publish path when it chose the HOST regime:
+        if a previously published automaton's id quarantine has grown
+        past ``host_reclaim_pending``, drop the automaton (the next
+        device use re-flattens from scratch) and drain the ids.
+
+        The size bound is hysteresis: a filter count oscillating
+        around ``device_min_filters`` must not pay a full re-flatten
+        per crossing — a stale automaton pins at most the bound
+        (~28B/id) until churn actually accumulates. Without any
+        reclaim, a broker that crossed the threshold once and fell
+        back would pin ``_pending_free`` forever (the round-4 leak's
+        second head). In-flight matchers are safe: they hold their
+        own (auto, map) snapshot references, and recycling only
+        mutates the live list."""
+        if self._auto is None or \
+                len(self._pending_free) <= self.config.host_reclaim_pending:
             return
         with self._lock:
-            if self._auto is None and not self._pending_free:
+            if self._auto is None or len(self._pending_free) <= \
+                    self.config.host_reclaim_pending:
                 return
             self._auto = None
             self._published = None

@@ -21,11 +21,20 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+import jax
+
+# never let a soak wander onto the (possibly wedged) tunneled chip
+jax.config.update("jax_platforms",
+                  os.environ.get("SOAK_PLATFORM", "cpu"))
+
 from emqx_tpu.mqtt import constants as C  # noqa: E402
 
 MINUTES = float(os.environ.get("SOAK_MINUTES", "30"))
 CLIENTS = int(os.environ.get("SOAK_CLIENTS", "40"))
 SAMPLE_S = float(os.environ.get("SOAK_SAMPLE_S", "30"))
+# >0 pre-loads background wildcard filters so the broker runs the
+# DEVICE publish regime (above device_min_filters) during the soak
+BG_FILTERS = int(os.environ.get("SOAK_BG_FILTERS", "0"))
 
 
 def _rss_mb() -> float:
@@ -87,6 +96,12 @@ async def main():
     n = Node(batch_ingress=True)
     n.add_listener(port=0)
     await n.start()
+    if BG_FILTERS:
+        for i in range(BG_FILTERS):
+            n.router.add_route(f"bg/{i}/+")
+        print(json.dumps({"bg_filters": BG_FILTERS,
+                          "device_regime":
+                          n.router.use_device_now()}), flush=True)
     port = n.listeners[0].port
     stop = asyncio.Event()
     stats = {"pubs": 0, "recvs": 0, "churns": 0, "reconnects": 0,

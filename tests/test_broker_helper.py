@@ -29,14 +29,23 @@ def test_registry_dense_ids_and_quarantine():
     assert reg.lookup(ia) is a
     reg.release(a)
     assert reg.lookup(ia) is None
-    # freed id must NOT recycle until tables rebuild (flush_free)
+    # freed id must NOT recycle while an in-flight device batch could
+    # still gather it: recycling is TIME-gated (QUARANTINE_S), not
+    # snapshot-gated (round-4: pipelined batches resolve sids against
+    # the live registry)
     c = object()
     ic = reg.register(c)
     assert ic == 2
-    reg.flush_free()
+    reg.flush_free()  # too young: still quarantined
     d = object()
-    assert reg.register(d) == ia  # now recycled
-    assert reg.count() == 3 and reg.capacity() == 3
+    assert reg.register(d) == 3
+    # past the dwell the id recycles
+    reg._quarantine[0] = (reg._quarantine[0][0],
+                          reg._quarantine[0][1] - reg.QUARANTINE_S - 1)
+    reg.flush_free()
+    e = object()
+    assert reg.register(e) == ia  # now recycled
+    assert reg.count() == 4 and reg.capacity() == 4
 
 
 def test_manager_state_small_and_big_split():

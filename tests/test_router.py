@@ -187,28 +187,34 @@ def test_published_snapshot_is_stable_across_churn():
     assert id_map[r.filter_id("keep/a")] == "keep/a"
 
 
-def test_quarantine_drains_when_falling_back_to_host_regime():
-    """A router that crossed the device threshold once and then
-    dropped below it must not pin freed ids forever: the publish
-    path's next use_device_now() check drops the stale automaton and
-    drains the quarantine (round-4 leak, second head)."""
-    r = _mk(device_min_filters=4)
+def test_quarantine_bounded_when_falling_back_to_host_regime():
+    """A router that crossed the device threshold once and dropped
+    below it must not pin freed ids forever — but an oscillating
+    filter count must not pay a re-flatten per crossing either:
+    reclaim_host_regime drops the stale automaton only once the
+    quarantine outgrows host_reclaim_pending (round-4 leak fix with
+    hysteresis)."""
+    r = _mk(device_min_filters=4, host_reclaim_pending=8)
     for i in range(6):
         r.add_route(f"fb/{i}")
     assert r.use_device_now()
     r.rebuild()  # device-regime generation published
     for i in range(5):
-        r.delete_route(f"fb/{i}")  # below threshold, ids quarantined
-    assert len(r._pending_free) == 5
-    assert not r.use_device_now()  # host regime: drop + drain
-    assert r._pending_free == []
-    assert len(r._free_ids) == 5
-    assert r._auto is None
-    # churn in the host regime now recycles in place
-    cap = len(r._id_to_filter)
-    for i in range(50):
+        r.delete_route(f"fb/{i}")  # below threshold, quarantined
+    assert not r.use_device_now()
+    r.reclaim_host_regime()  # under the bound: hysteresis holds
+    assert r._auto is not None and len(r._pending_free) == 5
+    for i in range(10):  # churn past the bound
         r.add_route(f"fb2/{i}")
         r.delete_route(f"fb2/{i}")
+    r.reclaim_host_regime()
+    assert r._auto is None
+    assert r._pending_free == []
+    # host-regime churn now recycles in place
+    cap = len(r._id_to_filter)
+    for i in range(50):
+        r.add_route(f"x/{i}")
+        r.delete_route(f"x/{i}")
     assert len(r._id_to_filter) == cap
     # and crossing back up re-flattens cleanly with exact matching
     for i in range(6):

@@ -132,3 +132,25 @@ def test_v5_empty_clientid_with_cs0_rejected():
         clean_start=False))[0]
     assert ack.reason_code != 0
     assert chan.close_after_send
+
+
+def test_disconnect_cannot_raise_expiry_from_zero():
+    """MQTT-3.14.2.2.2: a CONNECT with Session-Expiry-Interval 0
+    cannot be upgraded to a persistent session at DISCONNECT — the
+    server answers PROTOCOL_ERROR (src/emqx_channel.erl:639-643)."""
+    from emqx_tpu.mqtt.packet import Disconnect
+
+    zone = Zone(name="zk-se")
+    _, chan, ack = _connect(zone)  # v5, no expiry property -> 0
+    assert ack.reason_code == 0
+    out = chan.handle_in(Disconnect(
+        reason_code=0, properties={"Session-Expiry-Interval": 300}))
+    assert any(isinstance(p, Disconnect) and p.reason_code == 0x82
+               for p in out), out
+    # and a session opened WITH expiry may lower/raise it freely
+    _, chan2, _ = _connect(zone, client_id="se2", props={
+        "Session-Expiry-Interval": 100})
+    out2 = chan2.handle_in(Disconnect(
+        reason_code=0, properties={"Session-Expiry-Interval": 900}))
+    assert out2 == []
+    assert chan2.expiry_interval == 900
