@@ -93,9 +93,13 @@ class SubRegistry:
     #: sid referenced by an in-flight pipelined device batch must not
     #: retranslate while that batch can still gather it — table swaps
     #: alone don't prove safety (up to max_inflight batches hold old
-    #: tables). Batches live milliseconds; 5s is a hard upper bound
-    #: on any batch lifetime, and it also bounds the quarantine to
-    #: the last 5s of churn (the round-4 leak fix).
+    #: tables). Batches live milliseconds; 5s covers any sane batch
+    #: lifetime, and it also bounds the quarantine to the last 5s of
+    #: churn (the round-4 leak fix). Defense in depth, not the sole
+    #: guard: even a sid that DOES retranslate mid-batch is harmless,
+    #: because Broker._deliver_one only delivers when the resolved
+    #: sub is CURRENTLY subscribed to the matched filter — a stale
+    #: slot either drops or reaches a legitimate subscriber.
     QUARANTINE_S = 5.0
 
     def release(self, sub: object) -> None:
