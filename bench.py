@@ -114,6 +114,39 @@ def _first_leaf(out):
 TPU_LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_TPU_LAST.json")
 
+#: the headline metric shared by the configs aggregate and solo mixed
+#: mode — the one metric where staging must distinguish the two
+_MATRIX_METRIC = "publish_match_fanout_throughput"
+
+
+def _good_row(r: dict) -> bool:
+    """A config row that carries a real measurement — the single
+    definition shared by merge, resume, and the probe loop's
+    completeness check (they must never disagree on 'done')."""
+    return r.get("value") is not None and "error" not in r
+
+
+def _merge_staged_configs(prev: dict, rec: dict) -> dict:
+    """Row-level merge of a new aggregate into the staged one: a row
+    that errored in THIS run (tunnel wedged mid-matrix — the round-4
+    failure mode: 2 rows landed, then 6 init-hangs) inherits the
+    prior staged row's good measurement instead of erasing it. Good
+    new rows always win; `carried_ts` marks inherited ones. (Solo-
+    mode records stage under a separate ":solo" key — see
+    _stage_tpu_record — so prev and rec either both carry configs or
+    the merge is a no-op.)"""
+    if not (prev and prev.get("configs") and rec.get("configs")):
+        return rec
+    prior = {r.get("name"): r for r in prev["configs"] if _good_row(r)}
+    merged = []
+    for row in rec["configs"]:
+        old = prior.get(row.get("name"))
+        if not _good_row(row) and old is not None:
+            row = dict(old)
+            row.setdefault("carried_ts", prev.get("ts", "unknown"))
+        merged.append(row)
+    return dict(rec, configs=merged)
+
 
 def _stage_tpu_record(rec: dict) -> None:
     """Merge ``rec`` into the last-good TPU artifact under its metric
@@ -125,7 +158,18 @@ def _stage_tpu_record(rec: dict) -> None:
         if os.path.exists(TPU_LAST_PATH):
             with open(TPU_LAST_PATH) as f:
                 existing = json.load(f)
-        existing[rec["metric"]] = dict(
+        # a solo mixed-mode run (same metric as the matrix aggregate,
+        # no configs array) is staged under its own ":solo" slot: its
+        # workload shape is operator-chosen (BENCH_SUBS=anything), so
+        # it must neither erase the matrix aggregate nor have its
+        # fresher top-level value clobbered by a later resume window
+        # reusing matrix rows. Named modes have distinct metrics and
+        # stage unqualified.
+        key = rec["metric"]
+        if key == _MATRIX_METRIC and not rec.get("configs"):
+            key += ":solo"
+        rec = _merge_staged_configs(existing.get(key), rec)
+        existing[key] = dict(
             rec, ts=time.strftime("%Y-%m-%dT%H:%M:%S%z"))
         tmp = TPU_LAST_PATH + ".tmp"
         with open(tmp, "w") as f:
@@ -914,11 +958,28 @@ _CONFIG_MATRIX = [
     ("mixed_10m", {}, None, 10_000_000, 500_000),
     ("mixed_1m_uniform", {"BENCH_TRAFFIC": "uniform"}, None,
      1_000_000, 100_000),
+    # live row pinned to the CPU backend: it measures the HOST wire
+    # path (socket→deliver, host-regime filters — no device work at
+    # these counts), and in the round-4 TPU run a half-wedged tunnel
+    # made its in-process jax init hang for the row's full 900s
+    # budget. Pinning is labeled (row platform reads "cpu").
     ("live_paced", {"LIVE_RATE": "400", "LIVE_SECS": "5",
-                    "LIVE_PIPELINE": "4"}, "live", 0, 0),
+                    "LIVE_PIPELINE": "4", "BENCH_PLATFORM": "cpu"},
+     "live", 0, 0),
 ]
 
 _HEADLINE_ROW = "mixed_1m_zipf"
+
+
+def _row_spec(name: str, extra: dict, mode, subs_tpu) -> str:
+    """Stable fingerprint of a matrix row's workload spec. Resume
+    reuse requires the staged row to match: editing a row's
+    parameters (subs, mix, levels…) must invalidate its staged
+    measurement, not silently satisfy the new spec with old data."""
+    import hashlib
+
+    blob = json.dumps([name, extra, mode, subs_tpu], sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:10]
 
 
 def _last_json_line(text: str):
@@ -973,8 +1034,46 @@ def configs():
     # final JSON line prints
     deadline = time.monotonic() + float(
         os.environ.get("BENCH_DEADLINE", "3000"))
+    # BENCH_RESUME=1 (the recovery probe loop sets it): rows already
+    # measured on a real accelerator are reused from the staged
+    # artifact so a short tunnel-recovery window is spent ONLY on the
+    # rows still missing — each window fills in more of the matrix
+    # instead of re-measuring the headline until the tunnel re-wedges.
+    # stamp each EXECUTED row with the tree revision: resume can
+    # legitimately combine rows measured days apart, and a mixed-
+    # revision aggregate must be distinguishable from a single-run one
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or "unknown"
+    except Exception:
+        rev = "unknown"
+    staged_rows = {}
+    staged_ts = "unknown"
+    if os.environ.get("BENCH_RESUME") and not fallback:
+        last = _last_good_tpu(_MATRIX_METRIC) or {}
+        staged_ts = last.get("ts", "unknown")
+        staged_rows = {r.get("name"): r
+                       for r in last.get("configs", []) if _good_row(r)}
     rows = []
+    ran_any = False
     for name, extra, mode, subs_tpu, subs_cpu in _CONFIG_MATRIX:
+        spec = _row_spec(name, extra, mode, subs_tpu)
+        # rows staged before spec-stamping existed were measured under
+        # the then-current matrix; absence of "spec" is accepted once
+        # — any row executed from here on carries its spec
+        if name in staged_rows \
+                and staged_rows[name].get("spec", spec) == spec:
+            # keep the ORIGINAL measurement time: re-staging stamps a
+            # fresh top-level ts, and without measured_ts an all-
+            # reused cycle would make old numbers look fresh
+            row = dict(staged_rows[name], reused_staged=True)
+            row.setdefault("measured_ts",
+                           row.pop("carried_ts", staged_ts))
+            rows.append(row)
+            continue
         if time.monotonic() > deadline:
             rows.append({"name": name,
                          "error": "skipped: BENCH_DEADLINE reached"})
@@ -1001,7 +1100,9 @@ def configs():
         env.setdefault("BENCH_ITERS", "12")
         env.setdefault("BENCH_WINDOWS", "3")
         t0 = time.time()
-        row = {"name": name, "subs": subs or None}
+        ran_any = True
+        row = {"name": name, "subs": subs or None, "rev": rev,
+               "spec": spec}
         try:
             budget = min(cfg_timeout,
                          max(60.0, deadline - time.monotonic()))
@@ -1054,7 +1155,7 @@ def configs():
                      if r["name"] == "live_paced" and "error" not in r),
                     None)
     rec = {
-        "metric": "publish_match_fanout_throughput",
+        "metric": _MATRIX_METRIC,
         "unit": "msgs/sec",
         "platform": plat or "unreachable",
         "configs": rows,
@@ -1067,7 +1168,14 @@ def configs():
     else:
         rec["value"] = rec["vs_baseline"] = None
     if live_row is not None and "p99_deliver_ms" in live_row:
+        # keep the literal key (VERDICT r3 item 9's done-check), but
+        # label its provenance explicitly: socket-to-deliver latency
+        # is a HOST wire-path metric and the live row is CPU-pinned —
+        # the platform field says so instead of an impersonating
+        # unlabeled number or a renamed key nobody finds
         rec["p99_deliver_ms"] = live_row["p99_deliver_ms"]
+        rec["p99_deliver_platform"] = live_row.get("platform",
+                                                   "unknown")
     if fallback:
         # same labeling contract as _cpu_fallback_record: a CPU
         # number must never impersonate a TPU result
@@ -1088,8 +1196,10 @@ def configs():
     # real accelerator: stage into the last-good artifact (the
     # in-process _emit would init a backend here; platform is already
     # known from the probe, so stage directly) — but only a record
-    # whose headline survived; a null must not erase prior evidence
-    if rec.get("value") is not None:
+    # whose headline survived, and only when something actually RAN:
+    # an all-reused resume cycle must not re-stamp the artifact's ts
+    # over measurements it didn't make
+    if rec.get("value") is not None and ran_any:
         _stage_tpu_record(rec)
     print(json.dumps(rec), flush=True)
 
