@@ -118,6 +118,12 @@ TPU_LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: mode — the one metric where staging must distinguish the two
 _MATRIX_METRIC = "publish_match_fanout_throughput"
 
+#: aggregate fields lifted from the headline config row — one list
+#: shared by the emit path, the merge-inherit path, and the fallback
+#: cpu_ relabeling
+_HEADLINE_FIELDS = ("value", "vs_baseline", "p50_batch_ms",
+                    "p99_batch_ms")
+
 
 def _good_row(r: dict) -> bool:
     """A config row that carries a real measurement — the single
@@ -137,22 +143,62 @@ def _merge_staged_configs(prev: dict, rec: dict) -> dict:
     the merge is a no-op.)"""
     if not (prev and prev.get("configs") and rec.get("configs")):
         return rec
+    cur_specs = {name: _row_spec(name, extra, mode, subs_tpu)
+                 for name, extra, mode, subs_tpu, _ in _CONFIG_MATRIX}
+
+    def _ts_of(old: dict) -> str:
+        # original measurement time survives reuse cycles: carried_ts
+        # may have been folded into measured_ts by the resume path
+        return old.get("carried_ts", old.get(
+            "measured_ts", prev.get("ts", "unknown")))
+
+    def _inheritable(old: dict) -> bool:
+        # same spec rule as resume reuse: a row measured under an
+        # edited matrix spec must not satisfy the current one
+        # (rows missing "spec" predate stamping — accepted, same
+        # grace the resume path grants)
+        cur = cur_specs.get(old.get("name"))
+        return cur is None or old.get("spec", cur) == cur
+
     prior = {r.get("name"): r for r in prev["configs"] if _good_row(r)}
     merged = []
     for row in rec["configs"]:
-        old = prior.get(row.get("name"))
-        if not _good_row(row) and old is not None:
-            row = dict(old)
-            row.setdefault("carried_ts", prev.get("ts", "unknown"))
+        old = prior.pop(row.get("name"), None)
+        if not _good_row(row) and old is not None and _inheritable(old):
+            row = dict(old, carried_ts=_ts_of(old))
         merged.append(row)
-    return dict(rec, configs=merged)
+    # staged good rows the new record doesn't even mention (matrix
+    # reshuffle, partial record) stay — evidence is never dropped;
+    # the completeness check keys off the CURRENT matrix, so orphan
+    # rows are inert
+    for old in prior.values():
+        merged.append(dict(old, carried_ts=_ts_of(old)))
+    # resume-cycle presentation flags must not persist as artifact
+    # state (a re-staged reused row is not "reused" in the artifact)
+    merged = [{k: v for k, v in r.items() if k != "reused_staged"}
+              for r in merged]
+    rec = dict(rec, configs=merged)
+    # top-level headline fields follow the (possibly inherited)
+    # headline row: a run whose headline failed but measured OTHER
+    # rows must stage those without nulling the aggregate value
+    head = next((r for r in merged if r.get("name") == _HEADLINE_ROW),
+                None)
+    if rec.get("value") is None and head is not None and _good_row(head):
+        for fld in _HEADLINE_FIELDS:
+            if fld in head:
+                rec[fld] = head[fld]
+        rec["headline_carried_ts"] = head.get(
+            "carried_ts", prev.get("ts", "unknown"))
+    return rec
 
 
-def _stage_tpu_record(rec: dict) -> None:
+def _stage_tpu_record(rec: dict):
     """Merge ``rec`` into the last-good TPU artifact under its metric
-    key. Never called with a null value — a failed run must not erase
-    prior evidence. Swallows everything: persistence must never break
-    the bench line."""
+    key and return the staged (merged, ts-stamped) record — or None
+    when persistence failed. A failed run never erases prior
+    evidence: errored rows and a failed headline inherit the staged
+    measurements via _merge_staged_configs. Swallows everything:
+    persistence must never break the bench line."""
     try:
         existing = {}
         if os.path.exists(TPU_LAST_PATH):
@@ -169,14 +215,15 @@ def _stage_tpu_record(rec: dict) -> None:
         if key == _MATRIX_METRIC and not rec.get("configs"):
             key += ":solo"
         rec = _merge_staged_configs(existing.get(key), rec)
-        existing[key] = dict(
-            rec, ts=time.strftime("%Y-%m-%dT%H:%M:%S%z"))
+        staged = dict(rec, ts=time.strftime("%Y-%m-%dT%H:%M:%S%z"))
+        existing[key] = staged
         tmp = TPU_LAST_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(existing, f, indent=1, sort_keys=True)
         os.replace(tmp, TPU_LAST_PATH)
+        return staged
     except Exception:
-        pass
+        return None
 
 
 def _emit(rec: dict) -> None:
@@ -1072,6 +1119,9 @@ def configs():
             row = dict(staged_rows[name], reused_staged=True)
             row.setdefault("measured_ts",
                            row.pop("carried_ts", staged_ts))
+            # pre-spec rows: record the acceptance explicitly so the
+            # once-only grace actually expires on re-staging
+            row.setdefault("spec", spec)
             rows.append(row)
             continue
         if time.monotonic() > deadline:
@@ -1161,8 +1211,7 @@ def configs():
         "configs": rows,
     }
     if head is not None:
-        for fld in ("value", "vs_baseline", "p50_batch_ms",
-                    "p99_batch_ms"):
+        for fld in _HEADLINE_FIELDS:
             if fld in head:
                 rec[fld] = head[fld]
     else:
@@ -1179,8 +1228,7 @@ def configs():
     if fallback:
         # same labeling contract as _cpu_fallback_record: a CPU
         # number must never impersonate a TPU result
-        for fld in ("value", "vs_baseline", "p50_batch_ms",
-                    "p99_batch_ms", "p99_deliver_ms"):
+        for fld in _HEADLINE_FIELDS + ("p99_deliver_ms",):
             if rec.get(fld) is not None:
                 rec[f"cpu_{fld}"] = rec.pop(fld)
         rec["value"] = rec["vs_baseline"] = None
@@ -1195,12 +1243,31 @@ def configs():
         return
     # real accelerator: stage into the last-good artifact (the
     # in-process _emit would init a backend here; platform is already
-    # known from the probe, so stage directly) — but only a record
-    # whose headline survived, and only when something actually RAN:
+    # known from the probe, so stage directly). Stage when anything
+    # actually RAN and produced at least one good row — a run whose
+    # HEADLINE failed still banks its other measurements (merge
+    # inherits the staged headline, so the aggregate value survives);
     # an all-reused resume cycle must not re-stamp the artifact's ts
-    # over measurements it didn't make
-    if rec.get("value") is not None and ran_any:
-        _stage_tpu_record(rec)
+    # over measurements it didn't make.
+    staged = None
+    if ran_any and any(_good_row(r) and not r.get("reused_staged")
+                       for r in rows):
+        staged = _stage_tpu_record(rec)
+        if staged is not None and rec.get("value") is None:
+            # surface the merge-inherited headline on the emitted
+            # line too, marked by headline_carried_ts
+            for fld in _HEADLINE_FIELDS + ("headline_carried_ts",):
+                if fld in staged:
+                    rec[fld] = staged[fld]
+    # a healthy-tunnel run that still lost rows (re-wedge, deadline
+    # skips) attaches the staged record — the SAME full-record shape
+    # the fallback path attaches — which merge-keeps every row ever
+    # measured on a real accelerator
+    if not all(_good_row(r) for r in rows):
+        last = staged if staged is not None \
+            else _last_good_tpu(_MATRIX_METRIC)
+        if last is not None:
+            rec["last_good_tpu"] = last
     print(json.dumps(rec), flush=True)
 
 
