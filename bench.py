@@ -160,19 +160,29 @@ def _merge_staged_configs(prev: dict, rec: dict) -> dict:
         cur = cur_specs.get(old.get("name"))
         return cur is None or old.get("spec", cur) == cur
 
+    def _inherit(old: dict) -> dict:
+        row = dict(old, carried_ts=_ts_of(old))
+        # same once-only pre-spec grace as resume reuse: record the
+        # acceptance so it expires on re-staging (resume stamps at
+        # reuse time; the merge paths must not re-grant it forever)
+        cur = cur_specs.get(row.get("name"))
+        if cur is not None:
+            row.setdefault("spec", cur)
+        return row
+
     prior = {r.get("name"): r for r in prev["configs"] if _good_row(r)}
     merged = []
     for row in rec["configs"]:
         old = prior.pop(row.get("name"), None)
         if not _good_row(row) and old is not None and _inheritable(old):
-            row = dict(old, carried_ts=_ts_of(old))
+            row = _inherit(old)
         merged.append(row)
     # staged good rows the new record doesn't even mention (matrix
     # reshuffle, partial record) stay — evidence is never dropped;
     # the completeness check keys off the CURRENT matrix, so orphan
     # rows are inert
     for old in prior.values():
-        merged.append(dict(old, carried_ts=_ts_of(old)))
+        merged.append(_inherit(old))
     # resume-cycle presentation flags must not persist as artifact
     # state (a re-staged reused row is not "reused" in the artifact)
     merged = [{k: v for k, v in r.items() if k != "reused_staged"}

@@ -195,14 +195,16 @@ def _module_classes() -> Dict[str, type]:
     from emqx_tpu.modules.acl_file import AclFileModule
     from emqx_tpu.modules.delayed import DelayedModule
     from emqx_tpu.modules.presence import PresenceModule
+    from emqx_tpu.modules.prometheus import PrometheusModule
     from emqx_tpu.modules.retainer import RetainerModule
     from emqx_tpu.modules.rewrite import RewriteModule
     from emqx_tpu.modules.subscription import SubscriptionModule
     from emqx_tpu.modules.topic_metrics import TopicMetricsModule
 
     return {cls.name: cls for cls in (
-        AclFileModule, DelayedModule, PresenceModule, RetainerModule,
-        RewriteModule, SubscriptionModule, TopicMetricsModule)}
+        AclFileModule, DelayedModule, PresenceModule, PrometheusModule,
+        RetainerModule, RewriteModule, SubscriptionModule,
+        TopicMetricsModule)}
 
 
 def build_node(cfg: NodeConfig):

@@ -21,6 +21,14 @@ class Module:
     def unload(self) -> None:
         raise NotImplementedError
 
+    def on_loop_start(self) -> None:
+        """Called by node.start() inside the running event loop.
+
+        Config-file modules load in boot_from_file BEFORE any loop
+        exists, so a module that needs background tasks (timers,
+        sockets) starts them here, idempotently — load() may already
+        have started them when it ran in an async context."""
+
 
 class ModuleRegistry:
     def __init__(self, node) -> None:
@@ -44,3 +52,16 @@ class ModuleRegistry:
 
     def loaded(self):
         return list(self._loaded)
+
+    def on_loop_start(self) -> None:
+        """Kick every loaded module's loop-start hook, crash-isolated
+        like hook callbacks (one broken module must not block the
+        node boot)."""
+        import logging
+
+        for mod in list(self._loaded.values()):
+            try:
+                mod.on_loop_start()
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "module %s on_loop_start failed", mod.name)

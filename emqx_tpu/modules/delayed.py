@@ -31,10 +31,16 @@ class DelayedModule(Module):
         self.node.hooks.add("message.publish", self.on_publish,
                             priority=100)
         try:
+            asyncio.get_running_loop()
+            self.on_loop_start()
+        except RuntimeError:
+            self._task = None  # no loop yet: node.start() kicks
+            #                    on_loop_start; bare-sync tests tick()
+
+    def on_loop_start(self) -> None:
+        if self._task is None or self._task.done():
             loop = asyncio.get_running_loop()
             self._task = loop.create_task(self._timer_loop())
-        except RuntimeError:
-            self._task = None  # sync context: call tick() manually
 
     def unload(self) -> None:
         if getattr(self.node.broker, 'delayed', None) is self:

@@ -1,0 +1,139 @@
+"""Prometheus exposition endpoint for the node's counters and gauges.
+
+The reference ecosystem ships this as the `emqx_prometheus` plugin
+(outside the core app); here it is a built-in module because the
+metric registries it reads (`emqx_tpu/metrics.py` ↔
+src/emqx_metrics.erl, `emqx_tpu/stats.py` ↔ src/emqx_stats.erl) are
+core surfaces and an ops stack without a scrape endpoint is
+incomplete. Stdlib-only: a minimal asyncio HTTP listener serving
+`GET /metrics` in the Prometheus text exposition format (0.0.4).
+
+Naming: metric/stat keys are dotted (`messages.received`,
+`subscriptions.count`); Prometheus names must match
+``[a-zA-Z_:][a-zA-Z0-9_:]*``, so dots and slashes become underscores
+under an ``emqx_`` prefix: ``emqx_messages_received``. Counters from
+the metrics registry are TYPE counter; stats are point-in-time TYPE
+gauge (their ``.max`` companions included).
+
+Env keys (``[modules.prometheus]``): ``host`` (default 127.0.0.1),
+``port`` (default 9505; 0 = ephemeral, the bound port is in
+``self.port`` after load).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+from typing import Optional
+
+from emqx_tpu.modules import Module
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(key: str) -> str:
+    return "emqx_" + _NAME_RE.sub("_", key)
+
+
+def render(metrics: dict, stats: dict) -> str:
+    """The two registries as one exposition document. Counters and
+    gauges carry no labels (single-node registry; per-topic metrics
+    stay in the topic_metrics module, deliberately unexported — an
+    unbounded topic set is a label-cardinality trap)."""
+    out = []
+    for key in sorted(metrics):
+        name = prom_name(key)
+        out.append(f"# TYPE {name} counter")
+        out.append(f"{name} {int(metrics[key])}")
+    for key in sorted(stats):
+        name = prom_name(key)
+        out.append(f"# TYPE {name} gauge")
+        out.append(f"{name} {int(stats[key])}")
+    return "\n".join(out) + "\n"
+
+
+class PrometheusModule(Module):
+    name = "prometheus"
+
+    def __init__(self, node) -> None:
+        super().__init__(node)
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._task: Optional[asyncio.Task] = None
+        self.port: Optional[int] = None
+
+    def load(self, env: dict) -> None:
+        self._host = env.get("host", "127.0.0.1")
+        self._port = int(env.get("port", 9505))
+        try:
+            asyncio.get_running_loop()
+            self.on_loop_start()
+        except RuntimeError:
+            pass  # no loop yet: node.start() kicks on_loop_start
+
+    def on_loop_start(self) -> None:
+        if self._task is None or (self._task.done()
+                                  and self._server is None):
+            loop = asyncio.get_running_loop()
+            self._task = loop.create_task(self._serve())
+
+    def unload(self) -> None:
+        # cancel first: a task still inside start_server would
+        # otherwise bind AFTER the close and leak a live listener
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    async def _serve(self) -> None:
+        try:
+            self._server = await asyncio.start_server(
+                self._handle, self._host, self._port)
+        except OSError as e:
+            # a silent scrape endpoint is an ops trap: say WHY at
+            # boot (EADDRINUSE etc), don't leave an unretrieved task
+            # exception for loop teardown
+            logging.getLogger(__name__).error(
+                "prometheus endpoint failed to bind %s:%s: %s",
+                self._host, self._port, e)
+            return
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            req = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            # drain headers to be a polite HTTP/1.1 peer
+            while True:
+                line = await asyncio.wait_for(reader.readline(),
+                                              timeout=5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = req.decode("latin-1").split()
+            if len(parts) >= 2 and parts[0] == "GET" \
+                    and parts[1].split("?")[0] == "/metrics":
+                # refresh registered gauge update-funs before reading,
+                # like the $SYS heartbeat does
+                self.node.stats.tick()
+                body = render(self.node.metrics.all(),
+                              self.node.stats.all()).encode()
+                head = (b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4; "
+                        b"charset=utf-8\r\n"
+                        b"Content-Length: %d\r\n"
+                        b"Connection: close\r\n\r\n" % len(body))
+                writer.write(head + body)
+            else:
+                writer.write(b"HTTP/1.1 404 Not Found\r\n"
+                             b"Content-Length: 0\r\n"
+                             b"Connection: close\r\n\r\n")
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass

@@ -207,6 +207,9 @@ class Node:
         total_cap = sum(lst.max_connections for lst in self.listeners)
         if total_cap > 0:
             self.vm_mon.max_count = total_cap
+        # config-file modules loaded before any loop existed start
+        # their background tasks now (delayed timers, scrape sockets)
+        self.modules.on_loop_start()
         loop = asyncio.get_event_loop()
         self._bg_tasks.append(loop.create_task(self._housekeeping()))
         self._bg_tasks.append(loop.create_task(self._sys_loop()))
