@@ -29,6 +29,24 @@ class Module:
         sockets) starts them here, idempotently — load() may already
         have started them when it ran in an async context."""
 
+    def on_loop_stop(self) -> None:
+        """Called by node.stop(): quiesce background tasks WITHOUT
+        unloading (hooks stay registered; a later start() re-kicks
+        on_loop_start — the reference keeps modules loaded across a
+        broker restart)."""
+
+    def _kick_on_loop(self) -> bool:
+        """load() helper: start loop-bound work now if a loop is
+        already running, else leave it for node.start()."""
+        import asyncio
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+        self.on_loop_start()
+        return True
+
 
 class ModuleRegistry:
     def __init__(self, node) -> None:
@@ -57,11 +75,17 @@ class ModuleRegistry:
         """Kick every loaded module's loop-start hook, crash-isolated
         like hook callbacks (one broken module must not block the
         node boot)."""
+        self._each("on_loop_start")
+
+    def on_loop_stop(self) -> None:
+        self._each("on_loop_stop")
+
+    def _each(self, hook: str) -> None:
         import logging
 
         for mod in list(self._loaded.values()):
             try:
-                mod.on_loop_start()
+                getattr(mod, hook)()
             except Exception:
                 logging.getLogger(__name__).exception(
-                    "module %s on_loop_start failed", mod.name)
+                    "module %s %s failed", mod.name, hook)

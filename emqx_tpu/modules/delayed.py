@@ -30,24 +30,25 @@ class DelayedModule(Module):
         self.node.broker.delayed = self  # the channel consults this
         self.node.hooks.add("message.publish", self.on_publish,
                             priority=100)
-        try:
-            asyncio.get_running_loop()
-            self.on_loop_start()
-        except RuntimeError:
-            self._task = None  # no loop yet: node.start() kicks
-            #                    on_loop_start; bare-sync tests tick()
+        # no loop yet -> node.start() kicks on_loop_start;
+        # bare-sync tests tick() manually
+        self._kick_on_loop()
 
     def on_loop_start(self) -> None:
         if self._task is None or self._task.done():
             loop = asyncio.get_running_loop()
             self._task = loop.create_task(self._timer_loop())
 
+    def on_loop_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
     def unload(self) -> None:
         if getattr(self.node.broker, 'delayed', None) is self:
             self.node.broker.delayed = None
         self.node.hooks.delete("message.publish", self.on_publish)
-        if self._task is not None:
-            self._task.cancel()
+        self.on_loop_stop()
 
     # -- hook -------------------------------------------------------------
 

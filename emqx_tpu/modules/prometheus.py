@@ -60,36 +60,39 @@ class PrometheusModule(Module):
         super().__init__(node)
         self._server: Optional[asyncio.base_events.Server] = None
         self._task: Optional[asyncio.Task] = None
+        self._closing = False
         self.port: Optional[int] = None
 
     def load(self, env: dict) -> None:
         self._host = env.get("host", "127.0.0.1")
         self._port = int(env.get("port", 9505))
-        try:
-            asyncio.get_running_loop()
-            self.on_loop_start()
-        except RuntimeError:
-            pass  # no loop yet: node.start() kicks on_loop_start
+        self._kick_on_loop()
 
     def on_loop_start(self) -> None:
+        self._closing = False
         if self._task is None or (self._task.done()
                                   and self._server is None):
             loop = asyncio.get_running_loop()
             self._task = loop.create_task(self._serve())
 
-    def unload(self) -> None:
-        # cancel first: a task still inside start_server would
-        # otherwise bind AFTER the close and leak a live listener
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
+    def on_loop_stop(self) -> None:
+        # flag-based shutdown, NOT a mid-bind cancel: cancelling the
+        # serve task exactly as start_server completes internally
+        # would drop an already-bound Server with no reference left
+        # to close — the flag lets _serve finish and self-close
+        self._closing = True
         if self._server is not None:
             self._server.close()
             self._server = None
+            self.port = None
+
+    def unload(self) -> None:
+        self.on_loop_stop()
+        self._task = None
 
     async def _serve(self) -> None:
         try:
-            self._server = await asyncio.start_server(
+            server = await asyncio.start_server(
                 self._handle, self._host, self._port)
         except OSError as e:
             # a silent scrape endpoint is an ops trap: say WHY at
@@ -99,7 +102,11 @@ class PrometheusModule(Module):
                 "prometheus endpoint failed to bind %s:%s: %s",
                 self._host, self._port, e)
             return
-        self.port = self._server.sockets[0].getsockname()[1]
+        if self._closing:  # unload/stop raced the bind
+            server.close()
+            return
+        self._server = server
+        self.port = server.sockets[0].getsockname()[1]
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -130,7 +137,10 @@ class PrometheusModule(Module):
                              b"Content-Length: 0\r\n"
                              b"Connection: close\r\n\r\n")
             await writer.drain()
-        except (asyncio.TimeoutError, ConnectionError):
+        except (asyncio.TimeoutError, ConnectionError, ValueError):
+            # ValueError = StreamReader's LimitOverrunError on a
+            # >64KiB line (scanner garbage) — drop, don't crash the
+            # connection task
             pass
         finally:
             try:

@@ -234,6 +234,9 @@ class Node:
         for t in self._bg_tasks:
             t.cancel()
         self._bg_tasks.clear()
+        # quiesce module background tasks (scrape sockets, timers)
+        # without unloading — start() re-kicks them
+        self.modules.on_loop_stop()
         # listeners first: drain() loops until quiescent, which never
         # happens while live connections keep submitting publishes
         for lst in self.listeners:

@@ -1,0 +1,150 @@
+"""REAL multi-process distributed bring-up: two OS processes join one
+jax.distributed world (coordinator over localhost, the multi-host
+control plane of SURVEY §2.3's TPU mapping) and run the actual
+sharded publish step over the GLOBAL mesh — cross-process collectives
+(Gloo on CPU, ICI/DCN on pods) carrying the trie-shard all-gather.
+
+This is the seam the single-process suites cannot cover:
+``tests/test_sharded.py`` proves the mesh program on 8 virtual
+devices inside ONE process; here the same program spans processes,
+each contributing 2 local devices, and every process verifies its
+addressable slice of the output against the host oracle.
+
+Pattern follows tests/test_cm_locker.py: the test spawns workers as
+subprocesses running THIS file with --worker.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _worker(pid: int, nproc: int, addr: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.ops.tokenize import WordTable, encode_batch
+    from emqx_tpu.parallel import distributed
+    from emqx_tpu.parallel.mesh import make_mesh
+    from emqx_tpu.parallel.sharded import (build_sharded,
+                                           build_sharded_fanout,
+                                           place_batch, place_sharded,
+                                           publish_step, shard_filters)
+
+    assert distributed.initialize(coordinator_address=addr,
+                                  num_processes=nproc, process_id=pid)
+    n_global = len(jax.devices())
+    assert n_global == 4, n_global  # 2 procs x 2 local devices
+
+    # identical deterministic build on every process (multi-process
+    # device_put requires same host data everywhere)
+    import random
+    rng = random.Random(7)
+    words = ["a", "b", "c", "d", "s1", "s2"]
+    filters = set()
+    while len(filters) < 60:
+        depth = rng.randint(1, 4)
+        ws = []
+        for i in range(depth):
+            r = rng.random()
+            if r < 0.2:
+                ws.append("+")
+            elif r < 0.3 and i == depth - 1:
+                ws.append("#")
+            else:
+                ws.append(rng.choice(words))
+        filters.add("/".join(ws))
+    filters = sorted(filters)
+    fids = {f: i for i, f in enumerate(filters)}
+    table = WordTable()
+    for f in filters:
+        for w in f.split("/"):
+            table.intern(w)
+    oracle = TrieOracle()
+    for f in filters:
+        oracle.insert(f)
+
+    n_data, n_trie = 2, 2
+    mesh = distributed.global_mesh(n_data=n_data, n_trie=n_trie)
+    assert dict(mesh.shape) == {"data": 2, "trie": 2}
+    shards = shard_filters(filters, n_trie)
+    auto = build_sharded(shards, fids, table)
+    rows = [{fids[f]: [fids[f] * 10] for f in shard} for shard in shards]
+    fan = build_sharded_fanout(rows, len(filters))
+
+    B = 16
+    topics = ["/".join(rng.choice(words)
+                       for _ in range(rng.randint(1, 4)))
+              for _ in range(B)]
+    ids_np, n_np, sys_np = encode_batch(table, topics, 8)
+
+    auto_d = place_sharded(mesh, auto)
+    fan_d = place_sharded(mesh, fan)
+    b = place_batch(mesh, ids_np, n_np, sys_np)
+    ids, subs, src, _bm, ovf, movf, stats = publish_step(
+        mesh, auto_d, fan_d, *b, k=32, m=32, d=64)
+
+    # every process checks the batch rows it can address: exact
+    # match-set parity with the oracle, and the fan-out subscriber
+    # slots derived from those matches
+    checked = 0
+    for shard in ids.addressable_shards:
+        sl = shard.index[0]
+        data = np.asarray(shard.data)
+        for local_i, row in enumerate(data):
+            topic = topics[sl.start + local_i]
+            got = {int(x) for x in row if x >= 0}
+            want = {fids[f] for f in oracle.match(topic)}
+            assert got == want, (topic, got, want)
+            checked += 1
+    for shard in subs.addressable_shards:
+        sl = shard.index[0]
+        data = np.asarray(shard.data)
+        for local_i, row in enumerate(data):
+            topic = topics[sl.start + local_i]
+            got = {int(x) for x in row if x >= 0}
+            want = {fids[f] * 10 for f in oracle.match(topic)}
+            assert got == want, (topic, got, want)
+    assert not np.asarray(
+        jax.device_get(movf.addressable_shards[0].data)).any()
+    print(f"WORKER {pid} PARITY OK rows={checked}", flush=True)
+
+
+def test_two_process_distributed_publish_parity():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--worker", str(pid), "2", addr],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"WORKER {pid} PARITY OK" in out, out[-3000:]
+
+
+if __name__ == "__main__" and "--worker" in sys.argv:
+    i = sys.argv.index("--worker")
+    sys.path.insert(0, REPO)
+    _worker(int(sys.argv[i + 1]), int(sys.argv[i + 2]),
+            sys.argv[i + 3])

@@ -6,6 +6,8 @@ emqx_prometheus plugin; here it reads the core metric/stat registries
 
 import asyncio
 
+import pytest
+
 from emqx_tpu.modules.prometheus import PrometheusModule, prom_name, render
 from emqx_tpu.node import Node
 from emqx_tpu.types import Message
@@ -124,5 +126,10 @@ async def _drive_config_node(node, mod, dm):
             if sub.got:
                 break
         assert [t for t, _ in sub.got] == ["later/t"]
+        bound_port = mod.port
     finally:
         await node.stop()
+    # stop quiesces module sockets: the real bound port must refuse
+    with pytest.raises(OSError):
+        await _scrape(bound_port)
+    assert mod._server is None and mod.port is None
