@@ -25,11 +25,247 @@ with a counter, like the plugin's ``max_retained_messages``) and
 
 from __future__ import annotations
 
-from typing import Dict
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
 
 from emqx_tpu import topic as T
 from emqx_tpu.modules import Module
 from emqx_tpu.types import Message
+
+log = logging.getLogger(__name__)
+
+
+#: '+' sentinel in an encoded FILTER row — never collides with real
+#: word ids (≥0) or the topic-side UNKNOWN (-1) / PAD (-2)
+_PLUS_ID = -3
+
+
+class RetainIndex:
+    """Device-side reverse index over retained topic NAMES.
+
+    The reference plugin indexes retained topics in its own Mnesia
+    trie so a wildcard subscribe doesn't scan the store. The
+    TPU-first equivalent inverts the publish problem: retained names
+    live as a persistent encoded ``[cap, L]`` word-id matrix, and a
+    wildcard subscribe matches its ONE filter against every stored
+    name in a single data-parallel device pass instead of N Python
+    ``T.match`` calls.
+
+    One filter needs no automaton walk at all: per level the filter
+    word either equals the topic word or is ``+``, with a ``#``
+    suffix relaxing the depth check and the ``$``-root rule masking
+    system topics — a pure elementwise program over ``[cap, L]``
+    (zero gathers, HBM-bandwidth bound; an earlier automaton-based
+    variant spent its time in per-level gather chains).
+
+    Rows are slot-allocated (free list); a deleted row gets
+    ``n_words = 0``, which matches nothing. Names deeper than ``L``
+    levels live in a host-matched side set, the same overflow
+    contract as the publish path. Below ``device_threshold`` live
+    rows (or on any device failure) matching falls back to the host
+    scan.
+    """
+
+    L = 16
+    GROW = 1024
+
+    def __init__(self) -> None:
+        from emqx_tpu.ops.tokenize import PAD, WordTable
+
+        self._pad = PAD
+        self._table = WordTable()
+        self._word_refs: Dict[str, int] = {}
+        self._cap = self.GROW
+        self._ids = np.full((self._cap, self.L), PAD, dtype=np.int32)
+        self._n = np.zeros(self._cap, dtype=np.int32)
+        self._sys = np.zeros(self._cap, dtype=bool)
+        self._row_topic: List[Optional[str]] = [None] * self._cap
+        self._row_of: Dict[str, int] = {}
+        self._free = list(range(self._cap - 1, -1, -1))
+        self._deep: set = set()
+        self._epoch = 0
+        self._dev = None  # (epoch, cap, ids, n, sys) device cache
+        self._dirty: set = set()  # rows mutated since _dev was built
+
+    def __len__(self) -> int:
+        return len(self._row_of) + len(self._deep)
+
+    def add(self, topic: str) -> None:
+        if topic in self._row_of or topic in self._deep:
+            return  # overwrite of the same name: index unchanged
+        ws = topic.split("/")
+        if len(ws) > self.L:
+            self._deep.add(topic)
+            return
+        if not self._free:
+            self._grow()
+        row = self._free.pop()
+        for j, w in enumerate(ws):
+            self._ids[row, j] = self._table.intern(w)
+            self._word_refs[w] = self._word_refs.get(w, 0) + 1
+        self._ids[row, len(ws):] = self._pad
+        self._n[row] = len(ws)
+        self._sys[row] = ws[0].startswith("$")
+        self._row_topic[row] = topic
+        self._row_of[topic] = row
+        self._touch(row)
+
+    def remove(self, topic: str) -> None:
+        if topic in self._deep:
+            self._deep.discard(topic)
+            return
+        row = self._row_of.pop(topic, None)
+        if row is None:
+            return
+        for w in topic.split("/"):
+            left = self._word_refs.get(w, 0) - 1
+            if left <= 0:
+                self._word_refs.pop(w, None)
+            else:
+                self._word_refs[w] = left
+        self._ids[row, :] = self._pad
+        self._n[row] = 0
+        self._sys[row] = False
+        self._row_topic[row] = None
+        self._free.append(row)
+        self._touch(row)
+        self._maybe_compact()
+
+    def clear(self) -> None:
+        self.__init__()
+
+    def _touch(self, row: int) -> None:
+        self._epoch += 1
+        if self._dev is not None:
+            self._dirty.add(row)
+
+    def _maybe_compact(self) -> None:
+        """Re-intern into a fresh WordTable when most interned words
+        are dead — name churn must not grow the table forever (the
+        same leak class the stability soak exists to catch)."""
+        dead = len(self._table) - len(self._word_refs)
+        if dead < max(4096, len(self._word_refs)):
+            return
+        from emqx_tpu.ops.tokenize import WordTable
+
+        table = WordTable()
+        for row, topic in enumerate(self._row_topic):
+            if topic is None:
+                continue
+            for j, w in enumerate(topic.split("/")):
+                self._ids[row, j] = table.intern(w)
+        self._table = table
+        self._dev = None
+        self._dirty.clear()
+        self._epoch += 1
+
+    def _grow(self) -> None:
+        old = self._cap
+        self._cap = old * 2
+        for name, fill in (("_ids", self._pad), ("_n", 0), ("_sys", False)):
+            arr = getattr(self, name)
+            shape = (self._cap,) + arr.shape[1:]
+            new = np.full(shape, fill, dtype=arr.dtype)
+            new[:old] = arr
+            setattr(self, name, new)
+        self._row_topic.extend([None] * old)
+        self._free.extend(range(self._cap - 1, old - 1, -1))
+
+    def match(self, flt: str, device_threshold: int = 4096) -> List[str]:
+        """All stored names matching ``flt`` (exact oracle parity)."""
+        deep_hits = [t for t in self._deep if T.match(t, flt)]
+        if len(self._row_of) < device_threshold:
+            return [t for t in self._row_of
+                    if T.match(t, flt)] + deep_hits
+        try:
+            return self._match_device(flt) + deep_hits
+        except Exception:
+            log.exception("retain index device match failed; "
+                          "host fallback")
+            return [t for t in self._row_of
+                    if T.match(t, flt)] + deep_hits
+
+    def _match_device(self, flt: str) -> List[str]:
+        import jax.numpy as jnp
+
+        ws = flt.split("/")
+        has_hash = ws[-1] == "#"
+        if has_hash:
+            ws = ws[:-1]
+        if len(ws) > self.L:
+            return []  # deeper than any indexed name can be
+        fw = np.full((self.L,), self._pad, dtype=np.int32)
+        for j, w in enumerate(ws):
+            # lookup, NOT intern: an unseen filter word (UNKNOWN=-1)
+            # matches no stored id >= 0 — identical result, and
+            # subscribe traffic can't grow the table
+            fw[j] = _PLUS_ID if w == "+" else self._table.lookup(w)
+        dev = self._dev
+        if dev is None or dev[0] != self._epoch or dev[1] != self._cap:
+            if (dev is not None and dev[1] == self._cap
+                    and len(self._dirty) <= 256):
+                # interleaved store/subscribe traffic: patch the few
+                # mutated rows instead of re-uploading the matrix
+                rows = np.fromiter(self._dirty, dtype=np.int32)
+                dev = (self._epoch, self._cap,
+                       dev[2].at[rows].set(self._ids[rows]),
+                       dev[3].at[rows].set(self._n[rows]),
+                       dev[4].at[rows].set(self._sys[rows]))
+            else:
+                dev = (self._epoch, self._cap, jnp.asarray(self._ids),
+                       jnp.asarray(self._n), jnp.asarray(self._sys))
+            self._dev = dev
+            self._dirty.clear()
+        ok = np.asarray(_match_names_call(
+            jnp.asarray(fw), np.int32(len(ws)), bool(has_hash),
+            dev[2], dev[3], dev[4]))
+        return [self._row_topic[row] for row in np.nonzero(ok)[0]
+                if self._row_topic[row] is not None]
+
+
+def _match_names(fw, fn, has_hash, topic_ids, n_words, sys_mask):
+    """One filter vs every stored name, elementwise (jitted below).
+
+    ``fw`` [L] filter word ids (``_PLUS_ID`` for ``+``, PAD beyond
+    ``fn``); ``fn`` word count excluding a trailing ``#``. Semantics
+    = emqx_topic:match/2: per-level equality with ``+`` wildcards; a
+    ``#`` suffix matches the parent itself and anything deeper
+    (src/emqx_topic.erl:64-87); root wildcards never match
+    ``$``-topics (src/emqx_trie.erl:162-163). Dead rows have
+    ``n_words == 0`` and too-deep names ``n_words < 0`` — both
+    excluded by the ``n > 0`` live gate (empty filters don't
+    validate, so ``fn == 0`` only happens for the bare ``#``)."""
+    import jax.numpy as jnp
+
+    L = topic_ids.shape[1]
+    lvl = jnp.arange(L, dtype=jnp.int32)[None, :]
+    word_ok = (topic_ids == fw[None, :]) | (fw[None, :] == _PLUS_ID)
+    prefix_ok = jnp.all(word_ok | (lvl >= fn), axis=1)
+    exact = prefix_ok & (n_words == fn)
+    deeper = has_hash & prefix_ok & (n_words >= fn)
+    ok = (exact | deeper) & (n_words > 0)
+    root_wild = (fw[0] == _PLUS_ID) | (has_hash & (fn == 0))
+    return ok & ~(sys_mask & root_wild)
+
+
+# jit once; shapes vary only with the index capacity (power-of-two
+# growth) so compile count stays logarithmic in store size
+def _jit_match_names():
+    import jax
+
+    return jax.jit(_match_names, static_argnums=(2,))
+
+
+_match_names_jitted = None
+
+
+def _match_names_call(*args):
+    global _match_names_jitted
+    if _match_names_jitted is None:
+        _match_names_jitted = _jit_match_names()
+    return _match_names_jitted(*args)
 
 
 class RetainerModule(Module):
@@ -38,6 +274,8 @@ class RetainerModule(Module):
     def __init__(self, node) -> None:
         super().__init__(node)
         self._store: Dict[str, Message] = {}
+        self._index = RetainIndex()
+        self.index_device_threshold = 4096
         # delete tombstones (topic -> delete time): a stale
         # rejoiner's sync must not resurrect a deleted message
         self._tombstones: Dict[str, float] = {}
@@ -50,6 +288,8 @@ class RetainerModule(Module):
     def load(self, env: dict) -> None:
         self.max_retained = int(env.get("max_retained", 1_000_000))
         self.max_payload = int(env.get("max_payload", 1 << 20))
+        self.index_device_threshold = int(
+            env.get("index_device_threshold", 4096))
         self.node.metrics.new("retained.count")
         self.node.metrics.new("retained.dropped")
         self.node.hooks.add("message.publish", self.on_publish,
@@ -61,6 +301,19 @@ class RetainerModule(Module):
         self.node.hooks.delete("message.publish", self.on_publish)
         self.node.hooks.delete("session.subscribed", self.on_subscribed)
         self._store.clear()
+        self._index.clear()
+
+    # every store mutation goes through these so the reverse index
+    # (device matrix) stays in lockstep with the dict
+    def _put(self, topic: str, msg: Message) -> None:
+        self._store[topic] = msg
+        self._index.add(topic)
+
+    def _pop(self, topic: str):
+        msg = self._store.pop(topic, None)
+        if msg is not None:
+            self._index.remove(topic)
+        return msg
 
     # -- store maintenance -------------------------------------------------
 
@@ -68,7 +321,7 @@ class RetainerModule(Module):
         if not msg.flags.get("retain") or msg.topic.startswith("$SYS/"):
             return None
         if not msg.payload:
-            if self._store.pop(msg.topic, None) is not None:
+            if self._pop(msg.topic) is not None:
                 self.node.metrics.dec("retained.count")
                 # monotone like apply_remote/apply_tombstone: a local
                 # delete must not move an (ahead-clock) peer's
@@ -88,7 +341,7 @@ class RetainerModule(Module):
         # the broadcast wire cache is per-live-delivery state, not
         # part of the retained record
         stored.headers.pop("_wire", None)
-        self._store[msg.topic] = stored
+        self._put(msg.topic, stored)
         self._replicate(msg.topic, stored)
         return None  # the message still routes normally
 
@@ -110,7 +363,7 @@ class RetainerModule(Module):
         tombstones, so a rejoiner's stale snapshot can neither
         clobber newer values nor resurrect deletions."""
         if msg is None:
-            if self._store.pop(topic, None) is not None:
+            if self._pop(topic) is not None:
                 self.node.metrics.dec("retained.count")
             # tombstone carries the DELETING message's origin
             # timestamp (not local wall-clock) so join-sync LWW stays
@@ -136,20 +389,20 @@ class RetainerModule(Module):
         cur = self._store.get(topic)
         if cur is not None:
             if not sync or msg.timestamp > cur.timestamp:
-                self._store[topic] = msg
+                self._put(topic, msg)
             return
         if len(self._store) >= self.max_retained:
             self.node.metrics.inc("retained.dropped")
             return
         self.node.metrics.inc("retained.count")
-        self._store[topic] = msg
+        self._put(topic, msg)
 
     def sweep_expired(self) -> int:
         """Drop expired entries (lazy pruning otherwise happens only
         on a matching subscribe)."""
         dead = [t for t, m in self._store.items() if m.is_expired()]
         for t in dead:
-            self._store.pop(t, None)
+            self._pop(t)
             self.node.metrics.dec("retained.count")
         self._sweep_tombstones()
         return len(dead)
@@ -168,7 +421,7 @@ class RetainerModule(Module):
         stored message older than the deletion."""
         cur = self._store.get(topic)
         if cur is not None and cur.timestamp <= ts:
-            self._store.pop(topic, None)
+            self._pop(topic)
             self.node.metrics.dec("retained.count")
         prev = self._tombstones.get(topic, 0.0)
         self._tombstones[topic] = max(prev, ts)
@@ -201,11 +454,14 @@ class RetainerModule(Module):
             # exact filter: one dict probe, not a store scan
             matches = [flt] if flt in self._store else []
         else:
-            matches = [t for t in self._store if T.match(t, flt)]
+            matches = self._index.match(
+                flt, device_threshold=self.index_device_threshold)
         for topic in matches:
-            msg = self._store[topic]
+            msg = self._store.get(topic)
+            if msg is None:
+                continue
             if msg.is_expired():
-                self._store.pop(topic, None)
+                self._pop(topic)
                 self.node.metrics.dec("retained.count")
                 continue
             out = msg.copy()

@@ -267,3 +267,154 @@ def test_apply_remote_enforces_max_payload():
     ok = M(topic="ok", payload=b"x" * 8, flags={"retain": True})
     mod.apply_remote("ok", ok)
     assert mod._store["ok"].payload == b"x" * 8
+
+
+# -- RetainIndex: the device-side reverse index ------------------------------
+
+def _host_matches(topics, flt):
+    from emqx_tpu import topic as T
+
+    return sorted(t for t in topics if T.match(t, flt))
+
+
+def test_retain_index_device_parity_random():
+    """Force the device path (threshold=0) and pin exact parity with
+    the host oracle over random stores/deletes — including $-topics
+    (root-wildcard exclusion), deep names (> L levels, host side
+    set), and re-used slots after deletes."""
+    import random
+
+    from emqx_tpu.modules.retainer import RetainIndex
+
+    rng = random.Random(42)
+    words = ["a", "b", "c", "d", "sensor", "west", "$SYS", "$priv"]
+    idx = RetainIndex()
+    live = set()
+
+    def rand_topic():
+        depth = rng.randint(1, 20)  # some exceed L=16
+        return "/".join(rng.choice(words) for _ in range(depth))
+
+    for _ in range(400):
+        t = rand_topic()
+        idx.add(t)
+        live.add(t)
+    # delete a third, re-add some (slot reuse)
+    dead = rng.sample(sorted(live), 130)
+    for t in dead:
+        idx.remove(t)
+        live.discard(t)
+    for t in dead[:40]:
+        idx.add(t)
+        live.add(t)
+    assert len(idx) == len(live)
+
+    filters = ["#", "+/+", "a/#", "+/west/+", "sensor/+/c",
+               "$SYS/#", "$SYS/+", "a/b/c", "+/+/+/+/#",
+               "/".join(["+"] * 18)]
+    for flt in filters:
+        got = sorted(idx.match(flt, device_threshold=0))
+        assert got == _host_matches(live, flt), flt
+
+
+def test_retain_index_grow_and_clear():
+    from emqx_tpu.modules.retainer import RetainIndex
+
+    idx = RetainIndex()
+    n = RetainIndex.GROW + 10  # force a capacity double
+    for i in range(n):
+        idx.add(f"grow/{i}")
+    assert len(idx) == n
+    assert sorted(idx.match("grow/+", device_threshold=0)) == sorted(
+        f"grow/{i}" for i in range(n))
+    idx.clear()
+    assert len(idx) == 0
+    assert idx.match("#", device_threshold=0) == []
+
+
+async def test_retainer_wildcard_lookup_via_device_index():
+    """Module integration: with the device threshold forced to 0, a
+    wildcard subscribe resolves retained messages through the index
+    and delivers exactly the matching set."""
+    n, _port_ = await _node()
+    try:
+        ret = n.modules._loaded["retainer"]
+        ret.index_device_threshold = 0
+
+        from emqx_tpu.types import Message
+
+        for t in ("home/k/temp", "home/l/temp", "home/k/hum", "$SYS/x"):
+            n.publish(Message(topic=t, payload=b"v",
+                              flags={"retain": True}))
+        sess = _FakeSession()
+        chan = type("Chan", (), {"session": sess})()
+        n.cm._channels["ridx"] = chan
+        ret.on_subscribed({"clientid": "ridx"}, "home/+/temp",
+                          {"qos": 0})
+        assert [f for f, _ in sess.got] == ["home/+/temp"] * 2
+        assert sorted(m.topic for _, m in sess.got) == [
+            "home/k/temp", "home/l/temp"]
+    finally:
+        await n.stop()
+
+
+class _FakeSession:
+    def __init__(self):
+        self.got = []
+
+    def deliver(self, f, m):
+        self.got.append((f, m))
+
+
+def test_retain_index_word_table_bounded_under_churn():
+    """Name churn must not grow the intern table forever (refcounted
+    words + compaction), and filter lookups never intern."""
+    from emqx_tpu.modules.retainer import RetainIndex
+
+    idx = RetainIndex()
+    for i in range(30_000):
+        t = f"churn/{i}/x"
+        idx.add(t)
+        idx.remove(t)
+    assert len(idx) == 0
+    # dead words get compacted away: far fewer than the 30K uniques
+    assert len(idx._table) < 10_000
+    # filter match with unseen words doesn't intern
+    before = len(idx._table)
+    idx.add("keep/a")
+    idx.match("never/+/seen/#", device_threshold=0)
+    assert len(idx._table) <= before + 2  # only keep/a's words
+
+
+def test_retain_index_device_patch_interleaved():
+    """Store mutations between subscribes patch the cached device
+    matrix (dirty rows) — parity must hold across interleaved
+    add/remove/match, including slot reuse."""
+    import random
+
+    from emqx_tpu import topic as T
+    from emqx_tpu.modules.retainer import RetainIndex
+
+    rng = random.Random(9)
+    idx = RetainIndex()
+    live = set()
+    for i in range(300):
+        t = f"a/{rng.randint(0, 50)}/b{i}"
+        idx.add(t)
+        live.add(t)
+    idx.match("a/#", device_threshold=0)  # builds the device cache
+    for step in range(30):
+        # mutate a few rows, then match — exercises the patch path
+        for _ in range(3):
+            if live and rng.random() < 0.5:
+                t = rng.choice(sorted(live))
+                idx.remove(t)
+                live.discard(t)
+            else:
+                t = f"a/{rng.randint(0, 50)}/n{step}_{rng.randint(0, 9)}"
+                idx.add(t)
+                live.add(t)
+        flt = rng.choice(["a/#", "a/+/+", "+/3/#", "#"])
+        got = sorted(idx.match(flt, device_threshold=0))
+        want = sorted(t for t in live if T.match(t, flt))
+        assert got == want, (step, flt)
