@@ -88,6 +88,7 @@ class RetainIndex:
         self._epoch = 0
         self._dev = None  # (epoch, cap, ids, n, sys) device cache
         self._dirty: set = set()  # rows mutated since _dev was built
+        self._device_broken = 0  # consecutive failures; >=3 disables
 
     def __len__(self) -> int:
         return len(self._row_of) + len(self._deep)
@@ -131,7 +132,11 @@ class RetainIndex:
         self._row_topic[row] = None
         self._free.append(row)
         self._touch(row)
-        self._maybe_compact()
+        # backstop only (loop-less library usage): the periodic sweep
+        # task owns compaction; this inline trigger fires far later
+        # so the publish hook never pays a big rebuild in the common
+        # case
+        self._maybe_compact(backstop=True)
 
     def clear(self) -> None:
         self.__init__()
@@ -141,12 +146,20 @@ class RetainIndex:
         if self._dev is not None:
             self._dirty.add(row)
 
-    def _maybe_compact(self) -> None:
+    def _compact_due(self, backstop: bool = False) -> bool:
+        dead = len(self._table) - len(self._word_refs)
+        live = len(self._word_refs)
+        if backstop:
+            return dead >= max(65536, 4 * max(live, 1))
+        return dead >= max(4096, live)
+
+    def _maybe_compact(self, backstop: bool = False) -> None:
         """Re-intern into a fresh WordTable when most interned words
         are dead — name churn must not grow the table forever (the
-        same leak class the stability soak exists to catch)."""
-        dead = len(self._table) - len(self._word_refs)
-        if dead < max(4096, len(self._word_refs)):
+        same leak class the stability soak exists to catch).
+        Synchronous; the periodic sweep prefers :meth:`compact_async`
+        which chunks the rebuild so the event loop never stalls."""
+        if not self._compact_due(backstop):
             return
         from emqx_tpu.ops.tokenize import WordTable
 
@@ -160,6 +173,37 @@ class RetainIndex:
         self._dev = None
         self._dirty.clear()
         self._epoch += 1
+
+    async def compact_async(self, chunk: int = 4096) -> bool:
+        """Cooperative compaction: rebuild the id matrix + table in
+        row chunks, yielding between chunks; a store mutation during
+        the rebuild aborts it (epoch guard) and the next sweep cycle
+        retries. Returns True when a swap happened."""
+        import asyncio
+
+        if not self._compact_due():
+            return False
+        from emqx_tpu.ops.tokenize import WordTable
+
+        start_epoch = self._epoch
+        table = WordTable()
+        new_ids = np.full_like(self._ids, self._pad)
+        for base in range(0, self._cap, chunk):
+            for row in range(base, min(base + chunk, self._cap)):
+                topic = self._row_topic[row]
+                if topic is None:
+                    continue
+                for j, w in enumerate(topic.split("/")):
+                    new_ids[row, j] = table.intern(w)
+            await asyncio.sleep(0)
+            if self._epoch != start_epoch:
+                return False
+        self._ids = new_ids
+        self._table = table
+        self._dev = None
+        self._dirty.clear()
+        self._epoch += 1
+        return True
 
     def _grow(self) -> None:
         old = self._cap
@@ -176,14 +220,26 @@ class RetainIndex:
     def match(self, flt: str, device_threshold: int = 4096) -> List[str]:
         """All stored names matching ``flt`` (exact oracle parity)."""
         deep_hits = [t for t in self._deep if T.match(t, flt)]
-        if len(self._row_of) < device_threshold:
+        if (len(self._row_of) < device_threshold
+                or self._device_broken >= 3):
             return [t for t in self._row_of
                     if T.match(t, flt)] + deep_hits
         try:
-            return self._match_device(flt) + deep_hits
+            out = self._match_device(flt) + deep_hits
+            self._device_broken = 0
+            return out
         except Exception:
-            log.exception("retain index device match failed; "
-                          "host fallback")
+            # circuit breaker: a host with a permanently failing
+            # backend must not pay a failed dispatch + a stack trace
+            # on EVERY wildcard subscribe
+            self._device_broken += 1
+            if self._device_broken >= 3:
+                log.exception(
+                    "retain index device match failed %d times; "
+                    "host scan from now on", self._device_broken)
+            else:
+                log.warning("retain index device match failed; "
+                            "host fallback (%d/3)", self._device_broken)
             return [t for t in self._row_of
                     if T.match(t, flt)] + deep_hits
 
@@ -290,6 +346,9 @@ class RetainerModule(Module):
         self.max_payload = int(env.get("max_payload", 1 << 20))
         self.index_device_threshold = int(
             env.get("index_device_threshold", 4096))
+        self.sweep_interval = float(env.get("sweep_interval", 60.0))
+        self._sweep_task = None
+        self._kick_on_loop()
         self.node.metrics.new("retained.count")
         self.node.metrics.new("retained.dropped")
         self.node.hooks.add("message.publish", self.on_publish,
@@ -297,7 +356,36 @@ class RetainerModule(Module):
         self.node.hooks.add("session.subscribed", self.on_subscribed,
                             priority=50)
 
+    def on_loop_start(self) -> None:
+        import asyncio
+
+        if getattr(self, "_sweep_task", None) is None \
+                or self._sweep_task.done():
+            self._sweep_task = asyncio.get_running_loop().create_task(
+                self._sweep_loop())
+
+    def on_loop_stop(self) -> None:
+        task = getattr(self, "_sweep_task", None)
+        if task is not None:
+            task.cancel()
+            self._sweep_task = None
+
+    async def _sweep_loop(self) -> None:
+        """Periodic expiry sweep (the reference plugin expires on a
+        timer too, not only lazily) + cooperative index compaction —
+        both off the publish hot path."""
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self.sweep_interval)
+            try:
+                self.sweep_expired()
+                await self._index.compact_async()
+            except Exception:
+                log.exception("retainer sweep failed")
+
     def unload(self) -> None:
+        self.on_loop_stop()
         self.node.hooks.delete("message.publish", self.on_publish)
         self.node.hooks.delete("session.subscribed", self.on_subscribed)
         self._store.clear()

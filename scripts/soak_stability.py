@@ -35,6 +35,13 @@ SAMPLE_S = float(os.environ.get("SOAK_SAMPLE_S", "30"))
 # >0 pre-loads background wildcard filters so the broker runs the
 # DEVICE publish regime (above device_min_filters) during the soak
 BG_FILTERS = int(os.environ.get("SOAK_BG_FILTERS", "0"))
+# SOAK_RETAIN=1: a retained-churn dimension — clients publish
+# retained messages on CHURNING topic names (unique words over time,
+# the RetainIndex leak surface: word-intern table, row slots, device
+# cache) and wildcard-subscribe so the reverse index actually runs;
+# SOAK_RETAIN_THRESHOLD forces the device path (default 64)
+RETAIN = os.environ.get("SOAK_RETAIN", "") == "1"
+RETAIN_THRESHOLD = int(os.environ.get("SOAK_RETAIN_THRESHOLD", "64"))
 
 
 def _rss_mb() -> float:
@@ -54,6 +61,7 @@ async def _client_loop(idx: int, port: int, stop: asyncio.Event,
     from tests.mqtt_client import TestClient
 
     rng = random.Random(idx)
+    seq = idx * 10_000_000  # unique retained names per client, forever
     while not stop.is_set():
         cli = TestClient(f"soak{idx}", version=C.MQTT_V5)
         try:
@@ -61,6 +69,19 @@ async def _client_loop(idx: int, port: int, stop: asyncio.Event,
             for _round in range(rng.randint(3, 10)):
                 if stop.is_set():
                     break
+                if RETAIN:
+                    # store a fresh-named retained message, delete an
+                    # older one (empty payload), and wildcard-sub so
+                    # the reverse index matches on the device path
+                    seq += 1
+                    await cli.publish(f"ret/{idx}/s{seq}", b"r",
+                                      qos=0, retain=True)
+                    if seq > 3:
+                        await cli.publish(f"ret/{idx}/s{seq - 3}",
+                                          b"", qos=0, retain=True)
+                    await cli.subscribe(f"ret/{idx}/#", qos=0)
+                    await cli.unsubscribe(f"ret/{idx}/#")
+                    stats["retains"] = stats.get("retains", 0) + 1
                 flt = f"soak/{rng.randrange(200)}/+"
                 await cli.subscribe(flt, qos=rng.randrange(2))
                 for _ in range(20):
@@ -102,6 +123,15 @@ async def main():
         print(json.dumps({"bg_filters": BG_FILTERS,
                           "device_regime":
                           n.router.use_device_now()}), flush=True)
+    if RETAIN:
+        ret = n.modules._loaded.get("retainer")
+        if ret is None:
+            from emqx_tpu.modules.retainer import RetainerModule
+            ret = n.modules.load(RetainerModule)
+        ret.index_device_threshold = RETAIN_THRESHOLD
+        print(json.dumps({"retain_dim": True,
+                          "index_device_threshold":
+                          RETAIN_THRESHOLD}), flush=True)
     port = n.listeners[0].port
     stop = asyncio.Event()
     stats = {"pubs": 0, "recvs": 0, "churns": 0, "reconnects": 0,
@@ -113,9 +143,15 @@ async def main():
     while time.monotonic() < t_end:
         await asyncio.sleep(SAMPLE_S)
         samples.append(round(_rss_now_mb(), 1))
+        extra = {}
+        if RETAIN:
+            ret = n.modules._loaded.get("retainer")
+            if ret is not None:
+                extra = {"retained": len(ret._store),
+                         "index_words": len(ret._index._table)}
         print(json.dumps({"t_min": round(
             (time.monotonic() - (t_end - MINUTES * 60)) / 60, 1),
-            "rss_mb": samples[-1], **stats}), flush=True)
+            "rss_mb": samples[-1], **stats, **extra}), flush=True)
     stop.set()
     await asyncio.gather(*tasks, return_exceptions=True)
     await n.stop()

@@ -372,13 +372,14 @@ def test_retain_index_word_table_bounded_under_churn():
     from emqx_tpu.modules.retainer import RetainIndex
 
     idx = RetainIndex()
-    for i in range(30_000):
+    # loop-less (library) usage: the inline BACKSTOP fires once dead
+    # words cross 65536; the periodic sweep task compacts far sooner
+    for i in range(70_000):
         t = f"churn/{i}/x"
         idx.add(t)
         idx.remove(t)
     assert len(idx) == 0
-    # dead words get compacted away: far fewer than the 30K uniques
-    assert len(idx._table) < 10_000
+    assert len(idx._table) < 65_536 + 4096
     # filter match with unseen words doesn't intern
     before = len(idx._table)
     idx.add("keep/a")
@@ -418,3 +419,38 @@ def test_retain_index_device_patch_interleaved():
         got = sorted(idx.match(flt, device_threshold=0))
         want = sorted(t for t in live if T.match(t, flt))
         assert got == want, (step, flt)
+
+
+async def test_retain_index_compact_async_cooperative():
+    """Chunked compaction swaps table+matrix without changing match
+    results, and aborts cleanly when a mutation lands mid-rebuild."""
+    from emqx_tpu import topic as T
+    from emqx_tpu.modules.retainer import RetainIndex
+
+    idx = RetainIndex()
+    for i in range(6000):
+        idx.add(f"c/{i}/x")
+    for i in range(5000):
+        idx.remove(f"c/{i}/x")
+    live = {f"c/{i}/x" for i in range(5000, 6000)}
+    assert idx._compact_due()
+    assert await idx.compact_async(chunk=256)
+    assert len(idx._table) < 3000  # dead words gone
+    got = sorted(idx.match("c/+/x", device_threshold=0))
+    assert got == sorted(live)
+    # mutation mid-rebuild aborts (epoch guard): simulate by patching
+    for i in range(6000, 12000):
+        idx.add(f"m/{i}/x")
+    for i in range(6000, 11900):
+        idx.remove(f"m/{i}/x")
+    assert idx._compact_due()
+    import asyncio
+
+    task = asyncio.get_event_loop().create_task(
+        idx.compact_async(chunk=64))
+    await asyncio.sleep(0)  # let the first chunk run
+    idx.add("mid/rebuild")
+    assert await task is False  # aborted, retried next sweep
+    got = sorted(idx.match("#", device_threshold=0))
+    want = sorted(t for t in (live | {f"m/{i}/x" for i in range(11900, 12000)} | {"mid/rebuild"}) if T.match(t, "#"))
+    assert got == want
