@@ -72,6 +72,10 @@ class ListenerConfig:
     path: str = "/mqtt"          # ws/wss
     max_connections: int = 1024000
     tls: Optional[dict] = None   # ssl/wss: TlsOptions kwargs
+    # PROXY protocol v1/v2 (fronting LB carries the real client
+    # address; reference listener.tcp.*.proxy_protocol)
+    proxy_protocol: bool = False
+    proxy_protocol_timeout: float = 3.0
 
 
 @dataclasses.dataclass
@@ -142,6 +146,17 @@ def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
         if key not in known:
             raise ConfigError(f"unknown listener setting: "
                               f"listeners[{i}].{key}")
+    if float(raw.get("proxy_protocol_timeout", 3.0)) <= 0:
+        # wait_for(..., 0) times out every accept instantly with only
+        # a debug log — make the foot-gun a startup error instead
+        raise ConfigError(
+            f"listeners[{i}].proxy_protocol_timeout must be > 0")
+    if raw.get("proxy_protocol") and ltype != "tcp":
+        # silently ignoring it would leave the LB's real-client
+        # addresses unseen — the worst kind of security-adjacent noop
+        raise ConfigError(
+            f"listeners[{i}]: proxy_protocol is only supported on "
+            f"type = \"tcp\" listeners")
     return ListenerConfig(type=ltype, tls=tls or None, **raw)
 
 
@@ -227,7 +242,10 @@ def build_node(cfg: NodeConfig):
         kw = dict(host=lc.host, port=lc.port, zone=zone, name=name,
                   max_connections=lc.max_connections)
         if lc.type == "tcp":
-            node.add_listener(**kw)
+            node.add_listener(
+                proxy_protocol=lc.proxy_protocol,
+                proxy_protocol_timeout=lc.proxy_protocol_timeout,
+                **kw)
         elif lc.type == "ws":
             node.add_ws_listener(path=lc.path, **kw)
         elif lc.type == "ssl":

@@ -37,11 +37,14 @@ class Connection:
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter,
                  broker, cm, zone: Optional[Zone] = None,
-                 listener: str = "tcp:default") -> None:
+                 listener: str = "tcp:default",
+                 peername=None) -> None:
         self.reader = reader
         self.writer = writer
         self.zone = zone or get_zone()
-        peer = writer.get_extra_info("peername") or ("?", 0)
+        # an explicit peername wins: the listener's PROXY-protocol
+        # parse carries the REAL client address from the LB
+        peer = peername or writer.get_extra_info("peername") or ("?", 0)
         peercert = None
         ssl_obj = writer.get_extra_info("ssl_object")
         if ssl_obj is not None:
@@ -325,6 +328,67 @@ class Connection:
                 return
 
 
+_PP2_SIG = b"\r\n\r\n\x00\r\nQUIT\n"
+
+
+async def read_proxy_header(reader: asyncio.StreamReader):
+    """Consume a PROXY protocol v1/v2 header; return the real client
+    ``(ip, port)`` or None (UNKNOWN / v2 LOCAL — keep the socket
+    peer). Raises on a malformed header (caller closes).
+
+    Reference: esockd's ``proxy_protocol`` listener option
+    (etc/emqx.conf listener.tcp.*.proxy_protocol) — a fronting load
+    balancer prepends the header so ACLs/bans/flapping/logs see the
+    real client, not the LB.
+    """
+    import ipaddress
+    import struct
+
+    head = await reader.readexactly(12)
+    if head == _PP2_SIG:
+        ver_cmd, fam, ln = struct.unpack(
+            "!BBH", await reader.readexactly(4))
+        if ver_cmd >> 4 != 2:
+            raise ValueError(f"bad PPv2 version {ver_cmd:#x}")
+        cmd = ver_cmd & 0x0F
+        if cmd > 1:
+            # spec: receivers must abort on reserved commands — a
+            # silently-admitted connection would wear the LB's
+            # address and poison bans/ACLs keyed on it
+            raise ValueError(f"bad PPv2 command {cmd}")
+        body = await reader.readexactly(ln)
+        if cmd == 0:  # LOCAL (health check): socket peer
+            return None
+        if fam >> 4 == 1:     # AF_INET
+            if ln < 12:
+                raise ValueError("truncated PPv2 INET block")
+            src = str(ipaddress.IPv4Address(body[0:4]))
+            sport = struct.unpack("!H", body[8:10])[0]
+            return (src, sport)
+        if fam >> 4 == 2:     # AF_INET6
+            if ln < 36:
+                raise ValueError("truncated PPv2 INET6 block")
+            src = str(ipaddress.IPv6Address(body[0:16]))
+            sport = struct.unpack("!H", body[32:34])[0]
+            return (src, sport)
+        return None  # AF_UNSPEC/unix: keep socket peer
+    if head[:6] == b"PROXY ":
+        rest = await reader.readuntil(b"\r\n")
+        line = (head + rest)[:-2].decode("latin-1")
+        if len(line) > 107:
+            raise ValueError("PPv1 header too long")
+        parts = line.split(" ")
+        if parts[1] == "UNKNOWN":
+            return None
+        if len(parts) != 6 or parts[1] not in ("TCP4", "TCP6"):
+            raise ValueError(f"bad PPv1 line {line!r}")
+        addr = ipaddress.ip_address(parts[2])
+        if addr.version != (4 if parts[1] == "TCP4" else 6):
+            raise ValueError(f"PPv1 family/address mismatch {line!r}")
+        return (parts[2], int(parts[4]))
+    raise ValueError("no PROXY header")
+
+
 class Listener:
     """TCP listener: accepts sockets, spawns Connections
     (reference: src/emqx_listeners.erl + esockd acceptors).
@@ -338,7 +402,9 @@ class Listener:
                  port: int = 1883, zone: Optional[Zone] = None,
                  name: str = "tcp:default",
                  max_connections: int = 1024000,
-                 ssl_context=None, reuse_port: bool = False) -> None:
+                 ssl_context=None, reuse_port: bool = False,
+                 proxy_protocol: bool = False,
+                 proxy_protocol_timeout: float = 3.0) -> None:
         self.broker = broker
         self.cm = cm
         self.host = host
@@ -346,6 +412,13 @@ class Listener:
         self.zone = zone or get_zone()
         self.name = name
         self.max_connections = max_connections
+        # PROXY protocol v1/v2 (reference: esockd proxy_protocol,
+        # etc/emqx.conf listener.tcp.*.proxy_protocol): a fronting LB
+        # prepends the REAL client address; the broker must see it
+        # for ACLs/flapping/bans/logs. Header must arrive within
+        # proxy_protocol_timeout or the socket closes.
+        self.proxy_protocol = proxy_protocol
+        self.proxy_protocol_timeout = proxy_protocol_timeout
         # SO_REUSEPORT: several worker processes bind the same port
         # and the kernel load-balances accepts (emqx_tpu.workers)
         self.reuse_port = reuse_port
@@ -373,6 +446,17 @@ class Listener:
         raw_writer = writer  # the socket writer, for set bookkeeping
         self._handshaking.add(raw_writer)
         try:
+            peername = None
+            if self.proxy_protocol:
+                try:
+                    peername = await asyncio.wait_for(
+                        read_proxy_header(reader),
+                        self.proxy_protocol_timeout)
+                except Exception as e:
+                    # no/garbled header within the window: the
+                    # listener is LB-only by configuration
+                    log.debug("proxy_protocol reject: %r", e)
+                    return
             hs = await self._handshake(reader, writer)
             if hs is False:
                 return
@@ -380,7 +464,8 @@ class Listener:
                 reader, writer = hs
             conn = self.connection_class(
                 reader, writer, self.broker, self.cm,
-                zone=self.zone, listener=self.name)
+                zone=self.zone, listener=self.name,
+                peername=peername)
             self._conns.add(conn)
             self._handshaking.discard(raw_writer)
             await conn.run()

@@ -111,11 +111,15 @@ class Node:
                      zone: Optional[Zone] = None,
                      name: str = "tcp:default",
                      max_connections: int = 1024000,
-                     reuse_port: bool = False) -> Listener:
+                     reuse_port: bool = False,
+                     proxy_protocol: bool = False,
+                     proxy_protocol_timeout: float = 3.0) -> Listener:
         lst = Listener(self.broker, self.cm, host=host, port=port,
                        zone=zone or self.zone, name=name,
                        max_connections=max_connections,
-                       reuse_port=reuse_port)
+                       reuse_port=reuse_port,
+                       proxy_protocol=proxy_protocol,
+                       proxy_protocol_timeout=proxy_protocol_timeout)
         self.listeners.append(lst)
         return lst
 

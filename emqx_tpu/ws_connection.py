@@ -198,9 +198,9 @@ class WsConnection(Connection):
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter,
                  broker, cm, zone: Optional[Zone] = None,
-                 listener: str = "ws:default") -> None:
+                 listener: str = "ws:default", peername=None) -> None:
         super().__init__(reader, writer, broker, cm, zone=zone,
-                         listener=listener)
+                         listener=listener, peername=peername)
         # one WS message may batch MULTIPLE MQTT packets (MQTT 5 §6.0),
         # so the reassembly bound is a multiple of the per-packet limit
         # (which the MQTT parser itself enforces), not the limit + slack

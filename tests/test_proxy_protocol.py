@@ -1,0 +1,129 @@
+"""PROXY protocol v1/v2 listener support (reference: esockd's
+proxy_protocol listener option, etc/emqx.conf
+listener.tcp.*.proxy_protocol) — a fronting load balancer prepends
+the real client address; ACLs/bans/flapping/logs must see it."""
+
+import asyncio
+import struct
+
+import pytest
+
+from emqx_tpu.connection import read_proxy_header
+from emqx_tpu.node import Node
+from emqx_tpu.types import Message
+from tests.mqtt_client import TestClient
+
+
+def _feed(data: bytes) -> asyncio.StreamReader:
+    r = asyncio.StreamReader()
+    r.feed_data(data)
+    r.feed_eof()
+    return r
+
+
+async def test_v1_header_parsed():
+    r = _feed(b"PROXY TCP4 203.0.113.7 10.0.0.1 54321 1883\r\nrest")
+    assert await read_proxy_header(r) == ("203.0.113.7", 54321)
+    assert await r.read() == b"rest"  # header fully consumed, no more
+
+
+async def test_v1_unknown_keeps_socket_peer():
+    r = _feed(b"PROXY UNKNOWN\r\nX")
+    assert await read_proxy_header(r) is None
+    assert await r.read() == b"X"
+
+
+async def test_v1_garbage_rejected():
+    with pytest.raises(ValueError):
+        await read_proxy_header(_feed(b"PROXY TCP4 nonsense\r\n"))
+    with pytest.raises(Exception):
+        await read_proxy_header(_feed(b"GET / HTTP/1.1\r\n\r\n"))
+
+
+def _ppv2(fam: int, body: bytes, cmd: int = 1) -> bytes:
+    return (b"\r\n\r\n\x00\r\nQUIT\n"
+            + struct.pack("!BBH", 0x20 | cmd, fam << 4 | 1, len(body))
+            + body)
+
+
+async def test_v2_inet_parsed():
+    body = (bytes([203, 0, 113, 9]) + bytes([10, 0, 0, 1])
+            + struct.pack("!HH", 61000, 1883))
+    r = _feed(_ppv2(1, body) + b"tail")
+    assert await read_proxy_header(r) == ("203.0.113.9", 61000)
+    assert await r.read() == b"tail"
+
+
+async def test_v2_inet6_parsed():
+    src = bytes(15) + bytes([1])      # ::1
+    dst = bytes(15) + bytes([2])
+    body = src + dst + struct.pack("!HH", 7000, 1883)
+    r = _feed(_ppv2(2, body))
+    assert await read_proxy_header(r) == ("::1", 7000)
+
+
+async def test_v2_local_keeps_socket_peer():
+    r = _feed(_ppv2(0, b"", cmd=0) + b"t")
+    assert await read_proxy_header(r) is None
+    assert await r.read() == b"t"
+
+
+async def test_listener_end_to_end_proxy_peername():
+    """A client behind the 'LB' (header prepended before CONNECT):
+    the channel's peername is the header's address, visible through
+    the connection-info surface; a bare client on the same listener
+    is rejected (no header)."""
+    n = Node(boot_listeners=False)
+    lst = n.add_listener(port=0, proxy_protocol=True,
+                         proxy_protocol_timeout=1.0)
+    await n.start()
+    try:
+        port = lst.port
+
+        cli = TestClient("pp1", version=4)
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       port)
+        # the 'LB' prepends the PROXY line before MQTT CONNECT flows
+        writer.write(b"PROXY TCP4 198.51.100.23 127.0.0.1 4242 1883\r\n")
+        await writer.drain()
+        await cli.connect_over(reader, writer)
+        chan = n.cm.lookup_channel("pp1")
+        assert chan is not None, "channel registered"
+        assert chan.peername == ("198.51.100.23", 4242), chan.peername
+        await cli.disconnect()
+
+        # no header -> closed within the timeout
+        bare = TestClient("pp2", version=4)
+        with pytest.raises(Exception):
+            await bare.connect(port=port, timeout=3)
+    finally:
+        await n.stop()
+
+
+async def test_v2_reserved_command_and_truncation_rejected():
+    body = bytes([203, 0, 113, 9, 10, 0, 0, 1]) + struct.pack(
+        "!HH", 61000, 1883)
+    with pytest.raises(ValueError):
+        await read_proxy_header(_feed(_ppv2(1, body, cmd=2)))
+    with pytest.raises(ValueError):  # truncated INET block
+        await read_proxy_header(_feed(_ppv2(1, body[:8])))
+
+
+async def test_v1_family_mismatch_rejected():
+    with pytest.raises(ValueError):
+        await read_proxy_header(
+            _feed(b"PROXY TCP4 ::1 ::1 1 2\r\n"))
+
+
+def test_config_rejects_bad_proxy_settings(tmp_path):
+    from emqx_tpu.config import ConfigError, load_config
+
+    p = tmp_path / "c.toml"
+    p.write_text('[[listeners]]\ntype = "ws"\nport = 1\n'
+                 'proxy_protocol = true\n')
+    with pytest.raises(ConfigError):
+        load_config(str(p))
+    p.write_text('[[listeners]]\ntype = "tcp"\nport = 1\n'
+                 'proxy_protocol = true\nproxy_protocol_timeout = 0\n')
+    with pytest.raises(ConfigError):
+        load_config(str(p))
