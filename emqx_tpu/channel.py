@@ -49,6 +49,23 @@ from emqx_tpu.zone import Zone, get_zone
 
 log = logging.getLogger("emqx_tpu.channel")
 
+def cert_username(peercert: dict, mode: str):
+    """Username from a TLS client cert: ``cn`` = the subject
+    commonName, ``dn`` = the full subject as an RFC4514-ish string
+    (src/emqx_channel.erl:200-214 via esockd_peercert)."""
+    subject = peercert.get("subject") or ()
+    if mode == "cn":
+        for rdn in subject:
+            for key, val in rdn:
+                if key == "commonName":
+                    return val
+        return None
+    if mode == "dn":
+        parts = [f"{key}={val}" for rdn in subject for key, val in rdn]
+        return ",".join(parts) if parts else None
+    return None
+
+
 # channel states
 IDLE = "idle"
 CONNECTING = "connecting"
@@ -60,7 +77,8 @@ class Channel:
     def __init__(self, broker, cm, zone: Optional[Zone] = None,
                  peername: Tuple[str, int] = ("127.0.0.1", 0),
                  listener: str = "tcp:default",
-                 peercert: Optional[dict] = None) -> None:
+                 peercert: Optional[dict] = None,
+                 peer_cert_as_username: Optional[str] = None) -> None:
         self.broker = broker
         self.cm = cm
         self.zone = zone or get_zone()
@@ -70,6 +88,9 @@ class Channel:
         # terminated TLS — the reference exposes it to auth plugins
         # via conninfo (src/emqx_channel.erl peercert enrichment)
         self.peercert = peercert
+        # "cn" | "dn": CONNECT username comes from the client cert
+        # (src/emqx_channel.erl:200-214 setting_peercert_infos)
+        self.peer_cert_as_username = peer_cert_as_username
         self.state = IDLE
         self.proto_ver = C.MQTT_V4
         self.client_id = ""
@@ -194,6 +215,15 @@ class Channel:
             return []
         self.state = CONNECTING
         self.proto_ver = pkt.proto_ver
+        # TLS-cert-derived username overrides the packet's, and feeds
+        # everything downstream (clientid derivation, auth, ACLs,
+        # bans) exactly as the reference's setting_peercert_infos
+        # result does (src/emqx_channel.erl:200-214)
+        username = pkt.username
+        if self.peer_cert_as_username and self.peercert:
+            cu = cert_username(self.peercert, self.peer_cert_as_username)
+            if cu is not None:
+                username = cu
         client_id = pkt.client_id
         if client_id == "":
             if not pkt.clean_start:
@@ -206,21 +236,21 @@ class Channel:
             assigned = True
         else:
             assigned = False
-        if self.zone.use_username_as_clientid and pkt.username:
+        if self.zone.use_username_as_clientid and username:
             # src/emqx_channel.erl:1383-1389 (before assignment so an
             # over-long username still hits the length check)
-            client_id = pkt.username
+            client_id = username
             assigned = False
         if len(client_id) > self.zone.max_clientid_len:
             return self._connack_error(RC.CLIENT_IDENTIFIER_NOT_VALID)
         self.client_id = client_id
-        self.username = pkt.username
+        self.username = username
         # every later log line from this task carries the client
         # context (src/emqx_channel.erl:1161-1162)
         set_metadata_clientid(client_id)
         set_metadata_peername(self.peername)
         self.clientinfo = ClientInfo(
-            clientid=client_id, username=pkt.username,
+            clientid=client_id, username=username,
             peerhost=self.peername[0], zone=self.zone.name,
             proto_ver=pkt.proto_ver, keepalive=pkt.keepalive,
             clean_start=pkt.clean_start, listener=self.listener,
@@ -233,7 +263,7 @@ class Channel:
         # banned?
         banned = getattr(self.broker, "banned", None)
         if self.zone.enable_ban and banned is not None and banned.check(
-                clientid=client_id, username=pkt.username,
+                clientid=client_id, username=username,
                 peerhost=self.peername[0]):
             return self._connack_error(RC.BANNED)
         # flapping
@@ -251,7 +281,7 @@ class Channel:
             self.broker.metrics.inc("client.auth.anonymous")
         self.clientinfo["is_superuser"] = auth.get("is_superuser", False)
         self.mountpoint = replvar(self.zone.mountpoint, client_id,
-                                  pkt.username or "")
+                                  username or "")
         # will message (kept until disconnect decides its fate)
         self.will = will_msg(pkt)
         if self.will is not None and self.mountpoint:

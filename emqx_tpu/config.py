@@ -76,6 +76,12 @@ class ListenerConfig:
     # address; reference listener.tcp.*.proxy_protocol)
     proxy_protocol: bool = False
     proxy_protocol_timeout: float = 3.0
+    # esockd-style accept controls (reference listener.*.access.N,
+    # listener.*.max_conn_rate) — tcp/ssl listeners
+    access: Optional[List[str]] = None
+    max_conn_rate: float = 0.0
+    # ssl listeners: CONNECT username from the client cert (cn | dn)
+    peer_cert_as_username: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -151,6 +157,42 @@ def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
         # a debug log — make the foot-gun a startup error instead
         raise ConfigError(
             f"listeners[{i}].proxy_protocol_timeout must be > 0")
+    if raw.get("access") is not None:
+        if ltype not in ("tcp", "ssl"):
+            raise ConfigError(
+                f"listeners[{i}]: access rules only apply to "
+                f"tcp/ssl listeners")
+        from emqx_tpu.connection import parse_access_rules
+        try:
+            parse_access_rules(raw["access"])
+        except ValueError as e:
+            raise ConfigError(f"listeners[{i}].access: {e}") from e
+    rate = float(raw.get("max_conn_rate", 0) or 0)
+    if rate < 0:
+        raise ConfigError(f"listeners[{i}].max_conn_rate must be >= 0")
+    if rate > 0 and ltype not in ("tcp", "ssl"):
+        # ws/wss listeners don't carry the accept bucket yet — a
+        # config-accepted-but-unenforced rate limit is a silent noop
+        raise ConfigError(
+            f"listeners[{i}]: max_conn_rate only applies to "
+            f"tcp/ssl listeners")
+    pcu = raw.get("peer_cert_as_username")
+    if pcu is not None:
+        if ltype != "ssl":
+            raise ConfigError(
+                f"listeners[{i}]: peer_cert_as_username needs a "
+                f"client-cert-bearing ssl listener")
+        if pcu not in ("cn", "dn"):
+            raise ConfigError(
+                f"listeners[{i}].peer_cert_as_username must be "
+                f"\"cn\" or \"dn\", got {pcu!r}")
+        if tls.get("verify") != "verify_peer":
+            # without peer verification no client ever presents a
+            # cert: every username would stay self-asserted while the
+            # operator believes it is cert-backed
+            raise ConfigError(
+                f"listeners[{i}]: peer_cert_as_username requires "
+                f"verify = \"verify_peer\"")
     if raw.get("proxy_protocol") and ltype != "tcp":
         # silently ignoring it would leave the LB's real-client
         # addresses unseen — the worst kind of security-adjacent noop
@@ -245,11 +287,18 @@ def build_node(cfg: NodeConfig):
             node.add_listener(
                 proxy_protocol=lc.proxy_protocol,
                 proxy_protocol_timeout=lc.proxy_protocol_timeout,
+                access_rules=lc.access,
+                max_conn_rate=lc.max_conn_rate,
                 **kw)
         elif lc.type == "ws":
             node.add_ws_listener(path=lc.path, **kw)
         elif lc.type == "ssl":
-            node.add_tls_listener(tls_options=TlsOptions(**lc.tls), **kw)
+            node.add_tls_listener(
+                tls_options=TlsOptions(**lc.tls),
+                access_rules=lc.access,
+                max_conn_rate=lc.max_conn_rate,
+                peer_cert_as_username=lc.peer_cert_as_username,
+                **kw)
         else:  # wss
             node.add_wss_listener(path=lc.path,
                                   tls_options=TlsOptions(**lc.tls), **kw)

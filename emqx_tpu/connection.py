@@ -38,7 +38,7 @@ class Connection:
                  writer: asyncio.StreamWriter,
                  broker, cm, zone: Optional[Zone] = None,
                  listener: str = "tcp:default",
-                 peername=None) -> None:
+                 peername=None, peer_cert_as_username=None) -> None:
         self.reader = reader
         self.writer = writer
         self.zone = zone or get_zone()
@@ -54,7 +54,8 @@ class Connection:
                 peercert = None
         self.channel = Channel(broker, cm, zone=self.zone,
                                peername=(str(peer[0]), int(peer[1])),
-                               listener=listener, peercert=peercert)
+                               listener=listener, peercert=peercert,
+                               peer_cert_as_username=peer_cert_as_username)
         self.channel.on_close = self._close_transport
         self.channel.on_deliver = self._schedule_flush
         self.channel.send_oob = self._send_packets
@@ -328,6 +329,44 @@ class Connection:
                 return
 
 
+def parse_access_rules(rules):
+    """``["allow 127.0.0.1", "deny 10.0.0.0/8", "allow all"]`` →
+    ordered (allow, network|None) pairs (reference: esockd access
+    rules, etc/emqx.conf listener.*.access.N). First match wins; NO
+    match denies — end the list with "allow all" for the reference's
+    default-open behavior (its shipped config does exactly that)."""
+    import ipaddress
+
+    parsed = []
+    for rule in rules:
+        parts = str(rule).split()
+        if len(parts) != 2 or parts[0] not in ("allow", "deny"):
+            raise ValueError(f"bad access rule {rule!r}")
+        who = None if parts[1] == "all" else \
+            ipaddress.ip_network(parts[1], strict=False)
+        parsed.append((parts[0] == "allow", who))
+    return parsed
+
+
+def check_access(parsed_rules, ip: str) -> bool:
+    import ipaddress
+
+    try:
+        addr = ipaddress.ip_address(ip)
+    except ValueError:
+        return False  # unknown peer form: never through an ACL
+    # dual-stack listeners hand IPv4 peers to us as ::ffff:a.b.c.d —
+    # an un-unmapped address would bypass every IPv4 deny rule
+    mapped = getattr(addr, "ipv4_mapped", None)
+    if mapped is not None:
+        addr = mapped
+    for allow, net in parsed_rules:
+        if net is None or (addr.version == net.version
+                           and addr in net):
+            return allow
+    return False
+
+
 _PP2_SIG = b"\r\n\r\n\x00\r\nQUIT\n"
 
 
@@ -404,7 +443,10 @@ class Listener:
                  max_connections: int = 1024000,
                  ssl_context=None, reuse_port: bool = False,
                  proxy_protocol: bool = False,
-                 proxy_protocol_timeout: float = 3.0) -> None:
+                 proxy_protocol_timeout: float = 3.0,
+                 access_rules=None,
+                 max_conn_rate: float = 0.0,
+                 peer_cert_as_username=None) -> None:
         self.broker = broker
         self.cm = cm
         self.host = host
@@ -419,6 +461,19 @@ class Listener:
         # proxy_protocol_timeout or the socket closes.
         self.proxy_protocol = proxy_protocol
         self.proxy_protocol_timeout = proxy_protocol_timeout
+        # esockd access rules: ordered allow/deny on the SOCKET peer
+        # (pre-PROXY — the LB's address is what reaches the port)
+        self.access_rules = (parse_access_rules(access_rules)
+                             if access_rules else None)
+        # esockd max_conn_rate: accept-rate token bucket; beyond it
+        # sockets close immediately (the reference pauses its
+        # acceptor; with asyncio's accept loop a fast close is the
+        # equivalent backpressure)
+        self._conn_bucket = (TokenBucket(max_conn_rate, max_conn_rate)
+                             if max_conn_rate > 0 else None)
+        # ssl listeners: derive the CONNECT username from the client
+        # cert ("cn" | "dn", src/emqx_channel.erl:200-214)
+        self.peer_cert_as_username = peer_cert_as_username
         # SO_REUSEPORT: several worker processes bind the same port
         # and the kernel load-balances accepts (emqx_tpu.workers)
         self.reuse_port = reuse_port
@@ -442,6 +497,18 @@ class Listener:
                 self.max_connections:
             writer.close()
             return
+        # access BEFORE the rate bucket: a denied peer hammering the
+        # port must not drain the accept budget of allowed clients
+        if self.access_rules is not None:
+            peer = writer.get_extra_info("peername") or ("?",)
+            if not check_access(self.access_rules, str(peer[0])):
+                writer.close()
+                return
+        if self._conn_bucket is not None:
+            if not self._conn_bucket.check(1.0):
+                writer.close()
+                return
+            self._conn_bucket.consume(1.0)
         conn = None
         raw_writer = writer  # the socket writer, for set bookkeeping
         self._handshaking.add(raw_writer)
@@ -465,7 +532,8 @@ class Listener:
             conn = self.connection_class(
                 reader, writer, self.broker, self.cm,
                 zone=self.zone, listener=self.name,
-                peername=peername)
+                peername=peername,
+                peer_cert_as_username=self.peer_cert_as_username)
             self._conns.add(conn)
             self._handshaking.discard(raw_writer)
             await conn.run()

@@ -113,13 +113,17 @@ class Node:
                      max_connections: int = 1024000,
                      reuse_port: bool = False,
                      proxy_protocol: bool = False,
-                     proxy_protocol_timeout: float = 3.0) -> Listener:
+                     proxy_protocol_timeout: float = 3.0,
+                     access_rules=None,
+                     max_conn_rate: float = 0.0) -> Listener:
         lst = Listener(self.broker, self.cm, host=host, port=port,
                        zone=zone or self.zone, name=name,
                        max_connections=max_connections,
                        reuse_port=reuse_port,
                        proxy_protocol=proxy_protocol,
-                       proxy_protocol_timeout=proxy_protocol_timeout)
+                       proxy_protocol_timeout=proxy_protocol_timeout,
+                       access_rules=access_rules,
+                       max_conn_rate=max_conn_rate)
         self.listeners.append(lst)
         return lst
 
@@ -138,7 +142,10 @@ class Node:
     def add_tls_listener(self, host: str = "127.0.0.1", port: int = 8883,
                          tls_options=None, zone: Optional[Zone] = None,
                          name: str = "ssl:default",
-                         max_connections: int = 1024000) -> Listener:
+                         max_connections: int = 1024000,
+                         access_rules=None,
+                         max_conn_rate: float = 0.0,
+                         peer_cert_as_username=None) -> Listener:
         """TLS-terminating MQTT listener (reference mqtt:ssl via
         esockd, src/emqx_listeners.erl:43-76). A PSK-only option set
         on an interpreter whose ``ssl`` lacks server-side PSK falls
@@ -156,14 +163,19 @@ class Node:
                 zone=zone or self.zone, name=name,
                 max_connections=max_connections, psk=opts.psk,
                 psk_identity_hint=opts.psk_identity_hint,
-                psk_ciphers=opts.ciphers or "PSK")
+                psk_ciphers=opts.ciphers or "PSK",
+                access_rules=access_rules,
+                max_conn_rate=max_conn_rate)
             self.listeners.append(lst)
             return lst
         ctx = make_server_context(opts)
         lst = Listener(self.broker, self.cm, host=host, port=port,
                        zone=zone or self.zone, name=name,
                        ssl_context=ctx,
-                       max_connections=max_connections)
+                       max_connections=max_connections,
+                       access_rules=access_rules,
+                       max_conn_rate=max_conn_rate,
+                       peer_cert_as_username=peer_cert_as_username)
         self.listeners.append(lst)
         return lst
 

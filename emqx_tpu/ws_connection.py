@@ -198,9 +198,11 @@ class WsConnection(Connection):
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter,
                  broker, cm, zone: Optional[Zone] = None,
-                 listener: str = "ws:default", peername=None) -> None:
+                 listener: str = "ws:default", peername=None,
+                 peer_cert_as_username=None) -> None:
         super().__init__(reader, writer, broker, cm, zone=zone,
-                         listener=listener, peername=peername)
+                         listener=listener, peername=peername,
+                         peer_cert_as_username=peer_cert_as_username)
         # one WS message may batch MULTIPLE MQTT packets (MQTT 5 §6.0),
         # so the reassembly bound is a multiple of the per-packet limit
         # (which the MQTT parser itself enforces), not the limit + slack
