@@ -88,6 +88,7 @@ class Connection:
         self._timers: list = []
         self._loop = None  # serving loop, captured by run()
         self._flush_scheduled = False  # coalesced delivery wakeups
+        self._send_guard: Optional[asyncio.Task] = None
 
     # -- IO ----------------------------------------------------------------
 
@@ -184,19 +185,89 @@ class Connection:
         if self._closing:
             return
         self._send_packets(self.channel.handle_deliver())
+        # slow-consumer guard: the fan-out path writes without
+        # draining (one slow subscriber must not stall a broadcast),
+        # so a consumer that stops reading would otherwise grow the
+        # transport buffer without bound. Past high_watermark the
+        # peer gets send_timeout seconds to drain or the socket
+        # closes (reference: send_timeout + send_timeout_close).
+        if (self.zone.send_timeout > 0 and self._loop is not None
+                and (self._send_guard is None
+                     or self._send_guard.done())):
+            tr = self.writer.transport
+            try:
+                over = (tr is not None and tr.get_write_buffer_size()
+                        > self.zone.high_watermark)
+            except Exception:
+                over = False
+            if over:
+                self._send_guard = self._loop.create_task(
+                    self._send_timeout_guard())
+
+    async def _send_timeout_guard(self) -> None:
+        try:
+            await asyncio.wait_for(self.writer.drain(),
+                                   self.zone.send_timeout)
+        except asyncio.TimeoutError:
+            if not self.zone.send_timeout_close:
+                log.warning("slow consumer %s: write buffer stuck > "
+                            "%.0fs (send_timeout_close off)",
+                            self.channel.peername,
+                            self.zone.send_timeout)
+                return
+            log.info("closing slow consumer %s: write buffer stuck "
+                     "> %.0fs", self.channel.peername,
+                     self.zone.send_timeout)
+            self.broker.metrics.inc("connections.closed.slow_consumer")
+            self.channel.disconnect_reason = "send_timeout"
+            # abort, not close: a graceful close would wait forever
+            # to flush the very buffer the peer refuses to drain
+            self._abort_transport()
+        except Exception:
+            pass  # socket died on its own
 
     def _close_transport(self) -> None:
         self._closing = True
         try:
             self.writer.close()
         except Exception:
+            return
+        # a graceful close flushes the write buffer first — a wedged
+        # peer would hold the socket (and the conn task, and
+        # Listener.stop) forever. Bound it by send_timeout, then
+        # abort. (send_timeout = 0 keeps closes unbounded.)
+        if self.zone.send_timeout > 0 and self._loop is not None:
+            self._loop.create_task(
+                self._ensure_closed(self.zone.send_timeout))
+
+    async def _ensure_closed(self, timeout: float) -> None:
+        try:
+            await asyncio.wait_for(self.writer.wait_closed(), timeout)
+        except asyncio.TimeoutError:
+            self._abort_transport()
+        except Exception:
             pass
+
+    def _abort_transport(self) -> None:
+        self._closing = True
+        try:
+            self.writer.transport.abort()
+        except Exception:
+            self._close_transport()
 
     async def _drain_and_close(self) -> None:
         """Flush pending bytes (error CONNACK / reason-coded
-        DISCONNECT), then close the socket."""
+        DISCONNECT), then close the socket — bounded: a peer that
+        won't drain must not pin the task forever."""
         try:
-            await self.writer.drain()
+            if self.zone.send_timeout > 0:
+                await asyncio.wait_for(self.writer.drain(),
+                                       self.zone.send_timeout)
+            else:
+                await self.writer.drain()
+        except asyncio.TimeoutError:
+            self._abort_transport()
+            return
         except Exception:
             pass
         self._close_transport()
@@ -204,6 +275,15 @@ class Connection:
     async def run(self) -> None:
         """The connection loop: read → parse → channel → write."""
         self._loop = asyncio.get_running_loop()
+        # make zone.high_watermark govern the TRANSPORT too: drain()
+        # in the read loop and in the guard resolves against these
+        # limits, so the knob means what it says instead of asyncio's
+        # fixed 64KB default
+        try:
+            self.writer.transport.set_write_buffer_limits(
+                high=self.zone.high_watermark)
+        except Exception:
+            pass
         idle_deadline = time.time() + self.zone.idle_timeout
         try:
             while not self._closing:

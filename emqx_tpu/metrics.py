@@ -75,9 +75,14 @@ DEVICE_METRICS = [
     "device.matches", "device.deliveries", "device.overflows",
 ]
 
+TRANSPORT_METRICS = [
+    # slow-consumer guard closes (zone send_timeout)
+    "connections.closed.slow_consumer",
+]
+
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
-               + AUTH_ACL_METRICS + DEVICE_METRICS)
+               + AUTH_ACL_METRICS + DEVICE_METRICS + TRANSPORT_METRICS)
 
 
 class Metrics:

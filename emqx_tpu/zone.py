@@ -79,6 +79,13 @@ class Zone:
     ratelimit_msg_in: Optional[tuple] = None
     ratelimit_bytes_in: Optional[tuple] = None
     quota_conn_messages: Optional[tuple] = None
+    # slow-consumer guard (reference listener.*.send_timeout +
+    # send_timeout_close): once the transport write buffer crosses
+    # high_watermark, the peer has send_timeout seconds to drain it
+    # or the connection closes (0 disables)
+    send_timeout: float = 15.0
+    send_timeout_close: bool = True
+    high_watermark: int = 1024 * 1024
     # forced-GC trigger (count, bytes), None disables
     # (etc/emqx.conf force_gc_policy, src/emqx_gc.erl)
     force_gc_policy: Optional[tuple] = (16000, 16 * 1024 * 1024)

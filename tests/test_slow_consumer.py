@@ -1,0 +1,92 @@
+"""Slow-consumer guard (reference: listener send_timeout +
+send_timeout_close): the QoS0 fan-out path writes without draining,
+so a subscriber that stops reading must be disconnected once its
+write buffer sits past high_watermark for send_timeout seconds —
+not grow server memory without bound."""
+
+import asyncio
+
+from emqx_tpu.node import Node
+from emqx_tpu.zone import Zone
+from tests.mqtt_client import TestClient
+
+
+async def test_slow_consumer_closed_and_fast_one_survives():
+    zone = Zone(name="slowtest", send_timeout=1.0,
+                high_watermark=64 * 1024, allow_anonymous=True)
+    n = Node(boot_listeners=False, zone=zone)
+    lst = n.add_listener(port=0, zone=zone)
+    await n.start()
+    try:
+        slow = TestClient("slow", version=4)
+        await slow.connect(port=lst.port)
+        await slow.subscribe("blast/#", qos=0)
+        fast = TestClient("fast", version=4)
+        await fast.connect(port=lst.port)
+        await fast.subscribe("blast/#", qos=0)
+        # wedge the slow client: stop its read loop so TCP backs up
+        slow._task.cancel()
+        pub = TestClient("pub", version=4)
+        await pub.connect(port=lst.port)
+        # kernel socket buffers (client recv + server send) absorb
+        # a few MB before the USER-SPACE write buffer grows — blast
+        # well past that
+        payload = b"x" * 16384
+        for i in range(2000):  # ~32MB
+            await pub.publish(f"blast/{i % 7}", payload, qos=0)
+            if i % 50 == 0:
+                await asyncio.sleep(0)
+        # within ~send_timeout the guard must close the slow channel
+        for _ in range(80):
+            await asyncio.sleep(0.1)
+            if n.cm.lookup_channel("slow") is None:
+                break
+        assert n.cm.lookup_channel("slow") is None, \
+            "slow consumer not closed"
+        assert n.metrics.val("connections.closed.slow_consumer") >= 1
+        # the fast subscriber is still connected and functional
+        assert n.cm.lookup_channel("fast") is not None
+        await pub.publish("blast/final", b"done", qos=0)
+        got = await asyncio.wait_for(fast.inbox.get(), 10)
+        while got.topic != "blast/final":
+            got = await asyncio.wait_for(fast.inbox.get(), 10)
+        await pub.disconnect()
+        await fast.disconnect()
+    finally:
+        await n.stop()
+
+
+async def test_kick_of_wedged_consumer_aborts_within_timeout():
+    """A graceful close (kick/takeover path) of a peer that refuses
+    to drain must abort within send_timeout instead of holding the
+    socket, the connection task, and Listener.stop forever."""
+    zone = Zone(name="kicktest", send_timeout=1.0,
+                high_watermark=64 * 1024, allow_anonymous=True)
+    n = Node(boot_listeners=False, zone=zone)
+    lst = n.add_listener(port=0, zone=zone)
+    await n.start()
+    try:
+        slow = TestClient("wedged", version=4)
+        await slow.connect(port=lst.port)
+        await slow.subscribe("k/#", qos=0)
+        slow._task.cancel()
+        pub = TestClient("kpub", version=4)
+        await pub.connect(port=lst.port)
+        # park ~2MB in the victim's buffers (below the guard's
+        # trigger odds on kernel-buffer-only, but enough that a
+        # graceful close cannot flush to a non-reading peer fast)
+        payload = b"y" * 16384
+        for i in range(1200):
+            await pub.publish(f"k/{i % 3}", payload, qos=0)
+            if i % 50 == 0:
+                await asyncio.sleep(0)
+        n.cm.kick_session("wedged")
+        for _ in range(60):
+            await asyncio.sleep(0.1)
+            if n.cm.lookup_channel("wedged") is None:
+                break
+        assert n.cm.lookup_channel("wedged") is None, "kick hung"
+        await pub.disconnect()
+    finally:
+        # node.stop() itself would hang if the close leaked
+        await asyncio.wait_for(n.stop(), 15)
