@@ -127,3 +127,31 @@ def test_config_rejects_bad_proxy_settings(tmp_path):
                  'proxy_protocol = true\nproxy_protocol_timeout = 0\n')
     with pytest.raises(ConfigError):
         load_config(str(p))
+
+
+async def test_fuzz_parser_never_hangs_or_crashes():
+    """Random garbage (including truncated PP2 sigs and PROXY-
+    prefixed noise) must terminate in ValueError / IncompleteReadError
+    / a peername tuple — no unexpected exception type. (The wait_for
+    is a belt for await-based stalls; a non-yielding loop would hang
+    the suite itself, which CI treats as failure.)"""
+    import random
+
+    rng = random.Random(5)
+    cases = []
+    for _ in range(300):
+        n = rng.randrange(0, 40)
+        cases.append(bytes(rng.randrange(256) for _ in range(n)))
+    for i in range(100):
+        cases.append(b"PROXY " + bytes(
+            rng.randrange(256) for _ in range(rng.randrange(0, 120))))
+        cases.append(b"\r\n\r\n\x00\r\nQUIT\n" + bytes(
+            rng.randrange(256) for _ in range(rng.randrange(0, 60))))
+    for data in cases:
+        r = _feed(data)
+        try:
+            res = await asyncio.wait_for(read_proxy_header(r), 2.0)
+            assert res is None or (isinstance(res, tuple)
+                                   and len(res) == 2)
+        except (ValueError, asyncio.IncompleteReadError):
+            pass
