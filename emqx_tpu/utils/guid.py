@@ -13,16 +13,31 @@ import time
 
 _lock = threading.Lock()
 _seq = 0
+_last_ts = 0
 _node_bits = (os.getpid() & 0xFFFF) << 16 | (
     int.from_bytes(os.urandom(2), "big"))
 
 
 def new_guid() -> int:
-    """A 128-bit int: ts_us(64) | node+pid entropy(32) | seq(32)."""
-    global _seq
-    ts = int(time.time() * 1_000_000)
+    """A 128-bit int: ts_us(64) | node+pid entropy(32) | seq(32).
+
+    Monotonic per generator: the timestamp is read and clamped UNDER
+    the lock (the reference advances from the last ts the same way,
+    src/emqx_guid.erl ts handling) — a wall-clock step backwards
+    holds the last timestamp rather than emitting a smaller id, and
+    no interleaving can pair an older ts with a newer seq."""
+    global _seq, _last_ts
     with _lock:
+        ts = int(time.time() * 1_000_000)
+        if ts < _last_ts:
+            ts = _last_ts  # clock stepped back: hold, stay monotonic
         _seq = (_seq + 1) & 0xFFFFFFFF
+        if _seq == 0:
+            # seq wrapped: advance the timestamp so the (ts, seq)
+            # pair can never repeat under a held clock (the reference
+            # advances ts on sequence exhaustion the same way)
+            ts += 1
+        _last_ts = ts
         seq = _seq
     return (ts << 64) | (_node_bits << 32) | seq
 

@@ -1,9 +1,12 @@
-"""Utility substrate: batch, sequence, pmon, misc pipeline
-(emqx_batch / emqx_sequence / emqx_pmon / emqx_misc parity)."""
+"""Utility substrate: batch, sequence, pmon, misc pipeline, guid
+(emqx_batch / emqx_sequence / emqx_pmon / emqx_misc / emqx_guid
+parity)."""
 
 import asyncio
+import time
 
 from emqx_tpu.utils.batch import AsyncBatcher, Batch
+from emqx_tpu.utils.guid import guid_timestamp, new_guid
 from emqx_tpu.utils.misc import ERROR, OK, pipeline, run_fold
 from emqx_tpu.utils.pmon import PMon
 from emqx_tpu.utils.sequence import Sequence
@@ -113,3 +116,40 @@ def test_pipeline_error_with_state():
 def test_run_fold():
     funs = [lambda acc, s: acc + s, lambda acc, s: acc * 2]
     assert run_fold(funs, 1, 3) == 8
+
+
+# -- guid (emqx_guid_SUITE parity: uniqueness + time ordering) --------------
+
+def test_guid_unique_and_monotonic():
+    ids = [new_guid() for _ in range(10_000)]
+    assert len(set(ids)) == len(ids)
+    # time-ordered layout: ids generated in sequence never decrease
+    assert all(a < b for a, b in zip(ids, ids[1:]))
+
+
+def test_guid_timestamp_roundtrip():
+    before = time.time()
+    g = new_guid()
+    after = time.time()
+    # 128-bit layout: ts_us(64) | entropy(32) | seq(32)
+    assert g < (1 << 128)
+    assert before - 1e-3 <= guid_timestamp(g) <= after + 1e-3
+
+
+def test_guid_thread_safety():
+    import threading
+
+    out: list = []
+    lock = threading.Lock()
+
+    def gen():
+        local = [new_guid() for _ in range(2_000)]
+        with lock:
+            out.extend(local)
+
+    threads = [threading.Thread(target=gen) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(out)) == len(out)
