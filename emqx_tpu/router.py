@@ -141,6 +141,13 @@ class Router:
         self._dirty = True
         self._rebuilds = 0
         self._patches = 0
+        # vocabulary revision: bumped when a filter INSERT completes
+        # (inserts intern new words; the word table is append-only,
+        # so deletes never invalidate an encoding). A batch encoded
+        # at revision R is only valid to dispatch at R —
+        # encode_place_sharded stamps it, publish_dispatch_sharded
+        # verifies and re-encodes on mismatch
+        self._mut_rev = 0
         # O(delta) maintenance (ops/patch.py): host mirror of the live
         # automaton; None until the first flatten. Mesh mode keeps ONE
         # PATCHER PER TRIE SHARD (stable hash assignment — a mutation
@@ -227,6 +234,13 @@ class Router:
                 self._routes[filter_] = dests
                 self._t_insert(filter_, fid)
                 self._patch_insert(filter_, fid)
+                # bump AFTER the insert interned its words: a batch
+                # encoded concurrently (encode takes _wt_lock only)
+                # then reads the OLD revision and looks stale at
+                # dispatch — re-encoded, safe. Bumping first would
+                # let it carry the new revision over a pre-intern
+                # word table: accepted stale, silent match miss
+                self._mut_rev += 1
             dests[dest] = dests.get(dest, 0) + 1
             return fid
 
@@ -302,6 +316,10 @@ class Router:
             if dests[dest] <= 0:
                 del dests[dest]
             if not dests:
+                # no revision bump: the word table is append-only, so
+                # removing a filter can never invalidate an existing
+                # encoding — bumping here would spuriously stale every
+                # in-flight pre-placed batch under unsubscribe churn
                 del self._routes[filter_]
                 self._t_delete(filter_)
                 fid = self._filter_ids.pop(filter_)
@@ -735,7 +753,7 @@ class Router:
         return all_ids, ovf, id_map, epoch
 
     def publish_dispatch_sharded(self, topics: Sequence[str],
-                                 fan_provider):
+                                 fan_provider, placed=None):
         """The PRODUCT multi-chip publish dispatch: match AND fan-out
         in one collective step (``parallel.sharded.publish_step`` with
         real per-shard fan tables, ``with_fanout=True``).
@@ -743,6 +761,10 @@ class Router:
         ``fan_provider(epoch, id_map) -> ShardedFanoutState | None``
         supplies fan tables (CSR + big-filter bitmaps) consistent
         with the automaton snapshot (the broker's FanoutManager).
+        ``placed`` (from :meth:`encode_place_sharded`) skips the host
+        encode + host→device transfer — a pipelined caller overlaps
+        that host half with in-flight device steps instead of paying
+        a synchronous transfer per call.
         Returns ``(ids_dev [B_pad, T·m], subs_dev [B_pad, T·d],
         src_dev [B_pad, T·d], bm [(union, has_big, bovf) | None],
         ovf_dev [B_pad], movf_dev [B_pad], id_map, epoch, big_fids)``
@@ -751,11 +773,38 @@ class Router:
         Reference: the dispatch fold src/emqx_broker.erl:283-309 run
         as one compiled mesh program."""
         return self._dispatch_sharded(topics, fan=fan_provider,
-                                      with_big=True)
+                                      with_big=True, placed=placed)
+
+    def encode_place_sharded(self, topics: Sequence[str]):
+        """Host half of the sharded dispatch: encode a topic batch
+        (padded to a bucket that splits evenly over the data axis)
+        and place it on the mesh. Returns ``(ids, n, sysm, rev)``
+        where ``rev`` is the route-table mutation revision the batch
+        was encoded at — :meth:`publish_dispatch_sharded` verifies it
+        and re-encodes if routes changed in between (a filter added
+        after encode may intern words the stale encoding mapped to
+        the unknown sentinel: its matches would silently miss)."""
+        from emqx_tpu.parallel.sharded import place_batch
+
+        cfg = self.config
+        mesh = cfg.mesh
+        # capture BEFORE encoding: a mutation racing the encode makes
+        # the batch look stale (re-encoded at dispatch) — never the
+        # reverse
+        rev = self._mut_rev
+        B = len(topics)
+        unit = cfg.min_batch * mesh.shape["data"]
+        bucket = unit  # bucket must split evenly over the data axis
+        while bucket < B:
+            bucket *= 2
+        padded = list(topics) + ["\x00/pad"] * (bucket - B)
+        with self._wt_lock:
+            ids, n, sysm = self._encode(padded, cfg.max_levels)
+        return (*place_batch(mesh, ids, n, sysm), rev)
 
     def _dispatch_sharded(self, topics: Sequence[str], fan=None,
-                          with_big: bool = False):
-        from emqx_tpu.parallel.sharded import place_batch, publish_step
+                          with_big: bool = False, placed=None):
+        from emqx_tpu.parallel.sharded import publish_step
 
         cfg = self.config
         mesh = cfg.mesh
@@ -769,15 +818,20 @@ class Router:
                 fan_tables = st.fan
                 bmt = st.bm
                 big_fids = st.big_fids
-        B = len(topics)
-        unit = cfg.min_batch * mesh.shape["data"]
-        bucket = unit  # bucket must split evenly over the data axis
-        while bucket < B:
-            bucket *= 2
-        padded = list(topics) + ["\x00/pad"] * (bucket - B)
-        with self._wt_lock:
-            ids, n, sysm = self._encode(padded, cfg.max_levels)
-        ids, n, sysm = place_batch(mesh, ids, n, sysm)
+        if placed is not None:
+            ids, n, sysm, rev = placed
+            if rev != self._mut_rev:
+                # routes changed since the batch was encoded — its
+                # word ids may predate newly interned vocabulary.
+                # Re-encode (correct, costs the transfer the caller
+                # tried to hide); requires the original topics
+                if topics is None:
+                    raise ValueError(
+                        "stale placed batch (routes changed since "
+                        "encode) and no topics to re-encode from")
+                ids, n, sysm, _ = self.encode_place_sharded(topics)
+        else:
+            ids, n, sysm, _ = self.encode_place_sharded(topics)
         use_fan = fan_tables is not None
         all_ids, subs, src, bm, ovf, movf, stats = publish_step(
             mesh, auto, fan_tables if use_fan else self._dummy_fan,

@@ -45,13 +45,14 @@ EOF
 import json, os, sys
 sys.path.insert(0, ".")
 import bench
-metric = bench._MODES[os.environ["MODE"]][1]
-try:
-    rec = json.load(open(bench.TPU_LAST_PATH)).get(metric)
-except Exception:
-    rec = None
-done = rec is not None and rec.get("value") is not None
-print(f"mode {os.environ['MODE']} ({metric}): "
+mode = os.environ["MODE"]
+metric = bench._MODES[mode][1]
+# mode_staged_done also checks the workload stamp where the mode
+# declares one — a staged record from a superseded methodology must
+# not satisfy the current definition (same rule as matrix row specs)
+done = bench.mode_staged_done(mode)
+rec = bench._last_good_tpu(metric)
+print(f"mode {mode} ({metric}): "
       f"{'already staged ' + str(rec.get('ts')) if done else 'missing'}")
 raise SystemExit(0 if done else 1)
 EOF
