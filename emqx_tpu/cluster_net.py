@@ -90,7 +90,12 @@ class SocketTransport(Transport):
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = threading.Event()
         self._probing: set = set()      # peers with a probe in flight
-        self._probe_tasks: set = set()  # cancelled at close()
+        # strong refs to spawned tasks (asyncio keeps only weak ones
+        # — an untracked task can be GC'd mid-flight); shutdown
+        # cancels via all_tasks(), so these are anchors, not the
+        # cancellation roster
+        self._probe_tasks: set = set()
+        self._peer_tasks: set = set()   # inbound _on_peer handlers
         self._closing = False
         # cast coalescing (round-4 front-door finding: one IO-loop
         # wakeup + one drain() PER forwarded message serialized the
@@ -150,10 +155,22 @@ class SocketTransport(Transport):
         async def _shutdown():
             if self._server is not None:
                 self._server.close()
-            pending = list(self._probe_tasks)
-            for task in pending:
-                task.cancel()
-            if pending:
+            # cancel EVERY task on this (transport-private) loop, not
+            # a bucket snapshot: a connection accepted just before
+            # close() spawns its handler task after the snapshot
+            # would be taken, and a racing cast() can schedule a
+            # fresh flush — both would be destroyed-while-pending.
+            # Loop until quiescent (each gather can run scheduled
+            # callbacks that spawn more tasks); bounded — _closing
+            # gates new probe spawns and the server accepts nothing.
+            me = asyncio.current_task()
+            for _ in range(10):
+                pending = [t for t in asyncio.all_tasks(self._loop)
+                           if t is not me and not t.done()]
+                if not pending:
+                    break
+                for task in pending:
+                    task.cancel()
                 # cancel() only schedules the CancelledError; the
                 # tasks must actually unwind before the loop stops,
                 # or loop.close() still reports them destroyed-
@@ -250,9 +267,15 @@ class SocketTransport(Transport):
             self._cast_flushing.update(addrs)
             self._cast_flush_scheduled = False
         for addr in addrs:
-            t = self._loop.create_task(self._flush_addr(addr))
-            self._probe_tasks.add(t)
-            t.add_done_callback(self._probe_tasks.discard)
+            self._track(self._loop.create_task(self._flush_addr(addr)),
+                        self._probe_tasks)
+
+    @staticmethod
+    def _track(task, bucket: set) -> None:
+        """Anchor a spawned task (asyncio holds only weak refs) and
+        drop the anchor when it finishes."""
+        bucket.add(task)
+        task.add_done_callback(bucket.discard)
 
     def _take_cast_buf(self, addr) -> bytes:
         """Atomically claim any buffered casts for ``addr`` (a call
@@ -398,6 +421,9 @@ class SocketTransport(Transport):
 
     async def _on_peer(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._track(task, self._peer_tasks)
         peer = writer.get_extra_info("peername")
         name = None
         try:
@@ -450,9 +476,8 @@ class SocketTransport(Transport):
                     and name not in self._probing and not self._closing:
                 coro = self._probe_then_nodedown(name)
                 try:
-                    task = self._loop.create_task(coro)
-                    self._probe_tasks.add(task)
-                    task.add_done_callback(self._probe_tasks.discard)
+                    self._track(self._loop.create_task(coro),
+                                self._probe_tasks)
                 except RuntimeError:  # transport shutting down
                     coro.close()
 
