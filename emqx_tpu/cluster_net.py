@@ -145,8 +145,8 @@ class SocketTransport(Transport):
             self._loop.close()
 
     def close(self) -> None:
-        if self._loop is None:
-            return
+        if self._loop is None or self._closing:
+            return  # idempotent: a second close() is a no-op
         # set BEFORE the shutdown callback runs: an _on_peer EOF
         # firing during the cancel/gather must not spawn a fresh
         # probe task that escapes it
@@ -155,6 +155,25 @@ class SocketTransport(Transport):
         async def _shutdown():
             if self._server is not None:
                 self._server.close()
+            # best-effort drain of casts buffered BEFORE close():
+            # leave()'s nodedown announcements ride the cast buffer,
+            # and the _closing gate stops the normal flush machinery
+            # — without this, a peer only learns of our departure
+            # via the slower link-monitor path. Bounded per peer.
+            with self._cast_lock:
+                addrs = [a for a, b in self._cast_buf.items() if b]
+            if addrs:
+                try:
+                    # all peers concurrently under ONE overall bound:
+                    # close() joins the IO thread with a 5s budget,
+                    # and N black-holed peers at 1s each serially
+                    # would blow it (leaving the loop live forever —
+                    # _closing makes a retry a no-op)
+                    await asyncio.wait_for(asyncio.gather(
+                        *(self._flush_once(a) for a in addrs),
+                        return_exceptions=True), 2.0)
+                except BaseException:
+                    pass
             # cancel EVERY task on this (transport-private) loop, not
             # a bucket snapshot: a connection accepted just before
             # close() spawns its handler task after the snapshot
@@ -185,7 +204,12 @@ class SocketTransport(Transport):
             self._loop.stop()
 
         try:
-            asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
+            coro = _shutdown()
+            try:
+                asyncio.run_coroutine_threadsafe(coro, self._loop)
+            except Exception:
+                coro.close()  # loop already gone: don't leak a
+                raise         # never-awaited coroutine warning
             self._thread.join(timeout=5)
         except Exception:
             pass
@@ -217,7 +241,9 @@ class SocketTransport(Transport):
         try:
             return asyncio.run_coroutine_threadsafe(
                 _sockname(), self._loop).result(timeout=self.call_timeout)
-        except Exception:
+        except (Exception, asyncio.CancelledError):
+            # CancelledError (BaseException): shutdown's task sweep —
+            # same best-effort None as any other failure here
             return None
 
     # -- outbound ----------------------------------------------------------
@@ -233,6 +259,8 @@ class SocketTransport(Transport):
         addr = self._peers.get(node)
         if addr is None:
             raise ConnectionError(f"unknown node: {node}")
+        if self._closing:
+            return  # fire-and-forget: a cast racing shutdown drops
         data = pickle.dumps((_CAST, 0, (op, args)),
                             protocol=pickle.HIGHEST_PROTOCOL)
         with self._cast_lock:
@@ -250,9 +278,17 @@ class SocketTransport(Transport):
             wake = not self._cast_flush_scheduled
             self._cast_flush_scheduled = True
         if wake:
-            self._loop.call_soon_threadsafe(self._spawn_cast_flush)
+            try:
+                self._loop.call_soon_threadsafe(self._spawn_cast_flush)
+            except RuntimeError:  # loop closed under the race window
+                pass
 
     def _spawn_cast_flush(self) -> None:
+        # closing: a cast() racing shutdown must not spawn a flush
+        # task between the quiescence loop's gather rounds — the
+        # sweep's boundedness depends on nothing new being scheduled
+        if self._closing:
+            return
         # one INDEPENDENT task per peer: a backpressured peer parking
         # in drain() must not head-of-line-block healthy peers. The
         # in-flight set guarantees at most ONE flush task per peer —
@@ -368,7 +404,12 @@ class SocketTransport(Transport):
         try:
             return fut.result(timeout=self.call_timeout)
         except (ConnectionError, asyncio.TimeoutError, OSError,
-                asyncio.IncompleteReadError, TimeoutError) as e:
+                asyncio.IncompleteReadError, TimeoutError,
+                asyncio.CancelledError) as e:
+            # CancelledError: close()'s all-task sweep cancelled the
+            # in-flight request — callers were promised a
+            # ConnectionError on shutdown, and CancelledError is a
+            # BaseException that would sail through their handlers
             raise ConnectionError(f"call {op} to {addr} failed: {e}") from e
 
     async def _connect(self, addr: Tuple[str, int]):

@@ -337,3 +337,39 @@ async def test_retained_replicates_over_socket_transport():
         if proc.poll() is None:
             proc.kill()
         proc.wait()
+
+
+def test_buffered_cast_survives_immediate_close():
+    """leave()'s nodedown announcement rides the cast buffer; a
+    close() racing the scheduled flush must still drain it (the
+    _closing gate stops the normal flush machinery, so _shutdown
+    performs one bounded best-effort flush before the task sweep) —
+    otherwise peers only learn of our exit via the slower
+    link-monitor path."""
+    import time
+
+    from emqx_tpu.cluster_net import SocketTransport
+
+    got = []
+
+    class FakeCluster:
+        def handle_rpc(self, op, *args):
+            got.append((op, args))
+            return True
+
+    a = SocketTransport("a", cookie="k")
+    b = SocketTransport("b", cookie="k")
+    try:
+        a.serve()
+        hb, pb = b.serve()
+        b.cluster = FakeCluster()
+        a.register_peer("b", hb, pb)
+        a.cast("b", "nodedown", "a")
+        a.close()  # immediately: the buffered cast must still land
+        deadline = time.time() + 3
+        while not got and time.time() < deadline:
+            time.sleep(0.05)
+        assert got and got[0][0] == "nodedown", got
+    finally:
+        a.close()
+        b.close()

@@ -39,6 +39,9 @@ def _worker(pid: int, nproc: int, addr: str) -> None:
 
     assert distributed.initialize(coordinator_address=addr,
                                   num_processes=nproc, process_id=pid)
+    # bring-up marker: the harness only retries failures that happen
+    # BEFORE this line (the coordinator port-race window)
+    print(f"WORKER {pid} INIT OK", flush=True)
     n_global = len(jax.devices())
     assert n_global == 4, n_global  # 2 procs x 2 local devices
 
@@ -116,11 +119,10 @@ def _worker(pid: int, nproc: int, addr: str) -> None:
     print(f"WORKER {pid} PARITY OK rows={checked}", flush=True)
 
 
-def test_two_process_distributed_publish_parity():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    addr = f"127.0.0.1:{port}"
+def _run_world(addr: str):
+    """Spawn the 2-process world on ``addr``; returns (procs, outs).
+    A hang is killed (both workers — the world is dead) and shows up
+    as a nonzero returncode, never an exception."""
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
@@ -132,12 +134,55 @@ def test_two_process_distributed_publish_parity():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            try:
+                out, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                # one hung worker means the world is dead — kill
+                # BOTH now so the second doesn't get its own fresh
+                # 180s budget
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                out, _ = p.communicate()
             outs.append(out)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return procs, outs
+
+
+#: failure signatures of the coordinator-port race — ONLY these are
+#: retried; a genuine distributed-parity failure (worker assertion)
+#: must fail the test on its first occurrence, not be re-rolled
+_PORT_RACE_SIGNS = ("Address already in use", "Connection refused",
+                    "failed to connect", "UNAVAILABLE",
+                    "DEADLINE_EXCEEDED")
+
+
+def test_two_process_distributed_publish_parity():
+    # the probed-free port races: between close() and the
+    # coordinator's bind the kernel can hand it out as an ephemeral
+    # source port (observed as a one-in-many suite flake) — the
+    # coordinator address must be known before spawn, so the fix is
+    # a fresh port per attempt, not SO_REUSEADDR
+    for _attempt in range(3):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs, outs = _run_world(f"127.0.0.1:{port}")
+        if all(p.returncode == 0 for p in procs):
+            break
+        # retry ONLY a bring-up failure (some worker never passed
+        # INIT — the coordinator port-race window) that also carries
+        # a connect-failure signature. A failure AFTER formation
+        # (parity assertion, deadlock mid-step) must fail here, not
+        # be re-rolled until it passes.
+        during_bringup = any("INIT OK" not in out for out in outs)
+        retryable = during_bringup and any(
+            sig in out for out in outs for sig in _PORT_RACE_SIGNS)
+        if not retryable:
+            break  # a real failure: surface it immediately
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"WORKER {pid} PARITY OK" in out, out[-3000:]
