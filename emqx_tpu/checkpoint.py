@@ -24,7 +24,7 @@ from typing import Optional
 
 import numpy as np
 
-FORMAT = 1
+FORMAT = 2  # v2: compressed walk tables (wt/node2), no CSR arrays
 
 
 def save(router, path: str) -> dict:
@@ -39,23 +39,20 @@ def save(router, path: str) -> dict:
                 else:
                     routes.append([flt, "n", "", dest, refs])
         arrays = {}
-        csr_refs = None
         p = router._patcher
         if p is not None and not router._dirty:
-            # the host patch mirrors ARE the automaton authority; the
-            # CSR arrays are immutable between rebuilds, so only their
-            # REFERENCES are taken under the lock — any device→host
-            # transfer happens after release
+            # the host patch mirrors ARE the automaton authority —
+            # the walk reads nothing else, so the snapshot is exactly
+            # the mirror (copied under the lock, compressed outside)
             arrays = {
-                "plus_child": p.plus_child, "hash_filter": p.hash_filter,
-                "end_filter": p.end_filter, "ht_state": p.ht_state,
-                "ht_word": p.ht_word, "ht_child": p.ht_child,
+                "wt": p.wt, "node2": p.node2,
+                "v2_hop": p.hop, "v2_depth": p.depth,
+                "hops_for_level": p.hops_for_level,
                 "seed": np.asarray([p.seed], dtype=np.uint32),
-                "dims": np.asarray([p.n_states, p.n_edges],
-                                   dtype=np.int64),
+                "dims": np.asarray(
+                    [p.n_states, p.n_edges, p.slots, p.take],
+                    dtype=np.int64),
             }
-            csr_refs = (router._auto.row_ptr, router._auto.edge_word,
-                        router._auto.edge_child)
         vocab = (router._native.words() if router._native is not None
                  else router._table.words())
         meta = {
@@ -68,10 +65,6 @@ def save(router, path: str) -> dict:
         # copy the live mirrors under the lock; compress + write
         # OUTSIDE it (a large snapshot must not stop the route plane)
         arrays = {k: np.array(v) for k, v in arrays.items()}
-    if csr_refs is not None:
-        arrays["row_ptr"] = np.asarray(csr_refs[0])
-        arrays["edge_word"] = np.asarray(csr_refs[1])
-        arrays["edge_child"] = np.asarray(csr_refs[2])
     np.savez_compressed(
         path,
         meta=np.frombuffer(
@@ -92,7 +85,7 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
     """
     import jax
 
-    from emqx_tpu.ops.csr import Automaton, pack_tables
+    from emqx_tpu.ops.csr import Automaton, device_view
     from emqx_tpu.ops.patch import AutoPatcher
 
     with np.load(path) as data:
@@ -101,8 +94,15 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
         tables_data = ({k: np.array(data[k]) for k in data.files
                         if k not in ("meta", "routes")}
                        if meta.get("has_tables") else {})
-    if meta.get("format") != FORMAT:
+    if meta.get("format") not in (1, FORMAT):
         raise ValueError(f"unknown checkpoint format {meta.get('format')}")
+    if meta.get("format") != FORMAT:
+        # older snapshot: its tables predate the compressed walk
+        # layout — the route log alone is always sufficient (replay
+        # below; first match re-flattens), so restore degrades
+        # instead of rejecting
+        tables_data = {}
+        meta["has_tables"] = False
     with router._lock:
         if router._routes:
             raise ValueError("checkpoint restore needs a fresh router")
@@ -149,17 +149,18 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
             d_ = tables_data
             dims = d_["dims"]
             host_auto = Automaton(
-                row_ptr=d_["row_ptr"], edge_word=d_["edge_word"],
-                edge_child=d_["edge_child"],
-                plus_child=d_["plus_child"],
-                hash_filter=d_["hash_filter"],
-                end_filter=d_["end_filter"],
-                n_states=int(dims[0]), n_edges=int(dims[1]),
-                ht_state=d_["ht_state"], ht_word=d_["ht_word"],
-                ht_child=d_["ht_child"], ht_seed=d_["seed"])
-            host_auto = pack_tables(host_auto)
-            auto = jax.device_put(host_auto) if use_dev else host_auto
+                row_ptr=None, edge_word=None, edge_child=None,
+                plus_child=None, hash_filter=None, end_filter=None,
+                n_states=0, n_edges=0,
+                wt=d_["wt"], wt_seed=d_["seed"], node2=d_["node2"],
+                hops_for_level=d_["hops_for_level"],
+                v2_hop=d_["v2_hop"], v2_depth=d_["v2_depth"],
+                v2_states=int(dims[0]), v2_edges=int(dims[1]),
+                wt_slots=int(dims[2]), wt_take=int(dims[3]))
+            dev_auto = device_view(host_auto)
+            auto = jax.device_put(dev_auto) if use_dev else dev_auto
             router._patcher = AutoPatcher(host_auto, intern)
+            router._install_walk_meta(host_auto)
             router._auto = auto
             router._auto_map = list(router._id_to_filter)
             router._dirty = False

@@ -5,35 +5,47 @@ This replaces the reference's per-word ETS trie walk
 batched, fixed-shape automaton walk under ``jit``:
 
   - a publish batch ``[B, L]`` of interned word ids is matched
-    against the CSR automaton (:mod:`emqx_tpu.ops.csr`) with one
-    ``lax.scan`` over topic levels;
-  - the NFA active set (≤ K states) advances by literal edges
-    (per-row binary search) and ``+`` edges; ``#`` terminals are
-    collected at every level (including the end-of-topic level — the
-    reference's ``'match_#'`` at match_node/3 :161-186);
+    against the compressed walk tables (:mod:`emqx_tpu.ops.csr`) with
+    one ``lax.scan`` over *hops* (compressed levels);
+  - the NFA active set (≤ K states) advances by literal edges (one
+    bucketed 2-choice hash probe pair per hop) and ``+`` edges; ``#``
+    terminals are collected at every reached state (the reference's
+    ``'match_#'`` at match_node/3 :161-186);
+  - in **wide** mode an edge consumes up to ``take`` words per hop;
+    the skipped chain words ride inline in the edge row and are
+    compared exactly against the topic's word window — parity never
+    rests on a hash value;
   - topics whose first word starts with ``$`` suppress root-level
     wildcards (emqx_trie.erl:162-163);
   - results are the matched filter ids ``[B, M]`` (-1 padded) plus a
-    per-topic overflow flag. Overflowed topics (active set > K or
-    matches > M or levels > L) must be re-matched on the host oracle —
-    parity is preserved by fallback, never silently truncated.
+    per-topic overflow flag. Overflowed topics (active set > K,
+    matches > M, levels > L, or a walk that needed more hops than the
+    compiled scan — possible only after deep patches) must be
+    re-matched on the host oracle: parity is preserved by fallback,
+    never silently truncated.
 
 All shapes are static; there is no data-dependent control flow, so XLA
-tiles and fuses the walk. ``vmap`` supplies the batch dimension.
+tiles and fuses the walk. ``vmap`` supplies the batch dimension. Row
+widths (8 ints narrow / 64 ints wide) sit on the TPU's fast gather
+paths — see the layout rationale in :mod:`emqx_tpu.ops.csr`.
 """
 
 from __future__ import annotations
 
 import functools
-import math
-import os
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from emqx_tpu.ops.csr import Automaton
+from emqx_tpu.ops.csr import (CW_PAD, NARROW_SLOT, WIDE_SLOT, Automaton,
+                              hash_mix)
+
+#: bits of the packed lane word reserved for the carried level
+#: (wide mode): packed = state * 32 + level, level ≤ 31
+_LVL_BITS = 5
+_LVL_MASK = (1 << _LVL_BITS) - 1
 
 
 class MatchResult(NamedTuple):
@@ -42,102 +54,25 @@ class MatchResult(NamedTuple):
     overflow: jax.Array  # bool[B] — host-oracle fallback required
 
 
-def _edge_lookup(auto: Automaton, iters: int, state: jax.Array, word: jax.Array) -> jax.Array:
-    """Child state via binary search in the state's CSR row, -1 if none.
+def walk_params(host_auto: Automaton, lb: int) -> dict:
+    """Static kernel parameters for a batch sliced to ``lb`` levels.
 
-    ``state`` may be -1 (inactive); ``word`` may be negative
-    (UNKNOWN/PAD) — both yield -1.
-    """
-    e_cap = auto.edge_word.shape[0]
-    s = jnp.maximum(state, 0)
-    lo = auto.row_ptr[s]
-    hi = auto.row_ptr[s + 1]
-    row_end = hi
-
-    def body(_, lh):
-        lo, hi = lh
-        mid = jnp.minimum((lo + hi) // 2, e_cap - 1)
-        pred = lo < hi
-        less = auto.edge_word[mid] < word
-        new_lo = jnp.where(pred & less, mid + 1, lo)
-        new_hi = jnp.where(pred & ~less, mid, hi)
-        return new_lo, new_hi
-
-    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
-    idx = jnp.minimum(lo, e_cap - 1)
-    found = (state >= 0) & (word >= 0) & (lo < row_end) & (auto.edge_word[idx] == word)
-    return jnp.where(found, auto.edge_child[idx], -1)
-
-
-def _edge_lookup_hash(auto: Automaton, states: jax.Array, word: jax.Array) -> jax.Array:
-    """Child states for the whole active set via the bucketed 2-choice
-    hash table — vs ~2·log2(E) scalar gathers for the CSR binary
-    search. With the packed mirror present each choice is ONE
-    [K, 12]-row gather of (state|word|child) triples (TPU gather cost
-    is per row, nearly independent of width — measured flat to width
-    ≥24); otherwise two 4-wide gathers per table.
-
-    ``states`` is the active set [K] (-1 = inactive); ``word`` a scalar
-    (may be UNKNOWN/PAD < 0). Returns [K] child ids, -1 = no edge.
-    """
-    from emqx_tpu.ops.csr import hash_mix
-
-    packed = auto.ht_packed is not None
-    nb = (auto.ht_packed if packed else auto.ht_state).shape[0]
-    seed = auto.ht_seed[0]
-    h1, h2 = hash_mix(states, jnp.broadcast_to(word, states.shape), seed)
-    b1 = (h1 & jnp.uint32(nb - 1)).astype(jnp.int32)
-    b2 = (h2 & jnp.uint32(nb - 1)).astype(jnp.int32)
-
-    def probe(b):
-        if packed:
-            row = auto.ht_packed[b]    # [K, 12]
-            rs, rw, rc = row[:, 0:4], row[:, 4:8], row[:, 8:12]
-        else:
-            rs, rw, rc = (auto.ht_state[b], auto.ht_word[b],
-                          auto.ht_child[b])
-        hit = (rs == states[:, None]) & (rw == word)
-        return jnp.max(jnp.where(hit, rc, -1), axis=1)
-
-    child = jnp.maximum(probe(b1), probe(b2))
-    live = (states >= 0) & (word >= 0)
-    return jnp.where(live, child, -1)
-
-
-# Active-set compaction strategy, read once at import. The scatter
-# path (cumsum + drop-mode scatter) measured ~60% faster than the
-# bitonic sort on v5e for the per-level compaction; EMQX_COMPACT=sort
-# keeps the sort variant selectable for A/B on other hardware.
-_COMPACT_SCATTER = os.environ.get("EMQX_COMPACT", "scatter") == "scatter"
-
-
-def _compact(cands: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
-    """Compact candidate states [2K] (-1 invalid) into [K]; overflow if >K.
-
-    Trie children are unique (each node has one parent), so no dedup is
-    needed — compaction is pure packing.
-    """
-    valid = cands >= 0
-    count = jnp.sum(valid)
-    if _COMPACT_SCATTER:
-        pos = jnp.cumsum(valid) - 1
-        packed = jnp.full((k,), -1, dtype=cands.dtype).at[
-            jnp.where(valid, pos, k)].set(cands, mode="drop")
-    else:
-        # Descending sort packs valid states to the front; -1s sink.
-        packed = -jnp.sort(-cands)[:k]
-    return packed, count > k
+    Read from the HOST automaton (``wt_slots``/``wt_take`` are python
+    ints; ``hops_for_level`` a host array) — never through jit."""
+    hl = host_auto.hops_for_level
+    steps = int(hl[min(lb, len(hl) - 1)])
+    return {"steps": steps, "slots": int(host_auto.wt_slots),
+            "take": int(host_auto.wt_take)}
 
 
 def depth_bucket(word_ids, n_words, min_levels: int = 2):
     """Slice the level axis to exactly the batch's deepest topic.
 
-    The scan runs L+1 steps whether or not any topic uses them
-    (static shapes), so every padded level is pure waste — 9 steps
-    instead of 6 for 5-level traffic costs ~45% extra walk. Exact
-    depths give at most ``max_levels`` jit variants (≤16), all
-    persistent-cache friendly; that beats paying pow2 padding on
-    every batch forever.
+    The scan's step count derives from the automaton's hop depth AND
+    the batch's deepest topic (walk_params), so every padded level is
+    pure waste. Exact depths give at most ``max_levels`` jit variants
+    (≤16), all persistent-cache friendly; that beats paying pow2
+    padding on every batch forever.
 
     Call with host (numpy) arrays, before device transfer. Topics
     flagged too-deep (n_words < 0) stay on the overflow path.
@@ -150,77 +85,177 @@ def depth_bucket(word_ids, n_words, min_levels: int = 2):
     return word_ids[:, :lb], n_words
 
 
-@functools.partial(jax.jit, static_argnames=("k", "m"))
+def _compact(cands: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Compact candidate lanes [2K] (-1 invalid) into [K]; overflow if
+    more than K valid. Trie children are unique (each node has one
+    parent), so no dedup is needed — compaction is pure packing. The
+    cumsum+drop-scatter measured ~60% faster than a bitonic sort on
+    v5e."""
+    valid = cands >= 0
+    count = jnp.sum(valid)
+    pos = jnp.cumsum(valid) - 1
+    packed = jnp.full((k,), -1, dtype=cands.dtype).at[
+        jnp.where(valid, pos, k)].set(cands, mode="drop")
+    return packed, count > k
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "m", "steps", "slots", "take"))
 def match_batch(
     auto: Automaton,
     word_ids: jax.Array,   # int32[B, L]
     n_words: jax.Array,    # int32[B] (-1 = too many levels → overflow)
     sys_mask: jax.Array,   # bool[B]
     *,
-    k: int = 64,
-    m: int = 128,
+    k: int = 16,
+    m: int = 64,
+    steps: int | None = None,
+    slots: int = 2,
+    take: int = 1,
 ) -> MatchResult:
-    """Match a publish batch against the automaton. See module doc."""
+    """Match a publish batch against the walk tables. See module doc.
+
+    ``steps``/``slots``/``take`` are the static kernel parameters from
+    :func:`walk_params` (defaults suit narrow tables and a full-depth
+    walk)."""
     L = word_ids.shape[1]
-    iters = max(1, math.ceil(math.log2(auto.edge_word.shape[0] + 1)))
+    if steps is None:
+        steps = L + 1
+    wide = take > 1
+    if wide and L > _LVL_MASK:
+        # the packed lane word carries the level in _LVL_BITS bits;
+        # deeper batches must use narrow tables (compress_automaton
+        # never emits wide ones for them)
+        raise ValueError(
+            f"wide walk supports at most {_LVL_MASK} levels, got {L}")
+    sw = WIDE_SLOT if wide else NARROW_SLOT
+    nb = auto.wt.shape[0]
+    seed = auto.wt_seed[0]
 
     def one(words: jax.Array, n: jax.Array, is_sys: jax.Array):
-        active0 = jnp.full((k,), -1, dtype=jnp.int32).at[0].set(0)
-        # Pad the level axis: step L sees PAD words only (end-of-topic).
-        words_ext = jnp.concatenate([words, jnp.full((1,), -2, dtype=jnp.int32)])
+        if wide:
+            # word windows: win_mat[l] = words padded beyond the topic
+            # [l : l+take] — the probe key word + the chain-compare
+            # window (static shifts; one row gather per lane per hop)
+            wp = jnp.concatenate(
+                [words, jnp.full((take,), -2, dtype=jnp.int32)])
+            win_mat = jnp.stack(
+                [wp[l:l + take] for l in range(L)])  # [L, take]
+        # narrow: level == step for every lane; word comes from xs
+        words_ext = jnp.concatenate(
+            [words, jnp.full((1,), -2, dtype=jnp.int32)])[:steps]
 
-        def step(carry, xs):
+        def probe_narrow(state, word, b):
+            row = auto.wt[b].reshape((k, slots, NARROW_SLOT))
+            hit = (row[..., 0] == state[:, None]) & (
+                row[..., 1] == word[:, None])
+            return jnp.max(jnp.where(hit, row[..., 2], -1), axis=1)
+
+        def probe_wide(state, lvl, win, b):
+            row = auto.wt[b].reshape((k, slots, WIDE_SLOT))
+            stake = row[..., 2]
+            hit = (row[..., 0] == state[:, None]) & (
+                row[..., 1] == win[:, None, 0])
+            # exact chain verify: every consumed word beyond the first
+            # must equal the inline chain word
+            for i in range(take - 1):
+                hit &= (stake <= i + 1) | (
+                    row[..., 4 + i] == win[:, None, 1 + i])
+            hit &= lvl[:, None] + stake <= n
+            child = jnp.max(jnp.where(hit, row[..., 3], -1), axis=1)
+            adv = jnp.max(jnp.where(hit, stake, 0), axis=1)
+            return child, adv
+
+        def step_fn(carry, xs):
             active, ovf = carry
-            word, l = xs
-            alive = active >= 0
-            at_root_sys = (l == 0) & is_sys
-            walking = l < n
-            ending = l == n
-
-            if auto.node_packed is not None:
-                # one [K, 4]-row gather: plus | hash_filter | end_filter
-                node = auto.node_packed[jnp.maximum(active, 0)]
-                plus_col = node[:, 0]
-                hashf_col = node[:, 1]
-                endf_col = node[:, 2]
+            if wide:
+                state = jnp.where(active >= 0,
+                                  active >> _LVL_BITS, -1)
+                lvl = active & _LVL_MASK
             else:
-                plus_col = auto.plus_child[jnp.maximum(active, 0)]
-                hashf_col = auto.hash_filter[jnp.maximum(active, 0)]
-                endf_col = auto.end_filter[jnp.maximum(active, 0)]
-
-            # '#'-child terminals at every live level (match_# semantics)
-            emit_h = jnp.where(
-                alive & (walking | ending) & ~at_root_sys, hashf_col, -1)
+                state = active
+                word, lvl_s = xs
+            alive = state >= 0
+            s_idx = jnp.maximum(state, 0)
+            node = auto.node2[s_idx]          # [K, 4] w4 gather
+            plus_col, hashf_col, endf_col = (
+                node[:, 0], node[:, 1], node[:, 2])
+            if wide:
+                at_root_sys = (active == 0) & is_sys
+                walking = alive & (lvl < n)
+                ending = alive & (lvl == n)
+            else:
+                at_root_sys = (lvl_s == 0) & is_sys & alive
+                walking = alive & (lvl_s < n)
+                ending = alive & (lvl_s == n)
+            # '#'-child terminals at every reached state (match_#),
             # exact terminals at end-of-topic
-            emit_e = jnp.where(alive & ending, endf_col, -1)
+            emit_h = jnp.where(
+                (walking | ending) & ~at_root_sys, hashf_col, -1)
+            emit_e = jnp.where(ending, endf_col, -1)
 
-            if auto.ht_packed is not None or auto.ht_state is not None:
-                lit = _edge_lookup_hash(auto, active, word)
+            if wide:
+                win = win_mat[jnp.minimum(lvl, L - 1)]
+                w0 = win[:, 0]
             else:
-                lit = jax.vmap(
-                    lambda s: _edge_lookup(auto, iters, s, word))(active)
-            plus = jnp.where(alive & ~at_root_sys, plus_col, -1)
-            cands = jnp.where(walking, jnp.concatenate([lit, plus]), -1)
+                win = None
+                w0 = jnp.broadcast_to(word, state.shape)
+            h1, h2 = hash_mix(state, w0, seed)
+            b1 = (h1 & jnp.uint32(nb - 1)).astype(jnp.int32)
+            b2 = (h2 & jnp.uint32(nb - 1)).astype(jnp.int32)
+            if wide:
+                c1, a1 = probe_wide(state, lvl, win, b1)
+                c2, a2 = probe_wide(state, lvl, win, b2)
+                child = jnp.maximum(c1, c2)
+                adv = jnp.maximum(a1, a2)
+                lit_ok = walking & (w0 >= 0) & (child >= 0)
+                lit = jnp.where(
+                    lit_ok,
+                    (child << _LVL_BITS) | (lvl + adv), -1)
+                plus_ok = walking & ~at_root_sys & (plus_col >= 0)
+                plus = jnp.where(
+                    plus_ok,
+                    (jnp.maximum(plus_col, 0) << _LVL_BITS) | (lvl + 1),
+                    -1)
+            else:
+                lit = jnp.maximum(probe_narrow(state, w0, b1),
+                                  probe_narrow(state, w0, b2))
+                lit = jnp.where(walking & (w0 >= 0), lit, -1)
+                plus = jnp.where(walking & ~at_root_sys, plus_col, -1)
+            cands = jnp.concatenate([lit, plus])
             nxt, over = _compact(cands, k)
             return (nxt, ovf | over), jnp.concatenate([emit_h, emit_e])
 
-        levels = jnp.arange(L + 1, dtype=jnp.int32)
-        (_, ovf), emits = lax.scan(
-            step, (active0, jnp.asarray(False)), (words_ext, levels))
+        active0 = jnp.full((k,), -1, dtype=jnp.int32).at[0].set(0)
+        if wide:
+            xs = None
+        else:
+            xs = (words_ext, jnp.arange(steps, dtype=jnp.int32))
+        (residue, ovf), emits = lax.scan(
+            step_fn, (active0, jnp.asarray(False)), xs, length=steps)
+        # lanes still alive after the last step were produced but
+        # never processed — their emits are missing. With a correct
+        # hop bound this cannot happen; a patch that deepened the
+        # automaton past the compiled bound flags those topics for
+        # the exact host fallback instead of silently missing.
+        if wide:
+            r_lvl = residue & _LVL_MASK
+            ovf = ovf | jnp.any((residue >= 0) & (r_lvl <= n))
+        else:
+            ovf = ovf | jnp.any((residue >= 0) & (steps <= n))
         flat = emits.reshape(-1)
         valid = flat >= 0
         cnt = jnp.sum(valid)
-        # final emit-packing: cumsum + drop-mode scatter into the m
-        # output slots (same packing as _compact; the old descending
-        # sort re-measured ~L·K·log² slower once timings forced true
-        # device completion)
+        # emit-packing: cumsum + drop-mode scatter into the m output
+        # slots (same packing as _compact)
         pos = jnp.cumsum(valid) - 1
         ids = jnp.full((m,), -1, dtype=flat.dtype).at[
             jnp.where(valid, pos, m)].set(flat, mode="drop")
         too_long = n < 0
         return MatchResult(
             ids=jnp.where(too_long, -1, ids),
-            count=jnp.where(too_long, 0, jnp.minimum(cnt, m)).astype(jnp.int32),
+            count=jnp.where(too_long, 0,
+                            jnp.minimum(cnt, m)).astype(jnp.int32),
             overflow=ovf | (cnt > m) | too_long,
         )
 

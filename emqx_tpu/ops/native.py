@@ -226,9 +226,12 @@ class NativeEngine:
     # -- flatten ----------------------------------------------------------
 
     def flatten(self, state_capacity: Optional[int] = None,
-                edge_capacity: Optional[int] = None):
-        from emqx_tpu.ops.csr import (Automaton, attach_edge_hash,
-                                      capacity_for)
+                edge_capacity: Optional[int] = None,
+                v2_state_capacity: Optional[int] = None,
+                n_buckets: Optional[int] = None,
+                skip_hash: bool = False):
+        from emqx_tpu.ops.csr import (Automaton, capacity_for,
+                                      finalize_automaton)
 
         S, E = self.counts()
         s_cap = capacity_for(S, state_capacity)
@@ -244,10 +247,15 @@ class NativeEngine:
             plus_child, hash_filter, end_filter)
         if n_states < 0:
             raise RuntimeError("flatten capacity underestimated")
-        return attach_edge_hash(Automaton(
+        auto = Automaton(
             row_ptr=row_ptr, edge_word=edge_word, edge_child=edge_child,
             plus_child=plus_child, hash_filter=hash_filter,
-            end_filter=end_filter, n_states=int(n_states), n_edges=E))
+            end_filter=end_filter, n_states=int(n_states), n_edges=E)
+        if skip_hash:
+            return auto
+        return finalize_automaton(auto,
+                                  state_capacity=v2_state_capacity,
+                                  n_buckets=n_buckets)
 
     # -- batch encode -----------------------------------------------------
 
@@ -335,26 +343,25 @@ class ShardedNativeEngine:
     # -- sharded flatten --------------------------------------------------
 
     def flatten_sharded(self, state_capacity: Optional[int] = None,
-                        edge_capacity: Optional[int] = None):
-        """All shards flattened at COMMON capacities and stacked —
-        the native analogue of ``parallel.sharded.build_sharded(...,
-        return_parts=True)``: returns ``(ShardedAutomaton, parts)``
-        where ``parts`` are the padded per-shard host Automatons that
-        seed the per-shard AutoPatcher mirrors."""
-        from emqx_tpu.ops.csr import (Automaton, attach_edge_hash,
-                                      buckets_for_capacity, capacity_for)
-        from emqx_tpu.parallel.sharded import _stack_sharded
+                        n_buckets: Optional[int] = None):
+        """All shards flattened, compressed at COMMON shapes and
+        stacked — the native analogue of
+        ``parallel.sharded.build_sharded(..., return_parts=True)``:
+        returns ``(ShardedAutomaton, parts)`` where ``parts`` are the
+        per-shard host Automatons that seed the per-shard AutoPatcher
+        mirrors."""
+        from emqx_tpu.ops.csr import Automaton, capacity_for
+        from emqx_tpu.parallel.sharded import (_stack_sharded,
+                                               finalize_parts)
 
         counts = []
         for t in self._tries:
             s, e = C.c_int64(), C.c_int64()
             self._lib.trie_counts(t, C.byref(s), C.byref(e))
             counts.append((s.value, e.value))
-        s_cap = capacity_for(max(s for s, _ in counts), state_capacity)
-        e_cap = capacity_for(max(e for _, e in counts) + 1,
-                             edge_capacity)
-        nb = buckets_for_capacity(e_cap)
-        parts = []
+        s_cap = capacity_for(max(s for s, _ in counts))
+        e_cap = capacity_for(max(e for _, e in counts) + 1)
+        autos = []
         for t, (_, n_e) in zip(self._tries, counts):
             row_ptr = np.empty((s_cap + 1,), dtype=np.int32)
             edge_word = np.empty((e_cap,), dtype=np.int32)
@@ -367,12 +374,13 @@ class ShardedNativeEngine:
                 plus_child, hash_filter, end_filter)
             if n_states < 0:
                 raise RuntimeError("flatten capacity underestimated")
-            parts.append(attach_edge_hash(Automaton(
+            autos.append(Automaton(
                 row_ptr=row_ptr, edge_word=edge_word,
                 edge_child=edge_child, plus_child=plus_child,
                 hash_filter=hash_filter, end_filter=end_filter,
-                n_states=int(n_states), n_edges=int(n_e)),
-                n_buckets=nb))
+                n_states=int(n_states), n_edges=int(n_e)))
+        parts = finalize_parts(autos, state_capacity=state_capacity,
+                               n_buckets=n_buckets)
         return _stack_sharded(parts), parts
 
 

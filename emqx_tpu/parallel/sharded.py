@@ -42,20 +42,16 @@ from emqx_tpu.ops.tokenize import WordTable
 
 
 class ShardedAutomaton(NamedTuple):
-    """T stacked automatons; leading axis is the trie-shard axis."""
+    """T stacked walk tables; leading axis is the trie-shard axis.
 
-    row_ptr: jax.Array      # [T, S_cap+1]
-    edge_word: jax.Array    # [T, E_cap]
-    edge_child: jax.Array   # [T, E_cap]
-    plus_child: jax.Array   # [T, S_cap]
-    hash_filter: jax.Array  # [T, S_cap]
-    end_filter: jax.Array   # [T, S_cap]
-    ht_state: jax.Array     # [T, NB, 4] — shared bucket count NB
-    ht_word: jax.Array      # [T, NB, 4]
-    ht_child: jax.Array     # [T, NB, 4]
-    ht_seed: jax.Array      # [T, 1]
-    ht_packed: jax.Array    # [T, NB, 12]
-    node_packed: jax.Array  # [T, S_cap, 4]
+    Only the fields the compiled walk reads are stacked (the CSR
+    flatten artifacts stay host-side with the per-shard patchers).
+    All shards share the bucket count, state capacity, slot layout
+    and step bound — the shard_map program is one compiled walk."""
+
+    wt: jax.Array        # int32[T, NB, slots*SW]
+    wt_seed: jax.Array   # uint32[T, 1]
+    node2: jax.Array     # int32[T, S2_cap, 4]
 
 
 class ShardedFanout(NamedTuple):
@@ -121,85 +117,102 @@ def shard_filters(filters: Sequence[str], n_shards: int) -> List[List[str]]:
     return shards
 
 
+def finalize_parts(
+    autos: Sequence[Automaton],
+    state_capacity: int | None = None,
+    n_buckets: int | None = None,
+) -> List[Automaton]:
+    """Compress + pack a list of per-shard flattened automatons with
+    SHARED shapes (state capacity, bucket count, slot layout, step
+    bound): the stacked shard_map program is one compiled walk, so
+    every shard must agree on every static. Mode is voted — if any
+    shard's trie is deep enough to want wide rows, all shards use
+    them (wide is correct for shallow tries, just wider gathers)."""
+    from emqx_tpu.ops.csr import (attach_walk_tables,
+                                  buckets_for_capacity, capacity_for,
+                                  compress_automaton)
+
+    comp = [compress_automaton(a) for a in autos]
+    if len({c[0].wt_slots for c in comp}) > 1:
+        comp = [compress_automaton(a, force_mode="wide") for a in autos]
+    s2_cap = max(c[0].node2.shape[0] for c in comp)
+    if state_capacity is not None:
+        s2_cap = max(s2_cap, state_capacity)
+    e2_cap = capacity_for(max(len(c[1].src) for c in comp) + 1)
+    slots = comp[0][0].wt_slots
+    nb = buckets_for_capacity(e2_cap, slots)
+    if n_buckets is not None:
+        nb = max(nb, n_buckets)
+    # one merged step bound: the stacked walk runs every shard for the
+    # max hop depth (per-shard patchers keep accounting on the merged
+    # array so a deep patch on one shard grows the shared bound)
+    hlen = max(len(c[0].hops_for_level) for c in comp)
+    merged = np.zeros(hlen, np.int32)
+    for a, _ in comp:
+        hl = a.hops_for_level
+        ext = np.concatenate(
+            [hl, np.minimum(int(hl[-1]) + np.arange(1, hlen - len(hl) + 1),
+                            np.arange(len(hl), hlen) + 1)]) \
+            if len(hl) < hlen else hl
+        merged = np.maximum(merged, ext.astype(np.int32))
+    parts = []
+    for a, edges in comp:
+        a = _pad_v2(a, s2_cap)
+        a = a._replace(hops_for_level=merged.copy())
+        parts.append(attach_walk_tables(a, edges, n_buckets=nb))
+    return parts
+
+
+def _pad_v2(a: Automaton, s2_cap: int) -> Automaton:
+    """Grow the v2 state-indexed arrays to a shared capacity."""
+    def pad2(arr, fill):
+        if arr.shape[0] == s2_cap:
+            return arr
+        out = np.full((s2_cap,) + arr.shape[1:], fill, dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    return a._replace(node2=pad2(a.node2, -1),
+                      v2_hop=pad2(a.v2_hop, -1),
+                      v2_depth=pad2(a.v2_depth, -1))
+
+
 def build_sharded(
     filter_shards: Sequence[Sequence[str]],
     filter_ids: Dict[str, int],
     table: WordTable,
     state_capacity: int | None = None,
-    edge_capacity: int | None = None,
+    n_buckets: int | None = None,
     return_parts: bool = False,
 ) -> ShardedAutomaton:
-    """Build one automaton per shard (global filter ids), pad to the
-    max capacity, and stack.
+    """Build one automaton per shard (global filter ids), compress
+    with shared shapes, and stack.
 
-    ``state_capacity``/``edge_capacity`` are retention floors (the
-    router passes its previous caps so rebuilds keep device shapes —
-    and jit specializations — stable). ``return_parts=True`` also
-    returns the padded per-shard HOST automatons: they seed the
-    per-shard :class:`~emqx_tpu.ops.patch.AutoPatcher` mirrors."""
-    from emqx_tpu.ops.csr import attach_edge_hash, buckets_for_capacity
-
+    ``state_capacity``/``n_buckets`` are retention floors (the router
+    passes its previous caps so rebuilds keep device shapes — and jit
+    specializations — stable). ``return_parts=True`` also returns the
+    per-shard HOST automatons: they seed the per-shard
+    :class:`~emqx_tpu.ops.patch.AutoPatcher` mirrors."""
     autos = []
     for shard in filter_shards:
         trie = TrieOracle()
         for f in shard:
             trie.insert(f)
-        autos.append(build_automaton(trie, filter_ids, table, skip_hash=True))
-    s_cap = max(a.row_ptr.shape[0] - 1 for a in autos)
-    e_cap = max(a.edge_word.shape[0] for a in autos)
-    if state_capacity is not None:
-        s_cap = max(s_cap, state_capacity)
-    if edge_capacity is not None:
-        e_cap = max(e_cap, edge_capacity)
-    nb = buckets_for_capacity(e_cap)
-    padded = [
-        attach_edge_hash(_pad_automaton(a, s_cap, e_cap), n_buckets=nb)
-        for a in autos
-    ]
-    stacked = _stack_sharded(padded)
+        autos.append(build_automaton(trie, filter_ids, table,
+                                     skip_hash=True))
+    parts = finalize_parts(autos, state_capacity=state_capacity,
+                           n_buckets=n_buckets)
+    stacked = _stack_sharded(parts)
     if return_parts:
-        return stacked, padded
+        return stacked, parts
     return stacked
 
 
-def _stack_sharded(padded: Sequence[Automaton]) -> ShardedAutomaton:
+def _stack_sharded(parts: Sequence[Automaton]) -> ShardedAutomaton:
     return ShardedAutomaton(
-        row_ptr=np.stack([a.row_ptr for a in padded]),
-        edge_word=np.stack([a.edge_word for a in padded]),
-        edge_child=np.stack([a.edge_child for a in padded]),
-        plus_child=np.stack([a.plus_child for a in padded]),
-        hash_filter=np.stack([a.hash_filter for a in padded]),
-        end_filter=np.stack([a.end_filter for a in padded]),
-        ht_state=np.stack([a.ht_state for a in padded]),
-        ht_word=np.stack([a.ht_word for a in padded]),
-        ht_child=np.stack([a.ht_child for a in padded]),
-        ht_seed=np.stack([a.ht_seed for a in padded]),
-        ht_packed=np.stack([a.ht_packed for a in padded]),
-        node_packed=np.stack([a.node_packed for a in padded]),
-    )
-
-
-def _pad_automaton(a: Automaton, s_cap: int, e_cap: int) -> Automaton:
-    """Grow a built automaton's arrays to shared capacities (padded
-    rows are empty; padded edges are out-of-range sentinels)."""
-    from emqx_tpu.ops.csr import _WORD_PAD
-
-    def pad(arr, n, fill):
-        if arr.shape[0] == n:
-            return arr
-        out = np.full((n,), fill, dtype=arr.dtype)
-        out[: arr.shape[0]] = arr
-        return out
-
-    return Automaton(
-        row_ptr=pad(a.row_ptr, s_cap + 1, a.n_edges),
-        edge_word=pad(a.edge_word, e_cap, _WORD_PAD),
-        edge_child=pad(a.edge_child, e_cap, -1),
-        plus_child=pad(a.plus_child, s_cap, -1),
-        hash_filter=pad(a.hash_filter, s_cap, -1),
-        end_filter=pad(a.end_filter, s_cap, -1),
-        n_states=a.n_states,
-        n_edges=a.n_edges,
+        wt=np.stack([a.wt for a in parts]),
+        wt_seed=np.stack([a.wt_seed for a in parts]),
+        node2=np.stack([a.node2 for a in parts]),
     )
 
 
@@ -242,9 +255,21 @@ def place_batch(mesh: Mesh, word_ids, n_words, sys_mask):
             jax.device_put(sys_mask, spec))
 
 
+def _local_auto(auto_t: ShardedAutomaton) -> Automaton:
+    """This shard's walkable Automaton view inside shard_map (the
+    leading shard axis is length 1 locally)."""
+    return Automaton(
+        row_ptr=None, edge_word=None, edge_child=None,
+        plus_child=None, hash_filter=None, end_filter=None,
+        n_states=0, n_edges=0,
+        wt=auto_t.wt[0], wt_seed=auto_t.wt_seed[0],
+        node2=auto_t.node2[0])
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "k", "m", "d", "mb", "with_fanout"))
+    static_argnames=("mesh", "k", "m", "d", "mb", "with_fanout",
+                     "steps", "slots", "take"))
 def publish_step(
     mesh: Mesh,
     auto: ShardedAutomaton,
@@ -259,6 +284,9 @@ def publish_step(
     d: int = 128,
     mb: int = 16,
     with_fanout: bool = True,
+    steps: int | None = None,
+    slots: int = 2,
+    take: int = 1,
 ):
     """The full multi-chip publish step.
 
@@ -298,15 +326,9 @@ def publish_step(
     use_dma = jax.default_backend() in ("tpu", "axon")
 
     def local(auto_t, fan_t, ids, n, sysm, bmt_t=None):
-        a = Automaton(
-            row_ptr=auto_t.row_ptr[0], edge_word=auto_t.edge_word[0],
-            edge_child=auto_t.edge_child[0], plus_child=auto_t.plus_child[0],
-            hash_filter=auto_t.hash_filter[0], end_filter=auto_t.end_filter[0],
-            n_states=0, n_edges=0, ht_state=auto_t.ht_state[0],
-            ht_word=auto_t.ht_word[0], ht_child=auto_t.ht_child[0],
-            ht_seed=auto_t.ht_seed[0], ht_packed=auto_t.ht_packed[0],
-            node_packed=auto_t.node_packed[0])
-        res = match_batch(a, ids, n, sysm, k=k, m=m)
+        a = _local_auto(auto_t)
+        res = match_batch(a, ids, n, sysm, k=k, m=m, steps=steps,
+                          slots=slots, take=take)
         if with_fanout:
             f = FanoutTable(
                 fan_t.row_ptr[0], fan_t.sub_ids[0], 0, 0,
@@ -376,7 +398,8 @@ def publish_step(
     )(*args)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "k", "m"))
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "m", "steps",
+                                             "slots", "take"))
 def shared_pick_step(
     mesh: Mesh,
     auto: ShardedAutomaton,
@@ -388,6 +411,9 @@ def shared_pick_step(
     *,
     k: int = 16,
     m: int = 32,
+    steps: int | None = None,
+    slots: int = 2,
+    take: int = 1,
 ):
     """Multi-chip $share dispatch: match + the device hash-strategy
     member pick (src/emqx_shared_sub.erl:229-275) in one collective
@@ -403,15 +429,9 @@ def shared_pick_step(
     from emqx_tpu.ops.fanout import pick_shared
 
     def local(auto_t, gfan_t, ids, n, sysm, s):
-        a = Automaton(
-            row_ptr=auto_t.row_ptr[0], edge_word=auto_t.edge_word[0],
-            edge_child=auto_t.edge_child[0], plus_child=auto_t.plus_child[0],
-            hash_filter=auto_t.hash_filter[0], end_filter=auto_t.end_filter[0],
-            n_states=0, n_edges=0, ht_state=auto_t.ht_state[0],
-            ht_word=auto_t.ht_word[0], ht_child=auto_t.ht_child[0],
-            ht_seed=auto_t.ht_seed[0], ht_packed=auto_t.ht_packed[0],
-            node_packed=auto_t.node_packed[0])
-        res = match_batch(a, ids, n, sysm, k=k, m=m)
+        a = _local_auto(auto_t)
+        res = match_batch(a, ids, n, sysm, k=k, m=m, steps=steps,
+                          slots=slots, take=take)
         f = FanoutTable(
             gfan_t.row_ptr[0], gfan_t.sub_ids[0], 0, 0,
             row_pairs=(None if gfan_t.row_pairs is None

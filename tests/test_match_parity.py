@@ -10,12 +10,13 @@ import pytest
 
 from emqx_tpu import topic as T
 from emqx_tpu.oracle import TrieOracle
-from emqx_tpu.ops.csr import build_automaton
-from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.csr import (attach_walk_tables, build_automaton,
+                              compress_automaton)
+from emqx_tpu.ops.match import match_batch, walk_params
 from emqx_tpu.ops.tokenize import WordTable, encode_batch
 
 
-def _build(filters):
+def _build(filters, mode=None):
     trie = TrieOracle()
     table = WordTable()
     fids = {}
@@ -24,19 +25,25 @@ def _build(filters):
         fids[f] = len(fids)
         for w in T.words(f):
             table.intern(w)
-    auto = build_automaton(trie, fids, table)
+    if mode is None:
+        auto = build_automaton(trie, fids, table)
+    else:  # pin the kernel layout (both must hold exact parity)
+        raw = build_automaton(trie, fids, table, skip_hash=True)
+        auto, edges = compress_automaton(raw, force_mode=mode)
+        auto = attach_walk_tables(auto, edges)
     inv = {v: k for k, v in fids.items()}
     return trie, table, auto, inv
 
 
 def _match_device(auto, table, topics, L=16, k=64, m=128):
     ids, n, sysm = encode_batch(table, topics, L)
-    res = match_batch(auto, ids, n, sysm, k=k, m=m)
+    res = match_batch(auto, ids, n, sysm, k=k, m=m,
+                      **walk_params(auto, ids.shape[1]))
     return res
 
 
-def _check_parity(filters, topics, L=16, k=64, m=128):
-    trie, table, auto, inv = _build(filters)
+def _check_parity(filters, topics, L=16, k=64, m=128, mode=None):
+    trie, table, auto, inv = _build(filters, mode=mode)
     res = _match_device(auto, table, topics, L=L, k=k, m=m)
     ids = np.asarray(res.ids)
     cnt = np.asarray(res.count)
@@ -94,7 +101,8 @@ def test_match_after_delete_rebuild():
     trie.delete("a/b")
     fids = {"a/+": 0, "b/#": 2}
     auto2 = build_automaton(trie, fids, table)
-    res = match_batch(auto2, *encode_batch(table, ["a/b"], 16), k=16, m=16)
+    res = match_batch(auto2, *encode_batch(table, ["a/b"], 16), k=16,
+                      m=16, **walk_params(auto2, 16))
     got = [j for j in np.asarray(res.ids)[0] if j >= 0]
     assert got == [0]
 
@@ -117,16 +125,38 @@ def _random_filter(rng, maxlen=6):
     return "/".join(ws)
 
 
-def test_random_parity():
+@pytest.mark.parametrize("mode", [None, "narrow", "wide"])
+def test_random_parity(mode):
     rng = random.Random(123)
     filters = list({_random_filter(rng) for _ in range(400)})
     topics = list({
         "/".join(_random_word(rng) for _ in range(rng.randint(1, 7)))
         for _ in range(300)
     })
-    ovf = _check_parity(filters, topics, L=8, k=128, m=256)
+    ovf = _check_parity(filters, topics, L=8, k=128, m=256, mode=mode)
     # with K=128 on a 400-filter trie nothing should overflow
     assert not ovf.any()
+
+
+@pytest.mark.parametrize("mode", ["narrow", "wide"])
+def test_deep_chain_parity(mode):
+    """Long single-child literal chains — the hash_1m_deep shape the
+    compression pass exists for (reference cost model:
+    src/emqx_trie.erl:161-186). Both kernel layouts must agree with
+    the oracle exactly, including topics that end mid-chain."""
+    rng = random.Random(77)
+    vocab = [f"v{i}" for i in range(9)]
+    filters = set()
+    while len(filters) < 300:
+        depth = rng.randint(1, 16)
+        ws = [rng.choice(vocab) for _ in range(depth)]
+        filters.add("/".join(ws[: rng.randint(1, depth)] + ["#"]))
+    filters = sorted(filters)
+    topics = ["/".join(rng.choice(vocab)
+                       for _ in range(rng.randint(1, 16)))
+              for _ in range(500)]
+    ovf = _check_parity(filters, topics, L=16, k=4, m=128, mode=mode)
+    assert not ovf.any()  # no '+' edges: active set is 1 lane
 
 
 def test_overflow_flagged_not_silent():

@@ -50,7 +50,7 @@ def test_sharded_match_parity(n_data, n_trie):
 
     mesh = make_mesh(n_data, n_trie)
     shards = shard_filters(filters, n_trie)
-    auto = build_sharded(shards, fids, table)
+    auto, parts = build_sharded(shards, fids, table, return_parts=True)
     rows = [{fids[f]: [fids[f] * 10, fids[f] * 10 + 1] for f in shard}
             for shard in shards]
     fan = build_sharded_fanout(rows, len(filters))
@@ -65,8 +65,11 @@ def test_sharded_match_parity(n_data, n_trie):
     fan_d = place_sharded(mesh, fan)
     b = place_batch(mesh, ids_np, n_np, sys_np)
 
+    from emqx_tpu.ops.match import walk_params
+
     ids, subs, src, _bm, ovf, movf, stats = publish_step(
-        mesh, auto_d, fan_d, *b, k=32, m=32, d=64)
+        mesh, auto_d, fan_d, *b, k=32, m=32, d=64,
+        **walk_params(parts[0], 8))
     assert _bm is None
     assert not np.asarray(movf).any()
     ids = np.asarray(ids)
@@ -381,10 +384,14 @@ def test_sharded_shared_pick_parity():
         oracle.insert(f)
         for w in f.split("/"):
             table.intern(w)
+    from emqx_tpu.ops.match import walk_params
+
     for n_data, n_trie in [(4, 2), (2, 4)]:
         mesh = make_mesh(n_data, n_trie)
         shards = shard_filters(filters, n_trie)
-        auto = build_sharded(shards, fids, table)
+        auto, parts = build_sharded(shards, fids, table,
+                                    return_parts=True)
+        wp = walk_params(parts[0], 8)
         members = {f: [fids[f] * 100 + j
                        for j in range(rng.randint(1, 5))]
                    for f in filters}
@@ -405,7 +412,7 @@ def test_sharded_shared_pick_parity():
             seeds, jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec("data")))
         picks, mids, ovf = shared_pick_step(
-            mesh, auto_d, gfan_d, *b, seeds_d, k=16, m=16)
+            mesh, auto_d, gfan_d, *b, seeds_d, k=16, m=16, **wp)
         picks, mids = np.asarray(picks), np.asarray(mids)
         assert not np.asarray(ovf).any()
         for i, t in enumerate(topics):
