@@ -23,12 +23,15 @@ Design:
     inbound handling onto the loop captured at ``serve()`` time and
     only falls back to inline execution in loop-less (sync test)
     processes.
-  - **Frames.** 4-byte big-endian length + pickle of
-    ``(kind, req_id, payload)``. Pickle is acceptable here for the
-    same reason Erlang term transfer is: a cluster link is a trusted,
-    cookie-gated channel between co-versioned peers (the reference
-    gates distribution with the Erlang cookie). The listener rejects
-    peers whose hello does not carry the shared cookie.
+  - **Frames.** 4-byte big-endian length + a DATA-ONLY payload
+    (:mod:`emqx_tpu.wire`) of ``(kind, req_id, payload)``. The
+    reference ships Erlang *terms* — pure data — over its
+    cookie-gated distribution; round 4 shipped pickle here, which is
+    a materially different contract (unpickling executes
+    sender-chosen constructors: one compromised peer = RCE on every
+    node). The wire codec decodes only a fixed value vocabulary; the
+    cookie gate remains, but is now an access control, not the last
+    line of defense.
   - **Per-peer connection cache** with lazy (re)connect, mirroring
     gen_rpc's per-key client sockets.
 """
@@ -37,11 +40,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import pickle
 import struct
 import threading
 from typing import Dict, Optional, Tuple
 
+from emqx_tpu import wire
 from emqx_tpu.cluster import Transport
 
 log = logging.getLogger("emqx_tpu.cluster_net")
@@ -52,7 +55,7 @@ _HELLO, _CAST, _CALL, _REPLY, _ERR = "hello", "cast", "call", "reply", "err"
 
 
 async def _send_frame(writer: asyncio.StreamWriter, obj) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = wire.dumps(obj)
     writer.write(_LEN.pack(len(data)) + data)
     await writer.drain()
 
@@ -62,7 +65,13 @@ async def _recv_frame(reader: asyncio.StreamReader):
     (n,) = _LEN.unpack(head)
     if n > _MAX_FRAME:
         raise ConnectionError(f"cluster frame too large: {n}")
-    return pickle.loads(await reader.readexactly(n))
+    try:
+        return wire.loads(await reader.readexactly(n))
+    except wire.WireError as e:
+        # malformed/hostile frame: drop the link (the peer handler's
+        # ConnectionError path), never anything worse — decode is
+        # data-only by construction
+        raise ConnectionError(f"bad cluster frame: {e}") from e
 
 
 class SocketTransport(Transport):
@@ -261,8 +270,7 @@ class SocketTransport(Transport):
             raise ConnectionError(f"unknown node: {node}")
         if self._closing:
             return  # fire-and-forget: a cast racing shutdown drops
-        data = pickle.dumps((_CAST, 0, (op, args)),
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        data = wire.dumps((_CAST, 0, (op, args)))
         with self._cast_lock:
             buf = self._cast_buf.setdefault(addr, bytearray())
             if len(buf) >= self._CAST_BUF_MAX:
@@ -370,7 +378,12 @@ class SocketTransport(Transport):
                     try:
                         writer.write(pending)
                         await writer.drain()
-                    except (ConnectionError, OSError, EOFError):
+                    except BaseException:
+                        # includes CancelledError: the shutdown
+                        # drain's wait_for cancels mid-write, and the
+                        # claimed frames must go back or the
+                        # best-effort drain silently loses them
+                        # (ADVICE r4 item 4)
                         self._requeue_cast_buf(addr, pending)
                         raise
                 return True

@@ -110,6 +110,74 @@ class Session:
 
     stats = info
 
+    # -- wire transfer (cross-node takeover) ------------------------------
+
+    def to_wire(self) -> dict:
+        """Pure-data snapshot for the cluster wire (emqx_tpu.wire) —
+        every value is a scalar, container, Message or SubOpts; no
+        live references (broker/notify are connection-local and the
+        takeover path severs them anyway)."""
+        return {
+            "client_id": self.client_id,
+            "clean_start": self.clean_start,
+            "created_at": self.created_at,
+            "subscriptions": dict(self.subscriptions),
+            "max_subscriptions": self.max_subscriptions,
+            "upgrade_qos": self.upgrade_qos,
+            "max_inflight": self.inflight.max_size,
+            "inflight": self.inflight.to_list(),
+            "next_pkt_id": self.next_pkt_id,
+            "retry_interval": self.retry_interval,
+            "awaiting_rel": dict(self.awaiting_rel),
+            "max_awaiting_rel": self.max_awaiting_rel,
+            "await_rel_timeout": self.await_rel_timeout,
+            "expiry_interval": self.expiry_interval,
+            "outbox": list(self.outbox),
+            "mq_max_len": self.mqueue.max_len,
+            "mq_store_qos0": self.mqueue.store_qos0,
+            "mq_priorities": self.mqueue.p_table,
+            "mq_default_p": self.mqueue.default_p,
+            "mq_dropped": self.mqueue.dropped,
+            # per-priority FIFO order preserved
+            "mq_items": [(p, list(q))
+                         for p, q in self.mqueue._q._qs.items()],
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Session":
+        """Rebuild a session from :meth:`to_wire` data. The result is
+        detached (no broker, not connected) — ``resume()`` attaches
+        it on the taking-over node."""
+        s = cls(
+            client_id=d["client_id"],
+            clean_start=bool(d["clean_start"]),
+            max_subscriptions=int(d["max_subscriptions"]),
+            max_inflight=int(d["max_inflight"]),
+            max_mqueue_len=int(d["mq_max_len"]),
+            mqueue_store_qos0=bool(d["mq_store_qos0"]),
+            mqueue_priorities=d["mq_priorities"],
+            mqueue_default_priority=d["mq_default_p"],
+            upgrade_qos=bool(d["upgrade_qos"]),
+            retry_interval=d["retry_interval"],
+            max_awaiting_rel=int(d["max_awaiting_rel"]),
+            await_rel_timeout=d["await_rel_timeout"],
+            expiry_interval=d["expiry_interval"],
+        )
+        s.created_at = d["created_at"]
+        s.subscriptions = dict(d["subscriptions"])
+        for pid, val in d["inflight"]:
+            s.inflight.insert(pid, val)
+        s.next_pkt_id = int(d["next_pkt_id"])
+        s.awaiting_rel = dict(d["awaiting_rel"])
+        s.outbox = list(d["outbox"])
+        s.mqueue.dropped = int(d["mq_dropped"])
+        for prio, items in d["mq_items"]:
+            for msg in items:
+                s.mqueue._q.push(msg, prio)
+                s.mqueue._len += 1
+        s.connected = False
+        return s
+
     # -- SUBSCRIBE / UNSUBSCRIBE ------------------------------------------
 
     def subscribe(self, topic_filter: str,

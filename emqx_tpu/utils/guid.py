@@ -22,10 +22,13 @@ def new_guid() -> int:
     """A 128-bit int: ts_us(64) | node+pid entropy(32) | seq(32).
 
     Monotonic per generator: the timestamp is read and clamped UNDER
-    the lock (the reference advances from the last ts the same way,
-    src/emqx_guid.erl ts handling) — a wall-clock step backwards
-    holds the last timestamp rather than emitting a smaller id, and
-    no interleaving can pair an older ts with a newer seq."""
+    the lock — a wall-clock step backwards holds the last timestamp
+    rather than emitting a smaller id, and no interleaving can pair
+    an older ts with a newer seq. This clamp deliberately STRENGTHENS
+    the reference (src/emqx_guid.erl takes a fresh erlang:system_time
+    per call with no last-ts guard, so its ids are only
+    timestamp-ordered while the clock is): same layout and ordering
+    intent, stronger guarantee under clock steps."""
     global _seq, _last_ts
     with _lock:
         ts = int(time.time() * 1_000_000)

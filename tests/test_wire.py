@@ -1,0 +1,130 @@
+"""The data-only cluster wire codec (emqx_tpu.wire): round-trips,
+session transfer, and the security property the round-4 pickle wire
+lacked — a hostile frame cannot execute code, it can only fail to
+decode (reference analogue: Erlang term transfer is data, not code).
+"""
+
+import math
+import pickle
+
+import pytest
+
+from emqx_tpu import wire
+from emqx_tpu.session import PUBREL_MARKER, Session
+from emqx_tpu.types import Message, SubOpts
+
+
+def rt(x):
+    return wire.loads(wire.dumps(x))
+
+
+def test_scalar_roundtrip():
+    for v in (None, True, False, 0, -1, 7, 1 << 40, -(1 << 62),
+              (1 << 80), 0.5, -2.25, float("inf"), "", "topic/a",
+              "ünïcode/…", b"", b"\x00\xff payload", "pubrel"):
+        got = rt(v)
+        assert got == v and type(got) is type(v), v
+    assert math.isnan(rt(float("nan")))
+
+
+def test_container_roundtrip():
+    v = {"a": [1, (2, 3), {"x": b"y"}],
+         7: ("mixed", None, [set([1, 2]), frozenset(["z"])]),
+         None: {1.5: "prio", float("inf"): "top"}}
+    got = rt(v)
+    assert got == v
+    # tuple/list distinction survives (handle_rpc unpacks positionally)
+    assert isinstance(got["a"][1], tuple)
+    assert isinstance(got[7][2][0], set)
+    assert isinstance(got[7][2][1], frozenset)
+
+
+def test_message_roundtrip():
+    m = Message(topic="a/b", payload=b"\x01\x02", qos=1, from_="c1",
+                flags={"retain": True},
+                headers={"properties": {"Message-Expiry-Interval": 9},
+                         "peerhost": "1.2.3.4"})
+    got = rt(m)
+    assert isinstance(got, Message)
+    assert (got.topic, got.payload, got.qos, got.from_) == \
+        ("a/b", b"\x01\x02", 1, "c1")
+    assert got.flags == m.flags and got.headers == m.headers
+    assert got.id == m.id and got.timestamp == m.timestamp
+
+
+def test_subopts_roundtrip():
+    o = SubOpts(qos=2, nl=1, rap=1, rh=2, share="g1", subid="s9")
+    got = rt(o)
+    assert isinstance(got, SubOpts) and got == o
+
+
+def test_session_roundtrip():
+    s = Session("c-wire", clean_start=False, max_inflight=8,
+                max_mqueue_len=50, mqueue_store_qos0=True,
+                mqueue_priorities={"hot/t": 5}, expiry_interval=120.0)
+    s.subscriptions = {"a/+": SubOpts(qos=1),
+                       "b/#": SubOpts(qos=2, share="g")}
+    s.inflight.insert(3, (Message(topic="a/x", qos=1), 123.0))
+    s.inflight.insert(5, (PUBREL_MARKER, 124.0))
+    s.awaiting_rel = {9: 125.0}
+    s.next_pkt_id = 77
+    s.mqueue.push(Message(topic="hot/t", qos=1, payload=b"p1"))
+    s.mqueue.push(Message(topic="cold/t", qos=1, payload=b"p2"))
+    s.outbox.append((None, Message(topic="o/t", qos=0)))
+    s.outbox.append((PUBREL_MARKER, 5))
+
+    got = rt(s)
+    assert isinstance(got, Session)
+    assert got.client_id == "c-wire" and not got.connected
+    assert got.broker is None and got.notify is None
+    assert set(got.subscriptions) == {"a/+", "b/#"}
+    assert got.subscriptions["b/#"].share == "g"
+    assert got.next_pkt_id == 77
+    assert got.inflight.lookup(5) == (PUBREL_MARKER, 124.0)
+    m3 = got.inflight.lookup(3)
+    assert isinstance(m3[0], Message) and m3[0].topic == "a/x"
+    assert got.awaiting_rel == {9: 125.0}
+    assert len(got.mqueue) == 2
+    first = got.mqueue.pop()
+    assert first.topic == "hot/t"  # priority order preserved
+    assert got.outbox[1] == (PUBREL_MARKER, 5)
+
+
+def test_unencodable_raises_at_sender():
+    class Evil:
+        pass
+
+    with pytest.raises(wire.WireError):
+        wire.dumps(Evil())
+    with pytest.raises(wire.WireError):
+        wire.dumps(lambda: 1)  # callables never cross the wire
+
+
+def test_malicious_frame_cannot_execute_code(tmp_path):
+    """A pickle bomb (the round-4 wire's RCE vector) fed to the new
+    decoder must raise, not execute. The payload, if unpickled, would
+    create a file — assert it does not exist after decode fails."""
+    marker = tmp_path / "pwned"
+
+    class Bomb:
+        def __reduce__(self):
+            import os
+            return (os.system, (f"touch {marker}",))
+
+    payload = pickle.dumps(Bomb())
+    with pytest.raises(wire.WireError):
+        wire.loads(payload)
+    assert not marker.exists()
+    # malformed-but-valid-JSON shapes fail cleanly too
+    for bad in (b"{\"a\": 1}", b"[\"Z\", 1]", b"[\"M\", []]",
+                b"[\"t\"]", b"\xff\xfe", b"[[1,2],3]"):
+        with pytest.raises(wire.WireError):
+            wire.loads(bad)
+
+
+def test_frame_decoder_never_builds_objects_from_names():
+    """Defense-in-depth probe: frames naming importable paths decode
+    to plain strings (or fail), never to live objects."""
+    got = wire.loads(wire.dumps(("os.system", "builtins.eval")))
+    assert got == ("os.system", "builtins.eval")
+    assert all(isinstance(x, str) for x in got)
