@@ -23,7 +23,6 @@ from typing import Optional
 from emqx_tpu.channel import Channel
 from emqx_tpu.gc import GcPolicy
 from emqx_tpu.limiter import TokenBucket
-from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.frame import FrameError, FrameTooLarge, Parser, serialize
 from emqx_tpu.mqtt.packet import Publish
 from emqx_tpu.zone import Zone, get_zone

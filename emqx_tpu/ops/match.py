@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from emqx_tpu.ops.csr import (CW_PAD, NARROW_SLOT, WIDE_SLOT, Automaton,
+from emqx_tpu.ops.csr import (NARROW_SLOT, WIDE_SLOT, Automaton,
                               hash_mix)
 
 #: bits of the packed lane word reserved for the carried level

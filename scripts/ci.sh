@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# The repo's one-command gate (VERDICT r4 item 7). The reference
+# gates with dialyzer/xref/elvis + suites in CI
+# (/root/reference/rebar.config:27-34, .github/workflows); this image
+# has no ruff/mypy/coverage and installs are off-limits, so the gate
+# is stdlib-built:
+#
+#   1. byte-compile everything            (syntax)
+#   2. scripts/lint.py                    (AST lint, must be clean)
+#   3. pytest                             (full suite, CPU mesh)
+#   4. scripts/cov.py over the suite      (line coverage report;
+#      COV=0 skips — it roughly doubles suite wall time)
+#
+# Exits nonzero on any violation.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== byte-compile =="
+python -m compileall -q emqx_tpu tests scripts bench.py __graft_entry__.py
+
+echo "== lint (scripts/lint.py) =="
+python scripts/lint.py
+
+echo "== pytest =="
+if [[ "${COV:-1}" == "0" ]]; then
+    python -m pytest tests -q
+else
+    echo "(measuring line coverage; COV=0 to skip)"
+    python scripts/cov.py --filter emqx_tpu --out COVERAGE.txt -- \
+        -m pytest tests -q
+    tail -1 COVERAGE.txt
+fi
+
+echo "CI gate: OK"

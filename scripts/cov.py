@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Stdlib line coverage via ``sys.monitoring`` (PEP 669).
+
+The reference tracks suite coverage through rebar3's cover tool
+(/root/reference/rebar.config:32-34, Makefile:96-98); this image has
+no coverage.py and installs are off-limits, so the gate measures with
+the same low-overhead mechanism coverage.py ≥7.4 uses: a LINE event
+callback that returns ``sys.monitoring.DISABLE`` after the first hit
+of each line, making steady-state cost ~zero.
+
+Usage:
+    python scripts/cov.py [--filter emqx_tpu/] -- -m pytest tests -q
+
+Executable-line baseline per file comes from compiling the source and
+walking nested code objects' ``co_lines()``. Report: per-file and
+total percent; exit status follows the wrapped command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from collections import defaultdict
+
+TOOL = 2  # sys.monitoring tool id (coverage.py uses 3)
+
+
+def executable_lines(path: str) -> set[int]:
+    try:
+        with open(path, "rb") as f:
+            code = compile(f.read(), path, "exec")
+    except (SyntaxError, OSError):
+        return set()
+    lines: set[int] = set()
+    stack = [code]
+    while stack:
+        co = stack.pop()
+        for start, _end, line in co.co_lines():
+            if line is not None and line > 0:
+                lines.add(line)
+        for const in co.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+    return lines
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filter", default="emqx_tpu",
+                    help="path prefix (relative to cwd) to measure")
+    ap.add_argument("--out", default=None,
+                    help="write the report here as well as stdout")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- -m module args  |  -- script.py args")
+    args = ap.parse_args()
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given")
+
+    root = os.path.abspath(args.filter)
+    hits: dict[str, set[int]] = defaultdict(set)
+
+    mon = sys.monitoring
+    mon.use_tool_id(TOOL, "emqx-cov")
+
+    def on_line(code, line):
+        fn = code.co_filename
+        if fn.startswith(root):
+            hits[fn].add(line)
+            return None  # keep events on: other lines of this code
+        return mon.DISABLE  # foreign file: never fire again here
+
+    mon.register_callback(TOOL, mon.events.LINE, on_line)
+    mon.set_events(TOOL, mon.events.LINE)
+
+    status = 0
+    try:
+        if cmd[0] == "-m":
+            # emulate `python -m`: cwd on sys.path (pytest's
+            # `from tests.helpers import …` imports depend on it)
+            sys.path.insert(0, os.getcwd())
+            sys.argv = cmd[1:]
+            runpy.run_module(cmd[1], run_name="__main__",
+                             alter_sys=True)
+        else:
+            sys.argv = cmd
+            runpy.run_path(cmd[0], run_name="__main__")
+    except SystemExit as e:
+        status = int(e.code or 0) if not isinstance(e.code, str) else 1
+    finally:
+        mon.set_events(TOOL, 0)
+        mon.free_tool_id(TOOL)
+
+    rows = []
+    tot_exec = tot_hit = 0
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            ex = executable_lines(path)
+            if not ex:
+                continue
+            hit = len(hits.get(path, set()) & ex)
+            rows.append((os.path.relpath(path), hit, len(ex)))
+            tot_exec += len(ex)
+            tot_hit += hit
+    lines_out = []
+    for path, hit, ex in sorted(rows):
+        lines_out.append(f"{path:55s} {hit:5d}/{ex:<5d} "
+                         f"{100.0 * hit / ex:5.1f}%")
+    pct = 100.0 * tot_hit / max(tot_exec, 1)
+    lines_out.append(f"{'TOTAL':55s} {tot_hit:5d}/{tot_exec:<5d} "
+                     f"{pct:5.1f}%")
+    report = "\n".join(lines_out)
+    print(report)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report + "\n")
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,7 @@ emqtt plays in the reference's CT suites (rebar.config:40-45)."""
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, List, Optional, Tuple
+from typing import Optional
 
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.frame import Parser, serialize

@@ -3,7 +3,6 @@ through the product Broker (the emqx_broker_helper analogue;
 reference behavior: src/emqx_broker_helper.erl:55,63-100 and the shard
 dispatch src/emqx_broker.erl:283-309)."""
 
-import numpy as np
 
 from emqx_tpu.broker import Broker
 from emqx_tpu.broker_helper import FanoutManager, SubRegistry, unpack_sids

@@ -13,7 +13,6 @@ code-review pass flagged as easy to regress:
   sheds instead of growing without bound.
 """
 
-import asyncio
 import socket
 import struct
 import threading

@@ -2,7 +2,6 @@
 device-state snapshot of the CSR automaton + route log, rebuildable
 either way)."""
 
-import numpy as np
 import pytest
 
 from emqx_tpu import checkpoint

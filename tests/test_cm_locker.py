@@ -12,7 +12,6 @@ import threading
 import time
 
 from emqx_tpu.cluster import Cluster, LocalTransport
-from emqx_tpu.cm_locker import ClusterLocker
 from emqx_tpu.node import Node
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -3,7 +3,6 @@
 metadata/formatter (emqx_logger_SUITE), host/runtime introspection
 (emqx_vm_SUITE)."""
 
-import asyncio
 import logging
 
 from emqx_tpu import logger as L

@@ -9,7 +9,7 @@ from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.frame import (
     FrameError, FrameTooLarge, Parser, serialize)
 from emqx_tpu.mqtt.packet import (
-    Auth, Connack, Connect, Disconnect, PubAck, Publish, Pingreq,
+    Auth, Connect, Disconnect, PubAck, Publish, Pingreq,
     Pingresp, Suback, Subscribe, Unsuback, Unsubscribe, check,
     to_message, will_msg, PacketError)
 

@@ -3,12 +3,10 @@ full broker node — the reference's emqx_client_SUITE /
 mqtt_protocol_v5_SUITE tier (SURVEY §4 tier 4)."""
 
 import asyncio
-import contextlib
 
 import pytest
 
 from emqx_tpu.mqtt import constants as C
-from emqx_tpu.node import Node
 from emqx_tpu.types import Message
 from tests.helpers import broker_node, node_port as _port
 from tests.mqtt_client import TestClient

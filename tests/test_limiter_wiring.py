@@ -9,7 +9,6 @@ These tests pin the zone knobs `quota_conn_messages` and
 QoS0, and measurable wire backpressure.
 """
 
-import asyncio
 import time
 
 

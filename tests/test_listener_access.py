@@ -4,7 +4,6 @@ etc/emqx.conf): ordered allow/deny access rules
 TLS-cert-derived usernames (peer_cert_as_username)."""
 
 import asyncio
-import ssl
 
 import pytest
 

@@ -12,7 +12,7 @@ from emqx_tpu.acl_cache import AclCache
 from emqx_tpu.banned import Banned
 from emqx_tpu.flapping import Flapping, FlappingConfig
 from emqx_tpu.hooks import Hooks, STOP
-from emqx_tpu.modules.acl_file import AclFileModule, DEFAULT_RULES
+from emqx_tpu.modules.acl_file import AclFileModule
 from emqx_tpu.modules.delayed import DelayedModule
 from emqx_tpu.modules.presence import PresenceModule
 from emqx_tpu.modules.rewrite import RewriteModule

@@ -8,7 +8,6 @@ import pytest
 from emqx_tpu import topic as T
 from emqx_tpu.oracle import TrieOracle
 from emqx_tpu.ops import native
-from emqx_tpu.ops.csr import build_automaton
 from emqx_tpu.ops.match import match_batch
 from emqx_tpu.ops.tokenize import WordTable, encode_batch
 

@@ -14,8 +14,7 @@ import random
 
 import pytest
 
-from emqx_tpu.inflight import Inflight, KeyExists
-from emqx_tpu.mqtt import constants as C
+from emqx_tpu.inflight import Inflight
 from emqx_tpu.mqtt import reason_codes as RC
 from emqx_tpu.mqueue import MQueue
 from emqx_tpu.pqueue import PQueue

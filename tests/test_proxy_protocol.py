@@ -10,7 +10,6 @@ import pytest
 
 from emqx_tpu.connection import read_proxy_header
 from emqx_tpu.node import Node
-from emqx_tpu.types import Message
 from tests.mqtt_client import TestClient
 
 

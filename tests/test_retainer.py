@@ -11,7 +11,6 @@ import pytest
 from emqx_tpu.modules.retainer import RetainerModule
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.node import Node
-from tests.helpers import broker_node, node_port as _port
 from tests.mqtt_client import TestClient
 
 

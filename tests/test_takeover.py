@@ -7,7 +7,6 @@ import asyncio
 import contextlib
 
 from emqx_tpu.mqtt import constants as C
-from emqx_tpu.node import Node
 from tests.helpers import broker_node, node_port as _port
 from tests.mqtt_client import TestClient
 

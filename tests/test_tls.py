@@ -11,7 +11,6 @@ import ssl
 
 import pytest
 
-from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.packet import Connack, Publish
 from emqx_tpu.node import Node
 from emqx_tpu.tls import TlsOptions, make_client_context, make_server_context

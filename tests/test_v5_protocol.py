@@ -13,7 +13,6 @@ import time
 
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.packet import Disconnect, Publish, Subscribe
-from emqx_tpu.node import Node
 from tests.helpers import broker_node, node_port as _port
 from tests.mqtt_client import TestClient
 
