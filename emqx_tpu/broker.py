@@ -57,6 +57,7 @@ class PendingBatch:
 
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
+        "host_matched", "host_inv",
         "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
@@ -74,6 +75,8 @@ class PendingBatch:
         self.results: List[int] = []
         self.live: List[Tuple[int, Message]] = []
         self.host_topics: Optional[List[str]] = None
+        self.host_matched = None  # host-path lazy match cache
+        self.host_inv = None
         self.inv: Optional[List[int]] = None
         self.n_uniq = 0
         self.st = None
@@ -556,11 +559,42 @@ class Broker:
         if pb.done:
             return pb.results
         if pb.host_topics is not None:
-            self._publish_host(pb, pb.host_topics)
+            self.publish_host_chunk(pb, 0, len(pb.live))
             pb.done = True
             return pb.results
+        self.publish_finish_chunk(pb, 0, len(pb.live))
+        pb.done = True
+        return pb.results
+
+    def publish_host_chunk(self, pb: PendingBatch, start: int,
+                           stop: int) -> None:
+        """Deliver rows ``[start, stop)`` of a deferred HOST-path
+        batch (the streaming form of the host branch — same contract
+        as :meth:`publish_finish_chunk`). The one trie walk over the
+        batch's unique topics happens on the first chunk and is
+        cached on the batch."""
+        if pb.host_matched is None:
+            uniq, pb.host_inv = dedup_topics(pb.host_topics)
+            pb.host_matched = self.router.match_filters(uniq)
+        for row in range(start, stop):
+            i, msg = pb.live[row]
+            filters = pb.host_matched[pb.host_inv[row]]
+            if not filters:
+                self._drop_no_subs(msg)
+                continue
+            pb.results[i] = self._route(filters, msg)
+
+    def publish_finish_chunk(self, pb: PendingBatch, start: int,
+                             stop: int) -> None:
+        """Deliver rows ``[start, stop)`` of a fetched batch — the
+        streaming form of :meth:`publish_finish`: the async ingress
+        yields to the event loop between chunks so early rows'
+        deliveries flush to subscriber sockets while later rows are
+        still routing, instead of the whole batch's tail waiting on
+        the full host loop (round-4 live p99 finding)."""
         m_ptr = pb.m_ptr
-        for row, (i, msg) in enumerate(pb.live):
+        for row in range(start, stop):
+            i, msg = pb.live[row]
             urow = pb.inv[row]  # packed results are per UNIQUE topic
             if pb.ovf[urow]:
                 # match overflow: this topic's result is unknown —
@@ -579,7 +613,6 @@ class Broker:
                 continue
             pb.results[i] = self._route_packed(urow, row_ids, filters,
                                                msg, pb)
-        return pb.results
 
     def _drop_no_subs(self, msg: Message) -> None:
         self.metrics.inc("messages.dropped")

@@ -48,7 +48,8 @@ log = logging.getLogger("emqx_tpu.ingress")
 class IngressBatcher:
     def __init__(self, broker, batch_size: int = 256,
                  linger_ms: float = 0.0, max_inflight: int = 4,
-                 batch_cap: int = 0) -> None:
+                 batch_cap: int = 0, queue_hiwater: int = 0,
+                 finish_chunk: int = 64) -> None:
         self.broker = broker
         self.batch_size = batch_size
         self.linger_ms = linger_ms
@@ -59,11 +60,26 @@ class IngressBatcher:
         # the hot path; the cap keeps steady-state traffic inside a
         # handful of already-compiled buckets
         self.batch_cap = batch_cap or batch_size * 4
+        # accumulator high-water mark: past it, connections PAUSE
+        # their read loops (wait_ready) until a flush drains the
+        # backlog — the reference bounds per-connection ingest with
+        # active_n (src/emqx_connection.erl:99); without a bound, a
+        # saturating publisher turns the accumulator into an
+        # unbounded standing queue and every delivery's tail latency
+        # becomes queue depth (round-4: 627ms p99 at saturation).
+        # Bounding here moves the queue into the publishers' TCP
+        # buffers, where backpressure belongs.
+        self.queue_hiwater = queue_hiwater or batch_size
+        # delivery-tail streaming: yield to the event loop every this
+        # many finished rows so early deliveries flush while later
+        # rows still route
+        self.finish_chunk = max(1, finish_chunk)
         self._pending: List[Tuple[Message, asyncio.Future]] = []
         self._handle = None
         self._inflight = 0
         self._chain: Optional[asyncio.Task] = None  # ordered delivery
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._ready: Optional[asyncio.Event] = None
         # observability (emqx_batch keeps a counter too)
         self.flushes = 0
         self.submitted = 0
@@ -118,7 +134,27 @@ class IngressBatcher:
         if pending:
             self.flushes += 1
             self.max_batch = max(self.max_batch, len(pending))
+        self._signal_ready()
         return pending
+
+    # -- ingest backpressure ----------------------------------------------
+
+    def backlogged(self) -> bool:
+        """Accumulator at/over the high-water mark — connections
+        should pause reading (the active_n analogue)."""
+        return len(self._pending) >= self.queue_hiwater
+
+    async def wait_ready(self) -> None:
+        """Park until a flush takes the backlog below the mark."""
+        while self.backlogged():
+            if self._ready is None or self._ready.is_set():
+                self._ready = asyncio.Event()
+            await self._ready.wait()
+
+    def _signal_ready(self) -> None:
+        if (self._ready is not None and not self._ready.is_set()
+                and not self.backlogged()):
+            self._ready.set()
 
     def _flush(self) -> None:
         # a capped take can leave a backlog: keep flushing chunks
@@ -127,7 +163,11 @@ class IngressBatcher:
             pending = self._take_pending(cap=self.batch_cap)
             # while earlier batches are in flight, a host-path batch
             # must not route (and no batch may resolve) ahead of them
-            # — begin with deferred host routing, chain the completion
+            # — begin with deferred host routing, chain the completion.
+            # (Deferring LARGE host batches unconditionally was tried
+            # and measured strictly worse: the ordered chain then
+            # stretches every batch across interleaved publisher
+            # reads, and probe latency tripled while throughput fell.)
             chain_active = (self._chain is not None
                             and not self._chain.done())
             try:
@@ -160,7 +200,23 @@ class IngressBatcher:
                     await asyncio.shield(prev)
                 except Exception:
                     pass
-            results = self.broker.publish_finish(pb)
+            if pb.done:
+                results = self.broker.publish_finish(pb)
+            else:
+                # stream the delivery tail: finish in chunks (device
+                # packed rows or deferred host routing), yielding
+                # between chunks so finished rows' deliveries flush to
+                # subscriber sockets while later rows still route
+                chunk_fn = (self.broker.publish_host_chunk
+                            if pb.host_topics is not None
+                            else self.broker.publish_finish_chunk)
+                n_rows = len(pb.live)
+                for s in range(0, n_rows, self.finish_chunk):
+                    chunk_fn(pb, s, min(s + self.finish_chunk, n_rows))
+                    if s + self.finish_chunk < n_rows:
+                        await asyncio.sleep(0)
+                pb.done = True
+                results = pb.results
         except Exception as e:
             log.exception("ingress batch completion failed")
             self._resolve_exc(pending, e)

@@ -375,6 +375,11 @@ class AutoPatcher:
     def dirty(self) -> bool:
         return bool(self._col or self._slot)
 
+    @property
+    def queued(self) -> int:
+        """Pending device updates (the router's drain-batch signal)."""
+        return len(self._col) + len(self._slot)
+
     def apply_updates(self, auto: Automaton) -> Automaton:
         """Replay queued host mutations onto the device automaton,
         returning a NEW automaton (old buffers untouched — matchers
@@ -442,8 +447,11 @@ class AutoPatcher:
 
 # drain chunk ladder, largest first: bounded compile count (one
 # specialization per rung), small steady-state pad, few passes for
-# a large idle-accumulated backlog
-_CHUNKS = (32768, 4096, 128)
+# a large idle-accumulated backlog. Floor 512 ≥ the router's
+# patch_drain_batch so a mutator-paid drain is ONE scatter pass —
+# every .at[].set chunk copy-on-writes the full table buffers, so
+# chunk count, not chunk size, is the cost that matters.
+_CHUNKS = (32768, 4096, 512)
 
 
 @jax.jit

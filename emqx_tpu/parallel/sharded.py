@@ -313,19 +313,72 @@ def publish_step(
       recompiles). ``stats`` is a dict of mesh-summed counters
       (matches, deliveries, overflows) — the device metric
       accumulator.
-    """
-    from emqx_tpu.ops.bitmap import (or_bitmaps_dma, or_bitmaps_xla,
-                                     rows_for_matches)
-    from emqx_tpu.ops.bitmap import BitmapTable
 
-    T = mesh.shape["trie"]
+    A 1×1 mesh runs the SAME local computation as a plain jit program
+    (every collective is the identity on one device): shard_map
+    dispatch does not pipeline through this environment's tunnel
+    (~1.6× overlap vs deep plain-jit pipelining — round-4's 9×
+    sharded-row gap), and a single-device mesh has nothing to
+    exchange. The multi-device path is byte-identical modulo the
+    collectives and stays exercised by the 8-device dryrun.
+    """
     with_bitmap = bmt is not None
     # Pallas manual-DMA on real accelerators; the scan fallback on the
     # virtual CPU mesh (interpret-mode Pallas inside shard_map is not
     # supported). Static at trace time.
     use_dma = jax.default_backend() in ("tpu", "axon")
+    single = mesh.shape["data"] == 1 and mesh.shape["trie"] == 1
 
-    def local(auto_t, fan_t, ids, n, sysm, bmt_t=None):
+    class _NullAxes:
+        """Collective ops on a 1-device mesh: identities/local sums."""
+        @staticmethod
+        def ag_tiled(x):
+            return x
+
+        @staticmethod
+        def or_over_trie(union):
+            return union
+
+        @staticmethod
+        def any_over_trie(x):
+            return x
+
+        @staticmethod
+        def sum_over_mesh(x):
+            return x
+
+        @staticmethod
+        def sum_over_data(x):
+            return x
+
+    class _MeshAxes:
+        @staticmethod
+        def ag_tiled(x):
+            return jax.lax.all_gather(x, "trie", axis=1, tiled=True)
+
+        @staticmethod
+        def or_over_trie(union):
+            ug = jax.lax.all_gather(union, "trie")       # [T, b, W]
+            return jax.lax.reduce(
+                ug, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+
+        @staticmethod
+        def any_over_trie(x):
+            return jax.lax.psum(x.astype(jnp.int32), "trie") > 0
+
+        @staticmethod
+        def sum_over_mesh(x):
+            return jax.lax.psum(x, ("data", "trie"))
+
+        @staticmethod
+        def sum_over_data(x):
+            return jax.lax.psum(x, "data")
+
+    def local(auto_t, fan_t, ids, n, sysm, bmt_t=None, C=_MeshAxes):
+        from emqx_tpu.ops.bitmap import (BitmapTable, or_bitmaps_dma,
+                                         or_bitmaps_xla,
+                                         rows_for_matches)
+
         a = _local_auto(auto_t)
         res = match_batch(a, ids, n, sysm, k=k, m=m, steps=steps,
                           slots=slots, take=take)
@@ -343,9 +396,9 @@ def publish_step(
             dovf = jnp.zeros((ids.shape[0],), bool)
         # exchange shard-local matches over ICI: every data shard gets
         # the union of all trie shards' match ids
-        all_ids = jax.lax.all_gather(res.ids, "trie", axis=1, tiled=True)
-        all_subs = jax.lax.all_gather(subs, "trie", axis=1, tiled=True)
-        all_src = jax.lax.all_gather(src, "trie", axis=1, tiled=True)
+        all_ids = C.ag_tiled(res.ids)
+        all_subs = C.ag_tiled(subs)
+        all_src = C.ag_tiled(src)
         bm_out = None
         big_deliv = None
         if with_bitmap:
@@ -355,40 +408,41 @@ def publish_step(
                      else or_bitmaps_xla(bt.bitmaps, rows_b))
             # per-topic union OR-combined over the trie axis (each
             # shard contributes its own big filters' members)
-            ug = jax.lax.all_gather(union, "trie")       # [T, b, W]
-            union = jax.lax.reduce(
-                ug, jnp.uint32(0), jax.lax.bitwise_or, (0,))
-            has_big = jax.lax.psum(
-                (rows_b >= 0).any(axis=1).astype(jnp.int32), "trie") > 0
-            bovf = jax.lax.psum(b_ovf.astype(jnp.int32), "trie") > 0
+            union = C.or_over_trie(union)
+            has_big = C.any_over_trie((rows_b >= 0).any(axis=1))
+            bovf = C.any_over_trie(b_ovf)
             big_deliv = jnp.sum(
                 jax.lax.population_count(union), dtype=jnp.int32)
             bm_out = (union, has_big, bovf)
         # per-row overflow, OR-reduced over the trie axis: one shard
         # overflowing means the row's union is incomplete
-        row_movf = jax.lax.psum(res.overflow.astype(jnp.int32), "trie") > 0
-        row_ovf = row_movf | (
-            jax.lax.psum(dovf.astype(jnp.int32), "trie") > 0)
-        deliv = jax.lax.psum(jnp.sum(dcount), ("data", "trie"))
+        row_movf = C.any_over_trie(res.overflow)
+        row_ovf = row_movf | C.any_over_trie(dovf)
+        deliv = C.sum_over_mesh(jnp.sum(dcount))
         if big_deliv is not None:
             # the OR-reduced union is IDENTICAL on every trie shard —
             # sum it over 'data' only (a trie psum would count each
             # big delivery T times)
-            deliv = deliv + jax.lax.psum(big_deliv, "data")
+            deliv = deliv + C.sum_over_data(big_deliv)
         stats = {
-            "matches": jax.lax.psum(jnp.sum(res.count), ("data", "trie")),
+            "matches": C.sum_over_mesh(jnp.sum(res.count)),
             "deliveries": deliv,
-            "overflows": jax.lax.psum(
-                jnp.sum(res.overflow | dovf), ("data", "trie")),
+            "overflows": C.sum_over_mesh(jnp.sum(res.overflow | dovf)),
         }
         return all_ids, all_subs, all_src, bm_out, row_ovf, row_movf, stats
 
-    in_specs = [P("trie"), P("trie"), P("data"), P("data"), P("data")]
     args = [auto, fan, word_ids, n_words, sys_mask]
+    if with_bitmap:
+        args.append(bmt)
+    if single:
+        out = local(*args, C=_NullAxes)
+        # the 1×1 outputs already carry the T=1 global shapes; cast
+        # the bool reductions to match the mesh path's dtypes
+        return out
+    in_specs = [P("trie"), P("trie"), P("data"), P("data"), P("data")]
     bm_spec = (P("data"), P("data"), P("data")) if with_bitmap else None
     if with_bitmap:
         in_specs.append(P("trie"))
-        args.append(bmt)
     return jax.shard_map(
         local, mesh=mesh,
         in_specs=tuple(in_specs),

@@ -33,8 +33,11 @@ def _rand_filters(rng, n):
     return sorted(out)
 
 
-@pytest.mark.parametrize("n_data,n_trie", [(4, 2), (2, 4), (8, 1)])
+@pytest.mark.parametrize("n_data,n_trie",
+                         [(4, 2), (2, 4), (8, 1), (1, 1)])
 def test_sharded_match_parity(n_data, n_trie):
+    # (1, 1) exercises the plain-jit fast path (no shard_map): its
+    # outputs must be indistinguishable from the collective program's
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     rng = random.Random(0)
