@@ -724,6 +724,9 @@ def shared():
     }), file=sys.stderr, flush=True)
     _emit({
         "metric": "shared_dispatch_throughput",
+        # the round-5 walk rewrite redefines the device program: a
+        # staged pre-rewrite record must not satisfy this mode
+        "workload": "walkv2",
         "value": round(throughput, 1),
         "unit": "msgs/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
@@ -1204,6 +1207,8 @@ def churn():
     print(json.dumps(info), file=sys.stderr, flush=True)
     _emit({
         "metric": "churn_match_p99_ms",
+        # r5: walk rewrite + mutator-side drain batching
+        "workload": "walkv2_drain",
         "value": round(p99_churn, 3),
         "unit": "ms",
         "vs_baseline": round(p99_base / p99_churn, 3)
@@ -1554,6 +1559,9 @@ _MODES = {
 #: data). Modes absent here accept any staged record.
 _MODE_WORKLOADS = {
     "sharded": "deduped_tick_v3_invexp",
+    "shared": "walkv2",
+    "churn": "walkv2_drain",
+    "live": "probe_v1",
 }
 
 

@@ -310,6 +310,8 @@ def live(emit=None) -> None:
     print(json.dumps(info), file=sys.stderr, flush=True)
     rec = {
         "metric": "live_socket_throughput",
+        # r5: ingest backpressure + paced service-latency probe
+        "workload": "probe_v1",
         "value": round(info["deliveries_per_s"], 1),
         "unit": "msgs/sec",
         "vs_baseline": round(info["deliveries_per_s"] / 1_000_000, 3),

@@ -34,7 +34,7 @@ EOF
   then
     echo "$(date -Is) TPU healthy — running bench matrix" >> "$LOG"
     ok=1
-    for mode in "" bigfan shared sharded churn; do
+    for mode in "" bigfan shared sharded churn live; do
       # the default mode is the 8-row configs matrix (up to
       # 8 x BENCH_CFG_TIMEOUT); named modes are single runs
       if [ -z "$mode" ]; then budget=8100; else budget=2400; fi
