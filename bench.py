@@ -797,6 +797,7 @@ def main():
     def make_step(k_):
         def step(ids, n, sysm):
             res = match_batch(auto, ids, n, sysm, k=k_, m=m,
+                              pack_ids=False,
                               **walk_params(host_auto, ids.shape[1]))
             m_ptr, packed = pack_matches(res.ids, pm=PM)
             f_ptr, subs, src, total = expand_packed(fan, m_ptr,
@@ -927,6 +928,7 @@ def latency():
 
     def one_step(ids, n, sysm):
         res = match_batch(auto, ids, n, sysm, k=k, m=m,
+                          pack_ids=False,
                           **walk_params(host_auto, ids.shape[1]))
         m_ptr, packed = pack_matches(res.ids, pm=PM)
         f_ptr, _subs, _src, total = expand_packed(fan_d, m_ptr,

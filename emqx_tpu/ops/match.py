@@ -85,22 +85,66 @@ def depth_bucket(word_ids, n_words, min_levels: int = 2):
     return word_ids[:, :lb], n_words
 
 
+@functools.lru_cache(maxsize=None)
+def _oddeven_network(n: int):
+    """Batcher odd-even mergesort comparator pairs for pow2 ``n``."""
+    pairs = []
+
+    def merge(lo, length, r):
+        step = r * 2
+        if step < length:
+            merge(lo, length, step)
+            merge(lo + r, length, step)
+            for i in range(lo + r, lo + length - r, step):
+                pairs.append((i, i + r))
+        else:
+            pairs.append((lo, lo + r))
+
+    def sort(lo, length):
+        if length > 1:
+            mid = length // 2
+            sort(lo, mid)
+            sort(lo + mid, mid)
+            merge(lo, length, 1)
+
+    sort(0, n)
+    return tuple(pairs)
+
+
 def _compact(cands: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     """Compact candidate lanes [2K] (-1 invalid) into [K]; overflow if
     more than K valid. Trie children are unique (each node has one
-    parent), so no dedup is needed — compaction is pure packing. The
-    cumsum+drop-scatter measured ~60% faster than a bitonic sort on
-    v5e."""
-    valid = cands >= 0
-    count = jnp.sum(valid)
-    pos = jnp.cumsum(valid) - 1
-    packed = jnp.full((k,), -1, dtype=cands.dtype).at[
-        jnp.where(valid, pos, k)].set(cands, mode="drop")
+    parent), so no dedup is needed — compaction is pure packing.
+
+    Small sets sort on a fixed Batcher network: pure elementwise
+    max/min on the VPU (descending — -1 lanes sink), measured well
+    under the cumsum+scatter compact's per-element scatter cost at
+    the 100K-unique batch scale. Wide sets (boosted k) fall back to
+    the scatter (comparator count grows as n·log²n)."""
+    n = cands.shape[0]
+    count = jnp.sum(cands >= 0)
+    if n <= 32:
+        p2 = 1
+        while p2 < n:
+            p2 *= 2
+        lanes = [cands[i] for i in range(n)] + \
+            [jnp.full((), -1, cands.dtype)] * (p2 - n)
+        for a, b in _oddeven_network(p2):
+            hi = jnp.maximum(lanes[a], lanes[b])
+            lo = jnp.minimum(lanes[a], lanes[b])
+            lanes[a], lanes[b] = hi, lo
+        packed = jnp.stack(lanes[:k])
+    else:
+        valid = cands >= 0
+        pos = jnp.cumsum(valid) - 1
+        packed = jnp.full((k,), -1, dtype=cands.dtype).at[
+            jnp.where(valid, pos, k)].set(cands, mode="drop")
     return packed, count > k
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "m", "steps", "slots", "take"))
+                   static_argnames=("k", "m", "steps", "slots", "take",
+                                    "pack_ids"))
 def match_batch(
     auto: Automaton,
     word_ids: jax.Array,   # int32[B, L]
@@ -112,12 +156,22 @@ def match_batch(
     steps: int | None = None,
     slots: int = 2,
     take: int = 1,
+    pack_ids: bool = True,
 ) -> MatchResult:
     """Match a publish batch against the walk tables. See module doc.
 
     ``steps``/``slots``/``take`` are the static kernel parameters from
     :func:`walk_params` (defaults suit narrow tables and a full-depth
-    walk)."""
+    walk).
+
+    ``pack_ids=False`` returns ``ids`` as the RAW emit slots
+    ``[B, steps*2k]`` (-1 holes, unordered) instead of compacting
+    into ``m``: callers that feed :func:`~emqx_tpu.ops.pack
+    .pack_matches` next would pay the per-topic cumsum+scatter twice
+    (~22ms/batch at the 100K-unique scale) — the global pack subsumes
+    it. Keep packing where a consumer's cost scales with the id
+    width (per-slot fan-out gathers: the sharded publish step, the
+    shared-group pick)."""
     L = word_ids.shape[1]
     if steps is None:
         steps = L + 1
@@ -246,17 +300,24 @@ def match_batch(
         flat = emits.reshape(-1)
         valid = flat >= 0
         cnt = jnp.sum(valid)
-        # emit-packing: cumsum + drop-mode scatter into the m output
-        # slots (same packing as _compact)
-        pos = jnp.cumsum(valid) - 1
-        ids = jnp.full((m,), -1, dtype=flat.dtype).at[
-            jnp.where(valid, pos, m)].set(flat, mode="drop")
         too_long = n < 0
+        if pack_ids:
+            # emit-packing: cumsum + drop-mode scatter into the m
+            # output slots (same packing as _compact's fallback)
+            pos = jnp.cumsum(valid) - 1
+            ids = jnp.full((m,), -1, dtype=flat.dtype).at[
+                jnp.where(valid, pos, m)].set(flat, mode="drop")
+            return MatchResult(
+                ids=jnp.where(too_long, -1, ids),
+                count=jnp.where(too_long, 0,
+                                jnp.minimum(cnt, m)).astype(jnp.int32),
+                overflow=ovf | (cnt > m) | too_long,
+            )
+        # raw slots: nothing can truncate, so m never overflows
         return MatchResult(
-            ids=jnp.where(too_long, -1, ids),
-            count=jnp.where(too_long, 0,
-                            jnp.minimum(cnt, m)).astype(jnp.int32),
-            overflow=ovf | (cnt > m) | too_long,
+            ids=jnp.where(too_long, -1, flat),
+            count=jnp.where(too_long, 0, cnt).astype(jnp.int32),
+            overflow=ovf | too_long,
         )
 
     return jax.vmap(one)(word_ids, n_words, sys_mask)

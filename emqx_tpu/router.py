@@ -767,7 +767,7 @@ class Router:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n = depth_bucket(ids, n)
         res = match_batch(auto, ids, n, sysm, k=self.effective_k(),
-                          m=cfg.max_matches,
+                          m=cfg.max_matches, pack_ids=False,
                           **self._walk_kw(ids.shape[1]))
         return res.ids, res.overflow, id_map, epoch
 
