@@ -123,17 +123,25 @@ def parity_campaign() -> None:
     for round_i in range(rounds):
         rng = random.Random(BASE + 7000 + round_i)
         mesh = default_mesh(8) if round_i % 2 else None
-        r = Router(MatcherConfig(mesh=mesh) if mesh
-                   else MatcherConfig())
+        # device_min_filters=8: small rounds must exercise the DEVICE
+        # matcher, not fall back to the host trie (the kernel is the
+        # thing under fuzz). Every third round goes deep + literal-
+        # heavy so the compressed wide walk and the patcher's
+        # mid-chain edge splits are the hot path.
+        cfg = (MatcherConfig(mesh=mesh, device_min_filters=8) if mesh
+               else MatcherConfig(device_min_filters=8))
+        r = Router(cfg)
         oracle = TrieOracle()
+        deep = round_i % 3 == 2
+        maxd = 14 if deep else 6
         words = ([f"w{i}" for i in range(rng.randint(4, 30))]
                  + ["$SYS", "$share"])
         live = set()
 
         def rand_filter():
-            depth = rng.randint(1, 6)
+            depth = rng.randint(1, maxd)
             ws = [rng.choice(words) for _ in range(depth)]
-            if rng.random() < 0.3:
+            if rng.random() < (0.1 if deep else 0.3):
                 ws[rng.randrange(depth)] = "+"
             if rng.random() < 0.2:
                 ws = ws[: rng.randint(1, depth)] + ["#"]
@@ -159,7 +167,7 @@ def parity_campaign() -> None:
                 else:
                     try_add(rand_filter())
             topics = ["/".join(rng.choice(words)
-                               for _ in range(rng.randint(1, 6)))
+                               for _ in range(rng.randint(1, maxd)))
                       for _ in range(64)]
             for t, g in zip(topics, r.match_filters(topics)):
                 expect = sorted(oracle.match(t))

@@ -34,6 +34,22 @@ EOF
   then
     echo "$(date -Is) TPU healthy — running bench matrix" >> "$LOG"
     ok=1
+    # device-contract smoke first (overflow fallback, boost_k, wide
+    # walk, deep-patch visibility asserted on the REAL chip →
+    # TPU_SMOKE.json); skip once the artifact is from an accelerator
+    if ! python - <<'EOF' >> "$LOG" 2>&1
+import json, sys
+try:
+    rec = json.load(open("TPU_SMOKE.json"))
+    ok = rec.get("ok") and "CPU" not in rec.get("device", "CPU")
+except Exception:
+    ok = False
+raise SystemExit(0 if ok else 1)
+EOF
+    then
+      echo "$(date -Is) running tpu_smoke" >> "$LOG"
+      timeout 900 python scripts/tpu_smoke.py >> "$LOG" 2>&1 || ok=0
+    fi
     for mode in "" bigfan shared sharded churn live; do
       # the default mode is the 8-row configs matrix (up to
       # 8 x BENCH_CFG_TIMEOUT); named modes are single runs
