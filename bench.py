@@ -788,36 +788,60 @@ def main():
     batches = [jax.device_put(b) for b in host_batches]
 
     # the PRODUCT pipeline: match → pack → fused sparse expansion
-    # (broker.publish_begin runs exactly this); budgets sized off the
-    # batch like the broker's learned buckets
+    # (broker.publish_begin runs exactly this); budgets start sized
+    # off the batch and then SHRINK to the warmup's observed totals —
+    # the broker's learned buckets work the same way (grow on
+    # overflow, so steady state runs the fitting bucket). The packed
+    # buffers' cummax/gather costs scale with the BUDGET, not the
+    # actual traffic, so a worst-case budget taxes every batch.
     bucket_rows = max(b[0].shape[0] for b in batches)
     PM = budget_for(bucket_rows, max(8, k))
     Q = budget_for(bucket_rows, int(os.environ.get("BENCH_PACKQ", "16")))
 
-    def make_step(k_):
+    def make_step(k_, pm_, q_):
         def step(ids, n, sysm):
             res = match_batch(auto, ids, n, sysm, k=k_, m=m,
                               pack_ids=False,
                               **walk_params(host_auto, ids.shape[1]))
-            m_ptr, packed = pack_matches(res.ids, pm=PM)
+            m_ptr, packed = pack_matches(res.ids, pm=pm_)
             f_ptr, subs, src, total = expand_packed(fan, m_ptr,
-                                                    packed, q=Q)
+                                                    packed, q=q_)
             return res.count, f_ptr, res.overflow, total, m_ptr[-1]
         return step
 
-    step = make_step(k)
+    step = make_step(k, PM, Q)
     ovf_w = uniq_w = 0
+    tot_m = tot_q = 0
     for b_, u in zip(batches, uniques):  # one compile per shape
         out = step(*b_)
         jax.block_until_ready(out)
         ovf_w += int(np.asarray(out[2])[:u].sum())
         uniq_w += u
+        tot_m = max(tot_m, int(np.asarray(out[4])))
+        tot_q = max(tot_q, int(np.asarray(out[3])))
     if k_env is None and ovf_w * 8 > uniq_w:
         # the product's boost_k response to the same >1/8 signal:
         # grow once and re-warm (overflowed rows would otherwise be
         # host-resolved — exact, but not what steady state runs)
         k = k * 2
-        step = make_step(k)
+        step = make_step(k, PM, Q)
+        tot_m = tot_q = 0
+        for b_ in batches:
+            out = step(*b_)
+            jax.block_until_ready(out)
+            tot_m = max(tot_m, int(np.asarray(out[4])))
+            tot_q = max(tot_q, int(np.asarray(out[3])))
+    # shrink to fitting buckets (1.3x headroom; overflow accounting
+    # below still flags any batch that outgrows them)
+    fit_m = budget_for(1, 1, floor=64)
+    while fit_m < tot_m * 1.3:
+        fit_m *= 2
+    fit_q = budget_for(1, 1, floor=64)
+    while fit_q < tot_q * 1.3:
+        fit_q *= 2
+    if fit_m < PM or fit_q < Q:
+        PM, Q = min(PM, fit_m), min(Q, fit_q)
+        step = make_step(k, PM, Q)
         for b_ in batches:
             jax.block_until_ready(step(*b_))
 
