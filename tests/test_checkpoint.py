@@ -159,3 +159,48 @@ def test_restore_remaps_saved_node_name(tmp_path):
     # the shared route's node remaps too; its group is untouched
     dests = {rt.dest for rt in r2.lookup_routes("a/+")}
     assert ("g1", "n2") in dests and "renamed" in dests and "n1" not in dests
+
+
+def test_v1_format_degrades_to_route_log(tmp_path):
+    """A pre-walk-rewrite (format 1) snapshot must RESTORE via the
+    route log instead of rejecting — the tables were always just an
+    optimization (checkpoint.py docstring contract)."""
+    import json
+
+    import numpy as np
+
+    r1 = _mk()
+    _fill(r1)
+    path = str(tmp_path / "old.npz")
+    checkpoint.save(r1, path)
+    # rewrite the snapshot as a format-1 file with v1-era table keys
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["meta"]).decode())
+        routes = data["routes"]
+    meta["format"] = 1
+    meta["has_tables"] = True
+    np.savez(
+        str(tmp_path / "v1.npz"),
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        routes=routes,
+        ht_state=np.zeros((4, 4), np.int32),  # v1 relics, ignored
+        plus_child=np.zeros((4,), np.int32))
+    r2 = _mk()
+    out = checkpoint.load(r2, str(tmp_path / "v1.npz"))
+    assert out["routes"] >= 6 and not out["tables_restored"]
+    assert sorted(x.topic for x in r2.match_routes("a/b")) == \
+        sorted(x.topic for x in r1.match_routes("a/b"))
+
+
+def test_unknown_format_rejected(tmp_path):
+    import json
+
+    import numpy as np
+
+    np.savez(str(tmp_path / "future.npz"),
+             meta=np.frombuffer(json.dumps(
+                 {"format": 99, "filter_ids": {}, "vocab": []}).encode(),
+                 dtype=np.uint8),
+             routes=np.frombuffer(b"[]", dtype=np.uint8))
+    with pytest.raises(ValueError):
+        checkpoint.load(_mk(), str(tmp_path / "future.npz"))
