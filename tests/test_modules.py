@@ -475,3 +475,38 @@ async def test_message_acked_hook_fires_on_puback_and_pubrec():
         await sub.close()
     finally:
         await n.stop()
+
+
+async def test_subscription_module_auto_subscribes_on_connect():
+    """emqx_mod_subscription semantics: templated %c/%u auto-subs at
+    CONNECT; unload stops them (reference
+    src/emqx_mod_subscription.erl)."""
+    from emqx_tpu.modules.subscription import SubscriptionModule
+    from tests.helpers import broker_node, node_port
+    from tests.mqtt_client import TestClient
+
+    async with broker_node() as n:
+        mod = n.modules.load(SubscriptionModule, env={
+            "topics": [("client/%c/inbox", 1), ("user/%u/all", 0)]})
+        c = TestClient("auto-c1", username="u9")
+        await c.connect(port=node_port(n))
+        import asyncio
+        await asyncio.sleep(0.1)
+        sess = n.cm.lookup_channel("auto-c1").session
+        assert "client/auto-c1/inbox" in sess.subscriptions
+        assert sess.subscriptions["client/auto-c1/inbox"].qos == 1
+        assert "user/u9/all" in sess.subscriptions
+        # the auto-subscription actually routes
+        p = TestClient("auto-pub")
+        await p.connect(port=node_port(n))
+        await p.publish("client/auto-c1/inbox", b"hi", qos=1)
+        pkt = await c.recv(timeout=10)
+        assert pkt.payload == b"hi"
+        await c.disconnect()
+        n.modules.unload(mod.name)
+        c2 = TestClient("auto-c2")
+        await c2.connect(port=node_port(n))
+        await asyncio.sleep(0.1)
+        assert not n.cm.lookup_channel("auto-c2").session.subscriptions
+        await c2.disconnect()
+        await p.disconnect()
