@@ -24,10 +24,11 @@ def _rows():
     batch = int(os.environ.get("BENCH_BATCH", "131072"))
     out = []
     for name, extra, mode, subs_tpu, _cpu in bench._CONFIG_MATRIX:
-        if mode is not None or not subs_tpu:
-            continue  # only main-mode rows build through the cache
+        if mode not in (None, "latency") or not subs_tpu:
+            continue  # main/latency rows build through the cache
         out.append((
-            name, subs_tpu, batch,
+            name, subs_tpu,
+            int(extra.get("BENCH_BATCH", batch)),
             int(extra.get("BENCH_LEVELS", "5")),
             extra.get("BENCH_MIX", "mixed"),
             extra.get("BENCH_TRAFFIC", "zipf"),
