@@ -170,3 +170,60 @@ def test_base62_roundtrip(n):
     from emqx_tpu.utils.base62 import decode, encode
 
     assert decode(encode(n)) == n
+
+
+@given(filters=st.lists(topic_filter(), min_size=1, max_size=30,
+                        unique=True),
+       topics=st.lists(topic_name, min_size=1, max_size=16),
+       mode=st.sampled_from(["narrow", "wide"]))
+@settings(max_examples=40, deadline=None)
+def test_compressed_walk_matches_oracle(filters, topics, mode):
+    """Both kernel layouts (forced) hold exact oracle parity on
+    arbitrary filter sets — the chain-compression invariant."""
+    import numpy as np
+
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.ops.csr import (attach_walk_tables, build_automaton,
+                                  compress_automaton, device_view)
+    from emqx_tpu.ops.match import match_batch, walk_params
+    from emqx_tpu.ops.tokenize import WordTable, encode_batch
+
+    trie, table, fids = TrieOracle(), WordTable(), {}
+    for f in filters:
+        trie.insert(f)
+        fids[f] = len(fids)
+        for w in T.words(f):
+            table.intern(w)
+    raw = build_automaton(trie, fids, table, skip_hash=True)
+    auto, edges = compress_automaton(raw, force_mode=mode)
+    auto = attach_walk_tables(auto, edges)
+    ids, n, sysm = encode_batch(table, topics, 16)
+    res = match_batch(device_view(auto), ids, n, sysm, k=32,
+                      **walk_params(auto, ids.shape[1]))
+    out = np.asarray(res.ids)
+    ovf = np.asarray(res.overflow)
+    inv = {v: k for k, v in fids.items()}
+    for i, t in enumerate(topics):
+        if ovf[i]:
+            continue  # bounded-capacity contract: host fallback
+        got = sorted(inv[j] for j in out[i] if j >= 0)
+        assert got == sorted(trie.match(t)), (t, mode)
+
+
+@given(data=st.recursive(
+    st.none() | st.booleans()
+    | st.integers(-(1 << 70), 1 << 70)
+    | st.floats(allow_nan=False) | st.text(max_size=30)
+    | st.binary(max_size=30),
+    lambda children: st.lists(children, max_size=4)
+    | st.tuples(children, children)
+    | st.dictionaries(st.text(max_size=8), children, max_size=4),
+    max_leaves=20))
+@settings(max_examples=150, deadline=None)
+def test_wire_codec_roundtrip_property(data):
+    """The cluster wire codec is total over its vocabulary: encode
+    then decode is the identity (types included)."""
+    from emqx_tpu import wire
+
+    got = wire.loads(wire.dumps(data))
+    assert got == data and type(got) is type(data)
