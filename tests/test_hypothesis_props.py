@@ -179,35 +179,11 @@ def test_base62_roundtrip(n):
 @settings(max_examples=40, deadline=None)
 def test_compressed_walk_matches_oracle(filters, topics, mode):
     """Both kernel layouts (forced) hold exact oracle parity on
-    arbitrary filter sets — the chain-compression invariant."""
-    import numpy as np
+    arbitrary filter sets — the chain-compression invariant. Reuses
+    the parity harness (incl. its res.count cross-check)."""
+    from tests.test_match_parity import _check_parity
 
-    from emqx_tpu.oracle import TrieOracle
-    from emqx_tpu.ops.csr import (attach_walk_tables, build_automaton,
-                                  compress_automaton, device_view)
-    from emqx_tpu.ops.match import match_batch, walk_params
-    from emqx_tpu.ops.tokenize import WordTable, encode_batch
-
-    trie, table, fids = TrieOracle(), WordTable(), {}
-    for f in filters:
-        trie.insert(f)
-        fids[f] = len(fids)
-        for w in T.words(f):
-            table.intern(w)
-    raw = build_automaton(trie, fids, table, skip_hash=True)
-    auto, edges = compress_automaton(raw, force_mode=mode)
-    auto = attach_walk_tables(auto, edges)
-    ids, n, sysm = encode_batch(table, topics, 16)
-    res = match_batch(device_view(auto), ids, n, sysm, k=32,
-                      **walk_params(auto, ids.shape[1]))
-    out = np.asarray(res.ids)
-    ovf = np.asarray(res.overflow)
-    inv = {v: k for k, v in fids.items()}
-    for i, t in enumerate(topics):
-        if ovf[i]:
-            continue  # bounded-capacity contract: host fallback
-        got = sorted(inv[j] for j in out[i] if j >= 0)
-        assert got == sorted(trie.match(t)), (t, mode)
+    _check_parity(filters, topics, k=32, mode=mode)
 
 
 @given(data=st.recursive(
@@ -222,8 +198,22 @@ def test_compressed_walk_matches_oracle(filters, topics, mode):
 @settings(max_examples=150, deadline=None)
 def test_wire_codec_roundtrip_property(data):
     """The cluster wire codec is total over its vocabulary: encode
-    then decode is the identity (types included)."""
+    then decode is the identity (types included, recursively —
+    Python equality conflates bool/int/float, so == alone would
+    accept True→1 corruption inside containers)."""
     from emqx_tpu import wire
 
+    def same(a, b):
+        if type(a) is not type(b):
+            return False
+        if isinstance(a, (list, tuple)):
+            return len(a) == len(b) and all(
+                same(x, y) for x, y in zip(a, b))
+        if isinstance(a, dict):
+            return (len(a) == len(b)
+                    and all(k in b and same(v, b[k])
+                            for k, v in a.items()))
+        return a == b or (a != a and b != b)  # NaN-safe
+
     got = wire.loads(wire.dumps(data))
-    assert got == data and type(got) is type(data)
+    assert same(got, data), (got, data)
