@@ -1471,7 +1471,8 @@ def configs():
                                 "build_s", "build_cached", "native",
                                 "unique_kmsgs_per_s",
                                 "avg_deliveries_per_unique", "k",
-                                "overflow_frac"):
+                                "overflow_frac",
+                                "thr_logical_msgs_per_s", "chain"):
                         if fld in inf:
                             row[fld] = inf[fld]
                 except Exception:
