@@ -145,3 +145,119 @@ def test_delete_then_reinsert_same_filter_single_drain():
     rev = {v: k for k, v in fids.items()}
     assert _match_set(out, table, rev, "a/b") == {"a/b"}
     assert _match_set(out, table, rev, "c") == {"c"}
+
+
+def test_wide_mode_split_churn_parity():
+    """Deep-chain (wide-layout) patching: inserts that diverge
+    mid-chain SPLIT compressed edges; deletes tombstone; the patched
+    automaton holds exact oracle parity and the hop bound grows so
+    deepened walks still emit (never silently miss)."""
+    from emqx_tpu.ops.csr import (attach_walk_tables,
+                                  compress_automaton, device_view)
+    from emqx_tpu.ops.match import walk_params
+
+    rng = random.Random(3)
+    vocab = [f"v{i}" for i in range(9)]
+
+    def deep_filter():
+        d = rng.randint(1, 12)
+        ws = [rng.choice(vocab) for _ in range(d)]
+        if rng.random() < 0.25:
+            ws = ws[: rng.randint(1, d)] + ["#"]
+        return "/".join(ws)
+
+    base = sorted({deep_filter() for _ in range(200)})
+    trie, table, fids = TrieOracle(), WordTable(), {}
+    for f in base:
+        trie.insert(f)
+        fids[f] = len(fids)
+        for w in f.split("/"):
+            if w not in ("+", "#"):
+                table.intern(w)
+    raw = build_automaton(trie, fids, table, skip_hash=True,
+                          state_capacity=1 << 13,
+                          edge_capacity=1 << 13)
+    auto, edges = compress_automaton(raw, force_mode="wide",
+                                     state_capacity=1 << 13)
+    auto = attach_walk_tables(auto, edges, edge_capacity=1 << 13)
+    assert auto.wt_take > 1
+    p = AutoPatcher(auto, table.intern)
+    dev = device_view(auto)
+
+    extra = sorted({deep_filter() for _ in range(250)} - set(base))
+    for f in extra:
+        trie.insert(f)
+        fids[f] = len(fids)
+        p.insert(f, fids[f])
+    for f in rng.sample(base, 60):
+        trie.delete(f)
+        assert p.delete(f), f
+    assert p.splits > 0  # the churn actually exercised splits
+    dev = p.apply_updates(dev)
+
+    topics = ["/".join(rng.choice(vocab)
+                       for _ in range(rng.randint(1, 12)))
+              for _ in range(400)]
+    ids, n, sysm = encode_batch(table, topics, 16)
+    wp = walk_params(auto, ids.shape[1])
+    # the patcher's grown bound, exactly as the Router reads it
+    wp["steps"] = int(p.hops_for_level[
+        min(ids.shape[1], len(p.hops_for_level) - 1)])
+    res = match_batch(dev, ids, n, sysm, k=8, **wp)
+    out = np.asarray(res.ids)
+    ovf = np.asarray(res.overflow)
+    rev = {v: k for k, v in fids.items()}
+    for i, t in enumerate(topics):
+        assert not ovf[i], t
+        got = sorted(rev[j] for j in out[i] if j >= 0)
+        assert got == sorted(trie.match(t)), t
+
+
+def test_wide_mode_stale_steps_flags_overflow():
+    """A walk compiled with the PRE-patch hop bound must flag the
+    deepened topics as overflow (exact host fallback) rather than
+    silently missing their matches."""
+    from emqx_tpu.ops.csr import (attach_walk_tables,
+                                  compress_automaton, device_view)
+    from emqx_tpu.ops.match import walk_params
+
+    base = ["root/" + "/".join(["c"] * 9)]  # one long chain
+    trie, table, fids = TrieOracle(), WordTable(), {}
+    for f in base:
+        trie.insert(f)
+        fids[f] = len(fids)
+        for w in f.split("/"):
+            table.intern(w)
+    raw = build_automaton(trie, fids, table, skip_hash=True,
+                          state_capacity=1 << 10,
+                          edge_capacity=1 << 10)
+    auto, edges = compress_automaton(raw, force_mode="wide",
+                                     state_capacity=1 << 10)
+    auto = attach_walk_tables(auto, edges, edge_capacity=1 << 10)
+    p = AutoPatcher(auto, table.intern)
+    stale = walk_params(auto, 16)  # bound BEFORE the deepening patch
+    # diverge mid-chain: splits lengthen the path beyond the bound
+    for i, newf in enumerate(
+            ["root/c/c/x1/y/z", "root/c/c/c/c/x2/y/z",
+             "root/c/c/c/c/c/c/x3/y/z"]):
+        trie.insert(newf)
+        fids[newf] = len(fids)
+        p.insert(newf, fids[newf])
+    assert p.hops_grown
+    dev = p.apply_updates(device_view(auto))
+    topic = "root/c/c/c/c/x2/y/z"
+    ids, n, sysm = encode_batch(table, [topic] * 4, 16)
+    res_stale = match_batch(dev, ids, n, sysm, k=4, **stale)
+    fresh = dict(stale)
+    fresh["steps"] = int(p.hops_for_level[
+        min(ids.shape[1], len(p.hops_for_level) - 1)])
+    res_fresh = match_batch(dev, ids, n, sysm, k=4, **fresh)
+    rev = {v: k for k, v in fids.items()}
+    got_fresh = sorted(rev[j]
+                       for j in np.asarray(res_fresh.ids)[0] if j >= 0)
+    assert got_fresh == [topic], got_fresh
+    if not bool(np.asarray(res_stale.overflow)[0]):
+        # stale bound happened to suffice — then results must agree
+        got = sorted(rev[j]
+                     for j in np.asarray(res_stale.ids)[0] if j >= 0)
+        assert got == got_fresh
