@@ -21,11 +21,14 @@ run() {
   rm -f "$log"
 }
 
-run BENCH_MODE=default
-run BENCH_MODE=default BENCH_SUBS=10000000 BENCH_ITERS=10 BENCH_WINDOWS=3
+# the default mode IS the full BASELINE config matrix (one bounded
+# subprocess per row, incl. latency_8k and live_paced)
+run BENCH_MODE=configs
 run BENCH_MODE=bigfan
 run BENCH_MODE=shared
-run BENCH_MODE=churn BENCH_SUBS=50000 BENCH_CHURN_RATE=5000
+run BENCH_MODE=sharded
+run BENCH_MODE=churn
+run BENCH_MODE=latency
 run BENCH_MODE=live LIVE_RATE=400
 run BENCH_MODE=live
 run BENCH_MODE=live LIVE_FILTERS=2000
