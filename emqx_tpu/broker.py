@@ -318,7 +318,13 @@ class Broker:
         # → pack (transfer compaction); all async-dispatched.
         # Duplicate topics in the batch (hot topics arrive many times
         # per tick) collapse to one device row; the delivery tail
-        # expands per message via the inverse index.
+        # expands per message via the inverse index. INTER-batch
+        # repeats additionally hit the router's epoch-guarded match
+        # cache (ops/match_cache.py): the dispatch below splits the
+        # unique topics into cache hits (one HBM gather, no NFA walk)
+        # and misses (walked, then inserted) — transparent here, the
+        # merged [B_pad, M] id array feeds the same fan-out/pack
+        # kernels either way.
         uniq, pb.inv = dedup_topics(topics)
         pb.n_uniq = len(uniq)
         if cfg.mesh is not None:
@@ -360,7 +366,10 @@ class Broker:
         (``publish_step(with_fanout=True)`` with the FanoutManager's
         per-shard tables); the dense gathered (subs, src) then pack
         on device for the coalesced fetch. Filters too big for the
-        ``d`` bound deliver host-side from ``pb.sh_big``."""
+        ``d`` bound deliver host-side from ``pb.sh_big``. Repeat
+        topics hit the router's sharded match cache (cached
+        ids/subs/src rows gather from HBM; only misses run the
+        collective step — see Router._sharded_dispatch_cached)."""
         def fan_provider(epoch, id_map):
             return self.helper.sharded_state(
                 epoch, id_map, cfg.mesh, self.router.effective_d())
@@ -527,6 +536,13 @@ class Broker:
             # cannot fix — only the match-only flag may boost
             n_u = max(1, pb.n_uniq)
             k_ovf = movf if movf is not None else ovf
+            n_fb = int(ovf[:n_u].sum())
+            if n_fb:
+                # host-oracle fallbacks feed the patcher's stale-hop
+                # compaction trigger (ADVICE r5): a patch-deepened
+                # automaton rebuilds instead of pinning hot deep
+                # topics to the host (and out of the match cache)
+                self.router.note_match_fallbacks(n_fb)
             if int(k_ovf[:n_u].sum()) * 8 > n_u:
                 self.router.boost_k()
             if movf is not None:

@@ -165,5 +165,6 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
             router._auto_map = list(router._id_to_filter)
             router._dirty = False
             router._published = (auto, router._auto_map,
-                                 router._rebuilds)
+                                 router._rebuilds,
+                                 router._cache_rev)
         return {"routes": len(routes), "tables_restored": bool(tables)}

@@ -108,9 +108,10 @@ class SocketTransport(Transport):
         self._closing = False
         # cast coalescing (round-4 front-door finding: one IO-loop
         # wakeup + one drain() PER forwarded message serialized the
-        # cross-worker path): casts pickle in the caller's thread,
-        # buffer per peer, and one scheduled flush writes the whole
-        # burst with a single drain per peer
+        # cross-worker path): casts serialize (data-only wire codec,
+        # emqx_tpu/wire.py) in the caller's thread, buffer per peer,
+        # and one scheduled flush writes the whole burst with a
+        # single drain per peer
         self._cast_buf: Dict[Tuple[str, int], bytearray] = {}
         self._cast_lock = threading.Lock()
         self._cast_flush_scheduled = False
@@ -570,10 +571,11 @@ class SocketTransport(Transport):
         The hello carries the probe flag (the peer must not treat
         this connection's close as a link drop, or every probe close
         would fire a counter-probe). Cluster peers are assumed
-        co-versioned — the link is cookie-gated and pickles Python
-        objects, so mixed-version clusters are out of contract; no
-        legacy-hello fallback exists (every attempted variant of one
-        reintroduced a probe storm or doubled dead-peer detection
+        co-versioned — the link is cookie-gated and frames carry the
+        data-only wire codec (emqx_tpu/wire.py; no pickle, no code on
+        the wire), but mixed-version clusters remain out of contract;
+        no legacy-hello fallback exists (every attempted variant of
+        one reintroduced a probe storm or doubled dead-peer detection
         latency)."""
         writer = None
         try:

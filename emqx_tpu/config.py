@@ -101,6 +101,10 @@ class NodeConfig:
     # directory of the config file: relative paths inside it (module
     # files, certs) resolve against this, not the process cwd
     base_dir: Optional[str] = None
+    # [matcher] section: device matcher / publish-path knobs
+    # (emqx_tpu.router.MatcherConfig — match-cache sizing and off
+    # switch, kernel bounds, host/device threshold). None = defaults.
+    matcher: Optional[Any] = None
 
 
 #: zone fields with a closed value set — a typo must be a startup
@@ -126,6 +130,31 @@ def _build_zone(name: str, raw: Dict[str, Any]) -> Zone:
                 f"{_ENUM_FIELDS[key]}, got {val!r}")
         kwargs[key] = val
     return Zone(name=name, **kwargs)
+
+
+def _build_matcher(raw: Dict[str, Any]):
+    """``[matcher]`` table → :class:`~emqx_tpu.router.MatcherConfig`.
+    Unknown keys are startup errors (same closed-schema rule as
+    zones: a typo'd ``match_cache = false`` must not silently leave
+    the cache on); ``mesh`` is runtime-only and not configurable
+    from a file."""
+    import dataclasses as _dc
+
+    from emqx_tpu.router import MatcherConfig
+
+    known = {f.name for f in _dc.fields(MatcherConfig)} - {"mesh"}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown matcher setting: matcher.{key}")
+        want = MatcherConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"matcher.{key} must be a boolean")
+        if want == "int" and (isinstance(val, bool)
+                              or not isinstance(val, int)):
+            raise ConfigError(f"matcher.{key} must be an integer")
+        kwargs[key] = val
+    return MatcherConfig(**kwargs)
 
 
 def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
@@ -226,6 +255,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
     cfg.cluster_port = node.get("cluster_port")
     cfg.load_default_modules = bool(
         node.get("load_default_modules", False))
+    mraw = raw.get("matcher")
+    if mraw is not None:
+        if not isinstance(mraw, dict):
+            raise ConfigError("matcher must be a table")
+        cfg.matcher = _build_matcher(mraw)
     for name, zraw in raw.get("zones", {}).items():
         cfg.zones[name] = _build_zone(name, zraw)
     for i, lraw in enumerate(raw.get("listeners", [])):
@@ -275,6 +309,7 @@ def build_node(cfg: NodeConfig):
         set_zone(zone)
     default = cfg.zones.get("default")
     node = Node(name=cfg.name, zone=default,
+                matcher=cfg.matcher,
                 sys_interval=cfg.sys_interval,
                 load_default_modules=cfg.load_default_modules,
                 boot_listeners=False)

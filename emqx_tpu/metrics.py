@@ -75,6 +75,15 @@ DEVICE_METRICS = [
     "device.matches", "device.deliveries", "device.overflows",
 ]
 
+# publish match cache (ops/match_cache.py): per-unique-topic hit/miss
+# split counters, drained from the router by the stats flush (and
+# thence into $SYS heartbeats + the Prometheus exposition). `stale`
+# counts entries found but epoch-invalidated (route churn / rebuild)
+CACHE_METRICS = [
+    "cache.match.hit", "cache.match.miss",
+    "cache.match.insert", "cache.match.stale",
+]
+
 TRANSPORT_METRICS = [
     # slow-consumer guard closes (zone send_timeout)
     "connections.closed.slow_consumer",
@@ -82,7 +91,8 @@ TRANSPORT_METRICS = [
 
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
-               + AUTH_ACL_METRICS + DEVICE_METRICS + TRANSPORT_METRICS)
+               + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
+               + TRANSPORT_METRICS)
 
 
 class Metrics:
@@ -134,6 +144,12 @@ class Metrics:
         overflows) into the host counters — one transfer per flush."""
         for key, val in stats.items():
             self.inc(f"device.{key}", int(val))
+
+    def fold_cache_stats(self, stats: Dict[str, int]) -> None:
+        """Fold drained match-cache counter deltas (hit/miss/insert/
+        stale) into the host counters (Router.drain_cache_stats)."""
+        for key, val in stats.items():
+            self.inc(f"cache.match.{key}", int(val))
 
 
 _QOS_RECV = ("messages.qos0.received", "messages.qos1.received",

@@ -299,6 +299,12 @@ class Node:
         dev = self.router.drain_device_stats()
         if any(dev.values()):
             self.metrics.fold_device_stats(dev)
+        cache = self.router.drain_cache_stats()
+        if any(cache.values()):
+            self.metrics.fold_cache_stats(cache)
+        stats.setstat("match.cache.entries.count",
+                      self.router.cache_entries(),
+                      "match.cache.entries.max")
 
     # -- facade (src/emqx.erl:26-64) --------------------------------------
 

@@ -1,0 +1,272 @@
+"""Epoch-guarded device-resident publish match cache.
+
+The publish hot loop (``emqx_broker:publish/1`` →
+``emqx_trie:match/1``, SURVEY §3.1 "HOT LOOP 1") re-walks every
+unique topic per batch, yet real traffic is massively repetitive:
+the Zipf bench rows see the same hot topics re-walked from scratch
+every tick (and EMQX itself ships a host-side route cache in front of
+``emqx_router:match_routes/1`` for exactly this reason). This module
+memoizes per-topic match rows in a fixed-shape HBM table so a repeat
+topic costs one gather instead of an NFA walk + per-topic compaction.
+
+Layout and contract:
+
+  - the device table is ``int32[slots, 1 + width]``: column 0 is a
+    validity/overflow flag, the rest the packed matched-filter-id row
+    (-1 padded). ``slots`` is a power of two; rows never move — the
+    host side owns a ``topic → slot`` index plus a per-slot epoch
+    *key*, so the device never hashes strings;
+  - entries are **epoch-guarded**: the key stored at insert time must
+    equal the probing key exactly or the entry is a (counted) stale
+    miss. The router bumps its cache revision on any filter-set
+    change, rebuild, or capacity boost — wildcard filters make
+    per-key invalidation intractable (an added ``a/+`` changes the
+    match set of unboundedly many cached topics), so invalidation is
+    whole-epoch and entries self-heal by re-insert. No flush kernel
+    exists or is needed;
+  - **overflow topics are never served from the cache**: a miss row
+    whose walk overflowed is stored as an invalid marker (flag 0,
+    ids all -1). A later hit on such a slot surfaces ``overflow=True``
+    and the caller's exact host-oracle fallback runs, same as a fresh
+    walk would have — parity by fallback, never truncation. The
+    marker pins the topic to the host path only until the next epoch
+    bump (route churn, compaction rebuild, k/d boost);
+  - probe/insert host bookkeeping is mutex-guarded and the device
+    table is updated functionally (``.at[].set`` returns a new
+    array), so a concurrent reader holding the probed table snapshot
+    can never observe a torn or reallocated row.
+
+All device work is async-dispatched: probe is pure host bookkeeping,
+``merge`` is one jit'd gather+scatter producing the combined
+``[B_pad, width]`` id array (hits from the table, misses from the
+fresh walk), ``insert`` one jit'd scatter. Nothing here ever forces a
+device→host sync — the publish path's coalesced fetch stays the only
+transfer.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MatchCache"]
+
+#: flag column values: _VALID = cached ids are the exact match set;
+#: _OVF = the walk overflowed (host fallback, match-only bound);
+#: _FOVF = overflow where the match side itself was fine (the mesh
+#: fan-out d bound) — merged back into (ovf, movf) so the router's
+#: boost_k/boost_d signals keep their meaning across cached batches
+_OVF, _VALID, _FOVF = 0, 1, 2
+
+_MIN_PAD = 8
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("b_pad",))
+def _merge_jit(table, hit_slots, hit_pos, miss_rows, miss_ovf,
+               miss_movf, miss_pos, *, b_pad: int):
+    """Combined id rows + overflow flags for one batch: gather hit
+    rows from the table snapshot, scatter them and the fresh miss
+    rows into the ``[b_pad, width]`` output (OOB positions drop —
+    that is how both pad rows and absent hits/misses vanish)."""
+    S = table.shape[0]
+    width = table.shape[1] - 1
+    out = jnp.full((b_pad, width), -1, jnp.int32)
+    ovf = jnp.zeros((b_pad,), bool)
+    movf = jnp.zeros((b_pad,), bool)
+    hv = table[jnp.clip(hit_slots, 0, S - 1)]
+    flag = hv[:, 0]
+    out = out.at[hit_pos].set(hv[:, 1:], mode="drop")
+    ovf = ovf.at[hit_pos].set(flag != _VALID, mode="drop")
+    movf = movf.at[hit_pos].set(flag == _OVF, mode="drop")
+    out = out.at[miss_pos].set(miss_rows, mode="drop")
+    ovf = ovf.at[miss_pos].set(miss_ovf | miss_movf, mode="drop")
+    movf = movf.at[miss_pos].set(miss_movf, mode="drop")
+    return out, ovf, movf
+
+
+@jax.jit
+def _insert_jit(table, idx, rows, ovf, movf):
+    """Scatter fresh miss rows into their slots. Overflowed rows are
+    stored as invalid markers (never as truncated results); padding
+    entries carry an out-of-range index and drop."""
+    flag = jnp.where(movf, _OVF, jnp.where(ovf, _FOVF, _VALID))
+    rows = jnp.where((ovf | movf)[:, None], -1, rows.astype(jnp.int32))
+    vals = jnp.concatenate(
+        [flag.astype(jnp.int32)[:, None], rows], axis=1)
+    return table.at[idx].set(vals, mode="drop")
+
+
+class _Probe:
+    """One batch's host-side split (returned by :meth:`MatchCache.
+    probe`): hit/miss positions, assigned slots, the epoch key, and
+    the device-table *snapshot* the hits must gather from (later
+    inserts produce new arrays, so the snapshot can't be clobbered)."""
+
+    __slots__ = ("table", "key", "hit_pos", "hit_slots", "miss_pos",
+                 "miss_topics", "miss_slots")
+
+    def __init__(self, table, key) -> None:
+        self.table = table
+        self.key = key
+        self.hit_pos: List[int] = []
+        self.hit_slots: List[int] = []
+        self.miss_pos: List[int] = []
+        self.miss_topics: List[str] = []
+        self.miss_slots: List[int] = []
+
+
+class MatchCache:
+    """Fixed-shape device match-row cache with host topic index.
+
+    ``width`` is the packed row width (``max_matches`` on one chip;
+    the mesh cache concatenates ids+subs+src into one wider row).
+    Eviction is a clock sweep over the slot ring: allocation cost is
+    O(1) per miss and a hot entry is only displaced once the ring
+    wraps — adequate for a cache whose entries are cheap to refill.
+    """
+
+    def __init__(self, slots: int, width: int) -> None:
+        self.slots = _pow2(max(2, int(slots)))
+        self.width = int(width)
+        self._lock = threading.Lock()
+        self._table = None  # lazy: int32[slots, 1 + width]
+        self._index: dict = {}                     # topic -> slot
+        self._slot_topic: List[Optional[str]] = [None] * self.slots
+        self._slot_key: List[Any] = [None] * self.slots
+        self._clock = 0
+        # cumulative counters (drain_stats hands out deltas)
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.stale = 0
+        self._drained = {"hit": 0, "miss": 0, "insert": 0, "stale": 0}
+
+    # -- host bookkeeping --------------------------------------------------
+
+    def _table_now(self):
+        if self._table is None:
+            self._table = jnp.full(
+                (self.slots, 1 + self.width), -1, jnp.int32)
+        return self._table
+
+    def _alloc(self, topic: str) -> int:
+        s = self._clock
+        self._clock = (s + 1) % self.slots
+        old = self._slot_topic[s]
+        if old is not None:
+            self._index.pop(old, None)
+        self._slot_topic[s] = topic
+        self._slot_key[s] = None  # pending until insert() lands
+        self._index[topic] = s
+        return s
+
+    def probe(self, topics: Sequence[str], key) -> _Probe:
+        """Split a unique-topic batch into hits (slot per topic, key
+        matches) and misses (slot assigned now, marked pending — a
+        crash before :meth:`insert` just leaves a permanent miss)."""
+        with self._lock:
+            p = _Probe(self._table_now(), key)
+            for i, t in enumerate(topics):
+                s = self._index.get(t)
+                if s is not None and self._slot_key[s] == key:
+                    p.hit_pos.append(i)
+                    p.hit_slots.append(s)
+                    continue
+                if s is not None:
+                    if self._slot_key[s] is not None:
+                        self.stale += 1  # pending slots aren't stale
+                    self._slot_key[s] = None
+                else:
+                    s = self._alloc(t)
+                p.miss_pos.append(i)
+                p.miss_topics.append(t)
+                p.miss_slots.append(s)
+            self.hits += len(p.hit_pos)
+            self.misses += len(p.miss_pos)
+            return p
+
+    # -- device ops --------------------------------------------------------
+
+    def insert(self, probe: _Probe, rows, ovf, movf=None) -> None:
+        """Store the fresh walk results for ``probe``'s misses.
+
+        ``rows`` is the (possibly batch-padded) ``[Mb, width]`` device
+        result; rows past the real miss count drop via OOB indices.
+        ``ovf`` rows store invalid markers, never truncated ids."""
+        n = len(probe.miss_slots)
+        if n == 0:
+            return
+        mb = int(rows.shape[0])
+        idx = np.full((mb,), self.slots, np.int32)  # OOB pad -> drop
+        idx[:n] = probe.miss_slots
+        if movf is None:
+            movf = ovf
+        with self._lock:
+            self._table = _insert_jit(self._table_now(), idx, rows,
+                                      ovf, movf)
+            for s, t in zip(probe.miss_slots, probe.miss_topics):
+                # skip slots another batch's clock sweep reassigned
+                if self._slot_topic[s] == t:
+                    self._slot_key[s] = probe.key
+            self.inserts += n
+
+    def merge(self, b_pad: int, probe: _Probe, miss_rows=None,
+              miss_ovf=None, miss_movf=None):
+        """One jit'd gather+scatter producing the batch's combined
+        ``(ids[b_pad, width], ovf[b_pad], movf[b_pad])`` device
+        arrays. Pass the miss walk outputs (or nothing when the batch
+        fully hit)."""
+        hb = _pow2(max(len(probe.hit_pos), 1), _MIN_PAD)
+        hit_slots = np.zeros((hb,), np.int32)
+        hit_pos = np.full((hb,), b_pad, np.int32)  # OOB pad -> drop
+        if probe.hit_pos:
+            hit_slots[:len(probe.hit_slots)] = probe.hit_slots
+            hit_pos[:len(probe.hit_pos)] = probe.hit_pos
+        if miss_rows is None:
+            miss_rows = jnp.full((1, self.width), -1, jnp.int32)
+            miss_ovf = jnp.zeros((1,), bool)
+            miss_movf = jnp.zeros((1,), bool)
+        elif miss_movf is None:
+            miss_movf = miss_ovf
+        mb = int(miss_rows.shape[0])
+        miss_pos = np.full((mb,), b_pad, np.int32)
+        miss_pos[:len(probe.miss_pos)] = probe.miss_pos
+        return _merge_jit(probe.table, hit_slots, hit_pos, miss_rows,
+                          miss_ovf, miss_movf, miss_pos, b_pad=b_pad)
+
+    # -- introspection -----------------------------------------------------
+
+    def entries(self) -> int:
+        return len(self._index)
+
+    def stats(self) -> dict:
+        """Cumulative counters (+ hit rate) — bench/introspection."""
+        total = self.hits + self.misses
+        return {
+            "hit": self.hits, "miss": self.misses,
+            "insert": self.inserts, "stale": self.stale,
+            "entries": self.entries(),
+            "hit_rate": (self.hits / total) if total else 0.0,
+        }
+
+    def drain_stats(self) -> dict:
+        """Counter deltas since the previous drain (the metrics-fold
+        contract, mirroring ``Router.drain_device_stats``)."""
+        with self._lock:
+            cur = {"hit": self.hits, "miss": self.misses,
+                   "insert": self.inserts, "stale": self.stale}
+            out = {k: cur[k] - self._drained[k] for k in cur}
+            self._drained = cur
+            return out

@@ -85,6 +85,14 @@ class AutoPatcher:
         self.tombstones = 0
         self.splits = 0
         self.hops_grown = False  # steps bound changed since flatten
+        # host-fallback matches observed while the hop bound is stale
+        # (a split bumps only the direct child's hop, so descendants'
+        # values run one low and hops_for_level can under-grow —
+        # correctness holds via the kernel's residual-overflow
+        # fallback, but hot deep topics then pin to the host oracle;
+        # counting those fallbacks as a compaction trigger rebuilds
+        # the automaton long before 1024 splits accumulate)
+        self.hop_fallbacks = 0
         # a PatchOverflow mid-insert leaves the mirror with a dangling
         # prefix (states/edges allocated for the words already walked).
         # That partial state must never reach the device: the patcher
@@ -363,11 +371,21 @@ class AutoPatcher:
         self.tombstones += 1
         return True
 
+    def note_hop_fallbacks(self, n: int) -> None:
+        """Record ``n`` host-fallback matches. Counted only while the
+        hop bound has grown since the flatten (the stale-hop regime):
+        overflow from an undersized active set is ``boost_k``'s
+        problem, not a rebuild trigger."""
+        if self.hops_grown:
+            self.hop_fallbacks += n
+
     def needs_compaction(self, live_filters: int) -> bool:
-        """Tombstones OR accumulated splits dominate: the automaton is
-        still correct, just wasteful/slower — rebuild off-stream."""
+        """Tombstones, accumulated splits, OR stale-hop host
+        fallbacks dominate: the automaton is still correct, just
+        wasteful/slower — rebuild off-stream."""
         bound = max(1024, live_filters)
-        return self.tombstones > bound or self.splits > bound
+        return self.tombstones > bound or self.splits > bound \
+            or self.hop_fallbacks > bound
 
     # -- device replay -----------------------------------------------------
 

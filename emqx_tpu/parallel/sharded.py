@@ -135,6 +135,16 @@ def finalize_parts(
     comp = [compress_automaton(a) for a in autos]
     if len({c[0].wt_slots for c in comp}) > 1:
         comp = [compress_automaton(a, force_mode="wide") for a in autos]
+        if len({c[0].wt_slots for c in comp}) > 1:
+            # a shard hit compress_automaton's wide-mode fallback
+            # guard (packed-lane capacity: states ≥ 2^26 or depth >
+            # 31) and stayed narrow despite the force — mixed row
+            # widths would crash the np.stack below, so demote EVERY
+            # shard to narrow (correct for any trie, just unskipped)
+            comp = [compress_automaton(a, force_mode="narrow")
+                    for a in autos]
+    assert len({c[0].wt_slots for c in comp}) == 1, \
+        "per-shard walk tables must agree on slot layout"
     s2_cap = max(c[0].node2.shape[0] for c in comp)
     if state_capacity is not None:
         s2_cap = max(s2_cap, state_capacity)

@@ -94,6 +94,17 @@ class MatcherConfig:
     # tail the reference's O(levels) dirty inserts never had
     # (src/emqx_router.erl:226-234).
     patch_drain_batch: int = 256
+    # publish match cache (ops/match_cache.py): epoch-guarded HBM
+    # memo of per-topic match rows — a repeat topic across batches
+    # costs one gather instead of an NFA walk. Any route add/delete
+    # (or rebuild / capacity boost) bumps the cache revision, so
+    # stale entries self-invalidate; overflow topics are never served
+    # from it (exact host fallback, as always). False restores the
+    # pre-cache dispatch byte-for-byte. Slot count is a power of two;
+    # footprint ≈ slots × (max_matches + 1) × 4 B (default 64K slots
+    # × 65 ints ≈ 16 MB of HBM).
+    match_cache: bool = True
+    match_cache_slots: int = 65536
 
 
 class Router:
@@ -185,6 +196,15 @@ class Router:
         # drained asynchronously by the stats flush — appending the
         # jax scalars defers the host transfer to drain time
         self._dev_stats: deque = deque(maxlen=65536)
+        # publish match cache (ops/match_cache.py), lazily built on
+        # first device match. _cache_rev is the whole-epoch guard:
+        # bumped on any filter-set change, rebuild (ids recycle), or
+        # host-regime reclaim — cached rows are only served while
+        # their insert-time (epoch, rev, boosts) key matches exactly
+        self._cache_rev = 0
+        self._match_cache_obj = None
+        self._sharded_cache_obj = None
+        self._sharded_cache_meta = None  # (T, m, d) the table is sized for
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
 
@@ -256,6 +276,9 @@ class Router:
                 # let it carry the new revision over a pre-intern
                 # word table: accepted stale, silent match miss
                 self._mut_rev += 1
+                # the new filter may match any cached topic — whole-
+                # epoch invalidation (see ops/match_cache.py)
+                self._cache_rev += 1
             dests[dest] = dests.get(dest, 0) + 1
             return fid
 
@@ -364,6 +387,7 @@ class Router:
                 self._id_to_filter[fid] = None
                 self._retire_id(fid)
                 self._patch_delete(filter_, fid)
+                self._cache_rev += 1  # cached rows may hold this fid
 
     def _retire_id(self, fid: int) -> None:
         """Freed filter id → quarantine or immediate recycle.
@@ -429,6 +453,7 @@ class Router:
                     self._id_to_filter[fid] = None
                     self._retire_id(fid)
                     self._patch_delete(f, fid)
+                    self._cache_rev += 1
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -493,7 +518,9 @@ class Router:
         self._dirty = False
         self._grow = {"state": 1, "edge": 1}
         self._rebuilds += 1
-        self._published = (auto, self._auto_map, self._rebuilds)
+        self._cache_rev += 1  # fresh id map: quarantined ids recycle
+        self._published = (auto, self._auto_map, self._rebuilds,
+                           self._cache_rev)
         return auto
 
     def _rebuild_sharded_locked(self):
@@ -547,7 +574,9 @@ class Router:
         self._dirty = False
         self._grow = {"state": 1, "edge": 1}
         self._rebuilds += 1
-        self._published = (auto, self._auto_map, self._rebuilds)
+        self._cache_rev += 1  # fresh id map: quarantined ids recycle
+        self._published = (auto, self._auto_map, self._rebuilds,
+                           self._cache_rev)
         return auto
 
     def _install_walk_meta(self, host_auto: Automaton,
@@ -617,7 +646,8 @@ class Router:
                      if p.dirty]
             if dirty:
                 self._auto = apply_stacked_multi(dirty, self._auto)
-        self._published = (self._auto, self._auto_map, self._rebuilds)
+        self._published = (self._auto, self._auto_map,
+                           self._rebuilds, self._cache_rev)
 
     def _schedule_compaction(self) -> None:
         if self._compacting:
@@ -658,6 +688,17 @@ class Router:
         always precedes the patch drain: a broken patcher (partial
         insert after overflow) is discarded by the rebuild before its
         queue could ever reach the device."""
+        return self.snapshot_cached()[:3]
+
+    def snapshot_cached(self) -> tuple:
+        """:meth:`automaton` plus the snapshot's cache revision —
+        ``(automaton, id→filter map, epoch, cache_rev)``. The rev is
+        stamped into the published tuple AT publish time (under the
+        lock), so it names exactly the mutation set the snapshot
+        includes: the match cache keys entries on it, and a mutation
+        concurrent with a probe can only make entries look stale
+        (re-walked, safe) — never serve pre-mutation rows as
+        fresh."""
         pub = self._published
         if pub is not None and not self._dirty \
                 and not self._patchers_dirty():
@@ -737,6 +778,7 @@ class Router:
             self._dirty = True  # next device use must re-flatten
             self._free_ids.extend(self._pending_free)
             self._pending_free.clear()
+            self._cache_rev += 1  # drained ids may recycle
 
     def match_dispatch(self, topics: Sequence[str]):
         """Dispatch-only device match: encode + enqueue the compiled
@@ -753,6 +795,9 @@ class Router:
         cfg = self.config
         if cfg.mesh is not None:
             return self._match_dispatch_sharded(topics)
+        cache = self._match_cache()
+        if cache is not None:
+            return self._match_dispatch_cached(topics, cache)
         auto, id_map, epoch = self.automaton()
         bucket = cfg.min_batch
         while bucket < len(topics):
@@ -770,6 +815,79 @@ class Router:
                           m=cfg.max_matches, pack_ids=False,
                           **self._walk_kw(ids.shape[1]))
         return res.ids, res.overflow, id_map, epoch
+
+    # -- publish match cache (ops/match_cache.py) -------------------------
+
+    def _match_cache(self):
+        """The single-chip publish match cache, lazily built (None =
+        disabled by config)."""
+        cfg = self.config
+        if not cfg.match_cache or cfg.match_cache_slots <= 0:
+            return None
+        if self._match_cache_obj is None:
+            from emqx_tpu.ops.match_cache import MatchCache
+
+            self._match_cache_obj = MatchCache(
+                cfg.match_cache_slots, cfg.max_matches)
+        return self._match_cache_obj
+
+    def _match_dispatch_cached(self, topics: Sequence[str], cache):
+        """Cache-split device match: probe the epoch-guarded cache,
+        walk ONLY the misses (``pack_ids=True`` — the per-topic
+        compaction buys fixed-width rows the cache and merge reuse),
+        merge one combined ``[B_pad, max_matches]`` id array and
+        insert the fresh rows. Same contract as the plain dispatch:
+        all device values in flight, no sync.
+
+        Ordering: the revision is read BEFORE the automaton snapshot,
+        so a racing mutation can only make fresh results look stale
+        (re-walked, safe) — never stale results look fresh."""
+        cfg = self.config
+        k_boost = self._k_boost  # read BEFORE the snapshot/walk: a
+        # concurrent boost then stales these entries, never the reverse
+        auto, id_map, epoch, rev = self.snapshot_cached()
+        key = (epoch, rev, k_boost)
+        bucket = cfg.min_batch
+        while bucket < len(topics):
+            bucket *= 2
+        probe = cache.probe(topics, key)
+        miss_rows = miss_ovf = None
+        if probe.miss_topics:
+            mb = cfg.min_batch
+            while mb < len(probe.miss_topics):
+                mb *= 2
+            padded = list(probe.miss_topics) + \
+                ["\x00/pad"] * (mb - len(probe.miss_topics))
+            with self._wt_lock:
+                ids, n, sysm = self._encode(padded, cfg.max_levels)
+            ids, n = depth_bucket(ids, n)
+            res = match_batch(auto, ids, n, sysm,
+                              k=self.effective_k(), m=cfg.max_matches,
+                              pack_ids=True,
+                              **self._walk_kw(ids.shape[1]))
+            miss_rows, miss_ovf = res.ids, res.overflow
+            cache.insert(probe, miss_rows, miss_ovf)
+        ids_dev, ovf_dev, _movf = cache.merge(bucket, probe,
+                                              miss_rows, miss_ovf)
+        return ids_dev, ovf_dev, id_map, epoch
+
+    def drain_cache_stats(self) -> Dict[str, int]:
+        """Match-cache counter deltas since the last drain (hit/miss/
+        insert/stale), summed over the single-chip and sharded
+        caches — folded into Metrics by the stats flush."""
+        out: Dict[str, int] = {}
+        for c in (self._match_cache_obj, self._sharded_cache_obj):
+            if c is None:
+                continue
+            for k2, v in c.drain_stats().items():
+                out[k2] = out.get(k2, 0) + v
+        return out
+
+    def cache_entries(self) -> int:
+        """Live entries across the publish match caches (gauge)."""
+        return sum(c.entries() for c in
+                   (self._match_cache_obj, self._sharded_cache_obj)
+                   if c is not None)
 
     def effective_k(self) -> int:
         """Active-set capacity: configured + any learned boost — or 1
@@ -809,6 +927,27 @@ class Router:
                 return False
             self._d_boost = min(d * 2, cap)
             return True
+
+    def note_match_fallbacks(self, n: int) -> None:
+        """The publish path resolved ``n`` topics on the host oracle
+        because their device walk overflowed. In the stale-hop regime
+        (a patch split deepened walk paths past what the mirror's hop
+        accounting tracks, ADVICE r5) those fallbacks are the only
+        signal the automaton needs a compacting rebuild — forward the
+        count to the live patcher(s), which count it alongside
+        splits/tombstones, and schedule compaction once it dominates.
+        Keeps hot deep topics eligible for the match cache instead of
+        pinned to the host oracle until 1024 splits accumulate."""
+        if n <= 0:
+            return
+        with self._lock:
+            pool = ([self._patcher] if self._patcher is not None
+                    else self._shard_patchers)
+            for p in pool:
+                p.note_hop_fallbacks(n)
+            if pool and not self._dirty and not self._compacting \
+                    and self._needs_compaction_locked():
+                self._schedule_compaction()
 
     def match_ids(self, topics: Sequence[str]):
         """Device match of a topic batch in snapshot-id space.
@@ -857,9 +996,93 @@ class Router:
         — ``movf_dev`` is the match-only overflow (the ``boost_k``
         signal; fan overflow must not grow k); no device→host sync.
         Reference: the dispatch fold src/emqx_broker.erl:283-309 run
-        as one compiled mesh program."""
+        as one compiled mesh program.
+
+        With the publish match cache enabled (and no big-filter
+        bitmaps live), repeat topics skip the collective step: the
+        cached (ids, subs, src) rows gather from HBM and only the
+        misses walk. A pre-``placed`` batch bypasses the cache (its
+        host half was already paid, and splitting it would re-encode)."""
+        if placed is None and topics is not None:
+            out = self._sharded_dispatch_cached(topics, fan_provider)
+            if out is not None:
+                return out
         return self._dispatch_sharded(topics, fan=fan_provider,
                                       with_big=True, placed=placed)
+
+    def _sharded_cache_for(self, n_trie: int, d: int):
+        """The mesh publish cache, sized for the CURRENT (T, m, d)
+        row widths — a ``boost_d`` regrows it (entries drop; they
+        were keyed to the old d anyway)."""
+        from emqx_tpu.ops.match_cache import MatchCache
+
+        cfg = self.config
+        meta = (n_trie, cfg.max_matches, d)
+        if self._sharded_cache_obj is None \
+                or self._sharded_cache_meta != meta:
+            width = n_trie * cfg.max_matches + 2 * n_trie * d
+            self._sharded_cache_obj = MatchCache(
+                cfg.match_cache_slots, width)
+            self._sharded_cache_meta = meta
+        return self._sharded_cache_obj
+
+    def _sharded_dispatch_cached(self, topics: Sequence[str],
+                                 fan_provider):
+        """Cache-split mesh publish dispatch, or None when the cache
+        does not apply (disabled, no fan state, or big-filter bitmaps
+        live — a bitmap union row is megabytes at 10M subs, far past
+        any sane per-entry budget, so that regime stays uncached).
+
+        One cache entry is a topic's concatenated (match ids [T·m],
+        gathered subs [T·d], src [T·d]) rows — everything the
+        collective step produces for it except the per-step stats
+        psums (device.match counters therefore count WALKED topics
+        only; the host-side hit counters carry the rest)."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        if not cfg.match_cache or cfg.match_cache_slots <= 0:
+            return None
+        boosts = (self._k_boost, self._d_boost)
+        auto, id_map, epoch, rev = self.snapshot_cached()
+        st = fan_provider(epoch, id_map)
+        if st is None or st.fan is None or st.bm is not None \
+                or st.big_fids:
+            return None
+        d = self.effective_d()
+        n_trie = cfg.mesh.shape["trie"]
+        cache = self._sharded_cache_for(n_trie, d)
+        key = (epoch, rev, boosts, st.version)
+        unit = cfg.min_batch * cfg.mesh.shape["data"]
+        bucket = unit
+        while bucket < len(topics):
+            bucket *= 2
+        probe = cache.probe(topics, key)
+        miss_rows = miss_ovf = miss_movf = None
+        if probe.miss_topics:
+            (m_ids, m_subs, m_src, m_bm, m_ovf, m_movf, m_map,
+             m_epoch, m_big) = self._dispatch_sharded(
+                probe.miss_topics, fan=lambda e, im: st,
+                with_big=True)
+            if m_bm is not None or m_big or m_subs is None \
+                    or m_epoch != epoch:
+                # the snapshot moved (or big filters appeared) while
+                # we split: abandon the cached path for this batch —
+                # the pending miss slots stay keyless (permanent
+                # miss), and the caller re-runs the legacy dispatch
+                return None
+            miss_rows = jnp.concatenate([m_ids, m_subs, m_src], axis=1)
+            miss_ovf, miss_movf = m_ovf, m_movf
+            cache.insert(probe, miss_rows, miss_ovf, miss_movf)
+        merged, ovf, movf = cache.merge(bucket, probe, miss_rows,
+                                        miss_ovf, miss_movf)
+        mw = n_trie * cfg.max_matches
+        dw = n_trie * d
+        ids = merged[:, :mw]
+        subs = merged[:, mw:mw + dw]
+        src = merged[:, mw + dw:]
+        return (ids, subs, src, None, ovf, movf, id_map, epoch,
+                frozenset())
 
     def encode_place_sharded(self, topics: Sequence[str]):
         """Host half of the sharded dispatch: encode a topic batch

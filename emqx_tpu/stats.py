@@ -18,6 +18,8 @@ STATS_KEYS = [
     "routes.count", "routes.max",
     "retained.count", "retained.max",
     "channels.count", "channels.max",
+    # live publish match-cache entries (emqx_tpu/ops/match_cache.py)
+    "match.cache.entries.count", "match.cache.entries.max",
 ]
 
 

@@ -138,9 +138,19 @@ def _dec(x: Any):
 
 
 def dumps(obj: Any) -> bytes:
-    """Encode ``obj`` into a data-only frame payload."""
-    return json.dumps(_enc(obj), separators=(",", ":"),
-                      ensure_ascii=False).encode("utf-8")
+    """Encode ``obj`` into a data-only frame payload. Raises
+    :class:`WireError` for anything unencodable — including failures
+    past ``_enc``'s type checks (strings carrying lone surrogates
+    raise ``UnicodeEncodeError`` at the utf-8 step; pathologically
+    deep structures raise ``RecursionError``): transport callers
+    handle WireError/ConnectionError only, mirroring ``loads``."""
+    try:
+        return json.dumps(_enc(obj), separators=(",", ":"),
+                          ensure_ascii=False).encode("utf-8")
+    except WireError:
+        raise
+    except Exception as e:
+        raise WireError(f"unencodable value on cluster wire: {e}") from e
 
 
 def loads(data: bytes) -> Any:

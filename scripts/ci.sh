@@ -21,6 +21,11 @@ python -m compileall -q emqx_tpu tests scripts bench.py __graft_entry__.py
 echo "== lint (scripts/lint.py) =="
 python scripts/lint.py
 
+echo "== match-cache parity (docs/MATCH_CACHE.md) =="
+# also part of the full suite below; run first so a cache parity
+# regression fails the gate before the long run
+python -m pytest tests/test_match_cache.py -q
+
 echo "== pytest =="
 if [[ "${COV:-1}" == "0" ]]; then
     python -m pytest tests -q

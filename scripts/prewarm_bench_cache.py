@@ -43,7 +43,8 @@ def main():
         if small and subs > 1_000_000:
             continue
         t0 = time.time()
-        _, cached, _, _, _, uniques, n_filters = bench.build_main_inputs(
+        (_, cached, _, _, _, uniques, n_filters,
+         _topics) = bench.build_main_inputs(
             subs, batch, levels, mix, traffic, wpl)
         print(f"{name}: {'cache hit' if cached else 'built'} "
               f"{n_filters} filters, avg_unique="

@@ -1,0 +1,286 @@
+"""Publish match cache (ops/match_cache.py + router integration):
+exact oracle parity through cache hits, misses, epoch invalidation
+under route churn, overflow bypass, the cache-off legacy path, and
+the sharded (mesh) cache on the 1×1 fast path."""
+
+import random
+
+import numpy as np
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.router import MatcherConfig, Router
+from emqx_tpu.types import Message
+
+
+def _mk(**kw):
+    kw.setdefault("device_min_filters", 0)
+    return Router(MatcherConfig(**kw), node="node1")
+
+
+class Q:
+    def __init__(self, client_id="c"):
+        self.client_id = client_id
+        self.inbox = []
+
+    def deliver(self, topic, msg):
+        self.inbox.append((topic, msg))
+
+
+# -- MatchCache unit ------------------------------------------------------
+
+
+def test_cache_unit_probe_insert_merge_roundtrip():
+    from emqx_tpu.ops.match_cache import MatchCache
+
+    c = MatchCache(16, 4)
+    key = ("e", 1)
+    topics = ["a", "b", "c"]
+    p = c.probe(topics, key)
+    assert p.hit_pos == [] and p.miss_topics == topics
+    rows = np.array([[1, -1, -1, -1],
+                     [2, 3, -1, -1],
+                     [4, 5, 6, -1]], np.int32)
+    ovf = np.zeros(3, bool)
+    c.insert(p, rows, ovf)
+    # second probe: all hits, merged rows identical
+    p2 = c.probe(["b", "a", "c", "d"], key)
+    assert p2.hit_pos == [0, 1, 2] and p2.miss_topics == ["d"]
+    merged, ovf2, _ = c.merge(
+        8, p2, np.full((1, 4), -1, np.int32), np.zeros(1, bool))
+    merged = np.asarray(merged)
+    assert merged[0].tolist() == [2, 3, -1, -1]
+    assert merged[1].tolist() == [1, -1, -1, -1]
+    assert merged[2].tolist() == [4, 5, 6, -1]
+    assert not np.asarray(ovf2)[:3].any()
+    # epoch bump: everything is a (stale-counted) miss again
+    p3 = c.probe(["a", "b"], ("e", 2))
+    assert p3.miss_topics == ["a", "b"]
+    assert c.stale == 2
+
+
+def test_cache_unit_overflow_rows_store_invalid_markers():
+    from emqx_tpu.ops.match_cache import MatchCache
+
+    c = MatchCache(8, 4)
+    key = 7
+    p = c.probe(["t"], key)
+    c.insert(p, np.array([[9, 9, 9, 9]], np.int32),
+             np.array([True]))
+    p2 = c.probe(["t"], key)
+    assert p2.hit_pos == [0]  # found — but flagged, never served
+    merged, ovf, _ = c.merge(4, p2)
+    assert np.asarray(ovf)[0]            # caller must host-fallback
+    assert (np.asarray(merged)[0] == -1).all()  # no truncated ids
+
+
+# -- single-device router path --------------------------------------------
+
+
+def _oracle_for(filters):
+    t = TrieOracle()
+    for f in filters:
+        t.insert(f)
+    return t
+
+
+def _assert_parity(r, oracle, topics):
+    got = r.match_filters(topics)
+    for t, row in zip(topics, got):
+        assert sorted(row) == sorted(oracle.match(t)), t
+
+
+def test_router_cached_parity_and_hit_counters():
+    r = _mk(match_cache_slots=256)
+    filters = ["s/+/a", "s/1/a", "s/#", "x/y", "+/y"]
+    for f in filters:
+        r.add_route(f)
+    oracle = _oracle_for(filters)
+    topics = ["s/1/a", "s/2/a", "x/y", "nope", "s/1/a", "x/y"]
+    _assert_parity(r, oracle, topics)
+    c = r._match_cache_obj
+    assert c is not None and c.inserts > 0
+    before = c.hits
+    _assert_parity(r, oracle, topics)  # identical batch: pure hits
+    assert c.hits > before
+    assert c.stats()["hit_rate"] > 0
+
+
+def test_epoch_invalidation_on_add_and_delete():
+    r = _mk(match_cache_slots=64)
+    r.add_route("a/b")
+    oracle = _oracle_for(["a/b"])
+    _assert_parity(r, oracle, ["a/b", "a/c"])
+    # a new wildcard must appear in the next match (no stale hit)
+    r.add_route("a/+")
+    oracle.insert("a/+")
+    _assert_parity(r, oracle, ["a/b", "a/c"])
+    # a delete must disappear (no ghost delivery)
+    r.delete_route("a/b")
+    oracle.delete("a/b")
+    _assert_parity(r, oracle, ["a/b", "a/c"])
+    assert r._match_cache_obj.stale > 0
+
+
+def test_churn_interleaved_with_cached_matches_stays_exact():
+    """The satellite churn bar: interleave add/delete with cached
+    matches and assert exact oracle parity after EVERY epoch bump —
+    no stale delivery, no missed delivery."""
+    rng = random.Random(7)
+    r = _mk(match_cache_slots=512)
+    oracle = TrieOracle()
+    words = ["a", "b", "c", "d"]
+    live = []
+    for f in ["a/#", "b/+", "a/b/c"]:
+        r.add_route(f)
+        oracle.insert(f)
+        live.append(f)
+    topics = ["/".join(rng.choice(words)
+                       for _ in range(rng.randint(1, 4)))
+              for _ in range(24)]
+    for step in range(30):
+        if live and rng.random() < 0.4:
+            f = live.pop(rng.randrange(len(live)))
+            r.delete_route(f)
+            oracle.delete(f)
+        else:
+            depth = rng.randint(1, 4)
+            ws = [rng.choice(words + ["+"]) for _ in range(depth)]
+            if rng.random() < 0.2:
+                ws.append("#")
+            f = "/".join(ws)
+            if f not in live:
+                r.add_route(f)
+                oracle.insert(f)
+                live.append(f)
+        batch = [rng.choice(topics) for _ in range(12)]  # hot repeats
+        _assert_parity(r, oracle, batch)
+    st = r._match_cache_obj.stats()
+    assert st["hit"] > 0 and st["stale"] > 0
+
+
+def test_overflow_topics_fall_back_exact_through_cache():
+    # max_matches=2 forces m-overflow for a topic matching 3 filters
+    r = _mk(match_cache_slots=64, max_matches=2, active_k=2)
+    filters = ["t/#", "t/+", "t/x", "other"]
+    for f in filters:
+        r.add_route(f)
+    oracle = _oracle_for(filters)
+    for _ in range(3):  # miss, then negative-cached hits
+        _assert_parity(r, oracle, ["t/x", "t/x", "other"])
+    assert r._match_cache_obj.hits > 0
+
+
+def test_cache_off_restores_legacy_dispatch_bytes():
+    """match_cache=False must run the pre-cache dispatch
+    byte-for-byte: raw (pack_ids=False) walk output, no cache
+    object ever built."""
+    from emqx_tpu.ops.match import depth_bucket, match_batch
+
+    filters = ["s/+/a", "s/1/a", "s/#", "x/y"]
+    topics = ["s/1/a", "x/y", "s/1/a", "zz"]
+    r = _mk(match_cache=False)
+    for f in filters:
+        r.add_route(f)
+    ids_dev, ovf_dev, id_map, epoch = r.match_dispatch(topics)
+    assert r._match_cache_obj is None
+    # replay the legacy dispatch by hand against the same snapshot
+    auto, id_map2, epoch2 = r.automaton()
+    assert epoch2 == epoch
+    cfg = r.config
+    bucket = cfg.min_batch
+    while bucket < len(topics):
+        bucket *= 2
+    padded = list(topics) + ["\x00/pad"] * (bucket - len(topics))
+    ids, n, sysm = r._encode(padded, cfg.max_levels)
+    ids, n = depth_bucket(ids, n)
+    res = match_batch(auto, ids, n, sysm, k=r.effective_k(),
+                      m=cfg.max_matches, pack_ids=False,
+                      **r._walk_kw(ids.shape[1]))
+    assert np.array_equal(np.asarray(ids_dev), np.asarray(res.ids))
+    assert np.array_equal(np.asarray(ovf_dev),
+                          np.asarray(res.overflow))
+
+
+def test_broker_publish_batch_hits_cache_across_batches():
+    b = Broker(config=MatcherConfig(device_min_filters=0,
+                                    match_cache_slots=128))
+    s1, s2 = Q("c1"), Q("c2")
+    b.subscribe(s1, "a/+")
+    b.subscribe(s2, "a/b")
+    msgs = [Message(topic=t) for t in ["a/b", "a/c", "a/b"]]
+    assert b.publish_batch(msgs) == [2, 1, 2]
+    c = b.router._match_cache_obj
+    hits_before = c.hits
+    assert b.publish_batch(msgs) == [2, 1, 2]  # all repeat topics
+    assert c.hits > hits_before
+    assert len(s1.inbox) == 6 and len(s2.inbox) == 4
+    # churn between batches: parity must survive the epoch bump
+    s3 = Q("c3")
+    b.subscribe(s3, "a/#")
+    assert b.publish_batch(msgs) == [3, 2, 3]
+
+
+def test_drain_cache_stats_feeds_metrics():
+    from emqx_tpu.metrics import Metrics
+
+    r = _mk(match_cache_slots=64)
+    r.add_route("m/1")
+    r.match_filters(["m/1", "m/1"])
+    r.match_filters(["m/1"])
+    m = Metrics()
+    drained = r.drain_cache_stats()
+    assert drained["miss"] >= 1 and drained["hit"] >= 1
+    m.fold_cache_stats(drained)
+    assert m.val("cache.match.hit") == drained["hit"]
+    assert m.val("cache.match.miss") == drained["miss"]
+    assert m.val("cache.match.insert") == drained["insert"]
+    # second drain: deltas only
+    assert r.drain_cache_stats()["hit"] == 0
+    assert r.cache_entries() >= 1
+
+
+# -- sharded (mesh) cache --------------------------------------------------
+
+
+def test_mesh_cached_publish_parity_1x1():
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    b = Broker(router=Router(
+        MatcherConfig(mesh=make_mesh(1, 1), fanout_d=8,
+                      match_cache_slots=128), node="local"))
+    s1, s2 = Q("c1"), Q("c2")
+    b.subscribe(s1, "a/+")
+    b.subscribe(s2, "a/b")
+    msgs = [Message(topic="a/b"), Message(topic="a/c"),
+            Message(topic="a/b")]
+    assert b.publish_batch(msgs) == [2, 1, 2]
+    cache = b.router._sharded_cache_obj
+    assert cache is not None and cache.inserts > 0
+    hits = cache.hits
+    assert b.publish_batch(msgs) == [2, 1, 2]
+    assert cache.hits > hits
+    # epoch bump via subscribe: cached rows must not ghost-deliver
+    s3 = Q("c3")
+    b.subscribe(s3, "a/#")
+    assert b.publish_batch(msgs) == [3, 2, 3]
+    b.unsubscribe(s3, "a/#")
+    assert b.publish_batch(msgs) == [2, 1, 2]
+
+
+def test_mesh_big_filters_bypass_cache():
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    # fanout_d=2 makes a 4-member filter "big" (bitmap path): the
+    # sharded cache must refuse (a union row is unboundedly wide) and
+    # the legacy collective path must stay exact
+    b = Broker(router=Router(
+        MatcherConfig(mesh=make_mesh(1, 1), fanout_d=2,
+                      match_cache_slots=128), node="local"))
+    subs = [Q(f"c{i}") for i in range(4)]
+    for s in subs:
+        b.subscribe(s, "big/t")
+    assert b.publish(Message(topic="big/t")) == 4
+    assert b.publish(Message(topic="big/t")) == 4
+    cache = b.router._sharded_cache_obj
+    assert cache is None or cache.hits == 0

@@ -261,3 +261,41 @@ def test_wide_mode_stale_steps_flags_overflow():
         got = sorted(rev[j]
                      for j in np.asarray(res_stale.ids)[0] if j >= 0)
         assert got == got_fresh
+
+
+def test_hop_fallbacks_trigger_compaction_signal():
+    """ADVICE r5: host fallbacks observed while the hop bound is
+    stale count toward needs_compaction alongside splits/tombstones
+    — a patch-deepened automaton rebuilds long before 1024 splits."""
+    table = WordTable()
+    auto, fids = _build(["a/b"], table, caps=(64, 64))
+    p = AutoPatcher(auto, table.intern)
+    p.note_hop_fallbacks(5000)
+    assert not p.needs_compaction(10)  # hops never grew: not counted
+    p.insert("a/b/c/d/e", 1)  # deepens the walk -> hops_grown
+    assert p.hops_grown
+    p.note_hop_fallbacks(500)
+    assert not p.needs_compaction(10)
+    p.note_hop_fallbacks(600)  # 1100 > max(1024, live)
+    assert p.needs_compaction(10)
+
+
+def test_router_note_match_fallbacks_schedules_rebuild():
+    import time
+
+    from emqx_tpu.router import MatcherConfig, Router
+
+    r = Router(MatcherConfig(device_min_filters=0), node="n")
+    r.add_route("a/b")
+    r.match_filters(["a/b"])  # first flatten + live patcher
+    rebuilds = r.stats()["rebuilds"]
+    # force the stale-hop regime, then report a fallback storm
+    r._patcher.hops_grown = True
+    r.note_match_fallbacks(2000)
+    for _ in range(200):  # background compaction thread
+        if r.stats()["rebuilds"] > rebuilds:
+            break
+        time.sleep(0.05)
+    assert r.stats()["rebuilds"] > rebuilds
+    # the fresh patcher starts clean
+    assert r._patcher.hop_fallbacks == 0

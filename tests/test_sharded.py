@@ -601,3 +601,32 @@ def test_placed_batch_stale_after_route_add_reencodes():
                       and id_map[i] is not None) for row in ids]
     assert matched[0] == ["a/+"]
     assert matched[1] == ["brandnew/word"], matched
+
+
+def test_finalize_parts_demotes_all_shards_on_wide_guard():
+    """ADVICE r5: a shard whose trie trips compress_automaton's
+    wide-mode fallback guard (depth > 31) stays narrow even under
+    force_mode="wide"; finalize_parts must then demote EVERY shard to
+    narrow instead of stacking mismatched row widths."""
+    from emqx_tpu.ops.csr import build_automaton
+    from emqx_tpu.parallel.sharded import finalize_parts
+
+    table = WordTable()
+
+    def raw(filters):
+        trie = TrieOracle()
+        fids = {}
+        for f in filters:
+            trie.insert(f)
+            fids[f] = len(fids)
+            for w in f.split("/"):
+                table.intern(w)
+        return build_automaton(trie, fids, table, skip_hash=True)
+
+    # shard 0: a long literal chain below depth 32 -> wants wide
+    deep_ok = "/".join(f"w{i}" for i in range(10))
+    # shard 1: depth 33 -> the guard forces narrow regardless
+    too_deep = "/".join(f"v{i}" for i in range(33))
+    parts = finalize_parts([raw([deep_ok]), raw([too_deep])])
+    assert len({p.wt_slots for p in parts}) == 1
+    assert all(p.wt_take == 1 for p in parts)  # demoted to narrow

@@ -128,3 +128,21 @@ def test_frame_decoder_never_builds_objects_from_names():
     got = wire.loads(wire.dumps(("os.system", "builtins.eval")))
     assert got == ("os.system", "builtins.eval")
     assert all(isinstance(x, str) for x in got)
+
+def test_dumps_lone_surrogate_raises_wireerror():
+    # json.dumps accepts the string; the utf-8 encode step raises
+    # UnicodeEncodeError — dumps() must keep its WireError contract
+    with pytest.raises(wire.WireError):
+        wire.dumps("bad \ud800 payload")
+    with pytest.raises(wire.WireError):
+        wire.dumps({"k": ["nested \udfff"]})
+
+
+def test_dumps_deep_structure_raises_wireerror():
+    import sys
+
+    x = "leaf"
+    for _ in range(sys.getrecursionlimit() * 2):
+        x = [x]
+    with pytest.raises(wire.WireError):
+        wire.dumps(x)
