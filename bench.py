@@ -923,6 +923,40 @@ def main():
         step, step_batches, windows, iters)
     throughput = batches_per_s * batch
     p50, p99 = _latency_pass(step, step_batches)
+
+    # per-stage attribution columns (ISSUE 2; docs/OBSERVABILITY.md):
+    # time nested pipeline PREFIXES — match only, match+pack, full —
+    # and difference them, attributing the row's latency to a stage
+    # instead of a vibe. Two small extra compiles + a few timed
+    # iterations; BENCH_BREAKDOWN=0 skips. Cache rows skip it too:
+    # their step is host-orchestrated (probe/merge around the walk)
+    # and the cache_* info fields already carry that split.
+    stage_ms = None
+    if not use_cache and os.environ.get("BENCH_BREAKDOWN", "1") == "1":
+        def step_match(ids, n, sysm):
+            res = match_batch(auto, ids, n, sysm, k=k, m=m,
+                              pack_ids=False,
+                              **walk_params(host_auto, ids.shape[1]))
+            return res.ids
+
+        def step_mp(ids, n, sysm):
+            res = match_batch(auto, ids, n, sysm, k=k, m=m,
+                              pack_ids=False,
+                              **walk_params(host_auto, ids.shape[1]))
+            m_ptr, packed = pack_matches(res.ids, pm=PM)
+            return packed, m_ptr
+
+        for s_ in (step_match, step_mp):  # compile outside the timing
+            for b_ in step_batches:
+                jax.block_until_ready(s_(*b_))
+        p50_m, _ = _latency_pass(step_match, step_batches, iters=8)
+        p50_mp, _ = _latency_pass(step_mp, step_batches, iters=8)
+        stage_ms = {
+            "match": round(p50_m, 3),
+            "pack": round(max(0.0, p50_mp - p50_m), 3),
+            "expand": round(max(0.0, p50 - p50_mp), 3),
+        }
+
     counts = np.asarray(outs[0][0])[:uniques[0]]
     deliv = np.diff(np.asarray(outs[0][1]))[:uniques[0]]
     ovf = sum(int(np.asarray(o[2]).sum()) for o in outs)
@@ -947,6 +981,8 @@ def main():
         "unique_kmsgs_per_s": round(batches_per_s * avg_unique / 1e3, 1),
         "window_mmsgs": [round(r * batch / 1e6, 2) for r in rates],
     }
+    if stage_ms is not None:
+        info["stage_p50_ms"] = stage_ms
     if use_cache:
         st1 = cache.stats()
         probed = (st1["hit"] - st0["hit"]) + (st1["miss"] - st0["miss"])
@@ -960,14 +996,17 @@ def main():
             (st1["hit"] - st0["hit"]) / probed, 4) if probed else 0.0
     import sys
     print(json.dumps(info), file=sys.stderr, flush=True)
-    _emit({
+    rec = {
         "metric": "publish_match_fanout_throughput",
         "value": round(throughput, 1),
         "unit": "msgs/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
         "p50_batch_ms": round(p50, 3),
         "p99_batch_ms": round(p99, 3),
-    })
+    }
+    if stage_ms is not None:
+        rec["stage_p50_ms"] = stage_ms
+    _emit(rec)
 
 
 def live():

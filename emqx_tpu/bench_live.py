@@ -293,6 +293,16 @@ async def _run() -> dict:
         out["probe_samples"] = int(probe_lats.size)
         out["probe_p50_ms"] = float(np.percentile(probe_lats, 50))
         out["probe_p99_ms"] = float(np.percentile(probe_lats, 99))
+    tel = getattr(node, "telemetry", None)
+    if tel is not None and tel.enabled:
+        # per-stage breakdown from the publish-path telemetry spans
+        # (docs/OBSERVABILITY.md): where a batch's latency went —
+        # match dispatch vs transfer wait vs delivery tail
+        out["stages"] = {
+            s: {"count": st["count"],
+                "p50_ms": round(st["p50_ms"], 3),
+                "p99_ms": round(st["p99_ms"], 3)}
+            for s, st in tel.stage_stats().items() if st["count"]}
     return out
 
 
@@ -336,6 +346,14 @@ def live(emit=None) -> None:
         rec["p99_batch_ms"] = round(info["p99_ms"], 3)
         rec["p99_deliver_ms"] = round(info["p99_ms"], 3)
         rec["p50_deliver_ms"] = round(info["p50_ms"], 3)
+    if "stages" in info:
+        # per-stage breakdown columns (telemetry spans): a latency
+        # regression in this row is attributable to a stage, not a
+        # vibe (ISSUE 2)
+        rec["stage_p50_ms"] = {s: v["p50_ms"]
+                               for s, v in info["stages"].items()}
+        rec["stage_p99_ms"] = {s: v["p99_ms"]
+                               for s, v in info["stages"].items()}
     if emit is not None:
         # the repo-root bench entry passes its _emit so the record
         # stages through the last-good-TPU artifact path

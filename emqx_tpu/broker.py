@@ -57,7 +57,7 @@ class PendingBatch:
 
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
-        "host_matched", "host_inv",
+        "host_matched", "host_inv", "span",
         "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
@@ -72,6 +72,10 @@ class PendingBatch:
 
     def __init__(self) -> None:
         self.done = False
+        # telemetry span (telemetry.PublishSpan | None) — None is the
+        # disabled fast path: every instrumented section below guards
+        # on it with one branch and touches no clock
+        self.span = None
         self.results: List[int] = []
         self.live: List[Tuple[int, Message]] = []
         self.host_topics: Optional[List[str]] = None
@@ -136,6 +140,9 @@ class Broker:
         self.flapping = None
         self.delayed = None
         self.tracer = None
+        # publish-path telemetry (telemetry.Telemetry), wired by Node
+        # next to router.telemetry; None = uninstrumented
+        self.telemetry = None
         # learned packed-transfer budgets per batch bucket: a workload
         # whose steady-state fan-out exceeds the configured budget
         # would otherwise pay a re-pack + second transfer EVERY batch
@@ -280,6 +287,10 @@ class Broker:
         ingress uses it while earlier batches are still in flight so a
         host-path batch cannot deliver ahead of them."""
         pb = PendingBatch()
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            pb.span = tel.begin(len(msgs))
+        sp = pb.span
         pb.results = [0] * len(msgs)
         for i, msg in enumerate(msgs):
             self.metrics.inc_msg(msg)
@@ -298,7 +309,10 @@ class Broker:
             pb.live.append((i, out))
         if not pb.live:
             pb.done = True
+            self._span_finish(pb)
             return pb
+        if sp is not None:
+            sp.topic = pb.live[0][1].topic
         topics = [m.topic for _, m in pb.live]
         cfg = self.router.config
         if not self.router.use_device_now():
@@ -307,11 +321,14 @@ class Broker:
             # hysteresis — an oscillating filter count must not pay a
             # re-flatten per threshold crossing)
             self.router.reclaim_host_regime()
+            if sp is not None:
+                sp.path = "host"
             if defer_host:
                 pb.host_topics = topics
             else:
                 self._publish_host(pb, topics)
                 pb.done = True
+                self._span_finish(pb)
             return pb
 
         # device match (HOT LOOP 1) → device fan-out (HOT LOOP 2)
@@ -327,10 +344,18 @@ class Broker:
         # kernels either way.
         uniq, pb.inv = dedup_topics(topics)
         pb.n_uniq = len(uniq)
+        if sp is not None:
+            sp.n_uniq = pb.n_uniq
         if cfg.mesh is not None:
             return self._publish_begin_mesh(pb, uniq, cfg)
+        t_m = sp.clock() if sp is not None else 0.0
         pb.ids_dev, pb.ovf_dev, pb.id_map, pb.epoch = \
             self.router.match_dispatch(uniq)
+        if sp is not None:
+            # closes the match stage; the router's cache-split path
+            # (telemetry-gated) left the cache_gather share to split
+            sp.stamp_match(self.router, t_m)
+            t_p = sp.clock()
         # phantom pad-row matches (wildcards match the pad topic) must
         # not reach the fan-out/pack kernels or the learned budgets
         pb.ids_dev = mask_pad_rows(pb.ids_dev, np.int32(len(uniq)))
@@ -357,6 +382,9 @@ class Broker:
             has_big = (rows_d >= 0).any(axis=1)
             pb.sel_d, pb.rows_packed_d, pb.bm_total_d = pack_union_rows(
                 union_d, has_big, pr=budgets[2])
+        if sp is not None:
+            sp.bucket = bucket
+            sp.add("pack", t_p)
         return pb
 
     def _publish_begin_mesh(self, pb: PendingBatch, uniq: List[str],
@@ -374,9 +402,20 @@ class Broker:
             return self.helper.sharded_state(
                 epoch, id_map, cfg.mesh, self.router.effective_d())
 
+        sp = pb.span
+        if sp is not None:
+            sp.path = "mesh"
+            t_m = sp.clock()
         (pb.ids_dev, subs_d, src_d, bm, pb.ovf_dev, pb.movf_d,
          pb.id_map, pb.epoch, pb.sh_big) = \
             self.router.publish_dispatch_sharded(uniq, fan_provider)
+        if sp is not None:
+            # the collective step dispatch (match + gather + ICI
+            # all-gather enqueued as one program); the sharded
+            # cache-split path leaves its gather share like the
+            # single-chip one
+            sp.stamp_match(self.router, t_m)
+            t_p = sp.clock()
         n_uniq = np.int32(pb.n_uniq)
         pb.ids_dev = mask_pad_rows(pb.ids_dev, n_uniq)
         bucket = pb.ids_dev.shape[0]
@@ -401,20 +440,40 @@ class Broker:
             pb.has_big_d = mask_pad_flags(has_big_d, n_uniq)
             pb.sel_d, pb.rows_packed_d, pb.bm_total_d = pack_union_rows(
                 union_d, pb.has_big_d, pr=budgets[2])
+        if sp is not None:
+            sp.bucket = bucket
+            sp.add("pack", t_p)
         return pb
 
     def _publish_host(self, pb: PendingBatch, topics: List[str]) -> None:
         """Host-path matching + routing for a begun batch (below the
         device threshold, device off, or empty route table). Hot
         topics dedup here too — one trie walk per unique topic."""
+        sp = pb.span
+        if sp is not None:
+            t_m = sp.clock()
         uniq, inv = dedup_topics(topics)
+        pb.n_uniq = len(uniq)
         matched = self.router.match_filters(uniq)
+        if sp is not None:
+            sp.n_uniq = pb.n_uniq
+            sp.add("match", t_m)  # host regime: the actual trie walk
+            t_d = sp.clock()
         for row, (i, msg) in enumerate(pb.live):
             filters = matched[inv[row]]
             if not filters:
                 self._drop_no_subs(msg)
                 continue
             pb.results[i] = self._route(filters, msg)
+        if sp is not None:
+            sp.add("dispatch", t_d)
+
+    def _span_finish(self, pb: PendingBatch) -> None:
+        """Close a batch's telemetry span (idempotent; no-op when
+        telemetry is off)."""
+        if pb.span is not None:
+            self.telemetry.finish(pb.span)
+            pb.span = None
 
     def publish_fetch(self, pb: PendingBatch) -> None:
         """Phase 2 — the blocking device→host transfer, coalesced.
@@ -430,6 +489,12 @@ class Broker:
             return
         import jax
 
+        sp = pb.span
+        if sp is not None:
+            # the ONE synchronizing stage: device execution queued by
+            # publish_begin surfaces as transfer wait here (no
+            # block_until_ready added — device_get already syncs)
+            t_f = sp.clock()
         cfg = self.router.config
         Bp = pb.ids_dev.shape[0]
         budgets = self._pack_budgets.get(Bp)
@@ -567,6 +632,9 @@ class Broker:
             pb.sel = sel
             pb.rows_packed = rows_p
             pb.bovf = bovf
+            if sp is not None:
+                sp.fallbacks = n_fb
+                sp.add("fetch", t_f)
             return
 
     def publish_finish(self, pb: PendingBatch) -> List[int]:
@@ -589,9 +657,17 @@ class Broker:
         as :meth:`publish_finish_chunk`). The one trie walk over the
         batch's unique topics happens on the first chunk and is
         cached on the batch."""
+        sp = pb.span
         if pb.host_matched is None:
+            if sp is not None:
+                t_m = sp.clock()
             uniq, pb.host_inv = dedup_topics(pb.host_topics)
             pb.host_matched = self.router.match_filters(uniq)
+            if sp is not None:
+                sp.n_uniq = len(uniq)
+                sp.add("match", t_m)
+        if sp is not None:
+            t_d = sp.clock()
         for row in range(start, stop):
             i, msg = pb.live[row]
             filters = pb.host_matched[pb.host_inv[row]]
@@ -599,6 +675,10 @@ class Broker:
                 self._drop_no_subs(msg)
                 continue
             pb.results[i] = self._route(filters, msg)
+        if sp is not None:
+            sp.add("dispatch", t_d)
+            if stop >= len(pb.live):
+                self._span_finish(pb)
 
     def publish_finish_chunk(self, pb: PendingBatch, start: int,
                              stop: int) -> None:
@@ -609,17 +689,25 @@ class Broker:
         still routing, instead of the whole batch's tail waiting on
         the full host loop (round-4 live p99 finding)."""
         m_ptr = pb.m_ptr
+        sp = pb.span
+        if sp is not None:
+            t_d = sp.clock()
         for row in range(start, stop):
             i, msg = pb.live[row]
             urow = pb.inv[row]  # packed results are per UNIQUE topic
             if pb.ovf[urow]:
                 # match overflow: this topic's result is unknown —
                 # full host path for it (exact parity, no truncation)
+                t_fb = sp.clock() if sp is not None else 0.0
                 filters = self.router.host_match(msg.topic)
                 if not filters:
                     self._drop_no_subs(msg)
-                    continue
-                pb.results[i] = self._route(filters, msg)
+                else:
+                    pb.results[i] = self._route(filters, msg)
+                if sp is not None:
+                    # a subset of dispatch time, split out so the
+                    # oracle-fallback cost is attributable on its own
+                    sp.add("host_fallback", t_fb)
                 continue
             row_ids = pb.ids_packed[m_ptr[urow]:m_ptr[urow + 1]]
             filters = [pb.id_map[j] for j in row_ids]
@@ -629,6 +717,10 @@ class Broker:
                 continue
             pb.results[i] = self._route_packed(urow, row_ids, filters,
                                                msg, pb)
+        if sp is not None:
+            sp.add("dispatch", t_d)
+            if stop >= len(pb.live):
+                self._span_finish(pb)
 
     def _drop_no_subs(self, msg: Message) -> None:
         self.metrics.inc("messages.dropped")

@@ -43,7 +43,11 @@ property).
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # py<3.11: tomllib IS tomli, vendored
+    import tomli as tomllib
 from typing import Any, Dict, List, Optional
 
 from emqx_tpu.zone import Zone, set_zone
@@ -105,6 +109,10 @@ class NodeConfig:
     # (emqx_tpu.router.MatcherConfig — match-cache sizing and off
     # switch, kernel bounds, host/device threshold). None = defaults.
     matcher: Optional[Any] = None
+    # [telemetry] section: publish-path stage histograms + slow-
+    # publish log (emqx_tpu.telemetry.TelemetryConfig). None =
+    # defaults (enabled).
+    telemetry: Optional[Any] = None
 
 
 #: zone fields with a closed value set — a typo must be a startup
@@ -155,6 +163,39 @@ def _build_matcher(raw: Dict[str, Any]):
             raise ConfigError(f"matcher.{key} must be an integer")
         kwargs[key] = val
     return MatcherConfig(**kwargs)
+
+
+def _build_telemetry(raw: Dict[str, Any]):
+    """``[telemetry]`` table → :class:`~emqx_tpu.telemetry
+    .TelemetryConfig`. Closed schema like zones/matcher: a typo'd
+    ``enabled = false`` silently leaving span recording on (or off)
+    is exactly the drift this rule exists to catch."""
+    import dataclasses as _dc
+
+    from emqx_tpu.telemetry import TelemetryConfig
+
+    known = {f.name for f in _dc.fields(TelemetryConfig)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown telemetry setting: "
+                              f"telemetry.{key}")
+        want = TelemetryConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"telemetry.{key} must be a boolean")
+        if want == "int" and (isinstance(val, bool)
+                              or not isinstance(val, int)):
+            raise ConfigError(f"telemetry.{key} must be an integer")
+        if want == "float":
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise ConfigError(f"telemetry.{key} must be a number")
+            val = float(val)
+        kwargs[key] = val
+    if kwargs.get("slow_threshold_ms", 1.0) < 0:
+        raise ConfigError("telemetry.slow_threshold_ms must be >= 0")
+    if kwargs.get("ring_size", 1) <= 0:
+        raise ConfigError("telemetry.ring_size must be > 0")
+    return TelemetryConfig(**kwargs)
 
 
 def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
@@ -260,6 +301,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         if not isinstance(mraw, dict):
             raise ConfigError("matcher must be a table")
         cfg.matcher = _build_matcher(mraw)
+    traw = raw.get("telemetry")
+    if traw is not None:
+        if not isinstance(traw, dict):
+            raise ConfigError("telemetry must be a table")
+        cfg.telemetry = _build_telemetry(traw)
     for name, zraw in raw.get("zones", {}).items():
         cfg.zones[name] = _build_zone(name, zraw)
     for i, lraw in enumerate(raw.get("listeners", [])):
@@ -310,6 +356,7 @@ def build_node(cfg: NodeConfig):
     default = cfg.zones.get("default")
     node = Node(name=cfg.name, zone=default,
                 matcher=cfg.matcher,
+                telemetry=cfg.telemetry,
                 sys_interval=cfg.sys_interval,
                 load_default_modules=cfg.load_default_modules,
                 boot_listeners=False)

@@ -78,8 +78,40 @@ class Ctl:
                               "list listeners + connection counts")
         self.register_command("log", self._log,
                               "set-level <debug|info|warning|error> | show")
+        self.register_command(
+            "telemetry", self._telemetry,
+            "stages | slow | reset — publish-path stage latency")
         from emqx_tpu.profiling import register_ctl
         register_ctl(self)
+
+    def _telemetry(self, args) -> str:
+        tel = getattr(self.node, "telemetry", None)
+        if tel is None:
+            return "telemetry not available on this node"
+        if not args or args[0] == "stages":
+            if not tel.enabled:
+                return "telemetry: disabled ([telemetry] enabled = false)"
+            from emqx_tpu.telemetry import STAGES
+            stats = tel.stage_stats()
+            lines = [f"{'stage':<14}{'count':>8}{'p50_ms':>10}"
+                     f"{'p95_ms':>10}{'p99_ms':>10}"]
+            for s in STAGES:
+                st = stats[s]
+                lines.append(f"{s:<14}{st['count']:>8}"
+                             f"{st['p50_ms']:>10.3f}"
+                             f"{st['p95_ms']:>10.3f}"
+                             f"{st['p99_ms']:>10.3f}")
+            lines.append(f"spans: {tel.spans_total}  slow: "
+                         f"{tel.slow_total} (threshold "
+                         f"{tel.config.slow_threshold_ms}ms)")
+            return "\n".join(lines)
+        if args[0] == "slow":
+            recs = tel.slow_records()
+            return json.dumps(recs, indent=2) if recs else "(none)"
+        if args[0] == "reset":
+            tel.reset()
+            return "ok"
+        raise ValueError(f"bad subcommand: {args[0]}")
 
     def _log(self, args) -> str:
         import logging

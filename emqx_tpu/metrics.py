@@ -94,6 +94,17 @@ ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
                + TRANSPORT_METRICS)
 
+#: registry names that are NOT monotonic — ``Metrics.dec`` runs on
+#: them in steady state (today: the retainer's live-entry count,
+#: modules/retainer.py). Prometheus semantics split on this: a
+#: ``counter`` may only go up (scrapers compute rate() over it and
+#: treat any decrease as a process restart), so the exposition
+#: (modules/prometheus.render) must emit these as ``gauge``. Add any
+#: new dec'd name here or its scraped rates turn to garbage.
+GAUGE_METRICS = frozenset({
+    "retained.count",
+})
+
 
 class Metrics:
     def __init__(self) -> None:

@@ -12,8 +12,16 @@ Naming: metric/stat keys are dotted (`messages.received`,
 `subscriptions.count`); Prometheus names must match
 ``[a-zA-Z_:][a-zA-Z0-9_:]*``, so dots and slashes become underscores
 under an ``emqx_`` prefix: ``emqx_messages_received``. Counters from
-the metrics registry are TYPE counter; stats are point-in-time TYPE
-gauge (their ``.max`` companions included).
+the metrics registry are TYPE counter — EXCEPT the audited
+non-monotonic names (`metrics.GAUGE_METRICS`, e.g. the retainer's
+live-entry count, which `Metrics.dec` moves down): those are TYPE
+gauge, because a scraper computes `rate()` over counters and reads
+any decrease as a process restart. Stats are point-in-time TYPE
+gauge (their ``.max`` companions included). Publish-path latency
+histograms (`emqx_tpu/telemetry.py`) render as proper histogram
+families: cumulative ``_bucket{le=...}`` lines (buckets in
+milliseconds, matching the ``_ms`` family suffix), ``_sum``,
+``_count``.
 
 Env keys (``[modules.prometheus]``): ``host`` (default 127.0.0.1),
 ``port`` (default 9505; 0 = ephemeral, the bound port is in
@@ -36,20 +44,38 @@ def prom_name(key: str) -> str:
     return "emqx_" + _NAME_RE.sub("_", key)
 
 
-def render(metrics: dict, stats: dict) -> str:
-    """The two registries as one exposition document. Counters and
+def render(metrics: dict, stats: dict,
+           histograms: Optional[dict] = None) -> str:
+    """The registries as one exposition document. Counters and
     gauges carry no labels (single-node registry; per-topic metrics
     stay in the topic_metrics module, deliberately unexported — an
-    unbounded topic set is a label-cardinality trap)."""
+    unbounded topic set is a label-cardinality trap); histogram
+    buckets carry only the standard ``le`` label.
+
+    ``histograms`` maps a ready-made family name to a
+    ``Histogram.snapshot()`` dict (cumulative ``(le, count)`` bucket
+    pairs + sum/count) — the shape ``Telemetry.histograms()``
+    produces."""
+    from emqx_tpu.metrics import GAUGE_METRICS
+
     out = []
     for key in sorted(metrics):
         name = prom_name(key)
-        out.append(f"# TYPE {name} counter")
+        kind = "gauge" if key in GAUGE_METRICS else "counter"
+        out.append(f"# TYPE {name} {kind}")
         out.append(f"{name} {int(metrics[key])}")
     for key in sorted(stats):
         name = prom_name(key)
         out.append(f"# TYPE {name} gauge")
         out.append(f"{name} {int(stats[key])}")
+    for name in sorted(histograms or ()):
+        snap = histograms[name]
+        out.append(f"# TYPE {name} histogram")
+        for le, cum in snap["buckets"]:
+            out.append(f'{name}_bucket{{le="{format(le, "g")}"}} {cum}')
+        out.append(f'{name}_bucket{{le="+Inf"}} {snap["count"]}')
+        out.append(f"{name}_sum {snap['sum']:.6f}")
+        out.append(f"{name}_count {snap['count']}")
     return "\n".join(out) + "\n"
 
 
@@ -124,8 +150,11 @@ class PrometheusModule(Module):
                 # refresh registered gauge update-funs before reading,
                 # like the $SYS heartbeat does
                 self.node.stats.tick()
+                tel = getattr(self.node, "telemetry", None)
+                hists = (tel.histograms()
+                         if tel is not None and tel.enabled else None)
                 body = render(self.node.metrics.all(),
-                              self.node.stats.all()).encode()
+                              self.node.stats.all(), hists).encode()
                 head = (b"HTTP/1.1 200 OK\r\n"
                         b"Content-Type: text/plain; version=0.0.4; "
                         b"charset=utf-8\r\n"

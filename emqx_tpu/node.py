@@ -32,6 +32,7 @@ from emqx_tpu.plugins import Plugins
 from emqx_tpu.router import MatcherConfig, Router
 from emqx_tpu.stats import Stats
 from emqx_tpu.sys_topics import SysTopics
+from emqx_tpu.telemetry import Telemetry, TelemetryConfig
 from emqx_tpu.tracer import Tracer
 from emqx_tpu.zone import Zone, get_zone
 
@@ -42,6 +43,7 @@ class Node:
     def __init__(self, name: str = "emqx_tpu@127.0.0.1",
                  zone: Optional[Zone] = None,
                  matcher: Optional[MatcherConfig] = None,
+                 telemetry: Optional[TelemetryConfig] = None,
                  boot_listeners: bool = True,
                  sys_interval: float = 60.0,
                  load_default_modules: bool = False,
@@ -75,8 +77,17 @@ class Node:
             banned=self.broker.banned, metrics=self.metrics)
         # ops (emqx_sys_sup)
         self.alarms = AlarmManager(broker=self.broker, node=name)
+        # publish-path telemetry (telemetry.py): stage histograms +
+        # slow-publish log. Wired onto broker AND router — the broker
+        # stamps the spans, the router's cache-split dispatch leaves
+        # its probe/merge share for the span to pick up
+        self.telemetry = Telemetry(telemetry, tracer=self.tracer,
+                                   alarms=self.alarms, node=name)
+        self.broker.telemetry = self.telemetry
+        self.router.telemetry = self.telemetry
         self.sys = SysTopics(self.broker, node=name, stats=self.stats,
-                             interval=sys_interval)
+                             interval=sys_interval,
+                             telemetry=self.telemetry)
         # host monitors (emqx_os_mon / emqx_vm_mon / emqx_sys_mon)
         self.os_mon = OsMon(self.alarms)
         self.vm_mon = VmMon(self.alarms, self.cm.connection_count,
@@ -305,6 +316,10 @@ class Node:
         stats.setstat("match.cache.entries.count",
                       self.router.cache_entries(),
                       "match.cache.entries.max")
+        stats.setstat("publish.spans.count", self.telemetry.spans_total,
+                      "publish.spans.max")
+        stats.setstat("publish.slow.count", self.telemetry.slow_total,
+                      "publish.slow.max")
 
     # -- facade (src/emqx.erl:26-64) --------------------------------------
 

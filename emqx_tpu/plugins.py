@@ -18,7 +18,11 @@ from __future__ import annotations
 import importlib
 import json
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # py<3.11: tomllib IS tomli, vendored
+    import tomli as tomllib
 from typing import Dict, List, Optional
 
 

@@ -79,7 +79,7 @@ class KernelTimer:
         self._samples: Dict[str, deque] = {}
 
     @contextlib.contextmanager
-    def span(self, name: str, block=None):
+    def span(self, name: str):
         import jax
 
         t0 = time.perf_counter()
@@ -134,7 +134,20 @@ def register_ctl(ctl) -> None:
             if _active["dir"] is not None:
                 return f"already tracing to {_active['dir']}"
             logdir = args[1] if len(args) > 1 else "/tmp/emqx_tpu_trace"
-            jax.profiler.start_trace(logdir)
+            try:
+                jax.profiler.start_trace(logdir)
+            except Exception as e:
+                # an unwritable dir must not strand a half-started
+                # trace with _active["dir"] unset (the next `start`
+                # would raise "already started" from inside jax with
+                # no way out but a restart): best-effort stop any
+                # partial trace, keep the registry consistent, and
+                # hand the operator the reason as text
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                return f"profile start failed: {e}"
             _active["dir"] = logdir
             return f"tracing to {logdir} (view with TensorBoard)"
         if args[0] == "stop":

@@ -25,6 +25,7 @@ workers, emqx_router.erl:185-186); here a mutex serializes mutations.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
@@ -205,6 +206,14 @@ class Router:
         self._match_cache_obj = None
         self._sharded_cache_obj = None
         self._sharded_cache_meta = None  # (T, m, d) the table is sized for
+        # publish-path telemetry (telemetry.Telemetry), wired by Node
+        # alongside broker.telemetry. When enabled, the cache-split
+        # dispatch leaves its per-batch probe/merge timing + hit/miss
+        # split in _last_dispatch for the broker's span to consume
+        # (PublishSpan.stamp_match pops it) — None otherwise, and the
+        # dispatch path pays nothing
+        self.telemetry = None
+        self._last_dispatch: Optional[dict] = None
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
 
@@ -850,7 +859,11 @@ class Router:
         bucket = cfg.min_batch
         while bucket < len(topics):
             bucket *= 2
+        tel = self.telemetry
+        timed = tel is not None and tel.enabled
+        t0 = time.perf_counter() if timed else 0.0
         probe = cache.probe(topics, key)
+        t1 = time.perf_counter() if timed else 0.0
         miss_rows = miss_ovf = None
         if probe.miss_topics:
             mb = cfg.min_batch
@@ -867,8 +880,19 @@ class Router:
                               **self._walk_kw(ids.shape[1]))
             miss_rows, miss_ovf = res.ids, res.overflow
             cache.insert(probe, miss_rows, miss_ovf)
+        t2 = time.perf_counter() if timed else 0.0
         ids_dev, ovf_dev, _movf = cache.merge(bucket, probe,
                                               miss_rows, miss_ovf)
+        if timed:
+            # probe (host hash walk) + merge (HBM-gather dispatch) =
+            # the cache_gather share of this dispatch; the remainder
+            # (encode + miss walk) is the match share
+            self._last_dispatch = {
+                "hit": len(probe.hit_pos),
+                "miss": len(probe.miss_topics),
+                "cache_gather_ms": ((t1 - t0) + (
+                    time.perf_counter() - t2)) * 1000.0,
+            }
         return ids_dev, ovf_dev, id_map, epoch
 
     def drain_cache_stats(self) -> Dict[str, int]:
@@ -1057,7 +1081,11 @@ class Router:
         bucket = unit
         while bucket < len(topics):
             bucket *= 2
+        tel = self.telemetry
+        timed = tel is not None and tel.enabled
+        t0 = time.perf_counter() if timed else 0.0
         probe = cache.probe(topics, key)
+        t1 = time.perf_counter() if timed else 0.0
         miss_rows = miss_ovf = miss_movf = None
         if probe.miss_topics:
             (m_ids, m_subs, m_src, m_bm, m_ovf, m_movf, m_map,
@@ -1074,6 +1102,7 @@ class Router:
             miss_rows = jnp.concatenate([m_ids, m_subs, m_src], axis=1)
             miss_ovf, miss_movf = m_ovf, m_movf
             cache.insert(probe, miss_rows, miss_ovf, miss_movf)
+        t2 = time.perf_counter() if timed else 0.0
         merged, ovf, movf = cache.merge(bucket, probe, miss_rows,
                                         miss_ovf, miss_movf)
         mw = n_trie * cfg.max_matches
@@ -1081,6 +1110,13 @@ class Router:
         ids = merged[:, :mw]
         subs = merged[:, mw:mw + dw]
         src = merged[:, mw + dw:]
+        if timed:
+            self._last_dispatch = {
+                "hit": len(probe.hit_pos),
+                "miss": len(probe.miss_topics),
+                "cache_gather_ms": ((t1 - t0) + (
+                    time.perf_counter() - t2)) * 1000.0,
+            }
         return (ids, subs, src, None, ovf, movf, id_map, epoch,
                 frozenset())
 

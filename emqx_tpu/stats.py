@@ -20,6 +20,11 @@ STATS_KEYS = [
     "channels.count", "channels.max",
     # live publish match-cache entries (emqx_tpu/ops/match_cache.py)
     "match.cache.entries.count", "match.cache.entries.max",
+    # publish-path telemetry (emqx_tpu/telemetry.py): recorded batch
+    # spans and slow-publish breaches (the .max watermarks make a
+    # between-heartbeats burst visible even after a reset)
+    "publish.spans.count", "publish.spans.max",
+    "publish.slow.count", "publish.slow.max",
 ]
 
 

@@ -15,11 +15,13 @@ SYSDESCR = "emqx_tpu — TPU-native MQTT broker"
 
 class SysTopics:
     def __init__(self, broker, node: str = "emqx_tpu@127.0.0.1",
-                 stats=None, interval: float = 60.0) -> None:
+                 stats=None, interval: float = 60.0,
+                 telemetry=None) -> None:
         self.broker = broker
         self.node = node
         self.stats = stats
         self.interval = interval
+        self.telemetry = telemetry
         self.started_at = time.time()
 
     def uptime(self) -> float:
@@ -50,3 +52,16 @@ class SysTopics:
         for k, v in self.broker.metrics.all().items():
             if v:
                 self._pub(f"metrics/{k}", str(v))
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            # per-stage p50/p99 from the same sample rings the
+            # Prometheus histograms and `ctl telemetry stages` read
+            stages = {
+                s: {"count": st["count"],
+                    "p50_ms": round(st["p50_ms"], 3),
+                    "p99_ms": round(st["p99_ms"], 3)}
+                for s, st in tel.stage_stats().items() if st["count"]}
+            self._pub("telemetry/stages", stages)
+            self._pub("telemetry/slow",
+                      {"count": tel.slow_total,
+                       "threshold_ms": tel.config.slow_threshold_ms})

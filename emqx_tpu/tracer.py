@@ -10,6 +10,7 @@ processes."""
 from __future__ import annotations
 
 import itertools
+import json
 import logging
 from typing import Dict, List, Tuple
 
@@ -24,6 +25,10 @@ class _TraceHandler(logging.Handler):
         self.kind = kind      # "clientid" | "topic"
         self.value = value
         self.sink = sink      # list or file-like
+        self.dead = False     # sink failed — emit is a no-op
+        # set by the owning Tracer: detaches this handler on a sink
+        # failure so a closed file doesn't stay subscribed forever
+        self.on_error = None
 
     def match(self, record: logging.LogRecord) -> bool:
         if self.kind == "clientid":
@@ -32,13 +37,22 @@ class _TraceHandler(logging.Handler):
         return topic is not None and T.match(topic, self.value)
 
     def emit(self, record: logging.LogRecord) -> None:
-        if not self.match(record):
+        if self.dead or not self.match(record):
             return
         line = self.format(record)
-        if hasattr(self.sink, "write"):
-            self.sink.write(line + "\n")
-        else:
-            self.sink.append(line)
+        try:
+            if hasattr(self.sink, "write"):
+                self.sink.write(line + "\n")
+            else:
+                self.sink.append(line)
+        except Exception:
+            # a closed/broken sink must not bubble out of the
+            # logging call on the PUBLISH path (trace_publish runs
+            # inside publish_begin): go dead immediately, then let
+            # the tracer unhook us cleanly
+            self.dead = True
+            if self.on_error is not None:
+                self.on_error(self)
 
 
 class Tracer:
@@ -59,15 +73,35 @@ class Tracer:
         h = _TraceHandler(kind, value, sink)
         h.setFormatter(logging.Formatter(
             "%(asctime)s [%(levelname)s] %(message)s"))
+        h.on_error = self._detach
         self._log.addHandler(h)
         self._traces[key] = h
         return sink
+
+    def _detach(self, h: _TraceHandler) -> None:
+        """A handler's sink failed mid-emit: unhook it from the
+        logger and the registry. REBIND the handler list rather than
+        mutating it — this runs from inside the logger's own
+        callHandlers iteration, and an in-place removal would shift
+        the list under the loop and skip the NEXT handler for the
+        current record."""
+        self._traces.pop((h.kind, h.value), None)
+        self._log.handlers = [x for x in self._log.handlers
+                              if x is not h]
 
     def stop_trace(self, kind: str, value: str) -> bool:
         h = self._traces.pop((kind, value), None)
         if h is None:
             return False
         self._log.removeHandler(h)
+        flush = getattr(h.sink, "flush", None)
+        if callable(flush):
+            # a file sink's buffered tail must land when the operator
+            # stops the trace — they read the file next
+            try:
+                flush()
+            except Exception:
+                pass
         return True
 
     def lookup_traces(self) -> List[Tuple[str, str]]:
@@ -85,3 +119,12 @@ class Tracer:
         if self._traces:
             self._log.debug("%s %s", direction, pkt,
                             extra={"clientid": clientid})
+
+    def trace_slow_publish(self, record: dict) -> None:
+        """Tee a slow-publish telemetry record (telemetry.Telemetry)
+        into the trace log: a topic trace whose filter matches the
+        batch's sample topic captures the per-stage breakdown inline
+        with that topic's publishes."""
+        if self._traces:
+            self._log.warning("SLOW PUBLISH %s", json.dumps(record),
+                              extra={"topic": record.get("topic")})

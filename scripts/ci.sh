@@ -26,6 +26,13 @@ echo "== match-cache parity (docs/MATCH_CACHE.md) =="
 # regression fails the gate before the long run
 python -m pytest tests/test_match_cache.py -q
 
+echo "== telemetry (docs/OBSERVABILITY.md) =="
+# the publish-path telemetry suite, incl. the disabled-mode A/B
+# guard (telemetry off => dispatch byte-identical to the
+# un-instrumented broker) — run before any bench smoke so an
+# instrumentation regression fails fast
+python -m pytest tests/test_telemetry.py -q
+
 echo "== pytest =="
 if [[ "${COV:-1}" == "0" ]]; then
     python -m pytest tests -q

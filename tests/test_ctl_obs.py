@@ -159,3 +159,30 @@ def test_vm_introspection_shapes():
     assert sysinfo.get("python") and sysinfo.get("cpu_count")
     devs = vm.get_device_info()
     assert isinstance(devs, list)  # device list renders (may be CPU)
+
+
+# -- publish-path telemetry ctl (ISSUE 2) -----------------------------------
+
+
+async def test_ctl_telemetry_stages_slow_reset():
+    from emqx_tpu.types import Message as _Msg
+
+    n = Node(boot_listeners=False, batch_ingress=False)
+    await n.start()
+    try:
+        s = Sub()
+        n.broker.subscribe(s, "tel/t")
+        n.publish(_Msg(topic="tel/t"))
+        out = n.ctl.run(["telemetry"])
+        assert "stage" in out and "end_to_end" in out
+        assert "p99_ms" in out
+        assert n.ctl.run(["telemetry", "slow"]) == "(none)"
+        # force a slow batch into the ring, then read + reset it
+        n.telemetry.config.slow_threshold_ms = 0.0
+        n.publish(_Msg(topic="tel/t"))
+        assert "end_to_end_ms" in n.ctl.run(["telemetry", "slow"])
+        assert n.ctl.run(["telemetry", "reset"]) == "ok"
+        assert n.ctl.run(["telemetry", "slow"]) == "(none)"
+        assert "error" in n.ctl.run(["telemetry", "nope"])
+    finally:
+        await n.stop()

@@ -299,7 +299,9 @@ async def test_retry_does_not_double_unmount():
     from emqx_tpu.zone import Zone
     z = Zone(name="mpr", mountpoint="pre/", retry_interval=0.0)
     async with broker_node(zone=z) as node:
-        sub = TestClient("r1")
+        # no auto-ack: the PUBACK would clear the inflight slot and
+        # there would be nothing left to retry
+        sub = TestClient("r1", auto_ack=False)
         await sub.connect(port=_port(node))
         await sub.subscribe("a/b", qos=1)
         chan = node.cm.lookup_channel("r1")

@@ -133,3 +133,53 @@ async def _drive_config_node(node, mod, dm):
     with pytest.raises(OSError):
         await _scrape(bound_port)
     assert mod._server is None and mod.port is None
+
+
+# -- publish-path telemetry exposition (ISSUE 2) ----------------------------
+
+
+def test_render_histograms_and_gauge_audit():
+    """Histogram families render with cumulative _bucket/_sum/_count
+    lines, and audited non-monotonic names (metrics.GAUGE_METRICS —
+    the retainer's live count is dec'd) say gauge, not counter."""
+    from emqx_tpu.telemetry import Telemetry, TelemetryConfig
+
+    tel = Telemetry(TelemetryConfig())
+    tel.hists["dispatch"].observe(0.2)
+    tel.hists["dispatch"].observe(2.0)
+    doc = render({"retained.count": 4}, {}, tel.histograms())
+    lines = doc.splitlines()
+    assert "# TYPE emqx_retained_count gauge" in lines
+    fam = "emqx_tpu_publish_stage_dispatch_ms"
+    assert f"# TYPE {fam} histogram" in lines
+    assert f'{fam}_bucket{{le="0.25"}} 1' in lines
+    assert f'{fam}_bucket{{le="+Inf"}} 2' in lines
+    assert f"{fam}_count 2" in lines
+
+
+async def test_scrape_serves_publish_stage_histograms():
+    """A live node's scrape carries the emqx_tpu_publish_stage_*
+    families (telemetry defaults on), fed by real publish spans."""
+    node = Node(name="promtel@test", boot_listeners=False)
+    mod = node.modules.load(PrometheusModule, env={"port": 0})
+    await node.start()
+    try:
+        for _ in range(100):
+            if mod.port:
+                break
+            await asyncio.sleep(0.01)
+        sub = CollectSub()
+        node.broker.subscribe(sub, "h/t")
+        node.publish(Message(topic="h/t"))
+        status, body = await _scrape(mod.port)
+        assert status == 200
+        for stage in ("match", "cache_gather", "host_fallback",
+                      "pack", "dispatch", "end_to_end"):
+            fam = f"emqx_tpu_publish_stage_{stage}_ms"
+            assert f"# TYPE {fam} histogram" in body, stage
+            assert f"{fam}_count" in body
+        # the host-path publish recorded real samples
+        assert "emqx_tpu_publish_stage_end_to_end_ms_count 1" in body
+    finally:
+        node.modules.unload("prometheus")
+        await node.stop()
