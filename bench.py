@@ -1404,7 +1404,21 @@ _CONFIG_MATRIX = [
     # made its in-process jax init hang for the row's full 900s
     # budget. Pinning is labeled (row platform reads "cpu").
     ("live_paced", {"LIVE_RATE": "400", "LIVE_SECS": "5",
-                    "LIVE_PIPELINE": "4", "BENCH_PLATFORM": "cpu"},
+                    "LIVE_PIPELINE": "4", "BENCH_PLATFORM": "cpu",
+                    # host regime: the dispatch planner never engages
+                    # below device_min_filters, so an off-pass would
+                    # measure the same tail twice
+                    "LIVE_AB": "0"},
+     "live", 0, 0),
+    # dispatch-planner A/B (docs/DISPATCH.md): the DEVICE live regime
+    # (background filters past device_min_filters) at saturating
+    # fan-out — the one record carries both tails' msgs/sec and
+    # wakeups/batch (planner_off_* columns). The planner pass runs
+    # FIRST, so any residual in-process warmup cost lands on the new
+    # tail, not the baseline — conservative for the speedup column
+    ("live_fan_ab", {"LIVE_FILTERS": "1200", "LIVE_SUBS": "32",
+                     "LIVE_TOPICS": "16", "LIVE_SECS": "5",
+                     "BENCH_PLATFORM": "cpu"},
      "live", 0, 0),
 ]
 

@@ -19,7 +19,10 @@ measure queue depth, use a paced rate for meaningful latency),
 LIVE_FILTERS (extra background subscriptions; push it past
 device_min_filters to measure the DEVICE live regime — default
 leaves the route table small, i.e. the host-match regime),
-BENCH_PLATFORM.
+LIVE_PLANNER (0 = legacy per-delivery tail instead of the batch
+dispatch planner, docs/DISPATCH.md), LIVE_AB (0 = skip the
+planner-off comparison pass the record's planner_off_* columns come
+from), BENCH_PLATFORM.
 """
 
 from __future__ import annotations
@@ -147,6 +150,7 @@ class _Peer:
 
 
 async def _run() -> dict:
+    from emqx_tpu.broker import DispatchConfig
     from emqx_tpu.node import Node
 
     n_pubs = int(os.environ.get("LIVE_PUBS", "8"))
@@ -162,7 +166,9 @@ async def _run() -> dict:
     # table crosses the device threshold — the live device regime
     n_filters = int(os.environ.get("LIVE_FILTERS", "0"))
 
-    node = Node(boot_listeners=False, batch_linger_ms=1.0)
+    planner = os.environ.get("LIVE_PLANNER", "1") != "0"
+    node = Node(boot_listeners=False, batch_linger_ms=1.0,
+                dispatch_config=DispatchConfig(planner=planner))
     lst = node.add_listener(port=0)
     await node.start()
 
@@ -219,10 +225,16 @@ async def _run() -> dict:
         from emqx_tpu.types import Message as _Msg
         bsz = 8
         while True:
-            node.broker.publish_batch(
-                [_Msg(topic=topics[i % len(topics)],
-                      payload=struct.pack("<q", 0))
-                 for i in range(bsz)])
+            # publish every bucket TWICE: the first batch takes the
+            # match-cache MISS path, the second the HIT path — each
+            # compiles different kernels per bucket, and an un-warmed
+            # hit-path compile used to stall the timed window (a
+            # multi-second in-window backend_compile)
+            for _ in range(2):
+                node.broker.publish_batch(
+                    [_Msg(topic=topics[i % len(topics)],
+                          payload=struct.pack("<q", 0))
+                     for i in range(bsz)])
             if bsz >= node.ingress.batch_cap:
                 break
             bsz *= 2
@@ -241,6 +253,7 @@ async def _run() -> dict:
         probe_sub.received = 0
     base_flushes = node.ingress.flushes
     base_submitted = node.ingress.submitted
+    base_wakeups = node.metrics.val("delivery.wakeups")
 
     stop = asyncio.Event()
     t0 = time.perf_counter()
@@ -261,6 +274,7 @@ async def _run() -> dict:
         if any(s.latencies for s in subs) else np.zeros(1)
     flushes = node.ingress.flushes - base_flushes
     submitted = node.ingress.submitted - base_submitted
+    wakeups = node.metrics.val("delivery.wakeups") - base_wakeups
 
     probe_lats = (np.asarray(probe_sub.latencies, np.float64)
                   if probe_sub is not None and probe_sub.latencies
@@ -282,6 +296,10 @@ async def _run() -> dict:
         "p50_ms": float(np.percentile(lats, 50)),
         "p99_ms": float(np.percentile(lats, 99)),
         "avg_device_batch": round(submitted / flushes, 2) if flushes else 0,
+        # delivery-tail wakeup pressure: scheduled connection flushes
+        # per ingress batch (the planner targets ≤1 per connection)
+        "wakeups_per_batch": round(wakeups / flushes, 2) if flushes else 0,
+        "planner": planner,
         "pubs": n_pubs, "subs": n_subs,
         "paced_rate_per_pub": rate,
         "bg_filters": n_filters,
@@ -318,6 +336,19 @@ def live(emit=None) -> None:
     enable_compile_cache()
     info = asyncio.run(_run())
     print(json.dumps(info), file=sys.stderr, flush=True)
+    # planner A/B: a second pass with the legacy per-delivery tail
+    # ([dispatch] planner = false) so the record carries the pair —
+    # msgs/sec and wakeups/batch for both tails (docs/DISPATCH.md).
+    # Skipped when the headline pass itself ran planner-off (the
+    # comparison would be off-vs-off) or LIVE_AB=0.
+    info_off = None
+    if info.get("planner") and os.environ.get("LIVE_AB", "1") != "0":
+        os.environ["LIVE_PLANNER"] = "0"
+        try:
+            info_off = asyncio.run(_run())
+        finally:
+            del os.environ["LIVE_PLANNER"]
+        print(json.dumps(info_off), file=sys.stderr, flush=True)
     rec = {
         "metric": "live_socket_throughput",
         # r5: ingest backpressure + paced service-latency probe
@@ -325,7 +356,18 @@ def live(emit=None) -> None:
         "value": round(info["deliveries_per_s"], 1),
         "unit": "msgs/sec",
         "vs_baseline": round(info["deliveries_per_s"] / 1_000_000, 3),
+        "planner": info.get("planner", True),
+        "wakeups_per_batch": info.get("wakeups_per_batch", 0),
     }
+    if info_off is not None:
+        rec["planner_off_msgs_per_s"] = round(
+            info_off["deliveries_per_s"], 1)
+        rec["planner_off_wakeups_per_batch"] = \
+            info_off.get("wakeups_per_batch", 0)
+        if info_off["deliveries_per_s"] > 0:
+            rec["planner_speedup"] = round(
+                info["deliveries_per_s"]
+                / info_off["deliveries_per_s"], 3)
     if "probe_p99_ms" in info:
         # per-message socket-to-deliver latency: the PACED PROBE's
         # samples (service latency through the loaded broker — what a

@@ -20,6 +20,7 @@ time; here ingress batches per tick, SURVEY §2.2).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -30,6 +31,7 @@ from emqx_tpu.broker_helper import FanoutManager, unpack_sids
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
+from emqx_tpu.ops.dispatch_plan import big_rows_for, build_plan
 from emqx_tpu.ops.fanout import expand_packed
 from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_flags,
                                mask_pad_rows, pack_fanout, pack_matches,
@@ -40,6 +42,26 @@ from emqx_tpu.types import Message, SubOpts
 from emqx_tpu.utils.batch import dedup_topics
 
 log = logging.getLogger("emqx_tpu.broker")
+
+
+@dataclasses.dataclass
+class DispatchConfig:
+    """``[dispatch]`` TOML section: the publish delivery tail
+    (docs/DISPATCH.md). Closed schema, like ``[matcher]``."""
+
+    #: batch dispatch planner (ops/dispatch_plan.py): group the
+    #: fetched packed deliveries BY SUBSCRIBER, resolve each session
+    #: once per batch, enqueue its whole group in one deliver_many and
+    #: fire one notify wakeup per connection per batch. False restores
+    #: the legacy per-(filter, subscriber) walk byte-for-byte.
+    planner: bool = True
+
+
+class _PlanState:
+    """Per-batch host routing state the planned delivery tail shares
+    between its prologue (per-row routing) and group chunks."""
+
+    __slots__ = ("row_local", "row_fast", "ftabs", "counts")
 
 
 class PendingBatch:
@@ -58,6 +80,7 @@ class PendingBatch:
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
         "host_matched", "host_inv", "span",
+        "plan", "plan_state",
         "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
@@ -81,6 +104,11 @@ class PendingBatch:
         self.host_topics: Optional[List[str]] = None
         self.host_matched = None  # host-path lazy match cache
         self.host_inv = None
+        # batch dispatch plan (ops/dispatch_plan.DispatchPlan), built
+        # by publish_fetch when the planner is on and the batch has no
+        # capacity-overflow row; None = legacy per-delivery walk
+        self.plan = None
+        self.plan_state = None
         self.inv: Optional[List[int]] = None
         self.n_uniq = 0
         self.st = None
@@ -112,8 +140,10 @@ class Broker:
         shared: Optional[SharedSub] = None,
         node: str = "local",
         config: Optional[MatcherConfig] = None,
+        dispatch_config: Optional[DispatchConfig] = None,
     ) -> None:
         self.node = node
+        self.dispatch_config = dispatch_config or DispatchConfig()
         self.router = router or Router(config=config, node=node)
         self.hooks = hooks or Hooks()
         self.metrics = metrics or Metrics()
@@ -625,17 +655,57 @@ class Broker:
             pb.f_ptr = f_ptr
             if subs_p is not None:
                 occ = int(f_ptr[-1])
-                pb.subs_packed = subs_p[:occ].tolist()
-                pb.src_packed = src_p[:occ].tolist()
+                subs_occ = subs_p[:occ]
+                src_occ = src_p[:occ]
             else:
-                pb.subs_packed = pb.src_packed = None
+                subs_occ = src_occ = None
             pb.sel = sel
             pb.rows_packed = rows_p
             pb.bovf = bovf
             if sp is not None:
                 sp.fallbacks = n_fb
                 sp.add("fetch", t_f)
+            if self.dispatch_config.planner:
+                t_pl = sp.clock() if sp is not None else 0.0
+                pb.plan = self._build_plan(pb, subs_occ, src_occ)
+                if sp is not None:
+                    sp.add("dispatch_plan", t_pl)
+            if pb.plan is not None:
+                # planned batches keep the numpy views (the plan
+                # already indexed them; the legacy walk's per-element
+                # list conversion is skipped entirely)
+                pb.subs_packed = subs_occ
+                pb.src_packed = src_occ
+            elif subs_occ is not None:
+                pb.subs_packed = subs_occ.tolist()
+                pb.src_packed = src_occ.tolist()
+            else:
+                pb.subs_packed = pb.src_packed = None
             return
+
+    def _build_plan(self, pb: PendingBatch, subs_packed, src_packed):
+        """Build the batch's subscriber-grouped dispatch plan
+        (ops/dispatch_plan.py) from the fetched packed arrays. Runs
+        wherever :meth:`publish_fetch` runs — possibly an executor
+        thread — so it touches no broker state beyond a lock-held
+        member snapshot for bitmap attribution. ``None`` = batch not
+        plannable (an overflow row needs the legacy mid-walk host
+        fallback); the legacy per-delivery path then runs unchanged."""
+        n_u = pb.n_uniq
+        if n_u and bool(pb.ovf[:n_u].any()):
+            return None
+        if pb.bovf is not None and n_u and bool(pb.bovf[:n_u].any()):
+            return None
+        big_set = pb.st.big_fids if pb.st is not None else pb.sh_big
+        big_map: Dict[int, list] = {}
+        if pb.sel is not None and big_set:
+            id_map = pb.id_map
+            big_map = big_rows_for(
+                pb.ids_packed, pb.m_ptr, pb.sel, pb.rows_packed,
+                sorted(set(pb.inv)), big_set,
+                lambda fid: self.helper.members_sorted(id_map[fid]))
+        return build_plan(pb.inv, n_u, pb.ovf, pb.bovf, pb.f_ptr,
+                          subs_packed, src_packed, big_map)
 
     def publish_finish(self, pb: PendingBatch) -> List[int]:
         """Phase 3 — the host delivery tail over the packed results
@@ -646,9 +716,192 @@ class Broker:
             self.publish_host_chunk(pb, 0, len(pb.live))
             pb.done = True
             return pb.results
-        self.publish_finish_chunk(pb, 0, len(pb.live))
+        if pb.plan is not None:
+            self.publish_finish_planned(pb, 0, pb.plan.n_groups)
+        else:
+            self.publish_finish_chunk(pb, 0, len(pb.live))
         pb.done = True
         return pb.results
+
+    def _plan_prologue(self, pb: PendingBatch) -> None:
+        """Per-batch routing pass before grouped delivery: classify
+        every matched filter id ONCE (local / shared / remote —
+        ``lookup_routes`` per unique fid per batch, not per message),
+        then walk the live rows in order doing only the per-message
+        host work the plan cannot carry: no-subscriber drops, shared-
+        group picks, remote forwards. Local delivery is the plan's."""
+        ps = _PlanState()
+        n_live = len(pb.live)
+        ps.row_local = bytearray(n_live)
+        ps.row_fast = bytearray(n_live)
+        ps.counts = [None] * n_live
+        ps.ftabs = {}
+        id_map = pb.id_map
+        m_ptr = pb.m_ptr
+        ids_packed = pb.ids_packed
+        inv = pb.inv
+        ftabs = ps.ftabs
+        route_of: Dict[int, tuple] = {}
+        for r in range(n_live):
+            i, msg = pb.live[r]
+            urow = inv[r]
+            seen_filter = False
+            local = False
+            n = 0
+            for j in ids_packed[m_ptr[urow]:m_ptr[urow + 1]]:
+                info = route_of.get(j)
+                if info is None:
+                    flt = id_map[j]
+                    if flt is None:
+                        info = (None, False, (), ())
+                    else:
+                        loc = False
+                        sh: Dict[str, List[str]] = {}
+                        rem: Dict[object, bool] = {}
+                        for route in self.router.lookup_routes(flt):
+                            dest = route.dest
+                            if isinstance(dest, tuple):
+                                sh.setdefault(dest[0], []) \
+                                    .append(dest[1])
+                            elif dest == self.node:
+                                loc = True
+                            else:
+                                rem[dest] = True
+                        ftabs[j] = self._subscribers.get(flt)
+                        info = (flt, loc, tuple(sh.items()),
+                                tuple(rem))
+                    route_of[j] = info
+                flt, loc, sh_items, rem_nodes = info
+                if flt is None:
+                    continue
+                seen_filter = True
+                local = local or loc
+                for group, nodes in sh_items:
+                    if self.shared_router is not None:
+                        # cluster: ONE delivery per group, all nodes
+                        n += self.shared_router(group, flt, nodes, msg)
+                    elif self.node in nodes:
+                        n += self.shared.dispatch(group, flt, msg)
+                for nd in rem_nodes:
+                    if self.forwarder is not None:
+                        self.forwarder(nd, flt, msg)
+                        self.metrics.inc("messages.forward")
+            if not seen_filter:
+                self._drop_no_subs(msg)
+                continue
+            pb.results[i] = n
+            if local:
+                ps.row_local[r] = 1
+            if msg.qos == 0 and not msg.flags.get("retain"):
+                # the message half of the QoS0 broadcast fast-path
+                # predicate, hoisted to once per row; the subopts half
+                # joins it per (group, filter) below
+                ps.row_fast[r] = 1
+        pb.plan_state = ps
+
+    def publish_finish_planned(self, pb: PendingBatch, gstart: int,
+                               gstop: int) -> None:
+        """Deliver subscriber groups ``[gstart, gstop)`` of a planned
+        batch — the planner's analogue of
+        :meth:`publish_finish_chunk`, chunked over plan GROUPS so the
+        async ingress can yield between sessions while every session
+        still receives its whole batch in one ``deliver_many`` call
+        and one notify wakeup. The first chunk runs the routing
+        prologue; the chunk that crosses the last group folds the
+        per-(message, filter) delivery counts into metrics/hooks/
+        results (the legacy walk's accounting, batched)."""
+        plan = pb.plan
+        sp = pb.span
+        if sp is not None:
+            t_d = sp.clock()
+        if gstart == 0:
+            self._plan_prologue(pb)
+        ps = pb.plan_state
+        lookup = self.helper.registry.lookup
+        id_map = pb.id_map
+        live = pb.live
+        g_ptr = plan.g_ptr
+        rows_s = plan.rows
+        fids_s = plan.fids
+        row_local = ps.row_local
+        row_fast = ps.row_fast
+        ftabs = ps.ftabs
+        counts = ps.counts
+        n_groups = plan.n_groups
+        for g in range(gstart, min(gstop, n_groups)):
+            sub = lookup(plan.g_sids[g])
+            if sub is None:
+                continue  # unsubscribed since the tables were built
+            sub_cid = getattr(sub, "client_id", None)
+            upgrade = getattr(sub, "upgrade_qos", False)
+            items: List[tuple] = []
+            accepted: List[tuple] = []
+            for k in range(g_ptr[g], g_ptr[g + 1]):
+                r = rows_s[k]
+                if not row_local[r]:
+                    continue
+                fid = fids_s[k]
+                ftab = ftabs.get(fid)
+                if ftab is None:
+                    continue
+                opts = ftab.get(sub)
+                if opts is None:
+                    continue
+                i, msg = live[r]
+                if opts.nl and sub_cid == msg.from_:
+                    self.metrics.inc("delivery.dropped")
+                    self.metrics.inc("delivery.dropped.no_local")
+                    continue
+                if "_wire" not in msg.headers:
+                    # shared wire-image cache, as _deliver_one primes
+                    msg.headers["_wire"] = {}
+                flt = id_map[fid]
+                fast = bool(row_fast[r]) and opts.share is None \
+                    and not opts.nl and opts.subid is None \
+                    and (opts.qos == 0 or not upgrade)
+                items.append((flt, msg, opts, fast))
+                accepted.append((r, flt))
+            if not items:
+                continue
+            dm = getattr(sub, "deliver_many", None)
+            delivered = accepted
+            if dm is not None:
+                try:
+                    dm(items)
+                except Exception:
+                    log.exception("deliver_many to %r failed", sub)
+                    delivered = []
+            else:
+                # plain subscriber objects (tests, sinks): the
+                # per-delivery protocol, still one resolve per batch
+                delivered = []
+                for (flt, msg, _o, _f), rf in zip(items, accepted):
+                    try:
+                        sub.deliver(flt, msg)
+                        delivered.append(rf)
+                    except Exception:
+                        log.exception("deliver to %r failed", sub)
+            for r, flt in delivered:
+                d = counts[r]
+                if d is None:
+                    d = counts[r] = {}
+                d[flt] = d.get(flt, 0) + 1
+        if gstop >= n_groups:
+            results = pb.results
+            for r, (i, msg) in enumerate(live):
+                d = counts[r]
+                if not d:
+                    continue
+                n = 0
+                for flt, cnt in d.items():
+                    n += cnt
+                    self.metrics.inc("messages.delivered", cnt)
+                    self.hooks.run("message.delivered", (msg, cnt))
+                results[i] += n
+        if sp is not None:
+            sp.add("dispatch", t_d)
+            if gstop >= n_groups:
+                self._span_finish(pb)
 
     def publish_host_chunk(self, pb: PendingBatch, start: int,
                            stop: int) -> None:
@@ -827,33 +1080,40 @@ class Broker:
         sids = unpack_sids(pb.rows_packed[pb.sel[row]])
         if len(matched_big) == 1:
             flt = id_map[matched_big[0]]
+            ftab = self._subscribers.get(flt)
             for sid in sids:
                 sub = self.helper.registry.lookup(int(sid))
                 if sub is not None:
-                    d = self._deliver_one(flt, sub, msg)
+                    d = self._deliver_one(flt, sub, msg, ftab)
                     if d:
                         per_filter[flt] = per_filter.get(flt, 0) + d
         else:
             rows_by_fid = [(fid, id_map[fid],
-                            self.helper.members(id_map[fid]))
+                            self.helper.members(id_map[fid]),
+                            self._subscribers.get(id_map[fid]))
                            for fid in matched_big]
             for sid in sids:
                 isid = int(sid)
                 sub = self.helper.registry.lookup(isid)
                 if sub is None:
                     continue
-                for fid, flt, members in rows_by_fid:
+                for fid, flt, members, ftab in rows_by_fid:
                     if isid in members:
-                        d = self._deliver_one(flt, sub, msg)
+                        d = self._deliver_one(flt, sub, msg, ftab)
                         if d:
                             per_filter[flt] = per_filter.get(flt, 0) + d
 
     def _deliver_one(self, topic_filter: str, sub: object,
-                     msg: Message) -> int:
+                     msg: Message, ftab: Optional[dict] = None) -> int:
         """One (filter, subscriber) delivery with the no-local check;
         the deliver carries the *subscribed filter* so the session can
-        resolve its subopts (emqx_broker.erl:298)."""
-        opts = self._subscribers.get(topic_filter, {}).get(sub)
+        resolve its subopts (emqx_broker.erl:298). Callers iterating
+        one filter's subscribers pass ``ftab`` (the filter's subopts
+        table) so the loop pays one dict fetch per FILTER, not per
+        subscriber."""
+        if ftab is None:
+            ftab = self._subscribers.get(topic_filter)
+        opts = ftab.get(sub) if ftab else None
         if opts is None:
             return 0  # unsubscribed since the tables were built
         if opts.nl and getattr(sub, "client_id", None) == msg.from_:
@@ -887,7 +1147,7 @@ class Broker:
             return 0
         n = 0
         for sub in list(ftab):
-            n += self._deliver_one(topic_filter, sub, msg)
+            n += self._deliver_one(topic_filter, sub, msg, ftab)
         if n:
             self.metrics.inc("messages.delivered", n)
             self.hooks.run("message.delivered", (msg, n))

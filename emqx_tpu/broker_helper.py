@@ -228,6 +228,17 @@ class FanoutManager:
     def members(self, filter_: str) -> Set[int]:
         return self.rows.get(filter_, set())
 
+    def members_sorted(self, filter_: Optional[str]) -> np.ndarray:
+        """Sorted member-sid array, copied under the lock: the
+        dispatch planner's bitmap attribution runs on the ingress
+        fetch thread, so it must not iterate the live (mutable) set
+        the way the on-loop delivery tail may."""
+        with self._lock:
+            row = self.rows.get(filter_) if filter_ is not None else None
+            if not row:
+                return np.empty(0, np.int64)
+            return np.sort(np.fromiter(row, np.int64, len(row)))
+
     def stats(self) -> Dict[str, int]:
         return {
             "subscribers.count": self.registry.count(),

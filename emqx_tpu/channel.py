@@ -782,6 +782,10 @@ class Channel:
         if self.session is None:
             return []
         out: List[Packet] = []
+        # fast-path (shared QoS0 wire image) metric increments batched
+        # per drain: the planner hands a session its whole batch in
+        # one enqueue, so one drain here covers many frames
+        n_fast = 0
         for pid, item in self.session.drain_outbox():
             if pid == PUBREL_MARKER:
                 out.append(self._ack(C.PUBREL, item))
@@ -801,8 +805,7 @@ class Channel:
                         self.broker.metrics.inc(
                             "delivery.dropped.too_large")
                         continue
-                    self.broker.metrics.inc("packets.publish.sent")
-                    self.broker.metrics.inc_sent(msg)
+                    n_fast += 1
                     out.append(data)
                     continue
             # copy before wire-mutation: the same object stays in the
@@ -858,6 +861,12 @@ class Channel:
             self.broker.metrics.inc("packets.publish.sent")
             self.broker.metrics.inc_sent(msg)
             out.append(pub)
+        if n_fast:
+            # the fast path is QoS0 by construction (pid is None)
+            m = self.broker.metrics
+            m.inc("packets.publish.sent", n_fast)
+            m.inc("messages.sent", n_fast)
+            m.inc("messages.qos0.sent", n_fast)
         return out
 
     def _wire_cached(self, msg) -> Optional[bytes]:

@@ -113,6 +113,10 @@ class NodeConfig:
     # publish log (emqx_tpu.telemetry.TelemetryConfig). None =
     # defaults (enabled).
     telemetry: Optional[Any] = None
+    # [dispatch] section: publish delivery-tail knobs
+    # (emqx_tpu.broker.DispatchConfig — batch dispatch planner on/off,
+    # docs/DISPATCH.md). None = defaults (planner on).
+    dispatch: Optional[Any] = None
 
 
 #: zone fields with a closed value set — a typo must be a startup
@@ -196,6 +200,28 @@ def _build_telemetry(raw: Dict[str, Any]):
     if kwargs.get("ring_size", 1) <= 0:
         raise ConfigError("telemetry.ring_size must be > 0")
     return TelemetryConfig(**kwargs)
+
+
+def _build_dispatch(raw: Dict[str, Any]):
+    """``[dispatch]`` table → :class:`~emqx_tpu.broker
+    .DispatchConfig`. Closed schema like zones/matcher/telemetry: a
+    typo'd ``planner = false`` silently leaving the planner on is the
+    drift this rule catches."""
+    import dataclasses as _dc
+
+    from emqx_tpu.broker import DispatchConfig
+
+    known = {f.name for f in _dc.fields(DispatchConfig)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown dispatch setting: "
+                              f"dispatch.{key}")
+        want = DispatchConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"dispatch.{key} must be a boolean")
+        kwargs[key] = val
+    return DispatchConfig(**kwargs)
 
 
 def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
@@ -306,6 +332,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         if not isinstance(traw, dict):
             raise ConfigError("telemetry must be a table")
         cfg.telemetry = _build_telemetry(traw)
+    draw = raw.get("dispatch")
+    if draw is not None:
+        if not isinstance(draw, dict):
+            raise ConfigError("dispatch must be a table")
+        cfg.dispatch = _build_dispatch(draw)
     for name, zraw in raw.get("zones", {}).items():
         cfg.zones[name] = _build_zone(name, zraw)
     for i, lraw in enumerate(raw.get("listeners", [])):
@@ -357,6 +388,7 @@ def build_node(cfg: NodeConfig):
     node = Node(name=cfg.name, zone=default,
                 matcher=cfg.matcher,
                 telemetry=cfg.telemetry,
+                dispatch_config=cfg.dispatch,
                 sys_interval=cfg.sys_interval,
                 load_default_modules=cfg.load_default_modules,
                 boot_listeners=False)

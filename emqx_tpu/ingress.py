@@ -203,17 +203,26 @@ class IngressBatcher:
             if pb.done:
                 results = self.broker.publish_finish(pb)
             else:
-                # stream the delivery tail: finish in chunks (device
-                # packed rows or deferred host routing), yielding
-                # between chunks so finished rows' deliveries flush to
-                # subscriber sockets while later rows still route
-                chunk_fn = (self.broker.publish_host_chunk
-                            if pb.host_topics is not None
-                            else self.broker.publish_finish_chunk)
-                n_rows = len(pb.live)
-                for s in range(0, n_rows, self.finish_chunk):
-                    chunk_fn(pb, s, min(s + self.finish_chunk, n_rows))
-                    if s + self.finish_chunk < n_rows:
+                # stream the delivery tail: finish in chunks, yielding
+                # between chunks so finished work's deliveries flush
+                # to subscriber sockets while the rest still routes.
+                # The chunk unit depends on the path: deferred host
+                # routing and the legacy packed walk chunk over LIVE
+                # ROWS; a planned batch (dispatch planner) chunks over
+                # SUBSCRIBER GROUPS — each session still gets its
+                # whole batch in one deliver_many + one wakeup
+                if pb.host_topics is not None:
+                    chunk_fn = self.broker.publish_host_chunk
+                    n_units = len(pb.live)
+                elif pb.plan is not None:
+                    chunk_fn = self.broker.publish_finish_planned
+                    n_units = pb.plan.n_groups
+                else:
+                    chunk_fn = self.broker.publish_finish_chunk
+                    n_units = len(pb.live)
+                for s in range(0, max(1, n_units), self.finish_chunk):
+                    chunk_fn(pb, s, min(s + self.finish_chunk, n_units))
+                    if s + self.finish_chunk < n_units:
                         await asyncio.sleep(0)
                 pb.done = True
                 results = pb.results
@@ -224,8 +233,16 @@ class IngressBatcher:
         finally:
             self._inflight -= 1
             if self._pending:
-                # a slot freed while messages accumulated
-                self._flush()
+                # a slot freed while messages accumulated — but
+                # flushing HERE would run inside this batch's
+                # completion, BEFORE its futures resolve below: a
+                # host-path flush can resolve newer publishes'
+                # futures synchronously, acking them ahead of this
+                # batch's older ones (MQTT-4.6.0 ack order), and a
+                # re-entrant failure path could touch this batch's
+                # futures twice. Schedule the flush for after this
+                # completion instead.
+                loop.call_soon(self._flush)
         self._resolve(pending, results)
 
     @staticmethod

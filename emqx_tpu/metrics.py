@@ -55,6 +55,11 @@ DELIVERY_METRICS = [
     "delivery.dropped", "delivery.dropped.no_local",
     "delivery.dropped.too_large", "delivery.dropped.qos0_msg",
     "delivery.dropped.queue_full", "delivery.dropped.expired",
+    # connection flush wakeups actually scheduled (after
+    # Connection._schedule_flush coalescing): with the dispatch
+    # planner this is ≤1 per connection per batch — the bench's
+    # wakeups/batch column divides it by ingress flushes
+    "delivery.wakeups",
 ]
 CLIENT_METRICS = [
     "client.connect", "client.connack", "client.connected",

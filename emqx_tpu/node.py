@@ -15,7 +15,7 @@ from typing import List, Optional
 
 from emqx_tpu.alarm import AlarmManager
 from emqx_tpu.banned import Banned
-from emqx_tpu.broker import Broker
+from emqx_tpu.broker import Broker, DispatchConfig
 from emqx_tpu.cm import ConnectionManager
 from emqx_tpu.connection import Listener
 from emqx_tpu.ctl import Ctl
@@ -44,6 +44,7 @@ class Node:
                  zone: Optional[Zone] = None,
                  matcher: Optional[MatcherConfig] = None,
                  telemetry: Optional[TelemetryConfig] = None,
+                 dispatch_config: Optional[DispatchConfig] = None,
                  boot_listeners: bool = True,
                  sys_interval: float = 60.0,
                  load_default_modules: bool = False,
@@ -61,7 +62,8 @@ class Node:
         # routing + pubsub core
         self.router = Router(config=matcher, node=name)
         self.broker = Broker(router=self.router, hooks=self.hooks,
-                             metrics=self.metrics, node=name)
+                             metrics=self.metrics, node=name,
+                             dispatch_config=dispatch_config)
         self.broker.tracer = self.tracer
         # ingress batcher: PUBLISHes from all connections aggregate
         # into one device publish_batch per tick (ingress.py)

@@ -24,8 +24,9 @@ enriches + windows the message and appends ready-to-send publishes to
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from emqx_tpu import topic as T
 from emqx_tpu.inflight import Inflight
 from emqx_tpu.mqueue import MQueue
 from emqx_tpu.types import Message, QOS_0, QOS_2, SubOpts
@@ -71,6 +72,13 @@ class Session:
         self.clean_start = clean_start
         self.created_at = time.time()
         self.subscriptions: Dict[str, SubOpts] = {}
+        # reverse share-suffix map: bare filter -> the full
+        # "$share/<g>/…" / "$queue/…" subscription key, so shared
+        # deliveries resolve their subopts in one dict fetch instead
+        # of a linear scan over every subscription (_enrich). First
+        # subscription wins on a bare-filter collision, matching the
+        # old scan's insertion-order pick.
+        self._share_keys: Dict[str, str] = {}
         self.max_subscriptions = max_subscriptions
         self.upgrade_qos = upgrade_qos
         self.inflight = Inflight(max_inflight)
@@ -165,6 +173,7 @@ class Session:
         )
         s.created_at = d["created_at"]
         s.subscriptions = dict(d["subscriptions"])
+        s._rebuild_share_keys()
         for pid, val in d["inflight"]:
             s.inflight.insert(pid, val)
         s.next_pkt_id = int(d["next_pkt_id"])
@@ -190,13 +199,32 @@ class Session:
         if self.broker is not None:
             self.broker.subscribe(self, topic_filter, opts)
         self.subscriptions[topic_filter] = opts
+        if opts.share is not None or topic_filter.startswith(
+                ("$share/", "$queue/")):
+            bare, _ = T.parse(topic_filter)
+            self._share_keys.setdefault(bare, topic_filter)
 
     def unsubscribe(self, topic_filter: str) -> SubOpts:
         if topic_filter not in self.subscriptions:
             raise SessionError(RC_NO_SUBSCRIPTION_EXISTED)
         if self.broker is not None:
             self.broker.unsubscribe(self, topic_filter)
-        return self.subscriptions.pop(topic_filter)
+        opts = self.subscriptions.pop(topic_filter)
+        if self._share_keys:
+            bare, _ = T.parse(topic_filter)
+            if self._share_keys.get(bare) == topic_filter:
+                # another group may still cover the bare filter
+                self._rebuild_share_keys()
+        return opts
+
+    def _rebuild_share_keys(self) -> None:
+        keys: Dict[str, str] = {}
+        for key, o in self.subscriptions.items():
+            if o.share is not None or key.startswith(
+                    ("$share/", "$queue/")):
+                bare, _ = T.parse(key)
+                keys.setdefault(bare, key)
+        self._share_keys = keys
 
     # -- inbound PUBLISH (client -> broker) -------------------------------
 
@@ -281,8 +309,35 @@ class Session:
         if self.outbox and self.notify is not None:
             self.notify()
 
-    def _enrich(self, topic_filter: str, msg: Message) -> Message:
-        opts = self.subscriptions.get(topic_filter)
+    def deliver_many(self, items: Iterable[tuple]) -> None:
+        """Batched broker→client delivery — the dispatch planner's
+        grouped enqueue (docs/DISPATCH.md). Each item is
+        ``(topic_filter, msg, opts, fast)``: the broker already
+        resolved this session's subopts from its own table (the same
+        SubOpts object ``subscriptions`` holds, so the per-delivery
+        dict fetch is hoisted out), and ``fast`` pre-classifies the
+        QoS0/plain-subopts broadcast fast path per (row, filter)
+        group. Everything enqueues, then ONE notify fires for the
+        whole group — the batch-wide wakeup coalescing that turns
+        N-deliveries-per-batch into one flush per connection."""
+        for flt, msg, opts, fast in items:
+            if fast and self.connected:
+                # the _enrich fast path, pre-decided: nothing to
+                # rewrite, every session shares the same object
+                self.outbox.append((None, msg))
+                continue
+            m = msg if fast else self._enrich(flt, msg, opts)
+            if not self.connected:
+                self.enqueue(m)
+            else:
+                self._deliver_msg(m)
+        if self.outbox and self.notify is not None:
+            self.notify()
+
+    def _enrich(self, topic_filter: str, msg: Message,
+                opts: Optional[SubOpts] = None) -> Message:
+        if opts is None:
+            opts = self.subscriptions.get(topic_filter)
         if (opts is not None and msg.qos == 0
                 and not msg.flags.get("retain")
                 and opts.share is None and not opts.nl
@@ -294,12 +349,14 @@ class Session:
             # image, see Broker._deliver_one); downstream treats it
             # as immutable
             return msg
-        # look up shared form too: session keys by full filter string
+        # look up the shared form too: the session keys by full
+        # filter string; the reverse share-suffix map (maintained on
+        # subscribe/unsubscribe) replaces the old linear scan over
+        # every subscription
         if opts is None:
-            for key, o in self.subscriptions.items():
-                if o.share and key.endswith("/" + topic_filter):
-                    opts = o
-                    break
+            key = self._share_keys.get(topic_filter)
+            if key is not None:
+                opts = self.subscriptions.get(key)
         m = Message(
             topic=msg.topic, payload=msg.payload, qos=msg.qos,
             from_=msg.from_, flags=dict(msg.flags),

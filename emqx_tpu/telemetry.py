@@ -40,6 +40,14 @@ Stage semantics (all host wall-clock, milliseconds):
                      ``block_until_ready`` is introduced anywhere:
                      spans only read the clock at boundaries the
                      pipeline already crosses.
+  ``dispatch_plan``  the batch dispatch planner's numpy grouping pass
+                     (ops/dispatch_plan.py): CSR/bitmap expansion +
+                     subscriber argsort over the fetched packed
+                     arrays. Runs right after the transfer, on the
+                     same (possibly executor) thread — recorded
+                     separately so planner cost is attributable
+                     against the dispatch time it saves. Zero when
+                     the planner is off or the batch fell back.
   ``host_fallback``  overflow topics re-matched on the host oracle
                      during the delivery tail (a subset of
                      ``dispatch`` time, recorded separately so
@@ -68,8 +76,8 @@ log = logging.getLogger("emqx_tpu.telemetry")
 
 #: the publish pipeline's stage names, in pipeline order (ctl and the
 #: $SYS heartbeat render in this order; Prometheus sorts its own)
-STAGES = ("match", "cache_gather", "pack", "fetch", "host_fallback",
-          "dispatch", "end_to_end")
+STAGES = ("match", "cache_gather", "pack", "fetch", "dispatch_plan",
+          "host_fallback", "dispatch", "end_to_end")
 
 #: fixed log-spaced bucket upper bounds, milliseconds (1-2.5-5 per
 #: decade, 10µs..5s). Fixed — not adaptive — so scrapes from

@@ -26,6 +26,12 @@ echo "== match-cache parity (docs/MATCH_CACHE.md) =="
 # regression fails the gate before the long run
 python -m pytest tests/test_match_cache.py -q
 
+echo "== dispatch planner parity (docs/DISPATCH.md) =="
+# planner-on vs legacy per-delivery tail: delivery counts, wire
+# bytes, metric deltas must be identical — a divergence here is a
+# delivery-correctness bug, fail before the long run
+python -m pytest tests/test_dispatch_plan.py -q
+
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B
 # guard (telemetry off => dispatch byte-identical to the

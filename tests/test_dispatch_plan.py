@@ -1,0 +1,373 @@
+"""Batch dispatch planner (emqx_tpu/ops/dispatch_plan.py +
+Broker.publish_finish_planned, docs/DISPATCH.md): plan grouping
+invariants, planner-on vs legacy-tail parity (delivery counts,
+per-subscriber streams, session outboxes, per-connection wire
+packets, metric deltas) across QoS0 broadcast / QoS1-2 inflight /
+no-local / shared-sub / mountpoint / bitmap big-fan, the ≤1
+notify-wakeup-per-connection-per-batch contract, overflow fallback to
+the legacy walk, and the [dispatch] config schema."""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker import Broker, DispatchConfig
+from emqx_tpu.config import ConfigError, parse_config
+from emqx_tpu.ops.dispatch_plan import DispatchPlan, build_plan
+from emqx_tpu.router import MatcherConfig, Router
+from emqx_tpu.session import Session
+from emqx_tpu.telemetry import Telemetry, TelemetryConfig
+from emqx_tpu.types import Message, SubOpts
+
+
+class Q:
+    def __init__(self, client_id="c"):
+        self.client_id = client_id
+        self.inbox = []
+
+    def deliver(self, topic, msg):
+        self.inbox.append((topic, msg))
+
+
+def _broker(planner: bool, **mk) -> Broker:
+    mk.setdefault("device_min_filters", 0)
+    return Broker(router=Router(MatcherConfig(**mk), node="node1"),
+                  dispatch_config=DispatchConfig(planner=planner))
+
+
+def _metric_deltas(broker):
+    return {k: v for k, v in broker.metrics.all().items()
+            if v and (k.startswith("messages.")
+                      or k.startswith("delivery."))}
+
+
+# -- plan grouping invariants ---------------------------------------------
+
+
+def test_build_plan_groups_by_subscriber_in_walk_order():
+    # two live rows over two unique topics; CSR pack:
+    #   urow0 -> (sub 7, fid 1), (sub 3, fid 1)
+    #   urow1 -> (sub 3, fid 2)
+    f_ptr = np.array([0, 2, 3])
+    subs = np.array([7, 3, 3])
+    src = np.array([1, 1, 2])
+    ovf = np.zeros(2, bool)
+    plan = build_plan([0, 1], 2, ovf, None, f_ptr, subs, src, {})
+    assert plan is not None and plan.n_groups == 2
+    # groups sorted by sid; within a group, legacy walk order (row-
+    # major, packed-slot order)
+    assert plan.g_sids == [3, 7]
+    g0 = slice(plan.g_ptr[0], plan.g_ptr[1])
+    assert plan.rows[g0] == [0, 1]
+    assert plan.fids[g0] == [1, 2]
+    g1 = slice(plan.g_ptr[1], plan.g_ptr[2])
+    assert plan.rows[g1] == [1 - 1]  # row 0
+    assert plan.fids[g1] == [1]
+
+
+def test_build_plan_expands_duplicate_topics_via_inverse_index():
+    # three live rows, rows 0 and 2 share unique topic 0
+    f_ptr = np.array([0, 1, 2])
+    subs = np.array([5, 9])
+    src = np.array([4, 6])
+    plan = build_plan([0, 1, 0], 2, np.zeros(2, bool), None,
+                      f_ptr, subs, src, {})
+    assert plan.n_deliveries == 3
+    g0 = slice(plan.g_ptr[0], plan.g_ptr[1])
+    assert plan.g_sids == [5, 9]
+    assert plan.rows[g0] == [0, 2]  # both copies, row order
+
+
+def test_build_plan_merges_bitmap_rows_after_csr_within_a_row():
+    f_ptr = np.array([0, 1])
+    subs = np.array([2])
+    src = np.array([0])
+    big = {0: [(8, np.array([1, 2], np.int64))]}
+    plan = build_plan([0], 1, np.zeros(1, bool),
+                      np.zeros(1, bool), f_ptr, subs, src, big)
+    assert plan.n_deliveries == 3
+    # sub 2's CSR slot and the bitmap bits, grouped by sid
+    assert plan.g_sids == [1, 2]
+    g2 = slice(plan.g_ptr[1], plan.g_ptr[2])
+    assert sorted(plan.fids[g2]) == [0, 8]
+    # within sub 2's group: CSR (fid 0) precedes bitmap (fid 8) —
+    # the legacy within-row walk order
+    assert plan.fids[g2] == [0, 8]
+
+
+def test_build_plan_refuses_overflow_batches():
+    f_ptr = np.array([0, 1])
+    subs = np.array([2])
+    src = np.array([0])
+    ovf = np.array([True])
+    assert build_plan([0], 1, ovf, None, f_ptr, subs, src, {}) is None
+    bovf = np.array([True])
+    assert build_plan([0], 1, np.zeros(1, bool), bovf,
+                      f_ptr, subs, src, {}) is None
+
+
+def test_empty_plan_has_zero_groups():
+    plan = DispatchPlan(np.empty(0, np.int64), np.empty(0, np.int64),
+                        np.empty(0, np.int64))
+    assert plan.n_groups == 0 and plan.n_deliveries == 0
+
+
+# -- planner vs legacy parity (device path) -------------------------------
+
+
+def _qos0_broadcast(b):
+    subs = [Q(f"c{i}") for i in range(4)]
+    b.subscribe(subs[0], "w/+/x")
+    b.subscribe(subs[1], "w/1/x")
+    b.subscribe(subs[2], "w/#")
+    b.subscribe(subs[3], "other")
+    res = []
+    for _ in range(3):
+        res.append(b.publish_batch(
+            [Message(topic="w/1/x"), Message(topic="w/2/x"),
+             Message(topic="nomatch"), Message(topic="w/1/x")]))
+    return res, [[(t, m.topic, m.qos) for t, m in s.inbox]
+                 for s in subs]
+
+
+def _no_local(b):
+    pub = Q("pub")
+    other = Q("other")
+    b.subscribe(pub, "t/+", SubOpts(nl=1))
+    b.subscribe(other, "t/+", SubOpts(nl=1))
+    res = [b.publish_batch([Message(topic="t/1", from_="pub"),
+                            Message(topic="t/2", from_="other")])]
+    return res, [[(t, m.topic) for t, m in s.inbox]
+                 for s in (pub, other)]
+
+
+def _sessions_qos12(b):
+    sess = [Session(f"s{i}", broker=b) for i in range(3)]
+    sess[0].subscribe("q/+", SubOpts(qos=1))
+    sess[1].subscribe("q/a", SubOpts(qos=2))
+    sess[2].subscribe("q/#", SubOpts(qos=0))
+    res = []
+    for k in range(2):
+        res.append(b.publish_batch(
+            [Message(topic="q/a", qos=2, from_="p"),
+             Message(topic="q/b", qos=1, from_="p"),
+             Message(topic="q/a", qos=0, from_="p")]))
+    outs = [[(pid, m.topic, m.qos, m.flags.get("dup", False))
+             for pid, m in s.outbox] for s in sess]
+    infl = [sorted(pid for pid, _ in s.inflight.to_list())
+            for s in sess]
+    return res, outs, infl
+
+
+def _shared_sub(b):
+    m1, m2, plain = Q("m1"), Q("m2"), Q("plain")
+    b.subscribe(m1, "$share/g/s/t")
+    b.subscribe(m2, "$share/g/s/t")
+    b.subscribe(plain, "s/t")
+    res = [b.publish_batch([Message(topic="s/t")]) for _ in range(4)]
+    # shared picks one member per publish; totals must match even if
+    # the pick rotates
+    return res, len(m1.inbox) + len(m2.inbox), \
+        [(t, m.topic) for t, m in plain.inbox]
+
+
+def _bitmap_bigfan(b):
+    # fanout_threshold=2 puts these filters on the bitmap path
+    subs = [Q(f"b{i}") for i in range(5)]
+    for s in subs[:4]:
+        b.subscribe(s, "big/t")
+    for s in subs[1:]:
+        b.subscribe(s, "big/+")      # second big filter: multi-fid union
+    b.subscribe(subs[0], "small/x")  # CSR path in the same batch
+    res = []
+    for _ in range(2):
+        res.append(b.publish_batch(
+            [Message(topic="big/t"), Message(topic="small/x"),
+             Message(topic="big/t")]))
+    return res, [[(t, m.topic) for t, m in s.inbox] for s in subs]
+
+
+@pytest.mark.parametrize("scenario,mk", [
+    (_qos0_broadcast, {}),
+    (_no_local, {}),
+    (_sessions_qos12, {}),
+    (_shared_sub, {}),
+    (_bitmap_bigfan, {"fanout_threshold": 2}),
+])
+def test_planner_parity_with_legacy_tail(scenario, mk):
+    b_on = _broker(True, **mk)
+    b_off = _broker(False, **mk)
+    got_on = scenario(b_on)
+    got_off = scenario(b_off)
+    assert got_on == got_off
+    assert _metric_deltas(b_on) == _metric_deltas(b_off)
+
+
+def test_planner_parity_on_mesh_1x1():
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    outs = []
+    for planner in (True, False):
+        b = Broker(router=Router(
+            MatcherConfig(mesh=make_mesh(1, 1), fanout_d=8), node="n"),
+            dispatch_config=DispatchConfig(planner=planner))
+        outs.append(_qos0_broadcast(b) + (_metric_deltas(b),))
+    assert outs[0] == outs[1]
+
+
+def test_match_overflow_batch_falls_back_to_legacy_walk():
+    # max_matches=1 with 2 matching filters per topic overflows the
+    # match output -> the batch must refuse to plan and still deliver
+    # exactly like the legacy walk (host re-match per overflow row)
+    outs = []
+    for planner in (True, False):
+        b = _broker(planner, max_matches=1)
+        s1, s2 = Q("c1"), Q("c2")
+        b.subscribe(s1, "o/+")
+        b.subscribe(s2, "o/1")
+        pb = b.publish_begin([Message(topic="o/1")])
+        assert not pb.done
+        b.publish_fetch(pb)
+        if planner:
+            assert pb.plan is None  # overflow row -> not plannable
+        res = b.publish_finish(pb)
+        outs.append((res, [(t, m.topic) for t, m in s1.inbox],
+                     [(t, m.topic) for t, m in s2.inbox],
+                     _metric_deltas(b)))
+    assert outs[0] == outs[1]
+    assert outs[0][0] == [2]
+
+
+def test_unsubscribed_since_fetch_is_skipped():
+    b = _broker(True)
+    s1, s2 = Q("c1"), Q("c2")
+    b.subscribe(s1, "u/t")
+    b.subscribe(s2, "u/t")
+    pb = b.publish_begin([Message(topic="u/t")])
+    b.publish_fetch(pb)
+    assert pb.plan is not None
+    b.unsubscribe(s2, "u/t")  # between fetch and finish
+    assert b.publish_finish(pb) == [1]
+    assert len(s1.inbox) == 1 and not s2.inbox
+    assert b.metrics.val("messages.delivered") == 1
+
+
+# -- wakeup coalescing: ≤1 notify per connection per batch ----------------
+
+
+def test_one_notify_per_session_per_batch():
+    b = _broker(True)
+    counts = {}
+    sess = []
+    for i in range(3):
+        s = Session(f"n{i}", broker=b)
+        counts[s.client_id] = 0
+
+        def notify(cid=s.client_id):
+            counts[cid] += 1
+
+        s.notify = notify
+        s.subscribe("hot/#")
+        sess.append(s)
+    msgs = [Message(topic=f"hot/{i % 4}") for i in range(16)]
+    assert b.publish_batch(msgs) == [3] * 16
+    # 16 deliveries each, ONE wakeup each (the legacy tail fires 16)
+    assert counts == {s.client_id: 1 for s in sess}
+    b.publish_batch(msgs)
+    assert all(v == 2 for v in counts.values())
+
+
+def test_legacy_tail_fires_per_delivery_wakeups():
+    b = _broker(False)
+    s = Session("leg", broker=b)
+    n = [0]
+    s.notify = lambda: n.__setitem__(0, n[0] + 1)
+    s.subscribe("hot/#")
+    b.publish_batch([Message(topic=f"hot/{i}") for i in range(8)])
+    assert n[0] == 8  # the contrast the planner removes
+
+
+# -- wire-level parity through real connections ---------------------------
+
+
+async def _wire_run(planner: bool):
+    from helpers import broker_node, node_port
+    from mqtt_client import TestClient
+    from emqx_tpu.zone import Zone
+
+    zone = Zone(name="default", mountpoint="mp/")
+    async with broker_node(zone=zone,
+                           matcher=MatcherConfig(device_min_filters=0),
+                           dispatch_config=DispatchConfig(
+                               planner=planner)) as node:
+        port = node_port(node)
+        s0 = TestClient("w0")
+        s1 = TestClient("w1")
+        pub = TestClient("wp")
+        for c in (s0, s1, pub):
+            await c.connect(port=port)
+        await s0.subscribe("x/+", qos=0)
+        await s1.subscribe("x/#", qos=1)
+        for i in range(12):
+            await pub.publish("x/t", payload=b"p%d" % i, qos=0)
+        await pub.publish("x/end", payload=b"end", qos=1)
+        got = []
+        for cli in (s0, s1):
+            pkts = []
+            for _ in range(13):
+                p = await cli.recv(timeout=5.0)
+                pkts.append((p.topic, bytes(p.payload), p.qos,
+                             p.retain, getattr(p, "dup", False)))
+            got.append(pkts)
+        for c in (s0, s1, pub):
+            await c.close()
+        return got
+
+
+async def test_wire_parity_planner_vs_legacy_with_mountpoint():
+    on = await _wire_run(True)
+    off = await _wire_run(False)
+    assert on == off
+    # sanity: the mountpoint round-tripped (subscriber sees bare topic)
+    assert on[0][0][0] == "x/t"
+
+
+# -- telemetry stage ------------------------------------------------------
+
+
+def test_dispatch_plan_stage_records_only_when_planning():
+    for planner, expect in ((True, 1), (False, 0)):
+        b = _broker(planner)
+        tel = Telemetry(TelemetryConfig())
+        b.telemetry = tel
+        b.router.telemetry = tel
+        s = Q()
+        b.subscribe(s, "t/+")
+        assert b.publish_batch([Message(topic="t/1")]) == [1]
+        assert tel.hists["dispatch_plan"].count == expect, planner
+        assert tel.hists["dispatch"].count == 1
+
+
+def test_host_path_never_records_dispatch_plan():
+    b = Broker()  # default: host regime
+    tel = Telemetry(TelemetryConfig())
+    b.telemetry = tel
+    b.router.telemetry = tel
+    s = Q()
+    b.subscribe(s, "h/+")
+    assert b.publish_batch([Message(topic="h/1")]) == [1]
+    assert tel.hists["dispatch_plan"].count == 0
+
+
+# -- [dispatch] config schema ---------------------------------------------
+
+
+def test_dispatch_config_section_parses_and_rejects_typos():
+    cfg = parse_config({"dispatch": {"planner": False}})
+    assert cfg.dispatch is not None and cfg.dispatch.planner is False
+    assert parse_config({}).dispatch is None
+    with pytest.raises(ConfigError, match="unknown dispatch setting"):
+        parse_config({"dispatch": {"plannner": False}})
+    with pytest.raises(ConfigError, match="must be a boolean"):
+        parse_config({"dispatch": {"planner": "yes"}})
+    with pytest.raises(ConfigError, match="must be a table"):
+        parse_config({"dispatch": True})

@@ -318,3 +318,47 @@ async def test_drain_waits_for_inflight_before_flushing_queue():
     bat.submit(Message(topic="z/new"), want_result=False)  # queued
     await bat.drain()
     assert s.got == ["z/old", "z/new"]
+
+
+async def test_flush_during_completion_cannot_reorder_or_double_resolve():
+    """Regression (ISSUE 3 satellite): _complete's slot-free flush
+    used to run RE-ENTRANTLY inside the finishing batch's completion,
+    before that batch's own futures resolved — a flush that resolves
+    synchronously there (e.g. publish_begin raising) completed NEWER
+    publishes' futures ahead of the older batch's, breaking ack
+    order. The flush must be scheduled for after resolution."""
+    import time
+
+    b = _dev_broker()
+    s = Rec()
+    b.subscribe(s, "r/+")
+    orig_fetch = b.publish_fetch
+
+    def slow_fetch(pb):
+        time.sleep(0.05)
+        orig_fetch(pb)
+
+    b.publish_fetch = slow_fetch
+    orig_begin = b.publish_begin
+    calls = [0]
+
+    def begin(msgs, defer_host=False):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise RuntimeError("boom")  # batch B fails at begin
+        return orig_begin(msgs, defer_host=defer_host)
+
+    b.publish_begin = begin
+    bat = IngressBatcher(b, batch_size=100, max_inflight=1)
+    order = []
+    fa = bat.submit(Message(topic="r/a"))
+    fa.add_done_callback(lambda f: order.append("A"))
+    await asyncio.sleep(0)        # batch A enters the pipeline
+    fb = bat.submit(Message(topic="r/b"))   # queues behind A
+    fb.add_done_callback(lambda f: order.append("B"))
+    await asyncio.wait({fa, fb})
+    await asyncio.sleep(0)        # drain done-callbacks
+    assert await fa == 1
+    assert isinstance(fb.exception(), RuntimeError)
+    # A's future resolved before B's, and each exactly once
+    assert order == ["A", "B"]

@@ -248,3 +248,46 @@ def test_shared_delivery_enriched():
     b.publish(_m(qos=1))
     [(pid, msg)] = s.drain_outbox()
     assert pid == 1 and msg.qos == 1
+
+
+def test_share_suffix_map_replaces_linear_scan():
+    # _enrich resolves shared subopts via the reverse share-suffix
+    # map (one dict fetch), not a scan over every subscription
+    s = Session("c1")
+    for i in range(50):
+        s.subscriptions[f"noise/{i}"] = SubOpts()
+    s.subscribe("$share/g/a/b", SubOpts(qos=2, subid=7))
+    s.subscribe("$queue/q/only", SubOpts(qos=1))
+    assert s._share_keys == {"a/b": "$share/g/a/b",
+                             "q/only": "$queue/q/only"}
+    m = s._enrich("a/b", _m(topic="a/b", qos=2))
+    assert m.qos == 2
+    assert m.get_header("properties")["Subscription-Identifier"] == 7
+    m = s._enrich("q/only", _m(topic="q/only", qos=1))
+    assert m.qos == 1
+
+
+def test_share_suffix_map_collision_first_wins_then_falls_back():
+    s = Session("c1")
+    s.subscribe("$share/g1/t/x", SubOpts(qos=1))
+    s.subscribe("$share/g2/t/x", SubOpts(qos=2))
+    # first subscription wins, matching the old scan's insertion-
+    # order pick
+    assert s._share_keys["t/x"] == "$share/g1/t/x"
+    assert s._enrich("t/x", _m(topic="t/x", qos=2)).qos == 1
+    s.unsubscribe("$share/g1/t/x")
+    # the surviving group takes over the bare filter
+    assert s._share_keys["t/x"] == "$share/g2/t/x"
+    assert s._enrich("t/x", _m(topic="t/x", qos=2)).qos == 2
+    s.unsubscribe("$share/g2/t/x")
+    assert s._share_keys == {}
+
+
+def test_share_suffix_map_survives_wire_roundtrip():
+    from emqx_tpu.session import Session as S
+
+    s = Session("c1")
+    s.subscribe("$share/g/w/t", SubOpts(qos=1))
+    s2 = S.from_wire(s.to_wire())
+    assert s2._share_keys == {"w/t": "$share/g/w/t"}
+    assert s2._enrich("w/t", _m(topic="w/t", qos=1)).qos == 1

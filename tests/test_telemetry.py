@@ -234,6 +234,27 @@ def test_enabled_mode_same_dispatch_results_as_reference():
     assert _run_workload(b_on) == _run_workload(b_ref)
 
 
+def test_disabled_mode_ab_guard_covers_dispatch_planner():
+    """The disabled-mode byte-identity guard, on BOTH delivery tails:
+    planner-on (the default, its dispatch_plan stage silent) and the
+    [dispatch] planner=false legacy walk."""
+    from emqx_tpu.broker import DispatchConfig
+
+    assert "dispatch_plan" in STAGES
+    for planner in (True, False):
+        dc = DispatchConfig(planner=planner)
+        b_off = Broker(router=Router(
+            MatcherConfig(device_min_filters=0, match_cache_slots=64),
+            node="node1"), dispatch_config=dc)
+        tel = _wire(b_off, TelemetryConfig(enabled=False))
+        b_ref = Broker(router=Router(
+            MatcherConfig(device_min_filters=0, match_cache_slots=64),
+            node="node1"), dispatch_config=dc)
+        assert _run_workload(b_off) == _run_workload(b_ref), planner
+        assert tel.spans_total == 0
+        assert all(h.count == 0 for h in tel.hists.values())
+
+
 # -- slow-publish log + alarm ---------------------------------------------
 
 
