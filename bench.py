@@ -1273,6 +1273,25 @@ def churn():
     leave match p99 unaffected; rebuild cost amortized by O(delta)
     patches, reference O(depth) semantics src/emqx_trie.erl:82-116).
 
+    Three churn shapes (ISSUE 4, docs/MATCH_CACHE.md "Partitioned
+    epochs"), all against the same router/filter set:
+
+      - **disjoint** (the headline): literal-rooted churn filters
+        (``churn/{i}/leaf``) whose first level is disjoint from the
+        matched topics' roots — partitioned epoch keys keep the other
+        partitions' cached entries valid, so the hit rate survives;
+      - **root_wildcard**: ``+/churnrw/{i}`` — every mutation is a
+        global epoch bump (the conservative fallback), hit rate
+        collapses by design, exactly as safe as whole-epoch;
+      - **share**: ``$share/<group>/churnsh{i}/leaf`` — partitions on
+        the level AFTER the share prefix.
+
+    Plus a partitioned-vs-whole-epoch A/B column: the disjoint pass
+    re-run with whole-epoch invalidation (``CHURN_PARTITIONS=1``
+    semantics, the PR-1 behavior) on the identical filter set.
+    ``CHURN_PARTITIONS=<n>`` pins the main passes' granularity (``1``
+    makes the headline itself whole-epoch and skips the A/B).
+
     Reports p99 batch-match latency WITH churn; ``vs_baseline`` is
     the no-churn p99 / churn p99 ratio (1.0 = unaffected)."""
     import sys
@@ -1287,9 +1306,12 @@ def churn():
     B = int(os.environ.get("BENCH_BATCH", "256"))
     rate = int(os.environ.get("BENCH_CHURN_RATE", "10000"))
     iters = int(os.environ.get("BENCH_ITERS", "60"))
+    p_env = int(os.environ.get("CHURN_PARTITIONS", "0"))
 
+    cfg = MatcherConfig() if p_env <= 0 \
+        else MatcherConfig(cache_partitions=p_env)
     filters, vocab = build_filters(rng, n_subs, 64)
-    r = Router(MatcherConfig())
+    r = Router(cfg)
     t0 = time.time()
     for f in filters:
         r.add_route(f)
@@ -1301,6 +1323,30 @@ def churn():
     r.match_ids(batches[0][0])      # chunk shape: compiles once, here)
     r.delete_route("warm/patch/path")
     r.match_ids(batches[0][0])
+
+    # warm every (hit-pad, miss-pad) cache shape the churn passes can
+    # produce: with partitioned epochs a churn batch is a PARTIAL
+    # hit/miss split (pre-partition churn was all-miss), and each new
+    # pow2 pad combo recompiles the merge/insert jits + the walk's
+    # miss bucket. One small batch per distinct shape here, so the
+    # timed p99 measures steady state, not first-touch XLA.
+    hot = list(dict.fromkeys(topics))[:B]
+    r.match_ids(hot)  # all cached now
+    def _p2(n, floor=8):
+        out = floor
+        while out < n:
+            out *= 2
+        return out
+    fresh_i = [0]
+    seen_sigs = set()
+    for m in range(1, B + 1):
+        sig = (_p2(max(B - m, 1)), _p2(m))
+        if sig in seen_sigs:
+            continue
+        seen_sigs.add(sig)
+        fresh = [f"wfresh/{fresh_i[0] + j}/x" for j in range(m)]
+        fresh_i[0] += m
+        r.match_ids(hot[:B - m] + fresh)
     build_s = time.time() - t0
 
     def step(batch):
@@ -1309,56 +1355,113 @@ def churn():
 
     p50_base, p99_base = _latency_pass(step, batches, iters)
 
-    stop = threading.Event()
-    churned = [0]
+    def churn_pass(mk):
+        """One timed pass under a churner adding/deleting ``mk(i)``
+        filters at `rate`/s. Strict add→delete pairing (the old
+        alternating loop's ``churn/{i-1}`` arithmetic could delete a
+        route it never added); the trailing add is cleaned up after
+        join so every pass leaves the filter set exactly as it found
+        it (the A/B passes must measure identical sets). Returns
+        (p50, p99, achieved rate, cache hit rate DURING the pass)."""
+        c = r._match_cache_obj
+        h0, m0 = (c.hits, c.misses) if c is not None else (0, 0)
+        stop = threading.Event()
+        churned = [0]
+        holder = {"pending": None}
 
-    def churner():
-        # alternating add/delete of fresh filters at `rate`/s: every
-        # mutation exercises the patch path (insert + tombstone)
-        i = 0
-        interval = 1.0 / max(1, rate)
-        next_t = time.perf_counter()
-        while not stop.is_set():
-            if i % 2 == 0:
-                r.add_route(f"churn/{i}/leaf")
-            else:
-                r.delete_route(f"churn/{i - 1}/leaf")
-            churned[0] += 1
-            i += 1
-            next_t += interval
-            pause = next_t - time.perf_counter()
-            if pause > 0:
-                time.sleep(pause)
+        def churner():
+            i = 0
+            interval = 1.0 / max(1, rate)
+            next_t = time.perf_counter()
+            while not stop.is_set():
+                if holder["pending"] is None:
+                    holder["pending"] = mk(i)
+                    r.add_route(holder["pending"])
+                    i += 1
+                else:
+                    r.delete_route(holder["pending"])
+                    holder["pending"] = None
+                churned[0] += 1
+                next_t += interval
+                pause = next_t - time.perf_counter()
+                if pause > 0:
+                    time.sleep(pause)
 
-    th = threading.Thread(target=churner, daemon=True)
-    t1 = time.time()
-    th.start()
-    p50_churn, p99_churn = _latency_pass(step, batches, iters)
-    stop.set()
-    th.join(timeout=5)
-    wall = time.time() - t1
+        th = threading.Thread(target=churner, daemon=True)
+        t1 = time.time()
+        th.start()
+        p50c, p99c = _latency_pass(step, batches, iters)
+        stop.set()
+        th.join(timeout=5)
+        wall = time.time() - t1
+        if holder["pending"] is not None:
+            r.delete_route(holder["pending"])
+            holder["pending"] = None
+        c = r._match_cache_obj
+        hd = (c.hits - h0) if c is not None else 0
+        md = (c.misses - m0) if c is not None else 0
+        hit_rate = hd / max(1, hd + md)
+        return (p50c, p99c, round(churned[0] / max(wall, 1e-9), 1),
+                round(hit_rate, 4))
+
+    p50_churn, p99_churn, rate_disj, hit_disj = \
+        churn_pass(lambda i: f"churn/{i}/leaf")
+    _, p99_rw, _, hit_rw = churn_pass(lambda i: f"+/churnrw/{i}")
+    _, p99_sh, _, hit_sh = \
+        churn_pass(lambda i: f"$share/churngrp/churnsh{i}/leaf")
+    # whole-epoch A/B on the SAME router/filter set: the bump
+    # granularity is read from the config at mutation time, so
+    # flipping it to 1 measures exactly the legacy invalidation on an
+    # identical automaton (existing partitioned-key entries go stale
+    # on first probe — irrelevant under churn, where whole-epoch
+    # invalidates everything every mutation anyway)
+    p99_whole = hit_whole = None
+    if r.config.cache_partitions > 1:
+        parts_used = r.config.cache_partitions
+        r.config.cache_partitions = 1
+        _, p99_whole, _, hit_whole = \
+            churn_pass(lambda i: f"churn/{i}/leaf")
+        r.config.cache_partitions = parts_used
     st = r.stats()
+    bumps = r.cache_bump_totals()
     info = {
         "subs": n_subs, "batch": B, "build_s": round(build_s, 1),
         "churn_target_rate": rate,
-        "churn_achieved_rate": round(churned[0] / max(wall, 1e-9), 1),
+        "churn_achieved_rate": rate_disj,
         "p50_ms_no_churn": round(p50_base, 3),
         "p99_ms_no_churn": round(p99_base, 3),
         "p50_ms_churn": round(p50_churn, 3),
         "rebuilds": st["rebuilds"], "patches": st["patches"],
+        "bump_global": bumps["global"],
+        "bump_partition": bumps["partition"],
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(info), file=sys.stderr, flush=True)
     _emit({
         "metric": "churn_match_p99_ms",
-        # r5: walk rewrite + mutator-side drain batching
-        "workload": "walkv2_drain",
+        # ISSUE 4: partitioned match-cache epochs — the headline is
+        # now measured with the cache surviving disjoint-prefix churn
+        "workload": "partitioned_epochs_v1",
         "value": round(p99_churn, 3),
         "unit": "ms",
         "vs_baseline": round(p99_base / p99_churn, 3)
         if p99_churn > 0 else 0.0,
         "p50_batch_ms": round(p50_churn, 3),
         "p99_batch_ms": round(p99_churn, 3),
+        "cache_partitions": r.config.cache_partitions,
+        "cache_hit_rate_churn": hit_disj,
+        # variant rows: conservative global-bump shapes
+        "root_wildcard_p99_ms": round(p99_rw, 3),
+        "root_wildcard_hit_rate": hit_rw,
+        "share_p99_ms": round(p99_sh, 3),
+        "share_hit_rate": hit_sh,
+        # whole-epoch A/B (None when CHURN_PARTITIONS=1 made the
+        # headline itself whole-epoch)
+        "whole_epoch_p99_ms": round(p99_whole, 3)
+        if p99_whole is not None else None,
+        "whole_epoch_hit_rate": hit_whole,
+        "partition_speedup": round(p99_whole / p99_churn, 3)
+        if p99_whole and p99_churn > 0 else None,
     })
 
 
@@ -1732,7 +1835,7 @@ _MODES = {
 _MODE_WORKLOADS = {
     "sharded": "deduped_tick_v3_invexp",
     "shared": "walkv2",
-    "churn": "walkv2_drain",
+    "churn": "partitioned_epochs_v1",
     "live": "probe_v1",
 }
 

@@ -166,6 +166,14 @@ def _build_matcher(raw: Dict[str, Any]):
                               or not isinstance(val, int)):
             raise ConfigError(f"matcher.{key} must be an integer")
         kwargs[key] = val
+    p = kwargs.get("cache_partitions")
+    if p is not None and (p < 1 or (p & (p - 1))):
+        # Router would reject this too (ValueError at node build);
+        # catching it here makes it a startup ConfigError with the
+        # file location semantics of every other [matcher] typo
+        raise ConfigError(
+            f"matcher.cache_partitions must be a power of two >= 1, "
+            f"got {p}")
     return MatcherConfig(**kwargs)
 
 

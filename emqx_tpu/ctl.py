@@ -81,8 +81,37 @@ class Ctl:
         self.register_command(
             "telemetry", self._telemetry,
             "stages | slow | reset — publish-path stage latency")
+        self.register_command(
+            "cache", self._cache,
+            "publish match-cache: hit/miss/stale, epoch-bump split, "
+            "partitions, fid quarantine")
         from emqx_tpu.profiling import register_ctl
         register_ctl(self)
+
+    def _cache(self, args) -> str:
+        """Everything needed to diagnose a hit-rate collapse from one
+        command (docs/MATCH_CACHE.md "Partitioned epochs"): per-cache
+        cumulative counters + hit rate, the bump.global/bump.partition
+        split, the live partition count, and the fid-quarantine
+        depth."""
+        r = self.node.router
+        out = {
+            "partitions": r.cache_partitions_live(),
+            "bumps": r.cache_bump_totals(),
+            "entries": r.cache_entries(),
+            "quarantined_ids": r.quarantined_ids(),
+        }
+        for name, c in (("single", r._match_cache_obj),
+                        ("sharded", r._sharded_cache_obj)):
+            if c is not None:
+                st = c.stats()
+                st["hit_rate"] = round(st["hit_rate"], 4)
+                out[name] = st
+        if r._match_cache_obj is None and r._sharded_cache_obj is None:
+            out["state"] = ("disabled" if not r.config.match_cache
+                            or r.config.match_cache_slots <= 0
+                            else "cold (no device match yet)")
+        return json.dumps(out, indent=2)
 
     def _telemetry(self, args) -> str:
         tel = getattr(self.node, "telemetry", None)

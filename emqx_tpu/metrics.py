@@ -83,10 +83,19 @@ DEVICE_METRICS = [
 # publish match cache (ops/match_cache.py): per-unique-topic hit/miss
 # split counters, drained from the router by the stats flush (and
 # thence into $SYS heartbeats + the Prometheus exposition). `stale`
-# counts entries found but epoch-invalidated (route churn / rebuild)
+# counts entries found but epoch-invalidated (route churn / rebuild).
+# The `bump.*` pair splits epoch-bump traffic by invalidation scope
+# (docs/MATCH_CACHE.md "Partitioned epochs"): `bump.partition` =
+# literal-rooted filter mutations that invalidated one partition,
+# `bump.global` = root-wildcard mutations / rebuilds / reclaims that
+# invalidated everything — a churn-driven hit-rate collapse is
+# diagnosable from this split alone (global racing ⇒ root-wildcard
+# churn; partition racing with `stale` ⇒ literal churn colliding
+# into hot partitions)
 CACHE_METRICS = [
     "cache.match.hit", "cache.match.miss",
     "cache.match.insert", "cache.match.stale",
+    "cache.match.bump.global", "cache.match.bump.partition",
 ]
 
 TRANSPORT_METRICS = [

@@ -109,6 +109,11 @@ class Node:
         # externally constructed Cluster attaching itself)
         self.cluster = None
         self._cluster_cfg: Optional[tuple] = None
+        # fid-quarantine growth watch (stats tick): depth at the last
+        # tick + consecutive-growth streak behind the
+        # router_ids_quarantined alarm (_update_stats)
+        self._quar_prev = 0
+        self._quar_streak = 0
         self.stats.register_update(self._update_stats)
 
     # convenience accessors
@@ -318,10 +323,48 @@ class Node:
         stats.setstat("match.cache.entries.count",
                       self.router.cache_entries(),
                       "match.cache.entries.max")
+        stats.setstat("match.cache.partition.live",
+                      self.router.cache_partitions_live())
+        self._watch_quarantine(stats)
         stats.setstat("publish.spans.count", self.telemetry.spans_total,
                       "publish.spans.max")
         stats.setstat("publish.slow.count", self.telemetry.slow_total,
                       "publish.slow.max")
+
+    #: consecutive growing stats ticks before the fid-quarantine
+    #: alarm fires (with the default 60s sys_interval: ~3 minutes of
+    #: monotonic growth — the round-4 soak leak crossed 200K ids in
+    #: one)
+    QUARANTINE_ALARM_TICKS = 3
+
+    def _watch_quarantine(self, stats: Stats) -> None:
+        """Publish the fid-quarantine depth gauge and raise the
+        ``router_ids_quarantined`` alarm on sustained growth past the
+        router's own reclaim bound — the device-regime analogue of
+        the host-regime reclaim (router.py ``_retire_id``): between
+        flattens nothing drains ``_pending_free``, so depth growing
+        every tick means subscribe churn is outpacing
+        compaction/rebuild and host memory grows linearly. Clears on
+        the first non-growing tick (a flatten drained it)."""
+        q = self.router.quarantined_ids()
+        stats.setstat("router.ids.quarantined.count", q,
+                      "router.ids.quarantined.max")
+        bound = self.router.config.host_reclaim_pending
+        if q > self._quar_prev and q > bound:
+            self._quar_streak += 1
+        else:
+            self._quar_streak = 0
+            self.alarms.deactivate("router_ids_quarantined")
+        self._quar_prev = q
+        if self._quar_streak >= self.QUARANTINE_ALARM_TICKS:
+            self.alarms.activate(
+                "router_ids_quarantined",
+                details={"quarantined": q,
+                         "streak_ticks": self._quar_streak,
+                         "bound": bound},
+                message=(f"router fid quarantine growing for "
+                         f"{self._quar_streak} stats ticks "
+                         f"(depth {q})"))
 
     # -- facade (src/emqx.erl:26-64) --------------------------------------
 

@@ -19,11 +19,17 @@ Layout and contract:
   - entries are **epoch-guarded**: the key stored at insert time must
     equal the probing key exactly or the entry is a (counted) stale
     miss. The router bumps its cache revision on any filter-set
-    change, rebuild, or capacity boost — wildcard filters make
+    change, rebuild, or capacity boost — wildcard filters make exact
     per-key invalidation intractable (an added ``a/+`` changes the
     match set of unboundedly many cached topics), so invalidation is
-    whole-epoch and entries self-heal by re-insert. No flush kernel
-    exists or is needed;
+    epoch-scoped and entries self-heal by re-insert. No flush kernel
+    exists or is needed. The cache itself is key-agnostic: the caller
+    may hand :meth:`MatchCache.probe` one batch-wide key (whole-epoch
+    invalidation, the ``cache_partitions = 1`` legacy behavior) or a
+    per-topic key list (the router's partitioned epochs — each key
+    carries the revision of the partition owning the topic's first
+    level, so disjoint-prefix route churn leaves other partitions'
+    entries valid; see docs/MATCH_CACHE.md "Partitioned epochs");
   - **overflow topics are never served from the cache**: a miss row
     whose walk overflowed is stored as an invalid marker (flag 0,
     ids all -1). A later hit on such a slot surfaces ``overflow=True``
@@ -110,12 +116,15 @@ def _insert_jit(table, idx, rows, ovf, movf):
 
 class _Probe:
     """One batch's host-side split (returned by :meth:`MatchCache.
-    probe`): hit/miss positions, assigned slots, the epoch key, and
+    probe`): hit/miss positions, assigned slots, the epoch key(s), and
     the device-table *snapshot* the hits must gather from (later
-    inserts produce new arrays, so the snapshot can't be clobbered)."""
+    inserts produce new arrays, so the snapshot can't be clobbered).
+    ``miss_keys`` is the per-miss insert key: identical to ``key``
+    under whole-epoch probing, the topic's own partitioned key when
+    the caller passed per-topic keys."""
 
     __slots__ = ("table", "key", "hit_pos", "hit_slots", "miss_pos",
-                 "miss_topics", "miss_slots")
+                 "miss_topics", "miss_slots", "miss_keys")
 
     def __init__(self, table, key) -> None:
         self.table = table
@@ -125,6 +134,7 @@ class _Probe:
         self.miss_pos: List[int] = []
         self.miss_topics: List[str] = []
         self.miss_slots: List[int] = []
+        self.miss_keys: List[Any] = []
 
 
 class MatchCache:
@@ -172,15 +182,24 @@ class MatchCache:
         self._index[topic] = s
         return s
 
-    def probe(self, topics: Sequence[str], key) -> _Probe:
+    def probe(self, topics: Sequence[str], key,
+              keys: Optional[Sequence[Any]] = None) -> _Probe:
         """Split a unique-topic batch into hits (slot per topic, key
         matches) and misses (slot assigned now, marked pending — a
-        crash before :meth:`insert` just leaves a permanent miss)."""
+        crash before :meth:`insert` just leaves a permanent miss).
+
+        ``keys`` (optional, parallel to ``topics``) overrides ``key``
+        per topic: the router's partitioned-epoch probe passes one key
+        per topic carrying that topic's partition revision. Omitted,
+        every topic probes (and later inserts) under the single
+        batch-wide ``key`` — byte-identical to the pre-partition
+        behavior."""
         with self._lock:
             p = _Probe(self._table_now(), key)
             for i, t in enumerate(topics):
+                k = key if keys is None else keys[i]
                 s = self._index.get(t)
-                if s is not None and self._slot_key[s] == key:
+                if s is not None and self._slot_key[s] == k:
                     p.hit_pos.append(i)
                     p.hit_slots.append(s)
                     continue
@@ -193,6 +212,7 @@ class MatchCache:
                 p.miss_pos.append(i)
                 p.miss_topics.append(t)
                 p.miss_slots.append(s)
+                p.miss_keys.append(k)
             self.hits += len(p.hit_pos)
             self.misses += len(p.miss_pos)
             return p
@@ -216,10 +236,11 @@ class MatchCache:
         with self._lock:
             self._table = _insert_jit(self._table_now(), idx, rows,
                                       ovf, movf)
-            for s, t in zip(probe.miss_slots, probe.miss_topics):
+            for s, t, k in zip(probe.miss_slots, probe.miss_topics,
+                               probe.miss_keys):
                 # skip slots another batch's clock sweep reassigned
                 if self._slot_topic[s] == t:
-                    self._slot_key[s] = probe.key
+                    self._slot_key[s] = k
             self.inserts += n
 
     def merge(self, b_pad: int, probe: _Probe, miss_rows=None,

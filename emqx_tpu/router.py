@@ -26,9 +26,10 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -97,15 +98,70 @@ class MatcherConfig:
     patch_drain_batch: int = 256
     # publish match cache (ops/match_cache.py): epoch-guarded HBM
     # memo of per-topic match rows — a repeat topic across batches
-    # costs one gather instead of an NFA walk. Any route add/delete
-    # (or rebuild / capacity boost) bumps the cache revision, so
-    # stale entries self-invalidate; overflow topics are never served
-    # from it (exact host fallback, as always). False restores the
-    # pre-cache dispatch byte-for-byte. Slot count is a power of two;
-    # footprint ≈ slots × (max_matches + 1) × 4 B (default 64K slots
-    # × 65 ints ≈ 16 MB of HBM).
+    # costs one gather instead of an NFA walk. A route add/delete
+    # bumps the affected partition's epoch (or the global one — see
+    # cache_partitions below), rebuilds/capacity boosts bump
+    # globally, so stale entries self-invalidate; overflow topics are
+    # never served from it (exact host fallback, as always). False
+    # restores the pre-cache dispatch byte-for-byte. Slot count is a
+    # power of two; footprint ≈ slots × (max_matches + 1) × 4 B
+    # (default 64K slots × 65 ints ≈ 16 MB of HBM).
     match_cache: bool = True
     match_cache_slots: int = 65536
+    # match-cache invalidation granularity: P-way partitioned epoch
+    # keys over the topic's FIRST LEVEL. A filter mutation whose root
+    # is a literal bumps only its partition's revision (a filter
+    # `a/+/c` can only change the match set of topics rooted at `a`),
+    # so disjoint-prefix subscribe/unsubscribe churn no longer
+    # collapses the hit rate to zero; root `+`/`#` filters (and
+    # rebuilds, reclaims) still bump the global revision — exactly as
+    # safe as whole-epoch. Power of two; 1 = legacy whole-epoch
+    # invalidation byte-for-byte (the PR-1 behavior).
+    cache_partitions: int = 64
+
+
+def topic_partition(topic: str, parts: int) -> int:
+    """Match-cache partition of a concrete topic: a stable hash of
+    its first level (``parts`` is a power of two). Stable across
+    processes (crc32, not ``hash``) so bench A/B runs and checkpoint
+    restores key identically."""
+    return zlib.crc32(topic.partition("/")[0].encode()) & (parts - 1)
+
+
+def filter_partitions(filter_: str, parts: int) -> Optional[Tuple[int, ...]]:
+    """Invalidation scope of a filter mutation under partitioned
+    epochs: the partition indices to bump, or ``None`` when only a
+    global bump is safe.
+
+    A filter whose first level is a **literal** ``L`` can only change
+    the match set of topics whose first level is exactly ``L`` (the
+    automaton descends level-by-level; ``+``/``#`` deeper in the
+    filter never widen the root), so bumping partition ``h(L)``
+    suffices. A root ``+`` or ``#`` matches topics of any root →
+    ``None``. A ``$share``/``$queue`` prefix is group routing, not
+    matching — the broker strips it before ``add_route`` — so a
+    prefixed filter reaching the router verbatim partitions on the
+    level AFTER the prefix (the root of the filter that actually
+    matches subscribers' topics) *plus* the raw ``$share`` root
+    (covering the literal interpretation: a trie handed the prefixed
+    string matches topics rooted ``$share``). A malformed or
+    wildcard-rooted inner filter falls back to ``None`` —
+    conservatively correct, never stale."""
+    root = filter_.partition("/")[0]
+    if root == T.PLUS or root == T.HASH:
+        return None
+    p0 = zlib.crc32(root.encode()) & (parts - 1)
+    if not filter_.startswith((T.SHARE_PREFIX, T.QUEUE_PREFIX)):
+        return (p0,)
+    try:
+        inner, _opts = T.parse(filter_)
+    except T.TopicError:
+        return None
+    iroot = inner.partition("/")[0]
+    if iroot == T.PLUS or iroot == T.HASH:
+        return None
+    p1 = zlib.crc32(iroot.encode()) & (parts - 1)
+    return (p0,) if p1 == p0 else (p0, p1)
 
 
 class Router:
@@ -198,11 +254,32 @@ class Router:
         # jax scalars defers the host transfer to drain time
         self._dev_stats: deque = deque(maxlen=65536)
         # publish match cache (ops/match_cache.py), lazily built on
-        # first device match. _cache_rev is the whole-epoch guard:
-        # bumped on any filter-set change, rebuild (ids recycle), or
-        # host-regime reclaim — cached rows are only served while
-        # their insert-time (epoch, rev, boosts) key matches exactly
+        # first device match. _cache_rev is the GLOBAL epoch guard:
+        # bumped on rebuild (ids recycle), host-regime reclaim, and
+        # any mutation whose invalidation scope can't be narrowed —
+        # cached rows are only served while their insert-time
+        # (epoch, rev[, partition_rev], boosts) key matches exactly.
+        # _part_revs scopes literal-rooted filter mutations to the
+        # one partition owning that first level (docs/MATCH_CACHE.md
+        # "Partitioned epochs"); sized at construction, bumped under
+        # _lock, snapshotted (tuple copy) by probes BEFORE the
+        # automaton snapshot so a racing mutation can only make
+        # entries look stale, never fresh
+        P = self.config.cache_partitions
+        if P < 1 or (P & (P - 1)):
+            raise ValueError(
+                f"cache_partitions must be a power of two >= 1, "
+                f"got {P}")
         self._cache_rev = 0
+        self._part_revs: List[int] = [0] * P
+        # epoch-bump accounting (cache.match.bump.* counters): how
+        # much of the invalidation traffic was scoped vs global — the
+        # churn-diagnosis split (a hit-rate collapse with bump.global
+        # racing means root-wildcard churn; with bump.partition it
+        # means literal churn colliding into hot partitions)
+        self._bump_global = 0
+        self._bump_partition = 0
+        self._bump_drained = (0, 0)
         self._match_cache_obj = None
         self._sharded_cache_obj = None
         self._sharded_cache_meta = None  # (T, m, d) the table is sized for
@@ -267,6 +344,24 @@ class Router:
             self._filter_ids[filter_] = fid
         return fid
 
+    def _bump_cache_rev(self, filter_: Optional[str] = None) -> None:
+        """Invalidate cached match rows a mutation can affect (call
+        under the lock). ``filter_=None`` — or any filter whose
+        invalidation scope can't be narrowed (root wildcard, malformed
+        share prefix), or legacy ``cache_partitions = 1`` — bumps the
+        global revision; a literal-rooted filter bumps only its
+        partition(s)."""
+        if filter_ is not None and self.config.cache_partitions > 1:
+            parts = filter_partitions(filter_,
+                                      self.config.cache_partitions)
+            if parts is not None:
+                for p in parts:
+                    self._part_revs[p] += 1
+                self._bump_partition += 1
+                return
+        self._cache_rev += 1
+        self._bump_global += 1
+
     def add_route(self, filter_: str, dest: object = None) -> int:
         """Add a route; returns the filter's dense id."""
         dest = self.node if dest is None else dest
@@ -285,9 +380,10 @@ class Router:
                 # let it carry the new revision over a pre-intern
                 # word table: accepted stale, silent match miss
                 self._mut_rev += 1
-                # the new filter may match any cached topic — whole-
-                # epoch invalidation (see ops/match_cache.py)
-                self._cache_rev += 1
+                # the new filter may change cached topics' match sets
+                # — invalidate its partition (literal root) or the
+                # whole epoch (root wildcard); see ops/match_cache.py
+                self._bump_cache_rev(filter_)
             dests[dest] = dests.get(dest, 0) + 1
             return fid
 
@@ -396,7 +492,9 @@ class Router:
                 self._id_to_filter[fid] = None
                 self._retire_id(fid)
                 self._patch_delete(filter_, fid)
-                self._cache_rev += 1  # cached rows may hold this fid
+                # cached rows may hold this fid — but only rows whose
+                # topic the filter matched, all inside its partition
+                self._bump_cache_rev(filter_)
 
     def _retire_id(self, fid: int) -> None:
         """Freed filter id → quarantine or immediate recycle.
@@ -462,7 +560,7 @@ class Router:
                     self._id_to_filter[fid] = None
                     self._retire_id(fid)
                     self._patch_delete(f, fid)
-                    self._cache_rev += 1
+                    self._bump_cache_rev(f)
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -527,7 +625,7 @@ class Router:
         self._dirty = False
         self._grow = {"state": 1, "edge": 1}
         self._rebuilds += 1
-        self._cache_rev += 1  # fresh id map: quarantined ids recycle
+        self._bump_cache_rev()  # fresh id map: quarantined ids recycle
         self._published = (auto, self._auto_map, self._rebuilds,
                            self._cache_rev)
         return auto
@@ -583,7 +681,7 @@ class Router:
         self._dirty = False
         self._grow = {"state": 1, "edge": 1}
         self._rebuilds += 1
-        self._cache_rev += 1  # fresh id map: quarantined ids recycle
+        self._bump_cache_rev()  # fresh id map: quarantined ids recycle
         self._published = (auto, self._auto_map, self._rebuilds,
                            self._cache_rev)
         return auto
@@ -787,7 +885,7 @@ class Router:
             self._dirty = True  # next device use must re-flatten
             self._free_ids.extend(self._pending_free)
             self._pending_free.clear()
-            self._cache_rev += 1  # drained ids may recycle
+            self._bump_cache_rev()  # drained ids may recycle
 
     def match_dispatch(self, topics: Sequence[str]):
         """Dispatch-only device match: encode + enqueue the compiled
@@ -854,15 +952,27 @@ class Router:
         cfg = self.config
         k_boost = self._k_boost  # read BEFORE the snapshot/walk: a
         # concurrent boost then stales these entries, never the reverse
+        # partition revisions: same read-before-snapshot rule (a
+        # mutation landing after this copy makes the probed keys look
+        # stale — re-walked, safe). Tuple copy = a consistent host
+        # snapshot the per-topic keys index into
+        part_snap = (tuple(self._part_revs)
+                     if cfg.cache_partitions > 1 else None)
         auto, id_map, epoch, rev = self.snapshot_cached()
         key = (epoch, rev, k_boost)
+        keys = None
+        if part_snap is not None:
+            mask = cfg.cache_partitions - 1
+            keys = [key + (part_snap[zlib.crc32(
+                t.partition("/")[0].encode()) & mask],)
+                for t in topics]
         bucket = cfg.min_batch
         while bucket < len(topics):
             bucket *= 2
         tel = self.telemetry
         timed = tel is not None and tel.enabled
         t0 = time.perf_counter() if timed else 0.0
-        probe = cache.probe(topics, key)
+        probe = cache.probe(topics, key, keys)
         t1 = time.perf_counter() if timed else 0.0
         miss_rows = miss_ovf = None
         if probe.miss_topics:
@@ -897,21 +1007,53 @@ class Router:
 
     def drain_cache_stats(self) -> Dict[str, int]:
         """Match-cache counter deltas since the last drain (hit/miss/
-        insert/stale), summed over the single-chip and sharded
-        caches — folded into Metrics by the stats flush."""
+        insert/stale, summed over the single-chip and sharded caches)
+        plus the router-level epoch-bump split (``bump.global`` /
+        ``bump.partition``) — folded into Metrics by the stats flush
+        under the ``cache.match.`` prefix."""
         out: Dict[str, int] = {}
         for c in (self._match_cache_obj, self._sharded_cache_obj):
             if c is None:
                 continue
             for k2, v in c.drain_stats().items():
                 out[k2] = out.get(k2, 0) + v
+        cfg = self.config
+        if cfg.match_cache and cfg.match_cache_slots > 0:
+            g, p = self._bump_global, self._bump_partition
+            out["bump.global"] = g - self._bump_drained[0]
+            out["bump.partition"] = p - self._bump_drained[1]
+            self._bump_drained = (g, p)
         return out
+
+    def cache_bump_totals(self) -> Dict[str, int]:
+        """Cumulative epoch-bump split (not deltas — `ctl cache` and
+        bench introspection; the metrics fold uses
+        :meth:`drain_cache_stats`)."""
+        return {"global": self._bump_global,
+                "partition": self._bump_partition}
 
     def cache_entries(self) -> int:
         """Live entries across the publish match caches (gauge)."""
         return sum(c.entries() for c in
                    (self._match_cache_obj, self._sharded_cache_obj)
                    if c is not None)
+
+    def cache_partitions_live(self) -> int:
+        """Partition epoch keys in effect for the publish match cache
+        (the ``match.cache.partition.live`` gauge): 0 = cache
+        disabled, 1 = legacy whole-epoch, else ``cache_partitions``."""
+        cfg = self.config
+        if not cfg.match_cache or cfg.match_cache_slots <= 0:
+            return 0
+        return cfg.cache_partitions
+
+    def quarantined_ids(self) -> int:
+        """Freed filter ids quarantined until the next flatten (the
+        ``router.ids.quarantined`` gauge — the round-4 soak leak's
+        visibility: between flattens this is the linear-growth
+        regime, and sustained growth without a rebuild means churn
+        is outpacing compaction)."""
+        return len(self._pending_free)
 
     def effective_k(self) -> int:
         """Active-set capacity: configured + any learned boost — or 1
@@ -1068,6 +1210,10 @@ class Router:
         if not cfg.match_cache or cfg.match_cache_slots <= 0:
             return None
         boosts = (self._k_boost, self._d_boost)
+        # partition revisions snapshot BEFORE the automaton snapshot
+        # (same stale-not-fresh ordering as the single-chip path)
+        part_snap = (tuple(self._part_revs)
+                     if cfg.cache_partitions > 1 else None)
         auto, id_map, epoch, rev = self.snapshot_cached()
         st = fan_provider(epoch, id_map)
         if st is None or st.fan is None or st.bm is not None \
@@ -1077,6 +1223,12 @@ class Router:
         n_trie = cfg.mesh.shape["trie"]
         cache = self._sharded_cache_for(n_trie, d)
         key = (epoch, rev, boosts, st.version)
+        keys = None
+        if part_snap is not None:
+            mask = cfg.cache_partitions - 1
+            keys = [key + (part_snap[zlib.crc32(
+                t.partition("/")[0].encode()) & mask],)
+                for t in topics]
         unit = cfg.min_batch * cfg.mesh.shape["data"]
         bucket = unit
         while bucket < len(topics):
@@ -1084,7 +1236,7 @@ class Router:
         tel = self.telemetry
         timed = tel is not None and tel.enabled
         t0 = time.perf_counter() if timed else 0.0
-        probe = cache.probe(topics, key)
+        probe = cache.probe(topics, key, keys)
         t1 = time.perf_counter() if timed else 0.0
         miss_rows = miss_ovf = miss_movf = None
         if probe.miss_topics:

@@ -20,6 +20,15 @@ STATS_KEYS = [
     "channels.count", "channels.max",
     # live publish match-cache entries (emqx_tpu/ops/match_cache.py)
     "match.cache.entries.count", "match.cache.entries.max",
+    # partition epoch keys in effect for the match cache (0 = cache
+    # off, 1 = legacy whole-epoch, else MatcherConfig.cache_partitions
+    # — docs/MATCH_CACHE.md "Partitioned epochs")
+    "match.cache.partition.live",
+    # freed filter ids quarantined until the next flatten
+    # (Router._pending_free — the round-4 soak leak's device-regime
+    # visibility; sustained growth raises the router_ids_quarantined
+    # alarm from the stats tick)
+    "router.ids.quarantined.count", "router.ids.quarantined.max",
     # publish-path telemetry (emqx_tpu/telemetry.py): recorded batch
     # spans and slow-publish breaches (the .max watermarks make a
     # between-heartbeats burst visible even after a reset)

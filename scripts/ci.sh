@@ -26,6 +26,13 @@ echo "== match-cache parity (docs/MATCH_CACHE.md) =="
 # regression fails the gate before the long run
 python -m pytest tests/test_match_cache.py -q
 
+echo "== partitioned-epoch churn parity (docs/MATCH_CACHE.md) =="
+# randomized interleaved add/delete/publish against the host oracle
+# (literal, root-wildcard, $share, overflow topics; single-chip +
+# mesh) incl. the cache_partitions=1 whole-epoch A/B guard — a
+# stale-serve here is a delivery-correctness bug, fail fast
+python -m pytest tests/test_cache_partition.py -q
+
 echo "== dispatch planner parity (docs/DISPATCH.md) =="
 # planner-on vs legacy per-delivery tail: delivery counts, wire
 # bytes, metric deltas must be identical — a divergence here is a

@@ -1,0 +1,389 @@
+"""Partitioned match-cache epochs (ISSUE 4, docs/MATCH_CACHE.md
+"Partitioned epochs"): invalidation-scope unit mapping, disjoint-
+prefix churn keeping entries valid, conservative global bumps for
+root wildcards / share prefixes, randomized interleaved churn parity
+against the host oracle on both the single-chip and mesh paths, the
+``cache_partitions = 1`` legacy whole-epoch A/B pin, and the new
+observability surfaces (bump counters, gauges, `ctl cache`, the
+fid-quarantine alarm)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.broker import Broker
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.router import (MatcherConfig, Router, filter_partitions,
+                             topic_partition)
+from emqx_tpu.types import Message
+
+
+def _mk(**kw):
+    kw.setdefault("device_min_filters", 0)
+    return Router(MatcherConfig(**kw), node="node1")
+
+
+class Q:
+    def __init__(self, client_id="c"):
+        self.client_id = client_id
+        self.inbox = []
+
+    def deliver(self, topic, msg):
+        self.inbox.append((topic, msg))
+
+
+def _oracle_for(filters):
+    t = TrieOracle()
+    for f in filters:
+        t.insert(f)
+    return t
+
+
+def _assert_parity(r, oracle, topics):
+    got = r.match_filters(topics)
+    for t, row in zip(topics, got):
+        assert sorted(row) == sorted(oracle.match(t)), t
+
+
+# -- invalidation-scope unit ------------------------------------------------
+
+
+def test_filter_partitions_mapping():
+    P = 64
+    # literal root: exactly its own partition, == the topic's
+    assert filter_partitions("a/+/c", P) == (topic_partition("a/x/c", P),)
+    assert filter_partitions("a/#", P) == filter_partitions("a/b", P)
+    # empty first level ("/x") is a literal too
+    assert filter_partitions("/x", P) == (topic_partition("/y/z", P),)
+    # root wildcards can match any topic root: global only
+    assert filter_partitions("+/x", P) is None
+    assert filter_partitions("#", P) is None
+    assert filter_partitions("+", P) is None
+    # share prefixes partition on the post-prefix root AND the raw
+    # '$share' root (covers a trie handed the string verbatim)
+    ps = filter_partitions("$share/g/a/b", P)
+    assert topic_partition("a/zz", P) in ps
+    assert topic_partition("$share/anything", P) in ps
+    pq = filter_partitions("$queue/a/b", P)
+    assert topic_partition("a/zz", P) in pq
+    # wildcard-rooted inner filter / malformed prefix: global
+    assert filter_partitions("$share/g/+/b", P) is None
+    assert filter_partitions("$share/nofilter", P) is None
+    # partitions stay inside [0, P)
+    for f in ("a/b", "$share/g/deep/x", "w0_1/w1_2"):
+        for p in filter_partitions(f, P):
+            assert 0 <= p < P
+
+
+def test_disjoint_literal_churn_keeps_entries_valid():
+    r = _mk(match_cache_slots=256, cache_partitions=64)
+    filters = ["a/+", "a/b", "b/#"]
+    for f in filters:
+        r.add_route(f)
+    oracle = _oracle_for(filters)
+    topics = ["a/b", "a/c", "b/x"]
+    _assert_parity(r, oracle, topics)  # fill
+    c = r._match_cache_obj
+    # warm one full churn round first: the early adds can overflow
+    # the tiny automaton's capacity and force a growth rebuild — a
+    # legitimate GLOBAL bump the measured round must not see
+    for i in range(8):
+        r.add_route(f"churn{i}/x/leaf")
+        r.delete_route(f"churn{i}/x/leaf")
+    _assert_parity(r, oracle, topics)  # re-fill post-rebuild
+    hits0, stale0, rebuilds0 = c.hits, c.stale, r._rebuilds
+    # literal-rooted churn in a DISJOINT partition: cached entries
+    # for a/* and b/* must stay valid (pure hits, no stale)
+    for i in range(8):
+        r.add_route(f"churn{i}/x/leaf")
+        oracle.insert(f"churn{i}/x/leaf")
+        _assert_parity(r, oracle, topics)
+        r.delete_route(f"churn{i}/x/leaf")
+        oracle.delete(f"churn{i}/x/leaf")
+    if r._rebuilds == rebuilds0:  # no capacity rebuild interfered
+        assert c.hits - hits0 == 8 * len(topics)
+        assert c.stale == stale0
+    assert r.cache_bump_totals()["partition"] >= 16
+    # ...and a literal mutation in a HOT partition invalidates only it
+    r.add_route("a/new")
+    oracle.insert("a/new")
+    _assert_parity(r, oracle, topics)  # a/* stale-missed, b/* hit
+    assert c.stale > stale0
+
+
+def test_root_wildcard_mutations_bump_globally():
+    r = _mk(match_cache_slots=128, cache_partitions=16)
+    r.add_route("a/b")
+    oracle = _oracle_for(["a/b"])
+    _assert_parity(r, oracle, ["a/b", "z/z"])
+    g0 = r.cache_bump_totals()["global"]
+    # root '+' and root '#' filters may match ANY cached topic — the
+    # partitioned code must fall back to the global bump and the next
+    # match must see them (no stale hit)
+    for f in ("+/b", "#"):
+        r.add_route(f)
+        oracle.insert(f)
+        _assert_parity(r, oracle, ["a/b", "z/z"])
+        r.delete_route(f)
+        oracle.delete(f)
+        _assert_parity(r, oracle, ["a/b", "z/z"])
+    assert r.cache_bump_totals()["global"] - g0 == 4
+    assert r._match_cache_obj.stale > 0
+
+
+def test_share_filter_bumps_post_prefix_partition():
+    r = _mk(match_cache_slots=128, cache_partitions=64)
+    for f in ("a/+", "b/x"):
+        r.add_route(f)
+    oracle = _oracle_for(["a/+", "b/x"])
+    _assert_parity(r, oracle, ["a/1", "b/x"])
+    c = r._match_cache_obj
+    stale0, hits0 = c.stale, c.hits
+    # a $share filter handed to the router verbatim (the broker
+    # normally strips the prefix) invalidates the POST-prefix
+    # partition: cached topics rooted 'a' must re-walk...
+    r.add_route("$share/g/a/leaf")
+    oracle.insert("$share/g/a/leaf")
+    _assert_parity(r, oracle, ["a/1", "b/x"])
+    assert c.stale > stale0  # 'a' partition re-walked
+    assert c.hits > hits0    # 'b' partition still served
+    # ...and the literal interpretation stays exact too: a topic
+    # rooted '$share' matches the verbatim filter through the cache
+    _assert_parity(r, oracle, ["$share/g/a/leaf", "a/1"])
+    r.delete_route("$share/g/a/leaf")
+    oracle.delete("$share/g/a/leaf")
+    _assert_parity(r, oracle, ["$share/g/a/leaf", "a/1", "b/x"])
+
+
+def test_partitions_one_is_legacy_whole_epoch():
+    """``cache_partitions = 1`` must reproduce the PR-1 whole-epoch
+    behavior exactly: every mutation bumps the global revision, probe
+    keys carry no partition component, and every cached entry goes
+    stale on any filter-set change."""
+    r1 = _mk(match_cache_slots=64, cache_partitions=1)
+    rev0 = r1._cache_rev
+    r1.add_route("a/b")
+    assert r1._cache_rev == rev0 + 1  # disjoint literal still global
+    assert r1._part_revs == [0]
+    oracle = _oracle_for(["a/b"])
+    _assert_parity(r1, oracle, ["a/b", "zz/q"])
+    # stored keys are the legacy 3-tuple (epoch, rev, k_boost)
+    keys = [k for k in r1._match_cache_obj._slot_key if k is not None]
+    assert keys and all(len(k) == 3 for k in keys)
+    c = r1._match_cache_obj
+    stale0 = c.stale
+    r1.add_route("disjoint/leaf")  # whole-epoch: stales EVERYTHING
+    oracle.insert("disjoint/leaf")
+    _assert_parity(r1, oracle, ["a/b", "zz/q"])
+    assert c.stale > stale0
+    assert r1.cache_bump_totals()["partition"] == 0
+    # and the partitioned router computes identical match rows on the
+    # same sequence (parity of results, not just counters)
+    r64 = _mk(match_cache_slots=64, cache_partitions=64)
+    for f in ("a/b", "disjoint/leaf"):
+        r64.add_route(f)
+    topics = ["a/b", "zz/q", "disjoint/leaf"]
+    ids1, ovf1 = r1.match_dispatch(topics)[:2]
+    ids64, ovf64 = r64.match_dispatch(topics)[:2]
+    assert np.array_equal(np.asarray(ids1), np.asarray(ids64))
+    assert np.array_equal(np.asarray(ovf1), np.asarray(ovf64))
+
+
+# -- randomized interleaved churn parity ------------------------------------
+
+
+def _random_filter(rng, words):
+    """A filter from the full class mix: literal, root-'+', root-'#',
+    deep wildcard, or a verbatim $share prefix."""
+    kind = rng.random()
+    depth = rng.randint(1, 4)
+    ws = [rng.choice(words) for _ in range(depth)]
+    if kind < 0.15:
+        ws[0] = "+"
+    elif kind < 0.25:
+        return "#"
+    elif kind < 0.45 and depth > 1:
+        ws[rng.randrange(1, depth)] = "+"
+    elif kind < 0.55:
+        return "$share/grp/" + "/".join(ws)
+    if rng.random() < 0.2:
+        ws = ws[:max(1, depth - 1)] + ["#"]
+    return "/".join(ws)
+
+
+def test_randomized_churn_parity_single_chip():
+    """The satellite bar: interleaved add/delete/match with literal,
+    root-wildcard, $share, and overflow-marker topics — exact oracle
+    parity after EVERY mutation, partition and global bumps both
+    exercised."""
+    rng = random.Random(11)
+    # small max_matches/active_k force m-overflow markers for hot
+    # topics matching many filters (host-fallback path through cache)
+    r = _mk(match_cache_slots=512, cache_partitions=16,
+            max_matches=4, active_k=4)
+    oracle = TrieOracle()
+    words = ["a", "b", "c", "d"]
+    live = []
+    topics = ["/".join(rng.choice(words)
+                       for _ in range(rng.randint(1, 4)))
+              for _ in range(20)] + ["$share/grp/a/b", "$sys-ish/x"]
+    for step in range(40):
+        if live and rng.random() < 0.45:
+            f = live.pop(rng.randrange(len(live)))
+            r.delete_route(f)
+            oracle.delete(f)
+        else:
+            f = _random_filter(rng, words)
+            if f not in live:
+                r.add_route(f)
+                oracle.insert(f)
+                live.append(f)
+        batch = [rng.choice(topics) for _ in range(10)]
+        _assert_parity(r, oracle, batch)
+    st = r._match_cache_obj.stats()
+    bumps = r.cache_bump_totals()
+    assert st["hit"] > 0 and st["stale"] > 0
+    assert bumps["global"] > 0 and bumps["partition"] > 0
+
+
+def test_randomized_churn_parity_mesh():
+    """Same interleaved-churn bar through the full broker on a 1×1
+    mesh (the sharded cache path): delivery counts must equal the
+    host-computed expectation after every subscribe/unsubscribe."""
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    rng = random.Random(5)
+    b = Broker(router=Router(
+        MatcherConfig(mesh=make_mesh(1, 1), fanout_d=8,
+                      match_cache_slots=128, cache_partitions=16),
+        node="local"))
+    words = ["a", "b", "c"]
+    subs = {}  # filter (as subscribed, incl $share) -> Q
+    topics = ["a/b", "a/c", "b/x", "c/c/c", "a/b"]
+
+    def expected(topic):
+        n = 0
+        for full in subs:
+            flt, opts = T.parse(full)
+            if T.match(topic, flt):
+                n += 1  # one member per share group -> 1 delivery
+        return n
+
+    for step in range(12):
+        if subs and rng.random() < 0.4:
+            full = rng.choice(list(subs))
+            q = subs.pop(full)
+            b.unsubscribe(q, full)
+        else:
+            depth = rng.randint(1, 3)
+            ws = [rng.choice(words) for _ in range(depth)]
+            if rng.random() < 0.2:
+                ws[rng.randrange(depth)] = "+"
+            full = "/".join(ws)
+            if rng.random() < 0.3:
+                full = f"$share/g{step}/{full}"
+            if full not in subs:
+                q = Q(f"c{step}")
+                subs[full] = q
+                b.subscribe(q, full)
+        msgs = [Message(topic=t) for t in topics]
+        got = b.publish_batch(msgs)
+        want = [expected(t) for t in topics]
+        assert got == want, (step, sorted(subs))
+    cache = b.router._sharded_cache_obj
+    assert cache is not None and cache.hits > 0
+
+
+def test_overflow_markers_respect_partition_epochs():
+    """Overflow markers (never-served slots) live under the same
+    partitioned keys: a disjoint literal add must NOT un-pin an
+    overflowed topic (still host fallback, still exact), while a
+    same-partition mutation re-keys it."""
+    r = _mk(match_cache_slots=64, cache_partitions=64,
+            max_matches=2, active_k=2)
+    filters = ["t/#", "t/+", "t/x", "other/y"]
+    for f in filters:
+        r.add_route(f)
+    oracle = _oracle_for(filters)
+    for _ in range(2):
+        _assert_parity(r, oracle, ["t/x", "other/y"])
+    c = r._match_cache_obj
+    hits0 = c.hits
+    r.add_route("disjoint/leaf")  # other partition
+    oracle.insert("disjoint/leaf")
+    _assert_parity(r, oracle, ["t/x", "other/y"])  # marker hit again
+    assert c.hits > hits0
+    r.add_route("t/y")  # t partition: marker re-keys, still exact
+    oracle.insert("t/y")
+    _assert_parity(r, oracle, ["t/x", "t/y", "other/y"])
+
+
+# -- observability surfaces -------------------------------------------------
+
+
+def test_bump_counters_drain_and_fold():
+    from emqx_tpu.metrics import Metrics
+
+    r = _mk(match_cache_slots=64, cache_partitions=16)
+    r.add_route("lit/x")     # partition bump
+    r.add_route("+/w")       # global bump
+    r.match_filters(["lit/x"])
+    drained = r.drain_cache_stats()
+    assert drained["bump.partition"] >= 1
+    assert drained["bump.global"] >= 1
+    m = Metrics()
+    m.fold_cache_stats(drained)
+    assert m.val("cache.match.bump.partition") == drained["bump.partition"]
+    assert m.val("cache.match.bump.global") == drained["bump.global"]
+    # second drain: deltas only
+    again = r.drain_cache_stats()
+    assert again["bump.partition"] == 0 and again["bump.global"] == 0
+    # cache off: no bump keys leak into the fold
+    r_off = _mk(match_cache=False)
+    r_off.add_route("a/b")
+    assert "bump.global" not in r_off.drain_cache_stats()
+
+
+def test_node_gauges_ctl_cache_and_quarantine_alarm():
+    from emqx_tpu.node import Node
+
+    n = Node(boot_listeners=False,
+             matcher=MatcherConfig(device_min_filters=0,
+                                   cache_partitions=16))
+    q = Q("c1")
+    n.subscribe(q, "g/t")
+    n.broker.publish(Message(topic="g/t"))
+    n.stats.tick()
+    assert n.stats.getstat("match.cache.partition.live") == 16
+    assert n.stats.getstat("router.ids.quarantined.count") == 0
+    out = n.ctl.run(["cache"])
+    assert '"partitions": 16' in out
+    assert "bumps" in out and "quarantined_ids" in out
+    # sustained quarantine growth (above the reclaim bound) raises
+    # the alarm on the 3rd growing tick; a flat tick clears it
+    bound = n.router.config.host_reclaim_pending
+    n.router._pending_free = list(range(bound + 1))
+    for i in range(Node.QUARANTINE_ALARM_TICKS):
+        n.router._pending_free.append(i)
+        n.stats.tick()
+    active = [a.name for a in n.alarms.get_alarms("activated")]
+    assert "router_ids_quarantined" in active
+    assert n.stats.getstat("router.ids.quarantined.count") > bound
+    n.stats.tick()  # no growth: clears
+    active = [a.name for a in n.alarms.get_alarms("activated")]
+    assert "router_ids_quarantined" not in active
+
+
+def test_matcher_toml_cache_partitions():
+    from emqx_tpu.config import ConfigError, _build_matcher
+
+    assert _build_matcher({"cache_partitions": 16}).cache_partitions == 16
+    assert _build_matcher({"cache_partitions": 1}).cache_partitions == 1
+    with pytest.raises(ConfigError):
+        _build_matcher({"cache_partitions": 24})
+    with pytest.raises(ConfigError):
+        _build_matcher({"cache_partitions": 0})
+    with pytest.raises(ValueError):
+        Router(MatcherConfig(cache_partitions=12))
