@@ -22,7 +22,12 @@ leaves the route table small, i.e. the host-match regime),
 LIVE_PLANNER (0 = legacy per-delivery tail instead of the batch
 dispatch planner, docs/DISPATCH.md), LIVE_AB (0 = skip the
 planner-off comparison pass the record's planner_off_* columns come
-from), BENCH_PLATFORM.
+from), LIVE_QOS (publish/subscribe QoS, default 0 — at 1 every
+delivery is a per-subscriber frame with its own packet id, the
+egress pre-serialization target), LIVE_PRESER (0 = per-delivery
+on-loop serialization instead of the pre-built templates),
+LIVE_PRESER_AB (0 = skip the QoS1 preserialize on/off pair the
+record's qos1_* columns come from), BENCH_PLATFORM.
 """
 
 from __future__ import annotations
@@ -37,7 +42,7 @@ import numpy as np
 
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.frame import Parser, serialize
-from emqx_tpu.mqtt.packet import Connect, Publish, Subscribe
+from emqx_tpu.mqtt.packet import Connect, PubAck, Publish, Subscribe
 
 
 class _Peer:
@@ -70,30 +75,53 @@ class _Peer:
             if pkts:
                 return pkts[0]
 
-    async def subscribe(self, flt: str) -> None:
+    async def subscribe(self, flt: str, qos: int = 0) -> None:
         await self._send(Subscribe(packet_id=1,
-                                   topic_filters=[(flt, {"qos": 0})]))
+                                   topic_filters=[(flt, {"qos": qos})]))
         await self._read_packet()  # SUBACK
 
     async def recv_loop(self) -> None:
         """Count deliveries + record socket-to-deliver latency from
-        the embedded send timestamp."""
+        the embedded send timestamp; QoS1 deliveries are PUBACKed so
+        the broker-side inflight window keeps draining."""
         try:
             while True:
                 data = await self.reader.read(65536)
                 if not data:
                     return
                 now = time.perf_counter_ns()
+                acked = False
                 for pkt in self.parser.feed(data):
                     if isinstance(pkt, Publish):
                         self.received += 1
                         (ts,) = struct.unpack_from("<q", pkt.payload)
                         self.latencies.append((now - ts) / 1e6)
+                        if pkt.qos == 1:
+                            self.writer.write(serialize(
+                                PubAck(type=C.PUBACK,
+                                       packet_id=pkt.packet_id),
+                                C.MQTT_V4))
+                            acked = True
+                if acked:
+                    await self.writer.drain()
+        except (asyncio.CancelledError, ConnectionResetError):
+            return
+
+    async def drain_loop(self) -> None:
+        """QoS1 publishers: read and discard the broker's PUBACK
+        stream so it neither backs up the socket nor trips the
+        slow-consumer guard."""
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    return
+                self.parser.feed(data)
         except (asyncio.CancelledError, ConnectionResetError):
             return
 
     async def publish_loop(self, topics, stop, pipeline: int,
-                           rate: float = 0.0) -> int:
+                           rate: float = 0.0, qos: int = 0) -> int:
         """Pipelined QoS0 publishing until ``stop`` is set; drains
         the socket buffer every ``pipeline`` sends so the OS buffer
         (not this coroutine) is the limiter.
@@ -110,7 +138,9 @@ class _Peer:
             i += 1
             payload = struct.pack("<q", time.perf_counter_ns())
             self.writer.write(serialize(
-                Publish(topic=topic, payload=payload, qos=0),
+                Publish(topic=topic, payload=payload, qos=qos,
+                        packet_id=(i - 1) % 0xFFFF + 1 if qos
+                        else None),
                 C.MQTT_V4))
             sent += 1
             if rate > 0:
@@ -166,9 +196,26 @@ async def _run() -> dict:
     # table crosses the device threshold — the live device regime
     n_filters = int(os.environ.get("LIVE_FILTERS", "0"))
 
+    # delivery QoS: at 1 every delivery is a per-subscriber frame
+    # with its own packet id — the egress pre-serialization target
+    qos = int(os.environ.get("LIVE_QOS", "0"))
+
     planner = os.environ.get("LIVE_PLANNER", "1") != "0"
-    node = Node(boot_listeners=False, batch_linger_ms=1.0,
-                dispatch_config=DispatchConfig(planner=planner))
+    preser = os.environ.get("LIVE_PRESER", "1") != "0"
+    zone = None
+    if qos:
+        # QoS>0 saturation needs a wide send window: the default
+        # 32-deep inflight caps throughput at the harness's ack
+        # round-trip, and the bench would measure the window, not
+        # the broker (pids wrap at 65535 — stay well below)
+        from emqx_tpu.zone import Zone
+        zone = Zone(name="default",
+                    max_inflight=int(os.environ.get(
+                        "LIVE_INFLIGHT", "8192")),
+                    max_mqueue_len=50000)
+    node = Node(boot_listeners=False, batch_linger_ms=1.0, zone=zone,
+                dispatch_config=DispatchConfig(planner=planner,
+                                               preserialize=preser))
     lst = node.add_listener(port=0)
     await node.start()
 
@@ -197,7 +244,8 @@ async def _run() -> dict:
         s = _Peer(f"sub{i}")
         await s.connect(lst.port)
         # mixed literal/wildcard subscription shapes
-        await s.subscribe("bench/+/v" if i % 2 else f"bench/t{i}/#")
+        await s.subscribe("bench/+/v" if i % 2 else f"bench/t{i}/#",
+                          qos=qos)
         subs.append(s)
     probe_sub = probe_pub = None
     if probe_rate > 0:
@@ -215,6 +263,10 @@ async def _run() -> dict:
         p = _Peer(f"pub{i}")
         await p.connect(lst.port)
         pubs.append(p)
+    if qos:
+        # QoS>0 publishers must drain their PUBACK stream
+        recv_tasks += [asyncio.ensure_future(p.drain_loop())
+                       for p in pubs]
 
     # warmup: force the jit compiles outside the timed window. In the
     # device regime every pow2 padding bucket the capped ingress can
@@ -240,7 +292,8 @@ async def _run() -> dict:
             bsz *= 2
     warm_stop = asyncio.Event()
     warm = [asyncio.ensure_future(
-        p.publish_loop(topics, warm_stop, pipeline, rate)) for p in pubs]
+        p.publish_loop(topics, warm_stop, pipeline, rate, qos))
+        for p in pubs]
     await asyncio.sleep(0.5)
     warm_stop.set()
     await asyncio.gather(*warm)
@@ -254,11 +307,13 @@ async def _run() -> dict:
     base_flushes = node.ingress.flushes
     base_submitted = node.ingress.submitted
     base_wakeups = node.metrics.val("delivery.wakeups")
+    base_onloop = node.metrics.val("delivery.serialize.onloop")
 
     stop = asyncio.Event()
     t0 = time.perf_counter()
     pub_tasks = [asyncio.ensure_future(
-        p.publish_loop(topics, stop, pipeline, rate)) for p in pubs]
+        p.publish_loop(topics, stop, pipeline, rate, qos))
+        for p in pubs]
     if probe_pub is not None:
         pub_tasks.append(asyncio.ensure_future(probe_pub.publish_loop(
             ["probe/t"], stop, 1, probe_rate)))
@@ -275,6 +330,7 @@ async def _run() -> dict:
     flushes = node.ingress.flushes - base_flushes
     submitted = node.ingress.submitted - base_submitted
     wakeups = node.metrics.val("delivery.wakeups") - base_wakeups
+    onloop = node.metrics.val("delivery.serialize.onloop") - base_onloop
 
     probe_lats = (np.asarray(probe_sub.latencies, np.float64)
                   if probe_sub is not None and probe_sub.latencies
@@ -300,6 +356,14 @@ async def _run() -> dict:
         # per ingress batch (the planner targets ≤1 per connection)
         "wakeups_per_batch": round(wakeups / flushes, 2) if flushes else 0,
         "planner": planner,
+        "preserialize": preser,
+        "qos": qos,
+        # frames serialized ON the loop per delivered frame: ~0 when
+        # pre-serialization covers the traffic, ~1 when every frame
+        # pays a full serialize() on the event loop
+        "serialize_onloop": onloop,
+        "onloop_per_delivery": round(onloop / received, 4)
+        if received else 0.0,
         "pubs": n_pubs, "subs": n_subs,
         "paced_rate_per_pub": rate,
         "bg_filters": n_filters,
@@ -349,6 +413,34 @@ def live(emit=None) -> None:
         finally:
             del os.environ["LIVE_PLANNER"]
         print(json.dumps(info_off), file=sys.stderr, flush=True)
+    # egress pre-serialization A/B: a QoS1 fan-out pair (preserialize
+    # on vs off) — QoS1 is where the template lane matters, every
+    # delivery being a per-subscriber frame with its own packet id
+    # (the QoS0 bulk already shares one wire image per message). The
+    # on-loop serialize counter is the mechanism check: ~0 per
+    # delivery with templates, ~1 without (docs/DISPATCH.md).
+    # Host-regime batches never plan, so there are no templates to
+    # A/B — the pair only runs where the serialize stage engages.
+    info_q1 = info_q1_off = None
+    if info.get("preserialize") and info.get("regime") == "device" \
+            and os.environ.get("LIVE_PRESER_AB", "1") != "0":
+        saved_qos = os.environ.get("LIVE_QOS")
+        os.environ["LIVE_QOS"] = "1"
+        try:
+            info_q1 = asyncio.run(_run())
+            print(json.dumps(info_q1), file=sys.stderr, flush=True)
+            os.environ["LIVE_PRESER"] = "0"
+            try:
+                info_q1_off = asyncio.run(_run())
+            finally:
+                del os.environ["LIVE_PRESER"]
+            print(json.dumps(info_q1_off), file=sys.stderr,
+                  flush=True)
+        finally:
+            if saved_qos is None:
+                del os.environ["LIVE_QOS"]
+            else:
+                os.environ["LIVE_QOS"] = saved_qos
     rec = {
         "metric": "live_socket_throughput",
         # r5: ingest backpressure + paced service-latency probe
@@ -358,7 +450,30 @@ def live(emit=None) -> None:
         "vs_baseline": round(info["deliveries_per_s"] / 1_000_000, 3),
         "planner": info.get("planner", True),
         "wakeups_per_batch": info.get("wakeups_per_batch", 0),
+        "preserialize": info.get("preserialize", True),
+        "onloop_per_delivery": info.get("onloop_per_delivery", 0.0),
     }
+    if info_q1 is not None:
+        # the QoS1 fan-out row: per-subscriber pid-stamped frames —
+        # the pre-serialization target traffic
+        rec["qos1_msgs_per_s"] = round(info_q1["deliveries_per_s"], 1)
+        rec["qos1_saturated_p99_ms"] = round(info_q1["p99_ms"], 3)
+        rec["qos1_onloop_per_delivery"] = \
+            info_q1.get("onloop_per_delivery", 0.0)
+        if "probe_p99_ms" in info_q1:
+            rec["qos1_probe_p99_ms"] = round(
+                info_q1["probe_p99_ms"], 3)
+    if info_q1_off is not None:
+        rec["qos1_preser_off_msgs_per_s"] = round(
+            info_q1_off["deliveries_per_s"], 1)
+        rec["qos1_preser_off_saturated_p99_ms"] = round(
+            info_q1_off["p99_ms"], 3)
+        rec["qos1_preser_off_onloop_per_delivery"] = \
+            info_q1_off.get("onloop_per_delivery", 0.0)
+        if info_q1 is not None and info_q1_off["deliveries_per_s"] > 0:
+            rec["preser_speedup"] = round(
+                info_q1["deliveries_per_s"]
+                / info_q1_off["deliveries_per_s"], 3)
     if info_off is not None:
         rec["planner_off_msgs_per_s"] = round(
             info_off["deliveries_per_s"], 1)

@@ -31,7 +31,8 @@ from emqx_tpu.broker_helper import FanoutManager, unpack_sids
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.ops.bitmap import or_bitmaps_auto, rows_for_matches
-from emqx_tpu.ops.dispatch_plan import big_rows_for, build_plan
+from emqx_tpu.ops.dispatch_plan import (big_rows_for, build_plan,
+                                        preserialize_plan)
 from emqx_tpu.ops.fanout import expand_packed
 from emqx_tpu.ops.pack import (budget_for, bundle_i32, mask_pad_flags,
                                mask_pad_rows, pack_fanout, pack_matches,
@@ -55,6 +56,17 @@ class DispatchConfig:
     #: fire one notify wakeup per connection per batch. False restores
     #: the legacy per-(filter, subscriber) walk byte-for-byte.
     planner: bool = True
+
+    #: egress pre-serialization (docs/DISPATCH.md "Egress
+    #: pre-serialization"): after the plan is built — on the same
+    #: (possibly executor) fetch thread — QoS0 shared wire images and
+    #: QoS1/2 packet-id-placeholder templates are pre-built per
+    #: (message, proto_ver, flags variant), so the event loop's
+    #: delivery tail patches 2 pid bytes into a buffer copy instead
+    #: of running a full serialize() per frame. False restores the
+    #: on-loop per-delivery serialization byte-for-byte. No effect
+    #: when the planner is off (there is no plan to walk).
+    preserialize: bool = True
 
 
 class _PlanState:
@@ -670,6 +682,19 @@ class Broker:
                 pb.plan = self._build_plan(pb, subs_occ, src_occ)
                 if sp is not None:
                     sp.add("dispatch_plan", t_pl)
+                if pb.plan is not None \
+                        and self.dispatch_config.preserialize:
+                    # egress pre-serialization: prime the messages'
+                    # shared wire images / pid templates here — off
+                    # the event loop when fetch runs on the ingress
+                    # executor — so the delivery tail patches bytes
+                    # instead of serializing (docs/DISPATCH.md)
+                    t_s = sp.clock() if sp is not None else 0.0
+                    preserialize_plan(pb.plan, pb.live, pb.id_map,
+                                      self._subscribers,
+                                      self.helper.registry.lookup)
+                    if sp is not None:
+                        sp.add("serialize", t_s)
             if pb.plan is not None:
                 # planned batches keep the numpy views (the plan
                 # already indexed them; the legacy walk's per-element

@@ -34,6 +34,7 @@ from emqx_tpu.logger import set_metadata_clientid, set_metadata_peername
 from emqx_tpu.mountpoint import mount, replvar, unmount
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqtt.frame import publish_template as wire_template
 from emqx_tpu.mqtt.frame import serialize as wire_serialize
 from emqx_tpu.mqtt_caps import PUB_DROP_CODES, check_pub, check_sub
 from emqx_tpu.mqtt.packet import (Auth, Connack, Connect, Disconnect,
@@ -322,6 +323,15 @@ class Channel:
             client_id, pkt.clean_start, self, sess_opts)
         self.session.broker = self.broker
         self.session.notify = self._notify_deliver
+        # egress pre-serialization hints (read off-loop by
+        # ops/dispatch_plan.preserialize_plan): pre-build wire bytes
+        # only for transports the fast lanes can actually serve —
+        # mountpoint unmounting and outbound topic aliasing rewrite
+        # per delivery, so those channels stay on the slow path
+        self.session.proto_ver = self.proto_ver
+        self.session.wire_fast_hint = bool(
+            self.wire_fast and not self.mountpoint
+            and not self.client_alias_max)
         # keepalive (server may override via zone)
         interval = pkt.keepalive
         props: Dict[str, Any] = {}
@@ -782,10 +792,15 @@ class Channel:
         if self.session is None:
             return []
         out: List[Packet] = []
-        # fast-path (shared QoS0 wire image) metric increments batched
-        # per drain: the planner hands a session its whole batch in
-        # one enqueue, so one drain here covers many frames
+        # fast-path (shared QoS0 wire image / pid-patched template)
+        # metric increments batched per drain: the planner hands a
+        # session its whole batch in one enqueue, so one drain here
+        # covers many frames
         n_fast = 0
+        n_tpl1 = n_tpl2 = 0
+        n_onloop = 0
+        wire_ok = (self.wire_fast and not self.mountpoint
+                   and not self.client_alias_max)
         for pid, item in self.session.drain_outbox():
             if pid == PUBREL_MARKER:
                 out.append(self._ack(C.PUBREL, item))
@@ -795,8 +810,7 @@ class Channel:
                 self.broker.metrics.inc("delivery.dropped")
                 self.broker.metrics.inc("delivery.dropped.expired")
                 continue
-            if pid is None and self.wire_fast and not self.mountpoint \
-                    and not self.client_alias_max:
+            if wire_ok and pid is None:
                 data = self._wire_cached(msg)
                 if data is not None:
                     if self.client_max_packet and \
@@ -806,6 +820,19 @@ class Channel:
                             "delivery.dropped.too_large")
                         continue
                     n_fast += 1
+                    out.append(data)
+                    continue
+            elif wire_ok and not self.client_max_packet:
+                # QoS1/2 pre-serialized lane: patch the packet id
+                # into a copy of the shared template (built off-loop
+                # by the planner's serialize stage) — no per-delivery
+                # serialize, no size gate needed (no client cap)
+                data = self._wire_template(pid, msg)
+                if data is not None:
+                    if msg.qos == C.QOS_2:
+                        n_tpl2 += 1
+                    else:
+                        n_tpl1 += 1
                     out.append(data)
                     continue
             # copy before wire-mutation: the same object stays in the
@@ -860,13 +887,26 @@ class Channel:
                     continue
             self.broker.metrics.inc("packets.publish.sent")
             self.broker.metrics.inc_sent(msg)
+            n_onloop += 1
             out.append(pub)
+        m = self.broker.metrics
         if n_fast:
             # the fast path is QoS0 by construction (pid is None)
-            m = self.broker.metrics
             m.inc("packets.publish.sent", n_fast)
             m.inc("messages.sent", n_fast)
             m.inc("messages.qos0.sent", n_fast)
+        if n_tpl1 or n_tpl2:
+            m.inc("packets.publish.sent", n_tpl1 + n_tpl2)
+            m.inc("messages.sent", n_tpl1 + n_tpl2)
+            if n_tpl1:
+                m.inc("messages.qos1.sent", n_tpl1)
+            if n_tpl2:
+                m.inc("messages.qos2.sent", n_tpl2)
+        if n_onloop:
+            # PUBLISHes that paid a full serialize on the event loop
+            # (ineligible traffic, or pre-serialization off) — the
+            # LIVE_PRESER bench A/B reads this per delivery
+            m.inc("delivery.serialize.onloop", n_onloop)
         return out
 
     def _wire_cached(self, msg) -> Optional[bytes]:
@@ -887,8 +927,11 @@ class Channel:
             return None
         # enriched copies SHARE this dict but can differ in the
         # byte-affecting flags (RAP keeps retain, shared redispatch
-        # sets dup) — they key separately
-        key = (self.proto_ver, msg.flags.get("retain", False),
+        # sets dup) — they key separately. The effective QoS byte is
+        # part of the key: a downgraded-to-QoS0 copy and its QoS>0
+        # original share the cache dicts through the shallow header
+        # copy, and must never serve each other's bytes.
+        key = (self.proto_ver, msg.qos, msg.flags.get("retain", False),
                msg.flags.get("dup", False))
         data = wire.get(key)
         if data is None:
@@ -897,7 +940,48 @@ class Channel:
                 pub.properties = {}
             data = wire_serialize(pub, self.proto_ver)
             wire[key] = data
+            # an image the pre-serialization stage didn't prime
+            # (preserialize off, legacy tail, or a late variant):
+            # built here, ON the loop
+            self.broker.metrics.inc("delivery.serialize.onloop")
         return data
+
+    def _wire_template(self, pid: int, msg) -> Optional[bytes]:
+        """QoS1/2 pre-serialized lane: one pid-patched copy of the
+        message's shared template (built off-loop by the planner's
+        serialize stage, ops/dispatch_plan.preserialize_plan) instead
+        of a full per-delivery ``serialize``. ``None`` = no template
+        cache on this message (pre-serialization off / legacy tail /
+        host path) or a per-delivery rewrite applies — take the slow
+        path."""
+        tpl = msg.headers.get("_wiretpl")
+        if tpl is None:
+            return None
+        if msg.headers.get("shared") is not None:
+            # group redispatch carries per-delivery original/dup state
+            return None
+        props = msg.headers.get("properties")
+        if props and ("Message-Expiry-Interval" in props
+                      or "Subscription-Identifier" in props):
+            return None
+        key = (self.proto_ver, msg.qos,
+               msg.flags.get("retain", False),
+               msg.flags.get("dup", False))
+        entry = tpl.get(key)
+        if entry is None:
+            # variant miss (retry DUP, a session resumed on another
+            # proto version): build once ON-loop and cache — later
+            # frames of the same variant patch instead of serialize
+            pub = from_message(pid, msg)
+            if self.proto_ver != C.MQTT_V5:
+                pub.properties = {}
+            entry = tpl[key] = wire_template(pub, self.proto_ver)
+            self.broker.metrics.inc("delivery.serialize.onloop")
+        data, off = entry
+        buf = bytearray(data)
+        buf[off] = (pid >> 8) & 0xFF
+        buf[off + 1] = pid & 0xFF
+        return bytes(buf)
 
     # -- timers -----------------------------------------------------------
 

@@ -114,8 +114,9 @@ class NodeConfig:
     # defaults (enabled).
     telemetry: Optional[Any] = None
     # [dispatch] section: publish delivery-tail knobs
-    # (emqx_tpu.broker.DispatchConfig — batch dispatch planner on/off,
-    # docs/DISPATCH.md). None = defaults (planner on).
+    # (emqx_tpu.broker.DispatchConfig — batch dispatch planner and
+    # egress pre-serialization on/off, docs/DISPATCH.md). None =
+    # defaults (planner + preserialize on).
     dispatch: Optional[Any] = None
 
 

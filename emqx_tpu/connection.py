@@ -99,64 +99,77 @@ class Connection:
     def _send_packets(self, pkts) -> None:
         from emqx_tpu.mqtt.packet import Publish
         max_out = self.channel.client_max_packet
-        # fast-path counters batched per call: a planner batch drains
-        # a whole outbox of shared wire images here, and four metric
-        # increments per frame were a measurable share of the tail
-        fast_pkts = 0
-        fast_bytes = 0
-        for pkt in pkts:
-            if type(pkt) is bytes:
-                # broadcast fast path: the channel already produced
-                # (and size-gated) the shared wire image
-                self.send_bytes += len(pkt)
-                self.send_pkts += 1
-                fast_pkts += 1
-                fast_bytes += len(pkt)
-                if not self._closing:
-                    self.writer.write(self._wrap_out(pkt))
-                continue
-            data = serialize(pkt, self.channel.proto_ver)
-            if max_out and len(data) > max_out:
-                # MQTT-3.1.2-24 covers EVERY packet. PUBLISHes are
-                # gated in Channel.handle_deliver (before alias and
-                # inflight effects); this is the backstop plus the
-                # non-PUBLISH handling: trim optional properties,
-                # and if the packet still can't fit, close rather
-                # than violate the client's declared limit.
-                if isinstance(pkt, Publish):
-                    # unreachable in normal operation: the channel
-                    # gates PUBLISHes (with inflight release + alias
-                    # rollback) before they get here
-                    log.warning("oversized PUBLISH reached transport "
-                                "backstop (%d > %d)", len(data), max_out)
-                    self.broker.metrics.inc("delivery.dropped")
-                    self.broker.metrics.inc("delivery.dropped.too_large")
+        # counters batched per call on BOTH lanes: a planner batch
+        # drains a whole outbox here, and per-frame metric increments
+        # were a measurable share of the tail
+        n_pkts = 0
+        n_bytes = 0
+        # consecutive pre-serialized frames coalesce into ONE
+        # transport writelines() — the planner's grouped tail makes
+        # runs of them the common case
+        wire_run: list = []
+        try:
+            for pkt in pkts:
+                if type(pkt) is bytes:
+                    # egress fast path: the channel already produced
+                    # (and size-gated) the wire bytes
+                    self.send_bytes += len(pkt)
+                    self.send_pkts += 1
+                    n_pkts += 1
+                    n_bytes += len(pkt)
+                    if not self._closing:
+                        wire_run.append(self._wrap_out(pkt))
                     continue
-                props = getattr(pkt, "properties", None)
-                if props:
-                    # MQTT-3.2.2.3: only Reason String / User
-                    # Properties may be dropped to fit — mandatory
-                    # properties (Assigned-Client-Identifier, server
-                    # limits) must survive
-                    props.pop("Reason-String", None)
-                    props.pop("User-Property", None)
-                    data = serialize(pkt, self.channel.proto_ver)
-                if len(data) > max_out:
-                    log.warning(
-                        "cannot fit %s under client max packet %d: "
-                        "closing %s", type(pkt).__name__, max_out,
-                        self.channel.peername)
-                    self._close_transport()
-                    return
-            self.send_bytes += len(data)
-            self.send_pkts += 1
-            self.broker.metrics.inc("packets.sent")
-            self.broker.metrics.inc("bytes.sent", len(data))
-            if not self._closing:
-                self.writer.write(self._wrap_out(data))
-        if fast_pkts:
-            self.broker.metrics.inc("packets.sent", fast_pkts)
-            self.broker.metrics.inc("bytes.sent", fast_bytes)
+                if wire_run:
+                    self.writer.writelines(wire_run)
+                    wire_run = []
+                data = serialize(pkt, self.channel.proto_ver)
+                if max_out and len(data) > max_out:
+                    # MQTT-3.1.2-24 covers EVERY packet. PUBLISHes are
+                    # gated in Channel.handle_deliver (before alias and
+                    # inflight effects); this is the backstop plus the
+                    # non-PUBLISH handling: trim optional properties,
+                    # and if the packet still can't fit, close rather
+                    # than violate the client's declared limit.
+                    if isinstance(pkt, Publish):
+                        # unreachable in normal operation: the channel
+                        # gates PUBLISHes (with inflight release + alias
+                        # rollback) before they get here
+                        log.warning("oversized PUBLISH reached transport "
+                                    "backstop (%d > %d)", len(data),
+                                    max_out)
+                        self.broker.metrics.inc("delivery.dropped")
+                        self.broker.metrics.inc(
+                            "delivery.dropped.too_large")
+                        continue
+                    props = getattr(pkt, "properties", None)
+                    if props:
+                        # MQTT-3.2.2.3: only Reason String / User
+                        # Properties may be dropped to fit — mandatory
+                        # properties (Assigned-Client-Identifier, server
+                        # limits) must survive
+                        props.pop("Reason-String", None)
+                        props.pop("User-Property", None)
+                        data = serialize(pkt, self.channel.proto_ver)
+                    if len(data) > max_out:
+                        log.warning(
+                            "cannot fit %s under client max packet %d: "
+                            "closing %s", type(pkt).__name__, max_out,
+                            self.channel.peername)
+                        self._close_transport()
+                        return
+                self.send_bytes += len(data)
+                self.send_pkts += 1
+                n_pkts += 1
+                n_bytes += len(data)
+                if not self._closing:
+                    self.writer.write(self._wrap_out(data))
+            if wire_run and not self._closing:
+                self.writer.writelines(wire_run)
+        finally:
+            if n_pkts:
+                self.broker.metrics.inc("packets.sent", n_pkts)
+                self.broker.metrics.inc("bytes.sent", n_bytes)
 
     def _schedule_flush(self) -> None:
         """Wake the writer when the broker delivered into our session

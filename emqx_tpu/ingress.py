@@ -13,7 +13,11 @@ returns, so the wire contract is unchanged.
 Pipelining: the device phases are split (broker.publish_begin /
 publish_fetch / publish_finish) so the blocking device→host transfer
 runs on an executor thread while the event loop keeps parsing
-sockets, and up to ``max_inflight`` batches overlap their transfers —
+sockets — along with everything else publish_fetch hangs off that
+thread: the dispatch-plan grouping pass and the egress
+pre-serialization of wire images/templates (docs/DISPATCH.md), so
+the loop-side tail is little more than buffer writes. Up to
+``max_inflight`` batches overlap their transfers —
 device round-trip latency is hidden behind the next batch's
 accumulation instead of serializing the whole node (the classic
 accelerator-serving double-buffering). Delivery stays ordered:

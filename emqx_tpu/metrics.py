@@ -60,6 +60,12 @@ DELIVERY_METRICS = [
     # planner this is ≤1 per connection per batch — the bench's
     # wakeups/batch column divides it by ingress flushes
     "delivery.wakeups",
+    # PUBLISH frames serialized ON the event loop (the per-delivery
+    # slow path, plus template/image cache misses that build there).
+    # With egress pre-serialization on (docs/DISPATCH.md) eligible
+    # traffic patches pre-built frames instead, so this stays ~0 —
+    # the bench's LIVE_PRESER A/B reads it per delivery
+    "delivery.serialize.onloop",
 ]
 CLIENT_METRICS = [
     "client.connect", "client.connack", "client.connected",

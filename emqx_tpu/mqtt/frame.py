@@ -509,6 +509,30 @@ class Parser:
 
 # -- serializer ------------------------------------------------------------
 
+def publish_template(pkt: Publish,
+                     version: int = C.MQTT_V4) -> Tuple[bytes, int]:
+    """Serialize a QoS>0 PUBLISH as a packet-id template: returns
+    ``(frame, pid_offset)`` where ``frame[pid_offset:pid_offset+2]``
+    is the big-endian packet id. The pid is ALWAYS exactly two bytes,
+    so the remaining-length varint is invariant across patches — one
+    ``bytearray(frame)`` copy plus a 2-byte write per subscriber
+    replaces a full :func:`serialize` on the egress fast lane
+    (docs/DISPATCH.md "Egress pre-serialization").
+
+    Offset derivation: 1 fixed-header byte, the remaining-length
+    varint (its last byte has the continuation bit clear), the 2-byte
+    topic length prefix, then the UTF-8 topic — the pid comes next
+    on every protocol version (v5 properties follow it)."""
+    if pkt.qos <= 0:
+        raise FrameError("publish_template needs qos > 0")
+    data = serialize(pkt, version)
+    i = 1
+    while data[i] & 0x80:
+        i += 1
+    off = i + 1 + 2 + len(pkt.topic.encode("utf-8"))
+    return data, off
+
+
 def serialize(pkt: Packet, version: int = C.MQTT_V4) -> bytes:
     v5 = version == C.MQTT_V5
     t = pkt.type

@@ -394,6 +394,19 @@ class PskStreamWriter:
         if out:
             self._writer.write(out)
 
+    def writelines(self, data) -> None:
+        """The egress fast lane flushes runs of pre-serialized frames
+        through one writelines() call (Connection._send_packets);
+        through TLS they still encrypt frame-by-frame, but the
+        ciphertext forwards as one write."""
+        if self._closed:
+            return
+        for chunk in data:
+            self._engine.write(chunk)
+        out = self._engine.outgoing()
+        if out:
+            self._writer.write(out)
+
     async def drain(self) -> None:
         await self._writer.drain()
 

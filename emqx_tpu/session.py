@@ -100,6 +100,14 @@ class Session:
         # deliveries then enqueue instead of entering the send window
         # (the reference channel's `disconnected` state)
         self.connected = True
+        # egress pre-serialization hints, stamped by the owning
+        # channel at CONNECT (ops/dispatch_plan.preserialize_plan
+        # reads them off-loop): the negotiated protocol version, and
+        # whether the transport can take shared wire bytes at all
+        # (wire_fast, no mountpoint, no outbound topic aliasing).
+        # None/False = never pre-build for this subscriber.
+        self.proto_ver: Optional[int] = None
+        self.wire_fast_hint = False
 
     # -- info --------------------------------------------------------------
 
@@ -320,6 +328,7 @@ class Session:
         group. Everything enqueues, then ONE notify fires for the
         whole group — the batch-wide wakeup coalescing that turns
         N-deliveries-per-batch into one flush per connection."""
+        now = None  # one inflight timestamp per delivery group
         for flt, msg, opts, fast in items:
             if fast and self.connected:
                 # the _enrich fast path, pre-decided: nothing to
@@ -330,7 +339,9 @@ class Session:
             if not self.connected:
                 self.enqueue(m)
             else:
-                self._deliver_msg(m)
+                if now is None:
+                    now = time.time()
+                self._deliver_msg(m, now)
         if self.outbox and self.notify is not None:
             self.notify()
 
@@ -388,7 +399,8 @@ class Session:
                 m.set_flag("dup", True)
         return m
 
-    def _deliver_msg(self, msg: Message) -> None:
+    def _deliver_msg(self, msg: Message,
+                     now: Optional[float] = None) -> None:
         if msg.qos == QOS_0:
             self.outbox.append((None, msg))
             return
@@ -396,7 +408,8 @@ class Session:
             self.enqueue(msg)
             return
         pid = self._next_pkt_id()
-        self.inflight.insert(pid, (msg, time.time()))
+        self.inflight.insert(
+            pid, (msg, time.time() if now is None else now))
         self.outbox.append((pid, msg))
 
     def enqueue(self, msg: Message) -> None:

@@ -48,6 +48,14 @@ Stage semantics (all host wall-clock, milliseconds):
                      separately so planner cost is attributable
                      against the dispatch time it saves. Zero when
                      the planner is off or the batch fell back.
+  ``serialize``      the egress pre-serialization pass
+                     (ops/dispatch_plan.preserialize_plan): QoS0
+                     shared wire images + QoS1/2 pid-placeholder
+                     templates built per (message, variant) right
+                     after the plan, on the same (possibly executor)
+                     thread — the serialize work the delivery tail no
+                     longer pays on-loop. Zero when ``[dispatch]
+                     preserialize = false`` or the batch didn't plan.
   ``host_fallback``  overflow topics re-matched on the host oracle
                      during the delivery tail (a subset of
                      ``dispatch`` time, recorded separately so
@@ -77,7 +85,7 @@ log = logging.getLogger("emqx_tpu.telemetry")
 #: the publish pipeline's stage names, in pipeline order (ctl and the
 #: $SYS heartbeat render in this order; Prometheus sorts its own)
 STAGES = ("match", "cache_gather", "pack", "fetch", "dispatch_plan",
-          "host_fallback", "dispatch", "end_to_end")
+          "serialize", "host_fallback", "dispatch", "end_to_end")
 
 #: fixed log-spaced bucket upper bounds, milliseconds (1-2.5-5 per
 #: decade, 10µs..5s). Fixed — not adaptive — so scrapes from

@@ -39,6 +39,12 @@ echo "== dispatch planner parity (docs/DISPATCH.md) =="
 # delivery-correctness bug, fail before the long run
 python -m pytest tests/test_dispatch_plan.py -q
 
+echo "== egress pre-serialization parity (docs/DISPATCH.md) =="
+# pid-patched template frames vs wire_serialize (independent codec as
+# second opinion) + preserialize on/off wire parity — a byte
+# divergence here corrupts client streams, fail before the long run
+python -m pytest tests/test_egress_serialize.py -q
+
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B
 # guard (telemetry off => dispatch byte-identical to the
