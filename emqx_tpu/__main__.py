@@ -22,6 +22,11 @@ def main(argv=None) -> int:
     ap.add_argument("--workers", type=int, default=0,
                     help="SO_REUSEPORT worker processes sharing the "
                          "port, clustered (0 = single process)")
+    ap.add_argument("--loops", type=int, default=1,
+                    help="front-door event loops inside the node "
+                         "(in-process connection sharding; 1 = "
+                         "single loop). Ignored with --workers > 1 "
+                         "or --config (use [node] loops there)")
     ap.add_argument("--restart-intensity", type=int, default=5,
                     help="max worker restarts per 60s window before "
                          "the pool gives up with a failure exit "
@@ -83,7 +88,7 @@ def main(argv=None) -> int:
         node = boot_from_file(args.config)
     else:
         from emqx_tpu.node import Node
-        node = Node(boot_listeners=False)
+        node = Node(boot_listeners=False, loops=max(1, args.loops))
         node.add_listener(host=args.host, port=args.port)
 
     async def run():

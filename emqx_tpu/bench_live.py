@@ -27,7 +27,16 @@ delivery is a per-subscriber frame with its own packet id, the
 egress pre-serialization target), LIVE_PRESER (0 = per-delivery
 on-loop serialization instead of the pre-built templates),
 LIVE_PRESER_AB (0 = skip the QoS1 preserialize on/off pair the
-record's qos1_* columns come from), BENCH_PLATFORM.
+record's qos1_* columns come from), LIVE_LOOPS (front-door event
+loops inside the node — [node] loops, docs/DISPATCH.md "Multi-loop
+front door"; >1 shards connections over loop threads and routes the
+delivery tail through the cross-loop ring), LIVE_LOOPS_AB (0 = skip
+the loops=1 comparison pass the record's loops1_* columns come
+from; only runs when LIVE_LOOPS > 1), BENCH_PLATFORM.
+
+On a single-core host the loop threads time-share with the harness
+clients — the multi-loop row there documents ring overhead; the
+harness is ready for a many-core run where it measures scaling.
 """
 
 from __future__ import annotations
@@ -202,6 +211,7 @@ async def _run() -> dict:
 
     planner = os.environ.get("LIVE_PLANNER", "1") != "0"
     preser = os.environ.get("LIVE_PRESER", "1") != "0"
+    loops = int(os.environ.get("LIVE_LOOPS", "1"))
     zone = None
     if qos:
         # QoS>0 saturation needs a wide send window: the default
@@ -214,6 +224,7 @@ async def _run() -> dict:
                         "LIVE_INFLIGHT", "8192")),
                     max_mqueue_len=50000)
     node = Node(boot_listeners=False, batch_linger_ms=1.0, zone=zone,
+                loops=loops,
                 dispatch_config=DispatchConfig(planner=planner,
                                                preserialize=preser))
     lst = node.add_listener(port=0)
@@ -308,6 +319,9 @@ async def _run() -> dict:
     base_submitted = node.ingress.submitted
     base_wakeups = node.metrics.val("delivery.wakeups")
     base_onloop = node.metrics.val("delivery.serialize.onloop")
+    base_xhand = node.metrics.val("delivery.xloop.handoffs")
+    base_xdeliv = node.metrics.val("delivery.xloop.deliveries")
+    base_delivered = node.metrics.val("messages.delivered")
 
     stop = asyncio.Event()
     t0 = time.perf_counter()
@@ -331,6 +345,10 @@ async def _run() -> dict:
     submitted = node.ingress.submitted - base_submitted
     wakeups = node.metrics.val("delivery.wakeups") - base_wakeups
     onloop = node.metrics.val("delivery.serialize.onloop") - base_onloop
+    xhand = node.metrics.val("delivery.xloop.handoffs") - base_xhand
+    xdeliv = node.metrics.val("delivery.xloop.deliveries") - base_xdeliv
+    delivered_srv = node.metrics.val("messages.delivered") \
+        - base_delivered
 
     probe_lats = (np.asarray(probe_sub.latencies, np.float64)
                   if probe_sub is not None and probe_sub.latencies
@@ -369,6 +387,14 @@ async def _run() -> dict:
         "bg_filters": n_filters,
         "regime": ("device" if node.broker.router.use_device_now()
                    else "host"),
+        # multi-loop front door: ring traffic during the timed window
+        # (one handoff per loop per batch; fraction = the share of
+        # the delivery tail the ring carried to non-home loops)
+        "loops": loops,
+        "xloop_handoffs_per_batch": round(xhand / flushes, 2)
+        if flushes else 0,
+        "xloop_fraction": round(xdeliv / delivered_srv, 3)
+        if delivered_srv else 0.0,
     }
     if probe_lats is not None:
         out["probe_rate"] = probe_rate
@@ -441,6 +467,24 @@ def live(emit=None) -> None:
                 del os.environ["LIVE_QOS"]
             else:
                 os.environ["LIVE_QOS"] = saved_qos
+    # multi-loop A/B: the LIVE_LOOPS > 1 headline vs the same
+    # workload on one loop — the front-door sharding pair
+    # (docs/DISPATCH.md "Multi-loop front door"). On a single-core
+    # host this documents ring overhead; on a many-core host it is
+    # the scaling row.
+    info_l1 = None
+    if info.get("loops", 1) > 1 \
+            and os.environ.get("LIVE_LOOPS_AB", "1") != "0":
+        saved_loops = os.environ.get("LIVE_LOOPS")
+        os.environ["LIVE_LOOPS"] = "1"
+        try:
+            info_l1 = asyncio.run(_run())
+        finally:
+            if saved_loops is None:
+                del os.environ["LIVE_LOOPS"]
+            else:
+                os.environ["LIVE_LOOPS"] = saved_loops
+        print(json.dumps(info_l1), file=sys.stderr, flush=True)
     rec = {
         "metric": "live_socket_throughput",
         # r5: ingest backpressure + paced service-latency probe
@@ -452,7 +496,20 @@ def live(emit=None) -> None:
         "wakeups_per_batch": info.get("wakeups_per_batch", 0),
         "preserialize": info.get("preserialize", True),
         "onloop_per_delivery": info.get("onloop_per_delivery", 0.0),
+        "loops": info.get("loops", 1),
     }
+    if rec["loops"] > 1:
+        rec["xloop_handoffs_per_batch"] = info.get(
+            "xloop_handoffs_per_batch", 0)
+        rec["xloop_fraction"] = info.get("xloop_fraction", 0.0)
+    if info_l1 is not None:
+        rec["loops1_msgs_per_s"] = round(
+            info_l1["deliveries_per_s"], 1)
+        rec["loops1_p99_ms"] = round(info_l1["p99_ms"], 3)
+        if info_l1["deliveries_per_s"] > 0:
+            rec["loops_speedup"] = round(
+                info["deliveries_per_s"]
+                / info_l1["deliveries_per_s"], 3)
     if info_q1 is not None:
         # the QoS1 fan-out row: per-subscriber pid-stamped frames —
         # the pre-serialization target traffic

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -71,9 +73,18 @@ class DispatchConfig:
 
 class _PlanState:
     """Per-batch host routing state the planned delivery tail shares
-    between its prologue (per-row routing) and group chunks."""
+    between its prologue (per-row routing) and group chunks — plus,
+    on a multi-loop node, the cross-loop delivery ring's join state
+    (docs/DISPATCH.md "Multi-loop front door"): the set of handed-off
+    groups, the per-handoff delivered-count results, and the events
+    the fold joins on. Everything after the prologue is read-only to
+    the handoff loops except the ``xloop_*`` fields, which mutate
+    under ``xloop_lock``."""
 
-    __slots__ = ("row_local", "row_fast", "ftabs", "counts")
+    __slots__ = ("row_local", "row_fast", "ftabs", "counts",
+                 "xg_set", "xloop_results", "xloop_deliveries",
+                 "xloop_left", "xloop_lock", "xloop_tev", "xloop_aev",
+                 "xloop_t0", "xloop_tdone", "folded")
 
 
 class PendingBatch:
@@ -92,7 +103,7 @@ class PendingBatch:
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
         "host_matched", "host_inv", "span",
-        "plan", "plan_state",
+        "plan", "plan_state", "xgroups",
         "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
         "m_ptr_d", "ids_packed_d",
@@ -121,6 +132,10 @@ class PendingBatch:
         # capacity-overflow row; None = legacy per-delivery walk
         self.plan = None
         self.plan_state = None
+        # cross-loop delivery partition (multi-loop front door):
+        # owning-loop index -> plan group indices, computed in
+        # publish_fetch; None = every group delivers from this loop
+        self.xgroups = None
         self.inv: Optional[List[int]] = None
         self.n_uniq = 0
         self.st = None
@@ -185,6 +200,17 @@ class Broker:
         # publish-path telemetry (telemetry.Telemetry), wired by Node
         # next to router.telemetry; None = uninstrumented
         self.telemetry = None
+        # multi-loop front door (loops.LoopGroup), set by Node.start
+        # when [node] loops > 1; None = single-loop, every multi-loop
+        # branch below is skipped entirely
+        self.loop_group = None
+        # serializes route/table mutations (subscribe/unsubscribe/
+        # subscriber_down) across front-door loops: a subscribe is a
+        # multi-step update over _subscribers + helper + router, and
+        # two loops interleaving them would corrupt the automaton.
+        # The publish match path stays lock-free — it reads published
+        # snapshots behind the router's epoch guards
+        self._route_lock = threading.RLock()
         # learned packed-transfer budgets per batch bucket: a workload
         # whose steady-state fan-out exceeds the configured budget
         # would otherwise pay a re-pack + second transfer EVERY batch
@@ -203,42 +229,45 @@ class Broker:
         opts = opts or SubOpts()
         if "share" in popts:
             opts.share = popts["share"]
-        subs = self._subscriptions.setdefault(sub, {})
-        resub = topic_filter in subs
-        subs[topic_filter] = opts
-        if opts.share is not None:
-            if not resub:
-                self.shared.subscribe(opts.share, flt, sub)
-                self.router.add_route(flt, dest=(opts.share, self.node))
-        else:
-            self._subscribers.setdefault(flt, {})[sub] = opts
-            if not resub:
-                self.helper.subscribe(flt, sub)
-                self.router.add_route(flt, dest=self.node)
+        with self._route_lock:
+            subs = self._subscriptions.setdefault(sub, {})
+            resub = topic_filter in subs
+            subs[topic_filter] = opts
+            if opts.share is not None:
+                if not resub:
+                    self.shared.subscribe(opts.share, flt, sub)
+                    self.router.add_route(
+                        flt, dest=(opts.share, self.node))
+            else:
+                self._subscribers.setdefault(flt, {})[sub] = opts
+                if not resub:
+                    self.helper.subscribe(flt, sub)
+                    self.router.add_route(flt, dest=self.node)
         return opts
 
     def unsubscribe(self, sub: object, topic_filter: str) -> bool:
         flt, popts = T.parse(topic_filter)
-        subs = self._subscriptions.get(sub)
-        if subs is None or topic_filter not in subs:
-            return False
-        opts = subs.pop(topic_filter)
-        if not subs:
-            del self._subscriptions[sub]
-        share = popts.get("share", opts.share)
-        if share is not None:
-            self.shared.unsubscribe(share, flt, sub)
-            self.router.delete_route(flt, dest=(share, self.node))
-        else:
-            ftab = self._subscribers.get(flt)
-            if ftab is not None:
-                ftab.pop(sub, None)
-                if not ftab:
-                    del self._subscribers[flt]
-            self.helper.unsubscribe(flt, sub)
-            self.router.delete_route(flt, dest=self.node)
-        if sub not in self._subscriptions:
-            self.helper.release(sub)
+        with self._route_lock:
+            subs = self._subscriptions.get(sub)
+            if subs is None or topic_filter not in subs:
+                return False
+            opts = subs.pop(topic_filter)
+            if not subs:
+                del self._subscriptions[sub]
+            share = popts.get("share", opts.share)
+            if share is not None:
+                self.shared.unsubscribe(share, flt, sub)
+                self.router.delete_route(flt, dest=(share, self.node))
+            else:
+                ftab = self._subscribers.get(flt)
+                if ftab is not None:
+                    ftab.pop(sub, None)
+                    if not ftab:
+                        del self._subscribers[flt]
+                self.helper.unsubscribe(flt, sub)
+                self.router.delete_route(flt, dest=self.node)
+            if sub not in self._subscriptions:
+                self.helper.release(sub)
         return True
 
     def subscriber_down(self, sub: object) -> None:
@@ -246,9 +275,10 @@ class Broker:
         (emqx_broker.erl:331-348); unacked shared-group messages are
         redispatched to the surviving members (the reference's
         shared-sub nack/redispatch, emqx_shared_sub.erl:131-227)."""
-        for key in list(self._subscriptions.get(sub, {})):
-            self.unsubscribe(sub, key)
-        self.shared.subscriber_down(sub)
+        with self._route_lock:
+            for key in list(self._subscriptions.get(sub, {})):
+                self.unsubscribe(sub, key)
+            self.shared.subscriber_down(sub)
         pending = getattr(sub, "take_shared_pending", None)
         if pending is not None:
             for group, flt, orig, was_sent in pending():
@@ -273,9 +303,10 @@ class Broker:
         """Remove a subscriber's table entries WITHOUT the death-path
         side effects (no shared redispatch): the session is being
         handed to another node's broker, which resubscribes it."""
-        for key in list(self._subscriptions.get(sub, {})):
-            self.unsubscribe(sub, key)
-        self.shared.subscriber_down(sub)
+        with self._route_lock:
+            for key in list(self._subscriptions.get(sub, {})):
+                self.unsubscribe(sub, key)
+            self.shared.subscriber_down(sub)
 
     def subscribers(self, topic_filter: str) -> List[object]:
         return list(self._subscribers.get(topic_filter, ()))
@@ -290,6 +321,24 @@ class Broker:
 
     def publish(self, msg: Message) -> int:
         """Publish one message; returns delivery count."""
+        lg = self.loop_group
+        if lg is not None and not lg.on_home_thread():
+            # multi-loop front door: a publish originating on a peer
+            # loop (a will firing in a peer-loop disconnect, a shared
+            # redispatch during one) must not drive the device plane
+            # from that thread — funnel it through the ingress
+            # accumulator (ordering preserved with in-flight batches)
+            # or, without one, post it to the main loop. The delivery
+            # count is unknown here; these paths ignore it.
+            ing = self.ingress
+            if ing is not None and ing.accepts_threadsafe():
+                ing.submit(msg, want_result=False)
+            else:
+                try:
+                    lg.post(0, lambda: self.publish_batch([msg]))
+                except RuntimeError:
+                    return 0  # home loop gone (shutdown race)
+            return 0
         return self.publish_batch([msg])[0]
 
     def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
@@ -695,6 +744,13 @@ class Broker:
                                       self.helper.registry.lookup)
                     if sp is not None:
                         sp.add("serialize", t_s)
+                if pb.plan is not None and self.loop_group is not None:
+                    # cross-loop delivery ring: partition the plan's
+                    # subscriber groups by owning loop here — still
+                    # off the event loop when fetch runs on the
+                    # ingress executor — so the finish prologue only
+                    # has to post one handoff per loop
+                    pb.xgroups = self._xloop_partition(pb.plan)
             if pb.plan is not None:
                 # planned batches keep the numpy views (the plan
                 # already indexed them; the legacy walk's per-element
@@ -743,6 +799,9 @@ class Broker:
             return pb.results
         if pb.plan is not None:
             self.publish_finish_planned(pb, 0, pb.plan.n_groups)
+            # multi-loop: block until the cross-loop handoffs report
+            # back, then fold (no-op on a single-loop node)
+            self.xloop_join_sync(pb)
         else:
             self.publish_finish_chunk(pb, 0, len(pb.live))
         pb.done = True
@@ -822,7 +881,14 @@ class Broker:
                 # predicate, hoisted to once per row; the subopts half
                 # joins it per (group, filter) below
                 ps.row_fast[r] = 1
+        ps.xg_set = None
+        ps.folded = False
         pb.plan_state = ps
+        if pb.xgroups:
+            # cross-loop delivery ring: hand each owning loop its
+            # share of the plan NOW, so peer loops enqueue their
+            # sessions' batches while this loop walks its own groups
+            self._post_xloop_handoffs(pb, ps)
 
     def publish_finish_planned(self, pb: PendingBatch, gstart: int,
                                gstop: int) -> None:
@@ -832,9 +898,13 @@ class Broker:
         async ingress can yield between sessions while every session
         still receives its whole batch in one ``deliver_many`` call
         and one notify wakeup. The first chunk runs the routing
-        prologue; the chunk that crosses the last group folds the
-        per-(message, filter) delivery counts into metrics/hooks/
-        results (the legacy walk's accounting, batched)."""
+        prologue (which also posts the cross-loop handoffs on a
+        multi-loop node — handed-off groups are skipped here); the
+        chunk that crosses the last group folds the per-(message,
+        filter) delivery counts into metrics/hooks/results (the
+        legacy walk's accounting, batched) — unless handoffs are
+        still in flight, in which case the fold belongs to the join
+        (:meth:`xloop_fold` / :meth:`xloop_join_sync`)."""
         plan = pb.plan
         sp = pb.span
         if sp is not None:
@@ -842,7 +912,42 @@ class Broker:
         if gstart == 0:
             self._plan_prologue(pb)
         ps = pb.plan_state
-        lookup = self.helper.registry.lookup
+        counts = ps.counts
+        xg_set = ps.xg_set
+        n_groups = plan.n_groups
+        for g in range(gstart, min(gstop, n_groups)):
+            if xg_set is not None and g in xg_set:
+                continue  # handed to its owning loop
+            for r, flt in self._deliver_plan_group(pb, ps, g):
+                d = counts[r]
+                if d is None:
+                    d = counts[r] = {}
+                d[flt] = d.get(flt, 0) + 1
+        folded = False
+        if gstop >= n_groups and (xg_set is None
+                                  or ps.xloop_left == 0):
+            self._plan_fold(pb)
+            folded = True
+        if sp is not None:
+            sp.add("dispatch", t_d)
+            if folded:
+                self._span_finish(pb)
+
+    def _deliver_plan_group(self, pb: PendingBatch, ps: _PlanState,
+                            g: int):
+        """Deliver one plan group — one subscriber's whole batch:
+        resolve the session once, enqueue everything in one
+        ``deliver_many``, fire one notify. Returns the delivered
+        ``(row, filter)`` pairs for the caller's count fold. Runs on
+        whichever loop owns the group's session: the main loop for
+        local groups, an owning peer loop inside a cross-loop handoff
+        (everything read here — plan arrays, prologue tables, live
+        messages with their pre-built wire images — is immutable
+        after the prologue)."""
+        plan = pb.plan
+        sub = self.helper.registry.lookup(plan.g_sids[g])
+        if sub is None:
+            return ()  # unsubscribed since the tables were built
         id_map = pb.id_map
         live = pb.live
         g_ptr = plan.g_ptr
@@ -851,82 +956,222 @@ class Broker:
         row_local = ps.row_local
         row_fast = ps.row_fast
         ftabs = ps.ftabs
-        counts = ps.counts
-        n_groups = plan.n_groups
-        for g in range(gstart, min(gstop, n_groups)):
-            sub = lookup(plan.g_sids[g])
-            if sub is None:
-                continue  # unsubscribed since the tables were built
-            sub_cid = getattr(sub, "client_id", None)
-            upgrade = getattr(sub, "upgrade_qos", False)
-            items: List[tuple] = []
-            accepted: List[tuple] = []
-            for k in range(g_ptr[g], g_ptr[g + 1]):
-                r = rows_s[k]
-                if not row_local[r]:
-                    continue
-                fid = fids_s[k]
-                ftab = ftabs.get(fid)
-                if ftab is None:
-                    continue
-                opts = ftab.get(sub)
-                if opts is None:
-                    continue
-                i, msg = live[r]
-                if opts.nl and sub_cid == msg.from_:
-                    self.metrics.inc("delivery.dropped")
-                    self.metrics.inc("delivery.dropped.no_local")
-                    continue
-                if "_wire" not in msg.headers:
-                    # shared wire-image cache, as _deliver_one primes
-                    msg.headers["_wire"] = {}
-                flt = id_map[fid]
-                fast = bool(row_fast[r]) and opts.share is None \
-                    and not opts.nl and opts.subid is None \
-                    and (opts.qos == 0 or not upgrade)
-                items.append((flt, msg, opts, fast))
-                accepted.append((r, flt))
-            if not items:
+        sub_cid = getattr(sub, "client_id", None)
+        upgrade = getattr(sub, "upgrade_qos", False)
+        items: List[tuple] = []
+        accepted: List[tuple] = []
+        for k in range(g_ptr[g], g_ptr[g + 1]):
+            r = rows_s[k]
+            if not row_local[r]:
                 continue
-            dm = getattr(sub, "deliver_many", None)
-            delivered = accepted
-            if dm is not None:
-                try:
-                    dm(items)
-                except Exception:
-                    log.exception("deliver_many to %r failed", sub)
-                    delivered = []
-            else:
-                # plain subscriber objects (tests, sinks): the
-                # per-delivery protocol, still one resolve per batch
-                delivered = []
-                for (flt, msg, _o, _f), rf in zip(items, accepted):
+            fid = fids_s[k]
+            ftab = ftabs.get(fid)
+            if ftab is None:
+                continue
+            opts = ftab.get(sub)
+            if opts is None:
+                continue
+            i, msg = live[r]
+            if opts.nl and sub_cid == msg.from_:
+                self.metrics.inc("delivery.dropped")
+                self.metrics.inc("delivery.dropped.no_local")
+                continue
+            if "_wire" not in msg.headers:
+                # shared wire-image cache, as _deliver_one primes
+                msg.headers["_wire"] = {}
+            flt = id_map[fid]
+            fast = bool(row_fast[r]) and opts.share is None \
+                and not opts.nl and opts.subid is None \
+                and (opts.qos == 0 or not upgrade)
+            items.append((flt, msg, opts, fast))
+            accepted.append((r, flt))
+        if not items:
+            return ()
+        dm = getattr(sub, "deliver_many", None)
+        if dm is not None:
+            try:
+                dm(items)
+            except Exception:
+                log.exception("deliver_many to %r failed", sub)
+                return ()
+            return accepted
+        # plain subscriber objects (tests, sinks): the per-delivery
+        # protocol, still one resolve per batch
+        delivered: List[tuple] = []
+        for (flt, msg, _o, _f), rf in zip(items, accepted):
+            try:
+                sub.deliver(flt, msg)
+                delivered.append(rf)
+            except Exception:
+                log.exception("deliver to %r failed", sub)
+        return delivered
+
+    def _plan_fold(self, pb: PendingBatch) -> None:
+        """Fold the batch's per-(message, filter) delivery counts into
+        metrics/hooks/results — the legacy walk's accounting, batched.
+        Runs exactly once, on the main loop, after every cross-loop
+        handoff reported back (idempotent via ``ps.folded``)."""
+        ps = pb.plan_state
+        if ps.folded:
+            return
+        ps.folded = True
+        counts = ps.counts
+        if ps.xg_set:
+            # merge the handoff loops' delivered counts (no more
+            # writers once xloop_left hit zero)
+            for rc in ps.xloop_results:
+                for r, d in rc.items():
+                    tgt = counts[r]
+                    if tgt is None:
+                        tgt = counts[r] = {}
+                    for flt, c in d.items():
+                        tgt[flt] = tgt.get(flt, 0) + c
+            if ps.xloop_deliveries:
+                self.metrics.inc("delivery.xloop.deliveries",
+                                 ps.xloop_deliveries)
+            sp = pb.span
+            if sp is not None:
+                sp.add_ms("xloop",
+                          (ps.xloop_tdone - ps.xloop_t0) * 1000.0)
+        results = pb.results
+        for r, (i, msg) in enumerate(pb.live):
+            d = counts[r]
+            if not d:
+                continue
+            n = 0
+            for flt, cnt in d.items():
+                n += cnt
+                self.metrics.inc("messages.delivered", cnt)
+                self.hooks.run("message.delivered", (msg, cnt))
+            results[i] += n
+
+    # -- cross-loop delivery ring (docs/DISPATCH.md) ----------------------
+
+    def _xloop_partition(self, plan) -> Optional[Dict[int, List[int]]]:
+        """Owning-loop index → plan group indices, for every group
+        whose session lives on a non-home loop (``Session.owner_loop``
+        stamped at CONNECT). Runs wherever ``publish_fetch`` runs —
+        registry lookups and attribute reads only. ``None`` = every
+        group is home-owned (the single-loop fast path)."""
+        lg = self.loop_group
+        lookup = self.helper.registry.lookup
+        g_sids = plan.g_sids
+        xg: Optional[Dict[int, List[int]]] = None
+        for g in range(plan.n_groups):
+            sub = lookup(g_sids[g])
+            if sub is None:
+                continue
+            idx = lg.index_of(getattr(sub, "owner_loop", None))
+            if idx == 0:
+                continue
+            if xg is None:
+                xg = {}
+            xg.setdefault(idx, []).append(g)
+        return xg
+
+    def _post_xloop_handoffs(self, pb: PendingBatch,
+                             ps: _PlanState) -> None:
+        """Post each owning loop its share of the plan — ONE
+        ``call_soon_threadsafe`` per loop per batch, carrying the
+        whole group list (the pre-built wire images/templates ride
+        along in the live messages' headers). The fold joins on the
+        results via :meth:`xloop_fold` / :meth:`xloop_join_sync`."""
+        import asyncio
+
+        lg = self.loop_group
+        xg_set: set = set()
+        for gids in pb.xgroups.values():
+            xg_set.update(gids)
+        ps.xg_set = xg_set
+        ps.xloop_results = []
+        ps.xloop_deliveries = 0
+        ps.xloop_lock = threading.Lock()
+        ps.xloop_left = len(pb.xgroups)
+        ps.xloop_t0 = ps.xloop_tdone = time.perf_counter()
+        ps.xloop_tev = threading.Event()
+        ps.xloop_aev = asyncio.Event()
+        self.metrics.inc("delivery.xloop.handoffs", len(pb.xgroups))
+        for idx, gids in pb.xgroups.items():
+            try:
+                lg.post(idx, self._run_xloop_groups, pb, gids)
+            except RuntimeError:
+                # owning loop gone (shutdown race): deliver from here
+                # — a cross-thread enqueue beats dropped messages
+                self._run_xloop_groups(pb, gids)
+
+    def _run_xloop_groups(self, pb: PendingBatch, gids) -> None:
+        """One cross-loop handoff, running ON the owning loop: deliver
+        this loop's subscriber groups (each session still gets its
+        whole batch in one ``deliver_many`` + one notify — the
+        single-loop invariants, preserved across the ring), then
+        report the delivered counts back for the main-loop fold."""
+        ps = pb.plan_state
+        counts: Dict[int, Dict[str, int]] = {}
+        n = 0
+        try:
+            for g in gids:
+                for r, flt in self._deliver_plan_group(pb, ps, g):
+                    d = counts.get(r)
+                    if d is None:
+                        d = counts[r] = {}
+                    d[flt] = d.get(flt, 0) + 1
+                    n += 1
+        except Exception:
+            log.exception("cross-loop delivery handoff failed")
+        finally:
+            with ps.xloop_lock:
+                ps.xloop_results.append(counts)
+                ps.xloop_deliveries += n
+                ps.xloop_left -= 1
+                done = ps.xloop_left == 0
+                if done:
+                    ps.xloop_tdone = time.perf_counter()
+            if done:
+                ps.xloop_tev.set()
+                lg = self.loop_group
+                aev = ps.xloop_aev
+                if lg is not None and aev is not None:
                     try:
-                        sub.deliver(flt, msg)
-                        delivered.append(rf)
-                    except Exception:
-                        log.exception("deliver to %r failed", sub)
-            for r, flt in delivered:
-                d = counts[r]
-                if d is None:
-                    d = counts[r] = {}
-                d[flt] = d.get(flt, 0) + 1
-        if gstop >= n_groups:
-            results = pb.results
-            for r, (i, msg) in enumerate(live):
-                d = counts[r]
-                if not d:
-                    continue
-                n = 0
-                for flt, cnt in d.items():
-                    n += cnt
-                    self.metrics.inc("messages.delivered", cnt)
-                    self.hooks.run("message.delivered", (msg, cnt))
-                results[i] += n
-        if sp is not None:
-            sp.add("dispatch", t_d)
-            if gstop >= n_groups:
-                self._span_finish(pb)
+                        lg.home.call_soon_threadsafe(aev.set)
+                    except RuntimeError:
+                        pass  # home loop gone (sync/shutdown path)
+
+    def xloop_event(self, pb: PendingBatch):
+        """The home-loop asyncio event the async ingress awaits before
+        folding a batch with cross-loop handoffs; ``None`` = no
+        handoffs (single loop, or every group was home-owned)."""
+        ps = pb.plan_state
+        if ps is None or not getattr(ps, "xg_set", None):
+            return None
+        return ps.xloop_aev
+
+    def xloop_fold(self, pb: PendingBatch) -> None:
+        """Join point once the handoffs completed: merge + fold +
+        close the span. No-op when the batch had no handoffs, or the
+        final local chunk already folded (the handoffs beat it)."""
+        ps = pb.plan_state
+        if ps is None or not getattr(ps, "xg_set", None):
+            return
+        self._plan_fold(pb)
+        self._span_finish(pb)
+
+    #: bound on the synchronous cross-loop join (shutdown flush, sync
+    #: publish_batch): peer loops run on their own threads, so the
+    #: wait cannot deadlock on them — the bound only breaks a wedged
+    #: loop out of the fold, with partial counts and a loud log
+    XLOOP_JOIN_TIMEOUT = 30.0
+
+    def xloop_join_sync(self, pb: PendingBatch) -> None:
+        """Blocking join for the synchronous publish path."""
+        ps = pb.plan_state
+        if ps is None or not getattr(ps, "xg_set", None):
+            return
+        if not ps.folded and ps.xloop_left:
+            if not ps.xloop_tev.wait(self.XLOOP_JOIN_TIMEOUT):
+                log.error("cross-loop delivery handoff incomplete "
+                          "after %.0fs — folding partial counts",
+                          self.XLOOP_JOIN_TIMEOUT)
+        self.xloop_fold(pb)
 
     def publish_host_chunk(self, pb: PendingBatch, start: int,
                            stop: int) -> None:

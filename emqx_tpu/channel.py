@@ -20,6 +20,7 @@ Pipelines follow the reference:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -123,6 +124,11 @@ class Channel:
         self.on_close = None          # force-close the socket
         self.on_deliver = None        # new outbox items are ready
         self.send_oob = None          # out-of-band packet send (kick)
+        # the serving event loop (set by Connection.run): with a
+        # multi-loop front door the CM marshals takeover/kick of this
+        # channel onto it — transports and session state are owned by
+        # that loop, never the caller's
+        self.owner_loop = None
         # broadcast fast path (set by the transport): handle_deliver
         # may return raw WIRE BYTES for QoS0 deliveries, sharing one
         # serialized frame across every subscriber of a message
@@ -332,6 +338,16 @@ class Channel:
         self.session.wire_fast_hint = bool(
             self.wire_fast and not self.mountpoint
             and not self.client_alias_max)
+        # loop-affine session ownership (docs/DISPATCH.md "Multi-loop
+        # front door"): the cross-loop delivery ring routes this
+        # session's planned subscriber group to its connection's loop
+        loop = self.owner_loop
+        if loop is None:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None
+        self.session.owner_loop = loop
         # keepalive (server may override via zone)
         interval = pkt.keepalive
         props: Dict[str, Any] = {}

@@ -196,17 +196,62 @@ class ConnectionManager:
         return Session(client_id, broker=self.broker,
                        clean_start=clean_start, **(opts or {}))
 
+    #: bound on a cross-loop channel marshal (takeover/kick of a
+    #: session owned by another front-door loop): a crossed pair of
+    #: simultaneous opposite-direction takeovers would otherwise
+    #: deadlock both loops — the timeout breaks it with a clear error
+    #: and the client retries
+    XLOOP_CALL_TIMEOUT = 15.0
+
+    def _call_channel(self, chan, fn):
+        """Run ``fn()`` on the channel's owning event loop (multi-loop
+        front door): transports and session state belong to that loop.
+        Same-loop / loop-less channels run inline — the single-loop
+        build's exact path."""
+        loop = getattr(chan, "owner_loop", None)
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if loop is None or loop is running or not loop.is_running():
+            return fn()
+        import concurrent.futures
+        cf: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _run():
+            try:
+                cf.set_result(fn())
+            except BaseException as e:  # marshal the failure back
+                cf.set_exception(e)
+
+        loop.call_soon_threadsafe(_run)
+        try:
+            return cf.result(timeout=self.XLOOP_CALL_TIMEOUT)
+        except concurrent.futures.TimeoutError:
+            raise RuntimeError(
+                f"cross-loop channel call for "
+                f"{getattr(chan, 'client_id', '?')!r} did not complete "
+                f"within {self.XLOOP_CALL_TIMEOUT:.0f}s (owning loop "
+                f"wedged or a crossed takeover pair)") from None
+
     def _takeover(self, old_chan) -> Optional[Session]:
-        """{takeover, begin/end} protocol against the old channel."""
-        sess = old_chan.takeover_begin()
-        old_chan.takeover_end(TAKEOVER_RC)
+        """{takeover, begin/end} protocol against the old channel —
+        run on the old channel's owning loop when the new connection
+        was accepted by a different one."""
+        def _do():
+            sess = old_chan.takeover_begin()
+            old_chan.takeover_end(TAKEOVER_RC)
+            return sess
+
+        sess = self._call_channel(old_chan, _do)
         if self.broker is not None:
             self.broker.metrics.inc("session.takeovered")
         return sess
 
     def _kick(self, chan, discard: bool) -> None:
         try:
-            chan.kick(discard=discard)
+            self._call_channel(
+                chan, lambda: chan.kick(discard=discard))
         except Exception:
             pass
         self.unregister_channel(getattr(chan, "client_id", ""), chan)
@@ -255,9 +300,12 @@ class ConnectionManager:
             return
         if expiry_interval > 0:
             # stay subscribed: deliveries enqueue to the mqueue while
-            # the owner is away (reference `disconnected` state)
+            # the owner is away (reference `disconnected` state). The
+            # loop stamp clears too: a detached session's mqueue is
+            # fed from the main loop until a reconnect re-stamps it
             session.connected = False
             session.notify = None
+            session.owner_loop = None
             self._detached[client_id] = (
                 session, time.time(), expiry_interval)
         else:

@@ -94,6 +94,10 @@ class NodeConfig:
     sys_interval: float = 60.0
     cookie: Optional[str] = None
     cluster_port: Optional[int] = None
+    # multi-loop front door (docs/DISPATCH.md "Multi-loop front
+    # door"): shard accepted connections over this many event loops
+    # inside the node. 1 = today's single-loop behavior, exactly.
+    loops: int = 1
     zones: Dict[str, Zone] = dataclasses.field(default_factory=dict)
     listeners: List[ListenerConfig] = dataclasses.field(
         default_factory=list)
@@ -323,7 +327,7 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
     node = raw.get("node", {})
     for key in node:
         if key not in ("name", "sys_interval", "cookie", "cluster_port",
-                       "load_default_modules"):
+                       "load_default_modules", "loops"):
             raise ConfigError(f"unknown node setting: node.{key}")
     cfg.name = node.get("name", cfg.name)
     cfg.sys_interval = float(node.get("sys_interval", cfg.sys_interval))
@@ -331,6 +335,12 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
     cfg.cluster_port = node.get("cluster_port")
     cfg.load_default_modules = bool(
         node.get("load_default_modules", False))
+    loops = node.get("loops", 1)
+    if isinstance(loops, bool) or not isinstance(loops, int) \
+            or loops < 1:
+        raise ConfigError(
+            f"node.loops must be an integer >= 1, got {loops!r}")
+    cfg.loops = loops
     mraw = raw.get("matcher")
     if mraw is not None:
         if not isinstance(mraw, dict):
@@ -400,6 +410,7 @@ def build_node(cfg: NodeConfig):
                 dispatch_config=cfg.dispatch,
                 sys_interval=cfg.sys_interval,
                 load_default_modules=cfg.load_default_modules,
+                loops=cfg.loops,
                 boot_listeners=False)
     for i, lc in enumerate(cfg.listeners):
         zone = cfg.zones.get(lc.zone)

@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Optional
+from typing import List, Optional
 
 from emqx_tpu.channel import Channel
 from emqx_tpu.gc import GcPolicy
@@ -96,6 +96,16 @@ class Connection:
         frame; plain TCP is the identity."""
         return data
 
+    def _writev(self, frames) -> None:
+        """Flush a run of pre-serialized MQTT frames in ONE transport
+        ``writelines`` (the writev-coalesced egress path). ``frames``
+        are RAW MQTT bytes: plain TCP writes them as-is (``_wrap_out``
+        is the identity here); the WS transport overrides this to
+        emit a flat (header, payload, header, payload, …) run instead
+        of wrapping — and copying — each frame. A subclass overriding
+        ``_wrap_out`` must override this too."""
+        self.writer.writelines(frames)
+
     def _send_packets(self, pkts) -> None:
         from emqx_tpu.mqtt.packet import Publish
         max_out = self.channel.client_max_packet
@@ -118,10 +128,10 @@ class Connection:
                     n_pkts += 1
                     n_bytes += len(pkt)
                     if not self._closing:
-                        wire_run.append(self._wrap_out(pkt))
+                        wire_run.append(pkt)
                     continue
                 if wire_run:
-                    self.writer.writelines(wire_run)
+                    self._writev(wire_run)
                     wire_run = []
                 data = serialize(pkt, self.channel.proto_ver)
                 if max_out and len(data) > max_out:
@@ -165,7 +175,7 @@ class Connection:
                 if not self._closing:
                     self.writer.write(self._wrap_out(data))
             if wire_run and not self._closing:
-                self.writer.writelines(wire_run)
+                self._writev(wire_run)
         finally:
             if n_pkts:
                 self.broker.metrics.inc("packets.sent", n_pkts)
@@ -298,6 +308,10 @@ class Connection:
     async def run(self) -> None:
         """The connection loop: read → parse → channel → write."""
         self._loop = asyncio.get_running_loop()
+        # multi-loop front door: session/channel ownership follows the
+        # serving loop (the CM marshals cross-loop takeover/kick onto
+        # it; the delivery ring routes this session's groups to it)
+        self.channel.owner_loop = self._loop
         # make zone.high_watermark govern the TRANSPORT too: drain()
         # in the read loop and in the guard resolves against these
         # limits, so the knob means what it says instead of asyncio's
@@ -611,6 +625,15 @@ class Listener:
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._handshaking: set = set()
+        # multi-loop front door (emqx_tpu.loops.LoopGroup, set by
+        # Node.start): with n > 1 loops, start() switches to the
+        # dispatcher accept path — a plain listening socket on the
+        # main loop, each accepted socket handed round-robin to an
+        # owning loop where the ENTIRE connection then runs
+        self.loop_group = None
+        self._lsock = None
+        self._accept_task: Optional[asyncio.Task] = None
+        self._loop_conns: List[int] = []
 
     async def _handshake(self, reader, writer):
         """Pre-MQTT negotiation; False rejects the socket (the
@@ -678,6 +701,10 @@ class Listener:
                     pass
 
     async def start(self) -> None:
+        lg = self.loop_group
+        if lg is not None and lg.n > 1:
+            await self._start_dispatch(lg)
+            return
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port,
             ssl=self.ssl_context,
@@ -686,22 +713,157 @@ class Listener:
         self.port = addr[1]
         log.info("listener %s on %s:%s", self.name, self.host, self.port)
 
+    # -- multi-loop accept dispatch (docs/DISPATCH.md) --------------------
+
+    async def _start_dispatch(self, lg) -> None:
+        """Multi-loop front door: accept on the main loop with a bare
+        socket (nothing is read before the handoff, so no bytes can
+        be lost), assign each connection round-robin to a loop, and
+        run it there end-to-end — handshake (incl. server-side TLS
+        via ``connect_accepted_socket``), channel FSM, timers and
+        delivery flushes all on the owning loop. Round-robin keeps
+        the per-loop connection counts balanced AND deterministic
+        (the parity suite pins cross-loop placement through it)."""
+        import socket as _socket
+
+        fam = (_socket.AF_INET6 if ":" in self.host
+               else _socket.AF_INET)
+        s = _socket.socket(fam, _socket.SOCK_STREAM)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        if self.reuse_port:
+            try:
+                s.setsockopt(_socket.SOL_SOCKET,
+                             _socket.SO_REUSEPORT, 1)
+            except (AttributeError, OSError):
+                pass
+        s.bind((self.host, self.port))
+        s.listen(1024)
+        s.setblocking(False)
+        self.port = s.getsockname()[1]
+        self._lsock = s
+        self._loop_conns = [0] * lg.n
+        self._accept_task = asyncio.get_running_loop().create_task(
+            self._accept_loop(lg))
+        log.info("listener %s on %s:%s (%d front-door loops)",
+                 self.name, self.host, self.port, lg.n)
+
+    async def _accept_loop(self, lg) -> None:
+        loop = asyncio.get_running_loop()
+        rr = 0
+        while True:
+            try:
+                sock, _addr = await loop.sock_accept(self._lsock)
+            except asyncio.CancelledError:
+                return
+            except OSError:
+                return  # listening socket closed (stop())
+            idx = rr % lg.n
+            rr += 1
+            target = lg.loops[idx]
+            if target is loop:
+                loop.create_task(self._serve_sock(sock, idx))
+            else:
+                try:
+                    target.call_soon_threadsafe(
+                        self._spawn_on_loop, sock, idx)
+                except RuntimeError:
+                    sock.close()  # owning loop gone (shutdown race)
+
+    def _spawn_on_loop(self, sock, idx: int) -> None:
+        # runs as a callback ON the owning loop
+        asyncio.get_running_loop().create_task(
+            self._serve_sock(sock, idx))
+
+    async def _serve_sock(self, sock, idx: int) -> None:
+        """Wrap a dispatched socket in streams on THIS loop and run
+        the shared client path (access rules, PROXY protocol, WS/TLS
+        handshakes — everything ``_on_client`` already does)."""
+        loop = asyncio.get_running_loop()
+        sock.setblocking(False)
+        reader = asyncio.StreamReader(limit=2 ** 16, loop=loop)
+        proto = asyncio.StreamReaderProtocol(reader, loop=loop)
+        try:
+            transport, _ = await loop.connect_accepted_socket(
+                lambda: proto, sock, ssl=self.ssl_context)
+        except Exception:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        writer = asyncio.StreamWriter(transport, proto, reader, loop)
+        self._loop_conns[idx] += 1  # only this loop touches slot idx
+        try:
+            await self._on_client(reader, writer)
+        finally:
+            self._loop_conns[idx] -= 1
+
+    def loop_connections(self) -> List[int]:
+        """Live connection count per front-door loop (dispatcher mode;
+        empty on a single-loop listener)."""
+        return list(self._loop_conns)
+
     async def stop(self) -> None:
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            try:
+                await self._accept_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._accept_task = None
+            if self._lsock is not None:
+                try:
+                    self._lsock.close()
+                except OSError:
+                    pass
+                self._lsock = None
+            self._close_all_conns()
+            # bounded wait for the per-loop handlers to unwind (their
+            # loops keep running; LoopGroup.stop reaps stragglers)
+            for _ in range(100):
+                if not self._conns and not self._handshaking:
+                    break
+                await asyncio.sleep(0.02)
+            return
         if self._server is not None:
             self._server.close()
             # force-close live connections: wait_closed() (3.12+)
             # blocks until every client handler returns
-            for w in list(self._handshaking):
-                try:
-                    w.close()
-                except Exception:
-                    pass
-            for conn in list(self._conns):
-                if not conn.channel.closed:
-                    conn.channel.disconnect_reason = "server_shutdown"
-                    conn.channel._shutdown()
-                conn._close_transport()
+            self._close_all_conns()
             await self._server.wait_closed()
+
+    def _close_all_conns(self) -> None:
+        """Shut every live connection down — on ITS loop: transports
+        are not thread-safe, so a multi-loop stop marshals each close
+        to the connection's serving loop."""
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        for w in list(self._handshaking):
+            try:
+                w.close()
+            except Exception:
+                pass
+        for conn in list(self._conns):
+            loop = conn._loop
+            if loop is None or loop is running or not loop.is_running():
+                self._shutdown_conn(conn)
+            else:
+                try:
+                    loop.call_soon_threadsafe(self._shutdown_conn, conn)
+                except RuntimeError:
+                    pass
+
+    @staticmethod
+    def _shutdown_conn(conn) -> None:
+        try:
+            if not conn.channel.closed:
+                conn.channel.disconnect_reason = "server_shutdown"
+                conn.channel._shutdown()
+            conn._close_transport()
+        except Exception:
+            pass
 
     def current_connections(self) -> int:
         return len(self._conns)

@@ -41,8 +41,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from emqx_tpu.types import Message
 
@@ -84,6 +85,16 @@ class IngressBatcher:
         self._chain: Optional[asyncio.Task] = None  # ordered delivery
         self._pool: Optional[ThreadPoolExecutor] = None
         self._ready: Optional[asyncio.Event] = None
+        # multi-loop front door (Node.start → bind_multiloop): the
+        # accumulator is then fed from several event-loop threads —
+        # appends/takes go under _plock, flushes are marshaled onto
+        # the home loop, futures resolve on their own loops, and the
+        # backpressure event becomes per-loop. All None/empty on a
+        # single-loop node: every hot-path branch below stays the
+        # legacy code byte-for-byte
+        self._plock: Optional[threading.Lock] = None
+        self._home: Optional[asyncio.AbstractEventLoop] = None
+        self._ready_multi: Dict[int, tuple] = {}
         # observability (emqx_batch keeps a counter too)
         self.flushes = 0
         self.submitted = 0
@@ -91,6 +102,18 @@ class IngressBatcher:
         self.max_queue = 0
 
     _DONE = object()  # sentinel: fire-and-forget submission accepted
+
+    def bind_multiloop(self, loop_group) -> None:
+        """Arm the thread-safe submission mode (multi-loop front
+        door): the accumulator's home is the loop group's main loop;
+        peer-loop submits append under a lock and kick a flush over
+        ``call_soon_threadsafe``."""
+        self._home = loop_group.home
+        if self._plock is None:
+            self._plock = threading.Lock()
+
+    def accepts_threadsafe(self) -> bool:
+        return self._plock is not None
 
     def _executor(self) -> ThreadPoolExecutor:
         if self._pool is None:
@@ -104,10 +127,18 @@ class IngressBatcher:
         resolves to the delivery count at flush; without (QoS0 — no
         ack, nobody awaits) no future is created, avoiding orphaned
         'exception never retrieved' noise on a failed flush. ``None``
-        = no running loop, the caller must publish synchronously."""
+        = no running loop, the caller must publish synchronously.
+
+        On a multi-loop node the future belongs to the CALLER'S loop
+        (acks flush from there) while the batch always flushes on the
+        home loop."""
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
+            loop = None
+        if self._plock is not None:
+            return self._submit_threadsafe(msg, want_result, loop)
+        if loop is None:
             return None
         fut = loop.create_future() if want_result else None
         self._pending.append((msg, fut))
@@ -123,6 +154,56 @@ class IngressBatcher:
                 self._handle = loop.call_soon(self._flush)
         return fut if fut is not None else self._DONE
 
+    def _submit_threadsafe(self, msg: Message, want_result: bool,
+                           loop):
+        """Multi-loop submit: append under the lock; flush decisions
+        run on the home loop (kicked over ``call_soon_threadsafe``
+        from peer loops — at most one kick outstanding per tick, the
+        linger/soon coalescing the legacy path gets from ``_handle``)."""
+        if want_result and loop is None:
+            return None  # sync caller: publish inline, as before
+        fut = loop.create_future() if want_result else None
+        with self._plock:
+            self._pending.append((msg, fut))
+            self.submitted += 1
+            n = len(self._pending)
+            if n > self.max_queue:
+                self.max_queue = n
+        home = self._home or loop
+        if loop is home:
+            if n >= self.batch_size:
+                self._flush()
+            elif n == 1:
+                if self.linger_ms > 0:
+                    self._handle = home.call_later(
+                        self.linger_ms / 1000.0, self._flush)
+                else:
+                    self._handle = home.call_soon(self._flush)
+        elif n == 1 or n >= self.batch_size:
+            try:
+                home.call_soon_threadsafe(self._remote_kick)
+            except RuntimeError:
+                pass  # home loop gone (shutdown race)
+        return fut if fut is not None else self._DONE
+
+    def _remote_kick(self) -> None:
+        """A peer-loop submit's flush request, now ON the home loop:
+        the kick itself IS the next-tick callback, so an un-lingered
+        accumulator flushes immediately ("everything that arrived
+        this tick"), and a lingering one arms the timer once."""
+        if not self._pending:
+            return
+        if len(self._pending) >= self.batch_size:
+            self._flush()
+            return
+        if self._handle is not None:
+            return  # a flush is already scheduled
+        if self.linger_ms > 0:
+            self._handle = self._home.call_later(
+                self.linger_ms / 1000.0, self._flush)
+        else:
+            self._flush()
+
     def _take_pending(self, cap: int = 0):
         """Shared flush prologue: cancel the linger timer, take up to
         ``cap`` messages (0 = all) off the accumulator, bump the
@@ -130,7 +211,18 @@ class IngressBatcher:
         if self._handle is not None:
             self._handle.cancel()
             self._handle = None
-        if cap and len(self._pending) > cap:
+        lock = self._plock
+        if lock is not None:
+            # multi-loop: peer loops append concurrently — the swap
+            # must be atomic with their appends or a message lands in
+            # a list already captured by the flush
+            with lock:
+                if cap and len(self._pending) > cap:
+                    pending = self._pending[:cap]
+                    del self._pending[:cap]
+                else:
+                    pending, self._pending = self._pending, []
+        elif cap and len(self._pending) > cap:
             pending = self._pending[:cap]
             del self._pending[:cap]
         else:
@@ -149,16 +241,47 @@ class IngressBatcher:
         return len(self._pending) >= self.queue_hiwater
 
     async def wait_ready(self) -> None:
-        """Park until a flush takes the backlog below the mark."""
+        """Park until a flush takes the backlog below the mark. On a
+        multi-loop node each loop parks on its OWN event (an asyncio
+        event belongs to one loop; waking them crosses threads)."""
+        if self._plock is None:
+            while self.backlogged():
+                if self._ready is None or self._ready.is_set():
+                    self._ready = asyncio.Event()
+                await self._ready.wait()
+            return
+        loop = asyncio.get_running_loop()
+        key = id(loop)
         while self.backlogged():
-            if self._ready is None or self._ready.is_set():
-                self._ready = asyncio.Event()
-            await self._ready.wait()
+            ent = self._ready_multi.get(key)
+            if ent is None or ent[1].is_set():
+                ent = (loop, asyncio.Event())
+                self._ready_multi[key] = ent
+            await ent[1].wait()
 
     def _signal_ready(self) -> None:
-        if (self._ready is not None and not self._ready.is_set()
-                and not self.backlogged()):
+        if self.backlogged():
+            return
+        if self._ready is not None and not self._ready.is_set():
             self._ready.set()
+        if self._ready_multi:
+            # wake every parked loop on its own thread. A loop adding
+            # a fresh event right after this snapshot just parks until
+            # the next flush signals again
+            waiters = list(self._ready_multi.values())
+            self._ready_multi.clear()
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            for lp, ev in waiters:
+                if lp is running:
+                    ev.set()
+                else:
+                    try:
+                        lp.call_soon_threadsafe(ev.set)
+                    except RuntimeError:
+                        pass
 
     def _flush(self) -> None:
         # a capped take can leave a backlog: keep flushing chunks
@@ -228,6 +351,15 @@ class IngressBatcher:
                     chunk_fn(pb, s, min(s + self.finish_chunk, n_units))
                     if s + self.finish_chunk < n_units:
                         await asyncio.sleep(0)
+                if pb.plan is not None:
+                    # multi-loop: the batch's results/metrics fold —
+                    # and therefore the ack futures below — wait for
+                    # the cross-loop handoffs to report back. None on
+                    # a single-loop node
+                    ev = self.broker.xloop_event(pb)
+                    if ev is not None:
+                        await ev.wait()
+                        self.broker.xloop_fold(pb)
                 pb.done = True
                 results = pb.results
         except Exception as e:
@@ -249,17 +381,52 @@ class IngressBatcher:
                 loop.call_soon(self._flush)
         self._resolve(pending, results)
 
-    @staticmethod
-    def _resolve(pending, results) -> None:
+    def _resolve(self, pending, results) -> None:
+        xloop = self._plock is not None
         for (_, fut), n in zip(pending, results):
-            if fut is not None and not fut.done():
+            if fut is None or fut.done():
+                continue
+            if xloop:
+                self._set_future(fut, n, None)
+            else:
                 fut.set_result(n)
 
-    @staticmethod
-    def _resolve_exc(pending, e) -> None:
+    def _resolve_exc(self, pending, e) -> None:
+        xloop = self._plock is not None
         for _, fut in pending:
-            if fut is not None and not fut.done():
+            if fut is None or fut.done():
+                continue
+            if xloop:
+                self._set_future(fut, None, e)
+            else:
                 fut.set_exception(e)
+
+    @staticmethod
+    def _set_future(fut, value, exc) -> None:
+        """Resolve a submit future on ITS loop (multi-loop: peer-loop
+        futures must not be completed from the home thread — the ack
+        callbacks hanging off them touch that loop's channel)."""
+        floop = fut.get_loop()
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+
+        def _do(f=fut, v=value, e=exc):
+            if f.done():
+                return
+            if e is not None:
+                f.set_exception(e)
+            else:
+                f.set_result(v)
+
+        if floop is running:
+            _do()
+        else:
+            try:
+                floop.call_soon_threadsafe(_do)
+            except RuntimeError:
+                pass  # owner loop gone; QoS>0 clients re-send
 
     def flush_now(self) -> None:
         """Drain whatever is pending synchronously (shutdown path and

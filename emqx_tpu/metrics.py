@@ -66,6 +66,12 @@ DELIVERY_METRICS = [
     # traffic patches pre-built frames instead, so this stays ~0 —
     # the bench's LIVE_PRESER A/B reads it per delivery
     "delivery.serialize.onloop",
+    # cross-loop delivery ring (docs/DISPATCH.md "Multi-loop front
+    # door"): handoffs posted to a session's owning event loop — at
+    # most one per loop per batch — and the deliveries they carried.
+    # Both stay 0 with [node] loops = 1
+    "delivery.xloop.handoffs",
+    "delivery.xloop.deliveries",
 ]
 CLIENT_METRICS = [
     "client.connect", "client.connack", "client.connected",
@@ -134,8 +140,21 @@ class Metrics:
         # the reference's counters array)
         self._counters: List[int] = [0] * MAX_METRICS
         self._index: Dict[str, int] = {}
+        # multi-loop front door ([node] loops > 1): counters are then
+        # incremented from several event-loop threads, and the bare
+        # read-modify-write below would lose updates under the GIL's
+        # opcode-level interleaving. Node.start() arms the lock; the
+        # single-loop build keeps the lock-free single-writer path
+        self._lock = None
         for name in ALL_METRICS:
             self.new(name)
+
+    def enable_threadsafe(self) -> None:
+        """Arm the increment lock (multi-loop nodes). One-way: a
+        started multi-loop node never goes back to single-writer."""
+        if self._lock is None:
+            import threading
+            self._lock = threading.Lock()
 
     def new(self, name: str) -> int:
         idx = self._index.get(name)
@@ -147,10 +166,20 @@ class Metrics:
         return idx
 
     def inc(self, name: str, n: int = 1) -> None:
-        self._counters[self._index[name]] += n
+        lock = self._lock
+        if lock is None:
+            self._counters[self._index[name]] += n
+        else:
+            with lock:
+                self._counters[self._index[name]] += n
 
     def dec(self, name: str, n: int = 1) -> None:
-        self._counters[self._index[name]] -= n
+        lock = self._lock
+        if lock is None:
+            self._counters[self._index[name]] -= n
+        else:
+            with lock:
+                self._counters[self._index[name]] -= n
 
     def val(self, name: str) -> int:
         return int(self._counters[self._index[name]])

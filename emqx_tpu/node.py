@@ -51,9 +51,24 @@ class Node:
                  batch_ingress: bool = True,
                  batch_size: int = 256,
                  batch_linger_ms: float = 0.0,
+                 loops: int = 1,
                  plugin_config_dir: Optional[str] = None) -> None:
         self.name = name
         self.zone = zone or get_zone()
+        # multi-loop front door ([node] loops, docs/DISPATCH.md
+        # "Multi-loop front door"): shard accepted connections over N
+        # event loops with loop-affine sessions and a cross-loop
+        # delivery ring. loops = 1 builds NO LoopGroup — every code
+        # path is the single-loop build byte-for-byte
+        if not isinstance(loops, int) or isinstance(loops, bool) \
+                or loops < 1:
+            raise ValueError(f"loops must be an integer >= 1, "
+                             f"got {loops!r}")
+        if loops > 1:
+            from emqx_tpu.loops import LoopGroup
+            self.loop_group = LoopGroup(loops)
+        else:
+            self.loop_group = None
         # kernel services (emqx_kernel_sup)
         self.hooks = Hooks()
         self.metrics = Metrics()
@@ -225,7 +240,17 @@ class Node:
             self.load_default_modules()
         if self.boot_listeners and not self.listeners:
             self.add_listener()
+        if self.loop_group is not None:
+            # multi-loop front door: peer loops come up BEFORE the
+            # listeners (a dispatched socket needs a running owner),
+            # and the shared-state paths arm their cross-thread modes
+            self.loop_group.start(asyncio.get_running_loop())
+            self.broker.loop_group = self.loop_group
+            self.metrics.enable_threadsafe()
+            if self.ingress is not None:
+                self.ingress.bind_multiloop(self.loop_group)
         for lst in self.listeners:
+            lst.loop_group = self.loop_group
             await lst.start()
         if self._cluster_cfg is not None and self.cluster is None:
             from emqx_tpu.cluster import Cluster
@@ -281,6 +306,10 @@ class Node:
             close = getattr(self.cluster.transport, "close", None)
             if close is not None:
                 close()
+        if self.loop_group is not None:
+            # after listeners + ingress drain: in-flight cross-loop
+            # handoffs have reported back, peer loops are idle
+            self.loop_group.stop()
         self._started = False
 
     async def _housekeeping(self) -> None:
@@ -325,6 +354,17 @@ class Node:
                       "match.cache.entries.max")
         stats.setstat("match.cache.partition.live",
                       self.router.cache_partitions_live())
+        if self.loop_group is not None:
+            # per-loop connection gauges (docs/OBSERVABILITY.md): the
+            # dispatcher's round-robin keeps these balanced — a skewed
+            # row means a loop is wedged or leaking handlers
+            per = [0] * self.loop_group.n
+            for lst in self.listeners:
+                for i, c in enumerate(getattr(lst, "_loop_conns", ())):
+                    per[i] += c
+            for i, c in enumerate(per):
+                stats.setstat(f"loop.{i}.connections", c,
+                              f"loop.{i}.connections.max")
         self._watch_quarantine(stats)
         stats.setstat("publish.spans.count", self.telemetry.spans_total,
                       "publish.spans.max")

@@ -108,6 +108,14 @@ class Session:
         # None/False = never pre-build for this subscriber.
         self.proto_ver: Optional[int] = None
         self.wire_fast_hint = False
+        # multi-loop front door (loops.LoopGroup): the event loop that
+        # owns this session's connection — stamped by the channel at
+        # CONNECT, cleared on detach. The dispatch planner's cross-loop
+        # delivery ring routes this session's subscriber group to that
+        # loop, so inflight/mqueue/outbox are only touched from it.
+        # None = deliver from the main loop (single-loop build,
+        # detached sessions, loop-less sync callers).
+        self.owner_loop = None
 
     # -- info --------------------------------------------------------------
 

@@ -62,6 +62,12 @@ Stage semantics (all host wall-clock, milliseconds):
                      fallback cost is attributable).
   ``dispatch``       the host delivery tail (packed-row expansion +
                      session ``deliver`` calls), summed over chunks.
+  ``xloop``          the cross-loop delivery ring (docs/DISPATCH.md
+                     "Multi-loop front door"): handoff post → last
+                     owning loop's group enqueue complete. Overlaps
+                     ``dispatch`` (the main loop delivers its own
+                     groups while peer loops run theirs); zero with
+                     ``[node] loops = 1``.
   ``end_to_end``     ``publish_begin`` entry → last delivery chunk.
 
 Cost model: disabled (``[telemetry] enabled = false``) the broker
@@ -85,7 +91,8 @@ log = logging.getLogger("emqx_tpu.telemetry")
 #: the publish pipeline's stage names, in pipeline order (ctl and the
 #: $SYS heartbeat render in this order; Prometheus sorts its own)
 STAGES = ("match", "cache_gather", "pack", "fetch", "dispatch_plan",
-          "serialize", "host_fallback", "dispatch", "end_to_end")
+          "serialize", "host_fallback", "dispatch", "xloop",
+          "end_to_end")
 
 #: fixed log-spaced bucket upper bounds, milliseconds (1-2.5-5 per
 #: decade, 10µs..5s). Fixed — not adaptive — so scrapes from

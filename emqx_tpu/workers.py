@@ -180,11 +180,52 @@ class WorkerPool:
             raise
         return self.port
 
+    #: bound on waiting for a worker process to fully exit before its
+    #: slot is reused (restart) or stop() returns. A worker that
+    #: hasn't exited still holds its SO_REUSEPORT share of the
+    #: listener port: the kernel keeps steering a fraction of new
+    #: connections at the dying process, so respawning next to an
+    #: orphan silently splits the listener.
+    REAP_TIMEOUT = 15.0
+
+    def _reap(self, p: subprocess.Popen) -> None:
+        """Ensure ``p`` has exited — TERM, then KILL, each with half
+        the budget — raising a clear error if the orphan survives
+        (its exit is what releases the SO_REUSEPORT port share)."""
+        if p.poll() is not None:
+            return
+        try:
+            p.terminate()
+        except OSError:
+            pass
+        try:
+            p.wait(timeout=self.REAP_TIMEOUT / 2)
+            return
+        except subprocess.TimeoutExpired:
+            pass
+        try:
+            p.kill()
+        except OSError:
+            pass
+        try:
+            p.wait(timeout=self.REAP_TIMEOUT / 2)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                f"worker pid {p.pid} did not exit within "
+                f"{self.REAP_TIMEOUT:.0f}s of SIGKILL; the orphan "
+                f"still holds its SO_REUSEPORT share of port "
+                f"{self.port} — refusing to respawn into a split "
+                f"listener") from None
+
     def restart_worker(self, idx: int) -> None:
         """Respawn a dead worker in place (the reference supervisor's
-        restart role). The replacement joins the cluster through any
-        LIVE worker's transport — membership is a mesh, so losing the
-        original seed (worker 0) doesn't strand the pool."""
+        restart role). The predecessor is reaped FIRST — a respawn
+        next to a live orphan would split the SO_REUSEPORT listener
+        between old and new processes. The replacement joins the
+        cluster through any LIVE worker's transport — membership is a
+        mesh, so losing the original seed (worker 0) doesn't strand
+        the pool."""
+        self._reap(self.procs[idx])
         seed = ""
         for j, p in enumerate(self.procs):
             if j != idx and p.poll() is None and self.tports[j]:
@@ -231,16 +272,30 @@ class WorkerPool:
                 except Exception:
                     p.send_signal(signal.SIGTERM)
         deadline = time.monotonic() + timeout
+        stuck = []
         for p in self.procs:
             try:
                 p.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
+                # a kill without a wait can leave an exiting orphan
+                # holding its SO_REUSEPORT port share past stop() —
+                # the next pool on this port would share accepts with
+                # it. Bounded, with a clear error for the true wedge
+                try:
+                    p.wait(timeout=self.REAP_TIMEOUT)
+                except subprocess.TimeoutExpired:
+                    stuck.append(p.pid)
         self.procs.clear()
         # keep bookkeeping aligned for a retried start(): stale
         # tports would otherwise misalign with the new procs list
         self.tports.clear()
         self._seed_addr = ""
+        if stuck:
+            raise RuntimeError(
+                f"worker pids {stuck} survived SIGKILL for "
+                f"{self.REAP_TIMEOUT:.0f}s; orphans may still hold "
+                f"their SO_REUSEPORT share of port {self.port}")
 
     def __enter__(self) -> "WorkerPool":
         self.start()

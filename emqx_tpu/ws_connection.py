@@ -39,10 +39,12 @@ def accept_key(key: str) -> str:
     return base64.b64encode(digest).decode()
 
 
-def encode_frame(opcode: int, payload: bytes) -> bytes:
-    """Server→client frame: FIN set, never masked."""
+def frame_header(opcode: int, n: int) -> bytes:
+    """Server→client frame header for an ``n``-byte payload: FIN set,
+    never masked. Split from :func:`encode_frame` so the coalesced
+    egress flush can writev ``(header, payload, …)`` runs without
+    concatenating (= copying) every payload into a fresh frame."""
     head = bytearray([0x80 | opcode])
-    n = len(payload)
     if n < 126:
         head.append(n)
     elif n < 65536:
@@ -51,7 +53,12 @@ def encode_frame(opcode: int, payload: bytes) -> bytes:
     else:
         head.append(127)
         head += n.to_bytes(8, "big")
-    return bytes(head) + payload
+    return bytes(head)
+
+
+def encode_frame(opcode: int, payload: bytes) -> bytes:
+    """Server→client frame: FIN set, never masked."""
+    return frame_header(opcode, len(payload)) + payload
 
 
 def _unmask(data: bytes, mask: bytes) -> bytes:
@@ -212,6 +219,20 @@ class WsConnection(Connection):
 
     def _wrap_out(self, data: bytes) -> bytes:
         return encode_frame(OP_BINARY, data)
+
+    def _writev(self, frames) -> None:
+        """Writev-coalesced egress for the WS transport: a run of
+        pre-serialized MQTT frames becomes one flat
+        ``(header, payload, header, payload, …)`` ``writelines`` —
+        one transport write per drain (like the TCP path since PR 5)
+        and zero per-frame payload copies (``encode_frame`` would
+        concatenate header + payload per frame)."""
+        parts: list = []
+        ap = parts.append
+        for data in frames:
+            ap(frame_header(OP_BINARY, len(data)))
+            ap(data)
+        self.writer.writelines(parts)
 
     async def _drain_and_close(self) -> None:
         if not self._closing and not self._sent_close:

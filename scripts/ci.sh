@@ -45,6 +45,13 @@ echo "== egress pre-serialization parity (docs/DISPATCH.md) =="
 # divergence here corrupts client streams, fail before the long run
 python -m pytest tests/test_egress_serialize.py -q
 
+echo "== multi-loop front-door parity (docs/DISPATCH.md) =="
+# loops=1 vs loops=2/4: wire content, pid sequences, delivery counts
+# and metric deltas must be identical across the cross-loop delivery
+# ring, incl. takeover of a session owned by another loop — a
+# divergence here is a delivery-correctness bug, fail fast
+python -m pytest tests/test_frontdoor_loops.py -q
+
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B
 # guard (telemetry off => dispatch byte-identical to the

@@ -1,19 +1,31 @@
-"""Front-door scaling curve: msgs/s through the full wire path at
-1/2/4 SO_REUSEPORT workers (VERDICT r3 item 7).
+"""Front-door scaling curve: msgs/s through the full wire path.
+
+Two sharding modes share one load harness:
+
+- **process mode** (default): 1/2/4 SO_REUSEPORT worker PROCESSES
+  (emqx_tpu.workers.WorkerPool, VERDICT r3 item 7) — the
+  cluster-of-processes shape.
+- **loops mode** (``--loops`` flag or ``CURVE_MODE=loops``): 1/2/4
+  front-door event LOOPS inside ONE Node (``[node] loops``,
+  docs/DISPATCH.md "Multi-loop front door") — in-process connection
+  sharding with the cross-loop delivery ring. The JSON adds
+  per-loop connection counts and the cross-loop forward fraction
+  (ring-carried deliveries / all deliveries) so bench rows can
+  record balance.
 
 Load model: S subscriber connections spread over T topics, P
 publisher connections blasting QoS0 round-robin with a bounded
 pipeline. Delivered messages are counted SERVER-side (summed
-`messages.delivered` across workers via the STATS? pipe), so client
-slowness can't inflate the number. Per-worker connection counts are
-printed to show the kernel's SO_REUSEPORT balancing and the
-cross-worker forward fraction.
+`messages.delivered` across workers via the STATS? pipe, or the
+node's metrics in loops mode), so client slowness can't inflate the
+number.
 
-On the single-core dev host the workers time-share one CPU with the
-load generator — the curve there measures process overhead, not
+On the single-core dev host the workers/loops time-share one CPU with
+the load generator — the curve there measures sharding overhead, not
 scaling headroom; run on a many-core host for the real curve.
 
-Usage: python scripts/frontdoor_curve.py [workers...] (default 1 2 4)
+Usage: python scripts/frontdoor_curve.py [--loops] [counts...]
+       (default counts: 1 2 4)
 """
 
 import asyncio
@@ -35,7 +47,10 @@ SECS = float(os.environ.get("CURVE_SECS", "6"))
 PIPELINE = int(os.environ.get("CURVE_PIPELINE", "32"))
 
 
-async def _run_load(port: int, pool: WorkerPool):
+async def _run_load(port: int, delivered_fn, conns_fn):
+    """Drive the load against ``port``; ``delivered_fn()`` reads the
+    server-side delivered total, ``conns_fn()`` the per-shard live
+    connection counts."""
     from tests.mqtt_client import TestClient
 
     subs = []
@@ -84,7 +99,7 @@ async def _run_load(port: int, pool: WorkerPool):
     # flight server-side must not be attributed to the timed window
     await asyncio.sleep(0.7)
 
-    base = sum(d for _, d in pool.stats())
+    base = delivered_fn()
     t0 = time.perf_counter()
     tasks = [asyncio.create_task(blast(p, i)) for i, p in enumerate(pubs)]
     await asyncio.sleep(SECS)
@@ -92,8 +107,8 @@ async def _run_load(port: int, pool: WorkerPool):
     sent = sum(await asyncio.gather(*tasks))
     elapsed = time.perf_counter() - t0
     await asyncio.sleep(0.5)  # let deliveries drain
-    stats = pool.stats()
-    delivered = sum(d for _, d in stats) - base
+    delivered = delivered_fn() - base
+    conns = conns_fn()
 
     for d in drains:
         d.cancel()
@@ -108,22 +123,82 @@ async def _run_load(port: int, pool: WorkerPool):
         "elapsed_s": round(elapsed, 2),
         "delivered_per_s": round(delivered / elapsed, 1),
         "sent_per_s": round(sent / elapsed, 1),
-        "conns_per_worker": [c for c, _ in stats],
+        "conns_per_worker": conns,
     }
 
 
+def _run_process_mode(n: int) -> dict:
+    with WorkerPool(n, port=0, platform="cpu") as pool:
+        res = asyncio.run(_run_load(
+            pool.port,
+            delivered_fn=lambda: sum(d for _, d in pool.stats()),
+            conns_fn=lambda: [c for c, _ in pool.stats()]))
+    res["workers"] = n
+    res["mode"] = "process"
+    return res
+
+
+def _run_loops_mode(n: int) -> dict:
+    async def _go():
+        from emqx_tpu.node import Node
+        from emqx_tpu.router import MatcherConfig
+
+        # device regime by default: the cross-loop ring rides the
+        # dispatch PLANNER (host-regime batches take the legacy walk
+        # and deliver from the main loop). CURVE_HOST=1 measures the
+        # host-match wire path instead
+        matcher = (None if os.environ.get("CURVE_HOST") == "1"
+                   else MatcherConfig(device_min_filters=0))
+        node = Node(boot_listeners=False, loops=n, matcher=matcher,
+                    batch_linger_ms=1.0)
+        lst = node.add_listener(port=0)
+        await node.start()
+        try:
+            res = await _run_load(
+                lst.port,
+                delivered_fn=lambda: node.metrics.val(
+                    "messages.delivered"),
+                conns_fn=lambda: (lst.loop_connections()
+                                  or [lst.current_connections()]))
+            res["xloop_deliveries"] = node.metrics.val(
+                "delivery.xloop.deliveries")
+            res["xloop_handoffs"] = node.metrics.val(
+                "delivery.xloop.handoffs")
+            # cross-loop forward fraction: how much of the delivery
+            # tail the ring carried to non-home loops (0 at loops=1;
+            # approaches (n-1)/n under balanced round-robin). Both
+            # terms cumulative since node start — same lifetime
+            res["xloop_fraction"] = round(
+                res["xloop_deliveries"]
+                / max(1, node.metrics.val("messages.delivered")), 3)
+        finally:
+            await node.stop()
+        return res
+
+    res = asyncio.run(_go())
+    res["loops"] = n
+    res["mode"] = "loops"
+    return res
+
+
 def main():
-    counts = [int(a) for a in sys.argv[1:]] or [1, 2, 4]
+    args = sys.argv[1:]
+    mode = os.environ.get("CURVE_MODE", "process")
+    if "--loops" in args:
+        args.remove("--loops")
+        mode = "loops"
+    counts = [int(a) for a in args] or [1, 2, 4]
+    runner = _run_loops_mode if mode == "loops" else _run_process_mode
     rows = []
     for n in counts:
-        with WorkerPool(n, port=0, platform="cpu") as pool:
-            res = asyncio.run(_run_load(pool.port, pool))
-        res["workers"] = n
+        res = runner(n)
         rows.append(res)
         print(json.dumps(res), flush=True)
     base = rows[0]["delivered_per_s"] or 1
+    key = "loops" if mode == "loops" else "workers"
     print(json.dumps({
-        "curve": {r["workers"]: round(r["delivered_per_s"] / base, 2)
+        "mode": mode,
+        "curve": {r[key]: round(r["delivered_per_s"] / base, 2)
                   for r in rows},
         "host_cores": os.cpu_count(),
     }), flush=True)
