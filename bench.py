@@ -246,6 +246,34 @@ def _stage_tpu_record(rec: dict):
         return None
 
 
+#: row provenance (ISSUE 7 satellite): every emitted row carries the
+#: matcher configuration that produced it — `walk_mode`
+#: (narrow/wide compressed walk), the settled active-set `k`
+#: (configured + learned boosts at emit time), the trie `builder`
+#: (native C++ vs python), and whether the `delta` automaton was
+#: live. Stale staged rows become *detectable* (e.g. a pre-
+#: compressed-walk `hash_1m_deep` row shows walk_mode narrow where
+#: the current tree would stamp wide) instead of silently riding
+#: along. Modes call `_set_prov(router)` once their router settles.
+_PROV: dict = {}
+
+
+def _set_prov(router) -> None:
+    global _PROV
+    try:
+        slots = router._walk_meta.get("slots", 2)
+        _PROV = {
+            "walk_mode": "wide" if slots == 4 else "narrow",
+            "settled_k": int(router.effective_k()),
+            "builder": ("native" if router._native is not None
+                        else "python"),
+            "delta": bool(router.config.delta
+                          and router.config.mesh is None),
+        }
+    except Exception:
+        _PROV = {}
+
+
 def _emit(rec: dict) -> None:
     """Print the headline JSON line; when the run executed on a real
     accelerator (not the CPU fallback), persist it into the last-good
@@ -257,6 +285,8 @@ def _emit(rec: dict) -> None:
     children all report the shared headline metric under different
     workload shapes, and a child staging directly could impersonate
     the headline if the parent dies mid-matrix."""
+    for k, v in _PROV.items():
+        rec.setdefault(k, v)
     try:
         import jax as _jax
 
@@ -996,6 +1026,15 @@ def main():
             (st1["hit"] - st0["hit"]) / probed, 4) if probed else 0.0
     import sys
     print(json.dumps(info), file=sys.stderr, flush=True)
+    # row provenance (mode builds raw automatons, no Router): stamp
+    # from the settled walk itself
+    global _PROV
+    _PROV = {
+        "walk_mode": "wide" if host_auto.wt_slots == 4 else "narrow",
+        "settled_k": int(k),
+        "builder": "native" if use_native else "python",
+        "delta": False,  # raw-automaton mode: no route-churn plane
+    }
     rec = {
         "metric": "publish_match_fanout_throughput",
         "value": round(throughput, 1),
@@ -1156,6 +1195,7 @@ def sharded():
               for _ in range(B * 4)]
     batches = [(topics[i * B:(i + 1) * B],) for i in range(4)]
     r.match_ids(batches[0][0])  # flatten + match jit warm
+    _set_prov(r)
     # one subscriber per subscription, rows on the automaton's own
     # stable shard assignment (what FanoutManager.sharded_state builds
     # in the product; built directly here to skip 1M host sub objects)
@@ -1362,18 +1402,23 @@ def churn():
         route it never added); the trailing add is cleaned up after
         join so every pass leaves the filter set exactly as it found
         it (the A/B passes must measure identical sets). Returns
-        (p50, p99, achieved rate, cache hit rate DURING the pass)."""
+        (p50, p99, achieved rate, cache hit rate DURING the pass,
+        route-op p99 ms) — the route-op percentile is the churn
+        plane's own latency, the number the off-lock compaction and
+        delta batching exist to hold down (ISSUE 7)."""
         c = r._match_cache_obj
         h0, m0 = (c.hits, c.misses) if c is not None else (0, 0)
         stop = threading.Event()
         churned = [0]
         holder = {"pending": None}
+        op_lat = []
 
         def churner():
             i = 0
             interval = 1.0 / max(1, rate)
             next_t = time.perf_counter()
             while not stop.is_set():
+                t_op = time.perf_counter()
                 if holder["pending"] is None:
                     holder["pending"] = mk(i)
                     r.add_route(holder["pending"])
@@ -1381,6 +1426,7 @@ def churn():
                 else:
                     r.delete_route(holder["pending"])
                     holder["pending"] = None
+                op_lat.append(time.perf_counter() - t_op)
                 churned[0] += 1
                 next_t += interval
                 pause = next_t - time.perf_counter()
@@ -1401,13 +1447,27 @@ def churn():
         hd = (c.hits - h0) if c is not None else 0
         md = (c.misses - m0) if c is not None else 0
         hit_rate = hd / max(1, hd + md)
+        route_p99 = (float(np.percentile(
+            np.array(op_lat) * 1000.0, 99)) if op_lat else 0.0)
         return (p50c, p99c, round(churned[0] / max(wall, 1e-9), 1),
-                round(hit_rate, 4))
+                round(hit_rate, 4), round(route_p99, 3))
 
-    p50_churn, p99_churn, rate_disj, hit_disj = \
+    _set_prov(r)
+    # warm the delta plane with one UNTIMED churn pass: the side-
+    # automaton's capacity-growth ladder, the packed-union and
+    # tombstone-mask kernels, and each wildcard shape all compile
+    # here — the timed passes measure steady state, not first-touch
+    # XLA (same discipline as the cache-shape sweep above)
+    churn_pass(lambda i: f"warmd/{i}/leaf")
+    churn_pass(lambda i: f"+/warmrw/{i}")
+    r.rebuild()  # fold warm deltas: every pass starts from the same
+    # compacted tables (shapes stay compiled; state does not linger)
+    for b_, in batches:  # re-warm the cache the fold invalidated
+        r.match_ids(b_)
+    p50_churn, p99_churn, rate_disj, hit_disj, route_p99 = \
         churn_pass(lambda i: f"churn/{i}/leaf")
-    _, p99_rw, _, hit_rw = churn_pass(lambda i: f"+/churnrw/{i}")
-    _, p99_sh, _, hit_sh = \
+    _, p99_rw, _, hit_rw, _ = churn_pass(lambda i: f"+/churnrw/{i}")
+    _, p99_sh, _, hit_sh, _ = \
         churn_pass(lambda i: f"$share/churngrp/churnsh{i}/leaf")
     # whole-epoch A/B on the SAME router/filter set: the bump
     # granularity is read from the config at mutation time, so
@@ -1419,9 +1479,68 @@ def churn():
     if r.config.cache_partitions > 1:
         parts_used = r.config.cache_partitions
         r.config.cache_partitions = 1
-        _, p99_whole, _, hit_whole = \
+        _, p99_whole, _, hit_whole, _ = \
             churn_pass(lambda i: f"churn/{i}/leaf")
         r.config.cache_partitions = parts_used
+
+    # delta on/off A/B on the SAME router/filter set (ISSUE 7):
+    # set_delta folds pending state through one rebuild, so both
+    # passes measure an identical automaton — only the churn-plane
+    # machinery differs (side-automaton two-probe vs patch-in-place)
+    p99_delta_off = hit_delta_off = route_p99_off = None
+    delta_was = r.config.delta
+    if delta_was:
+        r.set_delta(False)
+        r.add_route("warm/patch/path")   # drain-scatter jit warm for
+        r.match_ids(batches[0][0])       # the patch-in-place pass
+        r.delete_route("warm/patch/path")
+        r.match_ids(batches[0][0])
+        _, p99_delta_off, _, hit_delta_off, route_p99_off = \
+            churn_pass(lambda i: f"churn/{i}/leaf")
+        r.set_delta(True)
+
+    # steady-state compaction cost: the persistent trie makes a
+    # rebuild FLATTEN-ONLY — A/B against a fresh-engine rebuild that
+    # must re-insert the whole filter set first (what an off-lock
+    # design without the freeze protocol would pay per compaction)
+    t_c = time.perf_counter()
+    r.rebuild()
+    compaction_flatten_s = time.perf_counter() - t_c
+    fresh_rebuild_s = fresh_insert_s = None
+    if os.environ.get("CHURN_FRESH_AB", "1") != "0":
+        from emqx_tpu.ops.csr import device_view as _dview
+
+        t_f = time.perf_counter()
+        if r._native is not None:
+            from emqx_tpu.ops import native as _native_mod
+
+            eng = _native_mod.NativeEngine()
+            for i, f in enumerate(r.topics()):
+                eng.insert(f, i)
+            fresh_insert_s = time.perf_counter() - t_f
+            host = eng.flatten()
+            del eng
+        else:
+            from emqx_tpu.ops.csr import build_automaton as _build
+            from emqx_tpu.oracle import TrieOracle as _TO
+            from emqx_tpu.ops.tokenize import WordTable as _WT
+
+            trie, table = _TO(), _WT()
+            fids = {}
+            for i, f in enumerate(r.topics()):
+                trie.insert(f)
+                fids[f] = i
+                for w in f.split("/"):
+                    if w not in ("+", "#"):
+                        table.intern(w)
+            fresh_insert_s = time.perf_counter() - t_f
+            host = _build(trie, fids, table)
+        # a usable rebuild ends with tables ON DEVICE, exactly like
+        # the persistent path's rebuild() — excluding placement would
+        # flatter the fresh baseline
+        if r.config.use_device:
+            jax.block_until_ready(jax.device_put(_dview(host)))
+        fresh_rebuild_s = time.perf_counter() - t_f
     st = r.stats()
     bumps = r.cache_bump_totals()
     info = {
@@ -1439,9 +1558,12 @@ def churn():
     print(json.dumps(info), file=sys.stderr, flush=True)
     _emit({
         "metric": "churn_match_p99_ms",
-        # ISSUE 4: partitioned match-cache epochs — the headline is
-        # now measured with the cache surviving disjoint-prefix churn
-        "workload": "partitioned_epochs_v1",
+        # ISSUE 7: the online delta automaton — the headline is now
+        # measured with route churn absorbed by the side-automaton
+        # (main tables pristine) and compaction off-lock; the stamp
+        # invalidates staged partitioned_epochs_v1 rows (different
+        # churn-plane machinery under the same metric name)
+        "workload": "delta_automaton_v1",
         "value": round(p99_churn, 3),
         "unit": "ms",
         "vs_baseline": round(p99_base / p99_churn, 3)
@@ -1450,6 +1572,9 @@ def churn():
         "p99_batch_ms": round(p99_churn, 3),
         "cache_partitions": r.config.cache_partitions,
         "cache_hit_rate_churn": hit_disj,
+        # churn-plane latency: the route op itself (ISSUE 7 — the
+        # number the delta/off-lock design holds down)
+        "route_op_p99_ms": route_p99,
         # variant rows: conservative global-bump shapes
         "root_wildcard_p99_ms": round(p99_rw, 3),
         "root_wildcard_hit_rate": hit_rw,
@@ -1462,6 +1587,261 @@ def churn():
         "whole_epoch_hit_rate": hit_whole,
         "partition_speedup": round(p99_whole / p99_churn, 3)
         if p99_whole and p99_churn > 0 else None,
+        # delta on/off A/B on the identical router/filter set
+        "delta_off_p99_ms": round(p99_delta_off, 3)
+        if p99_delta_off is not None else None,
+        "delta_off_hit_rate": hit_delta_off,
+        "delta_speedup": round(p99_delta_off / p99_churn, 3)
+        if p99_delta_off and p99_churn > 0 else None,
+        "route_op_p99_ms_delta_off": route_p99_off,
+        "route_op_speedup": round(route_p99_off / route_p99, 3)
+        if route_p99_off and route_p99 > 0 else None,
+        "delta_merges": r.delta_info()["merges"],
+        "rebuild_stall_ms": r.delta_info()["rebuild_stall_ms"],
+        # steady-state compaction: persistent-trie flatten-only vs a
+        # fresh-engine re-insert rebuild (the ≥3× acceptance row)
+        "compaction_flatten_s": round(compaction_flatten_s, 3),
+        "fresh_rebuild_s": round(fresh_rebuild_s, 3)
+        if fresh_rebuild_s is not None else None,
+        "fresh_insert_s": round(fresh_insert_s, 3)
+        if fresh_insert_s is not None else None,
+        "persistent_speedup": round(
+            fresh_rebuild_s / compaction_flatten_s, 2)
+        if fresh_rebuild_s and compaction_flatten_s > 0 else None,
+    })
+
+
+def flapstorm():
+    """BENCH_MODE=flapstorm — sustained reconnect storm of a large
+    subscriber population (ISSUE 7 acceptance): ``FLAP_PCT_PER_MIN``
+    (default 10) percent of ``BENCH_SUBS`` churns per minute — each
+    reconnect unsubscribes and resubscribes its filter, the
+    mobile-fleet shape — while the publish match plane keeps serving
+    with bounded p99 and a stable cache hit rate. A dedicated hot
+    subset crash-loops hard enough to cross the ``emqx_flapping``
+    threshold and gets auto-banned (every reconnect consults
+    ``Banned.check``, as the product CONNECT path does), and session
+    takeovers keep flowing through the ConnectionManager against
+    channels of churning clients. Reports storm-time match p99 (vs a
+    storm-free base), hit rate, route-op p99, ban count and takeover
+    p99."""
+    import sys
+    import threading
+
+    jax = _jax_with_retry()
+
+    from emqx_tpu.banned import Banned
+    from emqx_tpu.cm import ConnectionManager
+    from emqx_tpu.flapping import Flapping, FlappingConfig
+    from emqx_tpu.router import MatcherConfig, Router
+    from emqx_tpu.session import Session
+
+    rng = random.Random(0)
+    n_subs = int(os.environ.get("BENCH_SUBS", "1000000"))
+    B = int(os.environ.get("BENCH_BATCH", "256"))
+    duration = float(os.environ.get("FLAP_SECONDS", "30"))
+    pct_min = float(os.environ.get("FLAP_PCT_PER_MIN", "10"))
+
+    filters, vocab = build_filters(rng, n_subs, 64)
+    r = Router(MatcherConfig())
+    t0 = time.time()
+    for f in filters:
+        r.add_route(f)
+    topics = ["/".join(zipf_choice(rng, lvl) for lvl in vocab[:4])
+              for _ in range(B * 8)]
+    batches = [(topics[i * B:(i + 1) * B],) for i in range(8)]
+    r.match_ids(batches[0][0])  # flatten + match jit warm
+    # warm the partial hit/miss cache shapes a storm batch can take
+    # (same sweep as BENCH_MODE=churn — without it the timed p99
+    # measures first-touch XLA, not the storm)
+    hot = list(dict.fromkeys(topics))[:B]
+    r.match_ids(hot)
+    # make the DELTA active before the shape sweep: flap a depth-
+    # representative sample of the population (delete+re-add), so the
+    # sweep below compiles the tombstone mask, side-automaton walk
+    # and packed-union kernels at every (hit-pad, miss-pad) combo —
+    # not the timed window. The pending warm deltas stay live so the
+    # storm continues on the same compiled shapes.
+    wrng = random.Random(9)
+    for idx in wrng.sample(range(len(filters)), min(32, len(filters))):
+        r.delete_route(filters[idx])
+        r.add_route(filters[idx])
+
+    def _p2(n, floor=8):
+        out = floor
+        while out < n:
+            out *= 2
+        return out
+
+    fresh_i = [0]
+    seen_sigs = set()
+    for m in range(1, B + 1):
+        sig = (_p2(max(B - m, 1)), _p2(m))
+        if sig in seen_sigs:
+            continue
+        seen_sigs.add(sig)
+        fresh = [f"wfresh/{fresh_i[0] + j}/x" for j in range(m)]
+        fresh_i[0] += m
+        r.match_ids(hot[:B - m] + fresh)
+    for (b,) in batches:
+        r.match_ids(b)
+    build_s = time.time() - t0
+    _set_prov(r)
+
+    def step(batch):
+        _, ids_np, _, _, _ = r.match_ids(batch)
+        return ids_np
+
+    p50_base, p99_base = _latency_pass(step, batches, 30)
+
+    flapping = Flapping(
+        banned=Banned(),
+        config=FlappingConfig(max_count=15, window=60.0,
+                              ban_time=300.0))
+    cm = ConnectionManager()
+
+    class _Chan:
+        __slots__ = ("client_id", "session")
+
+        def __init__(self, cid, sess):
+            self.client_id = cid
+            self.session = sess
+
+        def takeover_begin(self):
+            return self.session
+
+        def takeover_end(self, rc):
+            pass
+
+    stop = threading.Event()
+    counts = {"reconnects": 0, "ban_rejects": 0, "takeovers": 0}
+    op_lat: list = []
+    tko_lat: list = []
+    # the crash-loopers: a small fleet stuck in a tight
+    # connect/crash cycle — their rate is a property of the crash
+    # loop (~5 reconnects/s each), NOT of the population size, so
+    # they cross the flapping threshold (15-in-60s) within seconds
+    # at any scale
+    flap_ids = [f"flap-{i}" for i in range(8)]
+    churn_rate = max(1.0, n_subs * pct_min / 100.0 / 60.0)
+
+    c = r._match_cache_obj
+    h0, m0 = (c.hits, c.misses) if c is not None else (0, 0)
+
+    def storm():
+        srng = random.Random(1)
+        interval = 1.0 / churn_rate
+        i = 0
+        next_t = time.perf_counter()
+        while not stop.is_set():
+            idx = srng.randrange(len(filters))
+            cid = f"c-{idx}"
+            f = filters[idx]
+            t_op = time.perf_counter()
+            # the reconnect: session drops (unsubscribe), flap
+            # tracking, ban gate, resubscribe
+            r.delete_route(f)
+            flapping.disconnected(cid)
+            if flapping.banned.check(clientid=cid):
+                counts["ban_rejects"] += 1
+            r.add_route(f)  # population clients never cross the bar
+            op_lat.append(time.perf_counter() - t_op)
+            counts["reconnects"] += 1
+            i += 1
+            next_t += interval
+            pause = next_t - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+
+    def crash_loop():
+        i = 0
+        while not stop.is_set():
+            fcid = flap_ids[i % len(flap_ids)]
+            flapping.disconnected(fcid)
+            if flapping.banned.check(clientid=fcid):
+                counts["ban_rejects"] += 1
+            i += 1
+            time.sleep(0.025)  # ~5 reconnects/s per flapper
+
+    def takeovers():
+        j = 0
+        while not stop.is_set():
+            cid = f"tko-{j % 256}"
+            old = cm.lookup_channel(cid)
+            ch = _Chan(cid, Session(cid, clean_start=False))
+            t_op = time.perf_counter()
+            if old is None:
+                cm.register_channel(cid, ch)
+            else:
+                cm.open_session(cid, clean_start=False, channel=ch)
+                counts["takeovers"] += 1
+                tko_lat.append(time.perf_counter() - t_op)
+            j += 1
+            time.sleep(0.002)
+
+    th_storm = threading.Thread(target=storm, daemon=True)
+    th_flap = threading.Thread(target=crash_loop, daemon=True)
+    th_tko = threading.Thread(target=takeovers, daemon=True)
+    t1 = time.time()
+    th_storm.start()
+    th_flap.start()
+    th_tko.start()
+    lat = []
+    while time.time() - t1 < duration:
+        for i in range(len(batches)):
+            t_b = time.perf_counter()
+            np.asarray(step(*batches[i]))
+            lat.append((time.perf_counter() - t_b) * 1000.0)
+    stop.set()
+    th_storm.join(timeout=5)
+    th_flap.join(timeout=5)
+    th_tko.join(timeout=5)
+    wall = time.time() - t1
+    p50_storm = float(np.percentile(lat, 50))
+    p99_storm = float(np.percentile(lat, 99))
+    c = r._match_cache_obj
+    hd = (c.hits - h0) if c is not None else 0
+    md = (c.misses - m0) if c is not None else 0
+    hit_rate = hd / max(1, hd + md)
+    banned_n = sum(
+        1 for fc in flap_ids
+        if flapping.banned.look_up("clientid", fc) is not None)
+    route_p99 = (float(np.percentile(np.array(op_lat) * 1000.0, 99))
+                 if op_lat else 0.0)
+    tko_p99 = (float(np.percentile(np.array(tko_lat) * 1000.0, 99))
+               if tko_lat else 0.0)
+    info = {
+        "mode": "flapstorm", "subs": n_subs,
+        "build_s": round(build_s, 1),
+        "pct_per_min": pct_min,
+        "achieved_churn_per_s": round(
+            counts["reconnects"] / max(wall, 1e-9), 1),
+        "reconnects": counts["reconnects"],
+        "delta": r.delta_info(),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    _emit({
+        "metric": "flapstorm_match_p99_ms",
+        "workload": "flapstorm_v1",
+        "value": round(p99_storm, 3),
+        "unit": "ms",
+        # 1.0 = the storm is invisible to the match plane
+        "vs_baseline": round(p99_base / p99_storm, 3)
+        if p99_storm > 0 else 0.0,
+        "p50_batch_ms": round(p50_storm, 3),
+        "p99_batch_ms": round(p99_storm, 3),
+        "p99_ms_no_storm": round(p99_base, 3),
+        "pct_per_min": pct_min,
+        "achieved_churn_per_s": info["achieved_churn_per_s"],
+        "cache_hit_rate_storm": round(hit_rate, 4),
+        "route_op_p99_ms": round(route_p99, 3),
+        "flappers_banned": banned_n,
+        "ban_rejects": counts["ban_rejects"],
+        "takeovers": counts["takeovers"],
+        "takeover_p99_ms": round(tko_p99, 3),
+        "delta_merges": r.delta_info()["merges"],
+        "rebuild_stall_ms": r.delta_info()["rebuild_stall_ms"],
     })
 
 
@@ -1820,6 +2200,7 @@ _MODES = {
     "live": ("live", "live_socket_throughput", "msgs/sec"),
     "latency": ("latency", "latency_8k_p99_ms", "ms"),
     "churn": ("churn", "churn_match_p99_ms", "ms"),
+    "flapstorm": ("flapstorm", "flapstorm_match_p99_ms", "ms"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
     "mixed": ("main", "publish_match_fanout_throughput", "msgs/sec"),
     "configs": ("configs", "publish_match_fanout_throughput",
@@ -1835,8 +2216,9 @@ _MODES = {
 _MODE_WORKLOADS = {
     "sharded": "deduped_tick_v3_invexp",
     "shared": "walkv2",
-    "churn": "partitioned_epochs_v1",
+    "churn": "delta_automaton_v1",
     "live": "probe_v1",
+    "flapstorm": "flapstorm_v1",
 }
 
 

@@ -833,6 +833,8 @@ class Broker:
             local = False
             n = 0
             for j in ids_packed[m_ptr[urow]:m_ptr[urow + 1]]:
+                if j < 0:
+                    continue  # pad slot: id_map[-1] would alias
                 info = route_of.get(j)
                 if info is None:
                     flt = id_map[j]
@@ -1232,7 +1234,12 @@ class Broker:
                     # oracle-fallback cost is attributable on its own
                     sp.add("host_fallback", t_fb)
                 continue
-            row_ids = pb.ids_packed[m_ptr[urow]:m_ptr[urow + 1]]
+            # pad slots (-1) must never resolve through the id map —
+            # python's negative indexing would silently alias the
+            # LAST filter and deliver phantoms
+            row_ids = [j for j in
+                       pb.ids_packed[m_ptr[urow]:m_ptr[urow + 1]]
+                       if j >= 0]
             filters = [pb.id_map[j] for j in row_ids]
             filters = [f for f in filters if f is not None]
             if not filters:
@@ -1313,6 +1320,8 @@ class Broker:
             lookup = self.helper.registry.lookup
             if pb.f_ptr is not None:
                 for k in range(pb.f_ptr[row], pb.f_ptr[row + 1]):
+                    if pb.src_packed[k] < 0:
+                        continue  # pad slot: never index with -1
                     flt = id_map[pb.src_packed[k]]
                     sub = lookup(pb.subs_packed[k])
                     if sub is not None and flt is not None:

@@ -43,7 +43,11 @@ def save(router, path: str) -> dict:
         if p is not None and not router._dirty:
             # the host patch mirrors ARE the automaton authority —
             # the walk reads nothing else, so the snapshot is exactly
-            # the mirror (copied under the lock, compressed outside)
+            # the mirror (copied under the lock, compressed outside).
+            # DELTA mode keeps no mirror (docs/DELTA.md), so its
+            # snapshots are routes-only — restore replays the route
+            # log and re-flattens on first match, exactly the v1
+            # degradation path
             arrays = {
                 "wt": p.wt, "node2": p.node2,
                 "v2_hop": p.hop, "v2_depth": p.depth,
@@ -159,7 +163,11 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
                 wt_slots=int(dims[2]), wt_take=int(dims[3]))
             dev_auto = device_view(host_auto)
             auto = jax.device_put(dev_auto) if use_dev else dev_auto
-            router._patcher = AutoPatcher(host_auto, intern)
+            # a delta-mode restorer keeps no main-table mirror — the
+            # saved host arrays still install the walk tables, churn
+            # then flows through the side-automaton (docs/DELTA.md)
+            router._patcher = (None if router._delta_active
+                               else AutoPatcher(host_auto, intern))
             router._install_walk_meta(host_auto)
             router._auto = auto
             router._auto_map = list(router._id_to_filter)
@@ -167,4 +175,5 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
             router._published = (auto, router._auto_map,
                                  router._rebuilds,
                                  router._cache_rev)
+            router._publish_pair_locked()
         return {"routes": len(routes), "tables_restored": bool(tables)}

@@ -100,6 +100,11 @@ class Ctl:
             "bumps": r.cache_bump_totals(),
             "entries": r.cache_entries(),
             "quarantined_ids": r.quarantined_ids(),
+            # online delta automaton (docs/DELTA.md): pending side-
+            # automaton size, tombstones, merge count, and the
+            # cumulative lock-stall the off-lock compaction design
+            # keeps near zero
+            "delta": r.delta_info(),
         }
         for name, c in (("single", r._match_cache_obj),
                         ("sharded", r._sharded_cache_obj)):

@@ -115,10 +115,24 @@ TRANSPORT_METRICS = [
     "connections.closed.slow_consumer",
 ]
 
+# online delta automaton + off-lock compaction (ops/delta.py,
+# docs/DELTA.md), drained from the router by the stats flush:
+# `delta.probes` = match batches that ran the two-probe walk,
+# `delta.filters` = route adds absorbed by the side-automaton,
+# `delta.merges` = compactions that folded a delta into the main
+# tables, `rebuild.stall_ms` = cumulative milliseconds the router
+# lock was held across compaction freeze/swap sections (the number
+# the off-lock design keeps near zero — a multi-second value here
+# means rebuilds are stalling route ops again)
+AUTOMATON_METRICS = [
+    "automaton.delta.probes", "automaton.delta.filters",
+    "automaton.delta.merges", "automaton.rebuild.stall_ms",
+]
+
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
-               + TRANSPORT_METRICS)
+               + AUTOMATON_METRICS + TRANSPORT_METRICS)
 
 #: registry names that are NOT monotonic — ``Metrics.dec`` runs on
 #: them in steady state (today: the retainer's live-entry count,
@@ -210,6 +224,12 @@ class Metrics:
         stale) into the host counters (Router.drain_cache_stats)."""
         for key, val in stats.items():
             self.inc(f"cache.match.{key}", int(val))
+
+    def fold_automaton_stats(self, stats: Dict[str, int]) -> None:
+        """Fold drained delta-automaton / rebuild counter deltas
+        (Router.drain_automaton_stats)."""
+        for key, val in stats.items():
+            self.inc(f"automaton.{key}", int(val))
 
 
 _QOS_RECV = ("messages.qos0.received", "messages.qos1.received",

@@ -349,6 +349,9 @@ class Node:
         cache = self.router.drain_cache_stats()
         if any(cache.values()):
             self.metrics.fold_cache_stats(cache)
+        auto = self.router.drain_automaton_stats()
+        if any(auto.values()):
+            self.metrics.fold_automaton_stats(auto)
         stats.setstat("match.cache.entries.count",
                       self.router.cache_entries(),
                       "match.cache.entries.max")

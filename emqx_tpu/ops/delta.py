@@ -1,0 +1,338 @@
+"""Online delta automaton: storm-rate route churn without touching
+the main walk tables.
+
+The patch-in-place path (:mod:`emqx_tpu.ops.patch`) keeps the main
+automaton current by splitting edges and queueing device scatters per
+mutation — O(depth) per op, but a sustained reconnect storm decays
+the walk (splits lengthen paths, stale hop bounds pin hot topics to
+the host oracle) and every drain copy-on-writes the full walk tables.
+The reference broker never pays any of this: its trie writes are
+O(topic depth) Mnesia ops and reads never degrade
+(src/emqx_trie.erl:82-116).
+
+This module is the churn-plane answer (ROADMAP item 5): batch route
+**adds** into a small *side-automaton* probed alongside the main walk
+(two-probe, terminal-id union), and handle **deletes** as a
+post-match tombstone-id mask — the main tables stay byte-identical
+between compactions, so the walk never decays no matter how hard the
+route set churns. The side structures are tiny (bounded by
+``[matcher] delta_max_filters``), so:
+
+  - inserts patch the side-automaton's own :class:`AutoPatcher`
+    mirror — the copy-on-write apply touches kilobytes, not the main
+    tables' hundreds of megabytes;
+  - the side-automaton is always **narrow** (take ≡ 1): no chains,
+    therefore no splits and no hop decay — a filter's walk cost is
+    exactly its depth, and the automaton rebuilds from its own small
+    trie in milliseconds when capacity doubles;
+  - deletes never touch any automaton: the fid lands in a tombstone
+    set, compiled into a device mask applied to the merged match ids
+    (``-1``-ing them before the fan-out gathers — the id→filter map's
+    ``None`` translation remains the exact host-side backstop).
+
+A background compaction folds the delta into the main tables
+(``Router`` flattens its persistent trie OFF-lock and swaps under a
+short lock); the delta's ordered mutation **log** is what makes that
+seamless — mutations landing mid-flatten replay into a fresh delta
+via :meth:`DeltaAutomaton.split_after`, so the published
+(main, delta) pair is exact on both sides of the swap. See
+docs/DELTA.md.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, NamedTuple, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from emqx_tpu import topic as T
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.ops.csr import (Automaton, build_automaton, device_view,
+                              finalize_automaton)
+from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.patch import AutoPatcher, PatchOverflow
+
+
+class _InternTable:
+    """Adapter giving :func:`build_automaton` the one method it uses
+    (``intern``) over whichever engine owns the word table — the
+    delta MUST share the main automaton's word ids (both walks
+    consume the same encoded batch)."""
+
+    __slots__ = ("intern",)
+
+    def __init__(self, intern: Callable[[str], int]) -> None:
+        self.intern = intern
+
+
+class DeltaSnapshot(NamedTuple):
+    """One consistent, immutable view for lock-free matchers. ``auto``
+    is None when there are no pending adds (tombstone-only delta);
+    ``mask`` is None when there are no tombstones."""
+
+    auto: Optional[Automaton]     # walkable device view (narrow)
+    hops: Optional[np.ndarray]    # host hops_for_level of the view
+    k: int                        # active-set lanes the delta walk needs
+    mask: Optional[jax.Array]     # bool[cap] True = tombstoned fid
+    version: int
+    n_pending: int
+
+    def steps_for(self, lb: int) -> int:
+        hl = self.hops
+        if hl is None or len(hl) == 0:
+            return 1
+        return int(hl[min(lb, len(hl) - 1)])
+
+
+class DeltaAutomaton:
+    """Pending route mutations relative to the last main flatten.
+
+    All mutation methods are called under the router's lock (the
+    word-table lock additionally guards interning, same as the main
+    patch path); :meth:`snapshot` publishes an immutable view."""
+
+    def __init__(self, intern: Callable[[str], int],
+                 use_device: bool = True) -> None:
+        self.intern = intern
+        self.use_device = use_device
+        self.trie = TrieOracle()          # pending adds, host authority
+        self.fids: Dict[str, int] = {}    # pending filter → fid
+        self.tombs: Set[int] = set()      # fids tombstoned in MAIN tables
+        self.tomb_filters: Set[str] = set()
+        #: ordered mutation log — the replay seam the off-lock
+        #: compaction splits at (docs/DELTA.md "Mutation-log replay")
+        self.log: List[Tuple[str, str, int]] = []
+        self.has_plus = False
+        self.version = 0
+        self._host_auto: Optional[Automaton] = None
+        self._dev_auto: Optional[Automaton] = None
+        self._patcher: Optional[AutoPatcher] = None
+        self._flatten_dirty = False   # side-tables need a re-flatten
+        self._grow = 1                # capacity growth on overflow
+        self._mask_dirty = True
+        self._mask_dev: Optional[jax.Array] = None
+        self._mask_cap = 0
+        self._snap: Optional[DeltaSnapshot] = None
+        self._snap_key = None
+
+    # -- mutation (under the router lock) ---------------------------------
+
+    @property
+    def n_pending(self) -> int:
+        return len(self.fids)
+
+    @property
+    def n_tombstones(self) -> int:
+        return len(self.tombs)
+
+    def mark(self) -> int:
+        """Current log position — compaction records it at freeze
+        time; entries before it are folded into the flatten."""
+        return len(self.log)
+
+    def add(self, filter_: str, fid: int) -> None:
+        self.trie.insert(filter_)
+        self.fids[filter_] = fid
+        self.log.append(("+", filter_, fid))
+        if T.PLUS in T.words(filter_):
+            self.has_plus = True
+        self.version += 1
+        if self._flatten_dirty or self._patcher is None:
+            self._flatten_dirty = True
+            return
+        try:
+            self._patcher.insert(filter_, fid)
+        except PatchOverflow:
+            # side tables are small: just re-flatten them (ms) at the
+            # next snapshot, with doubled capacity
+            self._grow = 2
+            self._flatten_dirty = True
+
+    def delete(self, filter_: str, fid: int) -> None:
+        """A route delete: retract a pending add, or tombstone a
+        main-table fid."""
+        self.log.append(("-", filter_, fid))
+        self.version += 1
+        if filter_ in self.fids:
+            self.trie.delete(filter_)
+            del self.fids[filter_]
+            if not self._flatten_dirty and self._patcher is not None:
+                try:
+                    self._patcher.delete(filter_)
+                except PatchOverflow:
+                    self._flatten_dirty = True
+            return
+        self.tombs.add(fid)
+        self.tomb_filters.add(filter_)
+        self._mask_dirty = True
+
+    def split_after(self, mark: int) -> "Optional[DeltaAutomaton]":
+        """A fresh delta holding only the mutations after ``mark`` —
+        everything before it is in the new main tables (the off-lock
+        compaction flattened the trie they had already been applied
+        to). Replays with live semantics, so an add+delete pair
+        inside the window cancels and a delete of a pre-mark add
+        becomes a tombstone against the NEW tables."""
+        fresh = DeltaAutomaton(self.intern, self.use_device)
+        for op, f, fid in self.log[mark:]:
+            if op == "+":
+                fresh.add(f, fid)
+            else:
+                fresh.delete(f, fid)
+        if not fresh.fids and not fresh.tombs:
+            return None
+        return fresh
+
+    def needs_compaction(self, max_filters: int, live: int) -> bool:
+        """Pending adds at the configured bound, or tombstones
+        dominating the live set — fold into the main tables."""
+        return (len(self.fids) >= max_filters
+                or len(self.tombs) > max(1024, live))
+
+    # -- host match (oracle-fallback union) -------------------------------
+
+    def host_match(self, topic: str) -> List[str]:
+        """Pending-add filters matching ``topic`` (host side of the
+        two-probe union; tombstones are the caller's id-map ``None``
+        translation)."""
+        if not self.fids:
+            return []
+        return self.trie.match(topic)
+
+    # -- snapshot (side tables + tombstone mask) --------------------------
+
+    def _flatten(self) -> None:
+        cap = nb = None
+        if self._host_auto is not None \
+                and self._host_auto.node2 is not None:
+            cap = self._host_auto.node2.shape[0] * self._grow
+            nb = self._host_auto.wt.shape[0] * self._grow
+        table = _InternTable(self.intern)
+        base = build_automaton(self.trie, self.fids, table,
+                               skip_hash=True)
+        host = finalize_automaton(base, force_mode="narrow",
+                                  state_capacity=cap, n_buckets=nb)
+        self._host_auto = host
+        auto = device_view(host)
+        if self.use_device:
+            auto = jax.device_put(auto)
+        self._dev_auto = auto
+        self._patcher = AutoPatcher(host, self.intern)
+        self._flatten_dirty = False
+        self._grow = 1
+
+    def snapshot(self, id_cap: int, k_cap: int) -> DeltaSnapshot:
+        """The current immutable view (cached by version; call under
+        the router lock). ``id_cap`` sizes the tombstone mask (the
+        id→filter map length); ``k_cap`` is the active-set capacity a
+        wildcard-bearing delta walk gets."""
+        key = (self.version, id_cap > self._mask_cap, k_cap)
+        if self._snap is not None and self._snap_key == key \
+                and not self._flatten_dirty and not self._mask_dirty \
+                and (self._patcher is None or not self._patcher.dirty):
+            return self._snap
+        auto = hops = None
+        if self.fids:
+            if self._flatten_dirty or self._host_auto is None:
+                self._flatten()
+            elif self._patcher is not None and self._patcher.dirty:
+                self._dev_auto = self._patcher.apply_updates(
+                    self._dev_auto)
+            auto = self._dev_auto
+            hops = (self._patcher.hops_for_level
+                    if self._patcher is not None
+                    else self._host_auto.hops_for_level)
+        if self.tombs:
+            cap = self._mask_cap
+            if cap < id_cap or cap == 0:
+                cap = 16
+                while cap < id_cap:
+                    cap *= 2
+            if self._mask_dirty or cap != self._mask_cap:
+                m = np.zeros(cap, bool)
+                m[np.fromiter(self.tombs, np.int64,
+                              len(self.tombs))] = True
+                self._mask_dev = jax.device_put(m) if self.use_device \
+                    else jnp.asarray(m)
+                self._mask_cap = cap
+                self._mask_dirty = False
+            mask = self._mask_dev
+        else:
+            mask = None
+        self._snap = DeltaSnapshot(
+            auto=auto, hops=hops, k=(k_cap if self.has_plus else 1),
+            mask=mask, version=self.version, n_pending=len(self.fids))
+        self._snap_key = key
+        return self._snap
+
+
+# -- two-probe device merge -------------------------------------------------
+
+
+@jax.jit
+def _mask_ids(ids: jax.Array, mask: jax.Array) -> jax.Array:
+    """Post-match tombstone mask: ``-1`` every id whose mask bit is
+    set (deleted-but-not-yet-compacted fids never reach the fan-out
+    gathers)."""
+    hit = mask[jnp.clip(ids, 0, mask.shape[0] - 1)]
+    return jnp.where((ids >= 0) & hit, -1, ids)
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def _union_packed(a: jax.Array, b: jax.Array, *, m: int):
+    """Row-wise union of two packed id arrays into ``m`` slots.
+    Trie terminals are disjoint between the main tables and the delta
+    (a filter lives in exactly one), so union is pure packing; rows
+    whose combined set exceeds ``m`` flag overflow (host fallback,
+    same contract as the walk)."""
+    cat = jnp.concatenate([a, b], axis=1)
+
+    def one(row):
+        valid = row >= 0
+        cnt = jnp.sum(valid)
+        pos = jnp.cumsum(valid) - 1
+        out = jnp.full((m,), -1, row.dtype).at[
+            jnp.where(valid, pos, m)].set(row, mode="drop")
+        return out, cnt > m
+
+    return jax.vmap(one)(cat)
+
+
+def probe_raw(snap: DeltaSnapshot, word_ids, n_words, sys_mask,
+              main_ids, main_ovf, *, m: int):
+    """Two-probe merge for the RAW (``pack_ids=False``) dispatch:
+    walk the side-automaton over the already-encoded batch, CONCAT
+    its raw emit slots onto the main walk's (downstream packing
+    subsumes the union), OR the overflows, then tombstone-mask."""
+    ids, ovf = main_ids, main_ovf
+    if snap.auto is not None:
+        res = match_batch(
+            snap.auto, word_ids, n_words, sys_mask, k=snap.k, m=m,
+            pack_ids=False, steps=snap.steps_for(word_ids.shape[1]),
+            slots=2, take=1)
+        ids = jnp.concatenate([ids, res.ids], axis=1)
+        ovf = ovf | res.overflow
+    if snap.mask is not None:
+        ids = _mask_ids(ids, snap.mask)
+    return ids, ovf
+
+
+def probe_packed(snap: DeltaSnapshot, word_ids, n_words, sys_mask,
+                 main_ids, main_ovf, *, m: int):
+    """Two-probe merge for the PACKED (``pack_ids=True``) dispatch —
+    the match-cache miss walk: union into the fixed ``[B, m]`` row
+    shape cache entries carry, then tombstone-mask."""
+    ids, ovf = main_ids, main_ovf
+    if snap.auto is not None:
+        res = match_batch(
+            snap.auto, word_ids, n_words, sys_mask, k=snap.k, m=m,
+            pack_ids=True, steps=snap.steps_for(word_ids.shape[1]),
+            slots=2, take=1)
+        ids, u_ovf = _union_packed(ids, res.ids, m=m)
+        ovf = ovf | res.overflow | u_ovf
+    if snap.mask is not None:
+        ids = _mask_ids(ids, snap.mask)
+    return ids, ovf

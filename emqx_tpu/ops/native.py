@@ -85,6 +85,9 @@ def load_library():
         lib.trie_counts.argtypes = [C.c_void_p,
                                     C.POINTER(C.c_int64),
                                     C.POINTER(C.c_int64)]
+        lib.trie_counts_scan.argtypes = [C.c_void_p,
+                                         C.POINTER(C.c_int64),
+                                         C.POINTER(C.c_int64)]
         lib.trie_flatten.argtypes = [
             C.c_void_p, C.c_int64, C.c_int64, _i32p, _i32p, _i32p,
             _i32p, _i32p, _i32p]
@@ -208,8 +211,17 @@ class NativeEngine:
         return self._lib.trie_num_filters(self._trie)
 
     def counts(self) -> Tuple[int, int]:
+        """Live (states, edges) — O(1) incremental counters (the
+        capacity sizing every flatten pays)."""
         s, e = C.c_int64(), C.c_int64()
         self._lib.trie_counts(self._trie, C.byref(s), C.byref(e))
+        return s.value, e.value
+
+    def counts_scan(self) -> Tuple[int, int]:
+        """The full-DFS count — the parity oracle for :meth:`counts`
+        (tests only; O(nodes))."""
+        s, e = C.c_int64(), C.c_int64()
+        self._lib.trie_counts_scan(self._trie, C.byref(s), C.byref(e))
         return s.value, e.value
 
     def match(self, topic: str, cap: int = 4096) -> np.ndarray:

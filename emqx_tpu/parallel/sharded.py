@@ -41,6 +41,33 @@ from emqx_tpu.ops.fanout import (FanoutTable, build_fanout,
 from emqx_tpu.ops.tokenize import WordTable
 
 
+def shard_map_available() -> bool:
+    """Whether this JAX build carries a shard_map implementation at
+    all (the mesh suites skip — not error — without one)."""
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """Version-portable shard_map: ``jax.shard_map`` (new API,
+    ``check_vma``) when present, else the ``jax.experimental`` form
+    (``check_rep``). Replication checking is off either way — the
+    walk's scan carries start replicated and becomes varying."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 class ShardedAutomaton(NamedTuple):
     """T stacked walk tables; leading axis is the trie-shard axis.
 
@@ -453,12 +480,11 @@ def publish_step(
     bm_spec = (P("data"), P("data"), P("data")) if with_bitmap else None
     if with_bitmap:
         in_specs.append(P("trie"))
-    return jax.shard_map(
-        local, mesh=mesh,
-        in_specs=tuple(in_specs),
-        out_specs=(P("data"), P("data"), P("data"), bm_spec,
-                   P("data"), P("data"), P()),
-        check_vma=False,  # scan carries start replicated, become varying
+    return _shard_map(
+        local, mesh,
+        tuple(in_specs),
+        (P("data"), P("data"), P("data"), bm_spec,
+         P("data"), P("data"), P()),
     )(*args)
 
 
@@ -506,10 +532,9 @@ def shared_pick_step(
         ovf = jax.lax.psum(res.overflow.astype(jnp.int32), "trie") > 0
         return all_picks, all_ids, ovf
 
-    return jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P("trie"), P("trie"), P("data"), P("data"), P("data"),
-                  P("data")),
-        out_specs=(P("data"), P("data"), P("data")),
-        check_vma=False,
+    return _shard_map(
+        local, mesh,
+        (P("trie"), P("trie"), P("data"), P("data"), P("data"),
+         P("data")),
+        (P("data"), P("data"), P("data")),
     )(auto, gfan, word_ids, n_words, sys_mask, seeds)

@@ -118,6 +118,20 @@ class MatcherConfig:
     # safe as whole-epoch. Power of two; 1 = legacy whole-epoch
     # invalidation byte-for-byte (the PR-1 behavior).
     cache_partitions: int = 64
+    # online delta automaton (ops/delta.py, docs/DELTA.md): route adds
+    # batch into a small side-automaton probed alongside the main walk
+    # (terminal-id union), deletes become a post-match tombstone-id
+    # mask — the main tables stay PRISTINE during storms (no patch
+    # splits, no hop decay, no full-table scatter copies), and the
+    # background compaction flattens the persistent trie OFF-lock
+    # (route ops during the flatten complete in ms and land in the
+    # next delta generation via the mutation log). False restores the
+    # patch-in-place path byte-for-byte. A configured mesh keeps
+    # per-shard patch-in-place regardless (the delta is single-chip).
+    delta: bool = True
+    # pending delta adds that trigger the background merge compaction
+    # (also bounds the side-automaton walk cost)
+    delta_max_filters: int = 4096
 
 
 def topic_partition(topic: str, parts: int) -> int:
@@ -270,6 +284,10 @@ class Router:
             raise ValueError(
                 f"cache_partitions must be a power of two >= 1, "
                 f"got {P}")
+        if self.config.delta_max_filters < 1:
+            raise ValueError(
+                f"delta_max_filters must be >= 1, "
+                f"got {self.config.delta_max_filters}")
         self._cache_rev = 0
         self._part_revs: List[int] = [0] * P
         # epoch-bump accounting (cache.match.bump.* counters): how
@@ -291,8 +309,55 @@ class Router:
         # dispatch path pays nothing
         self.telemetry = None
         self._last_dispatch: Optional[dict] = None
+        # online delta automaton (ops/delta.py, docs/DELTA.md): the
+        # side structures holding route mutations the main tables
+        # haven't absorbed yet. Lazily created on the first delta-mode
+        # mutation against a live automaton; None = empty. _pub2 is
+        # the atomically-published (main snapshot, delta snapshot,
+        # delta version, k_boost) pair matchers read in ONE reference
+        # (reading main and delta separately could double- or
+        # zero-count a filter across a compaction swap). _freeze is
+        # the trie defer-log active while an off-lock flatten reads
+        # the (frozen) trie; _rebuild_inflight gates inline rebuilds
+        # away from the flatten window.
+        self._delta = None
+        self._delta_ver = 0
+        self._pub2: Optional[tuple] = None
+        self._freeze: Optional[dict] = None
+        self._rebuild_inflight = False
+        # automaton.delta.* / automaton.rebuild.* counters, drained by
+        # the stats flush (drain_automaton_stats)
+        self._delta_probes = 0
+        self._delta_filters = 0
+        self._delta_merges = 0
+        self._rebuild_stall_ms = 0.0
+        self._auto_drained = (0, 0, 0, 0)
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
+
+    @property
+    def _delta_active(self) -> bool:
+        """Delta mode in effect: configured on and single-chip (the
+        mesh keeps per-shard patch-in-place — its collective step has
+        no two-probe seam). Read per call so :meth:`set_delta` can
+        flip it at runtime (bench A/B on one router)."""
+        return self.config.delta and self.config.mesh is None
+
+    def _intern_fn(self):
+        """The engine's word-intern callable (the delta's side
+        structures must share the main word-id space — both walks
+        consume the same encoded batch)."""
+        if self._native is not None:
+            return self._native.intern
+        return self._table.intern
+
+    def _ensure_delta(self):
+        if self._delta is None:
+            from emqx_tpu.ops.delta import DeltaAutomaton
+
+            self._delta = DeltaAutomaton(self._intern_fn(),
+                                         self.config.use_device)
+        return self._delta
 
     def _t_insert(self, filter_: str, fid: int) -> None:
         with self._wt_lock:  # interning mutates the word table
@@ -313,6 +378,61 @@ class Router:
         else:
             self._trie.delete(filter_)
 
+    # -- freeze protocol (off-lock compaction, docs/DELTA.md) -------------
+    #
+    # While a background flatten reads the persistent trie OFF-lock,
+    # the trie must not be mutated (the flatten is read-only, so
+    # concurrent host matches stay safe — concurrent inserts would
+    # not). Route ops landing in that window defer into _freeze: the
+    # ordered log replays into the trie at swap time, and the small
+    # side trie/set compensate host matches meanwhile. Word interning
+    # still happens immediately (the word table is not the trie — the
+    # flatten never reads it on the native engine, and on the Python
+    # engine all its words are pre-interned), so concurrently encoded
+    # batches resolve the new vocabulary.
+
+    def _t_insert_route(self, filter_: str, fid: int) -> None:
+        fz = self._freeze
+        if fz is None:
+            self._t_insert(filter_, fid)
+            return
+        fz["log"].append(("+", filter_, fid))
+        fz["adds"].insert(filter_)
+        fz["add_fids"][filter_] = fid
+        fz["dels"].discard(filter_)
+        with self._wt_lock:
+            intern = self._intern_fn()
+            for w in T.words(filter_):
+                if w not in (T.PLUS, T.HASH):
+                    intern(w)
+
+    def _t_delete_route(self, filter_: str, fid: int) -> None:
+        fz = self._freeze
+        if fz is None:
+            self._t_delete(filter_)
+            return
+        fz["log"].append(("-", filter_, fid))
+        if filter_ in fz["add_fids"]:
+            fz["adds"].delete(filter_)
+            del fz["add_fids"][filter_]
+        else:
+            fz["dels"].add(filter_)
+
+    def _unfreeze_locked(self) -> None:
+        """Replay the deferred trie mutations in order and lift the
+        freeze (call under the lock, after the off-lock flatten is
+        done with the trie)."""
+        fz = self._freeze
+        if fz is None:
+            return
+        self._freeze = None
+        self._rebuild_inflight = False
+        for op, f, fid in fz["log"]:
+            if op == "+":
+                self._t_insert(f, fid)
+            else:
+                self._t_delete(f)
+
     def _t_match(self, topic: str) -> List[str]:
         """Host-side exact match (fallback path); call under lock."""
         if self._native is not None:
@@ -324,6 +444,23 @@ class Router:
                     out.append(f)
             return out
         return self._trie.match(topic)
+
+    def _host_match_locked(self, topic: str) -> List[str]:
+        """:meth:`_t_match` plus the freeze-window compensation: while
+        an off-lock flatten holds the trie frozen, deferred adds come
+        from the freeze side-trie and deferred deletes are subtracted
+        (the native engine's are already dropped by the id map's
+        ``None`` translation). Exact at every instant."""
+        out = self._t_match(topic)
+        fz = self._freeze
+        if fz is not None:
+            if self._native is None and fz["dels"]:
+                out = [f for f in out if f not in fz["dels"]]
+            if fz["add_fids"]:
+                seen = set(out)
+                out = out + [f for f in fz["adds"].match(topic)
+                             if f not in seen]
+        return out
 
     def _encode(self, topics: Sequence[str], max_levels: int):
         if self._native is not None:
@@ -371,8 +508,15 @@ class Router:
             if dests is None:
                 dests = {}
                 self._routes[filter_] = dests
-                self._t_insert(filter_, fid)
-                self._patch_insert(filter_, fid)
+                self._t_insert_route(filter_, fid)
+                if self._delta_active and self._auto is not None \
+                        and not self._dirty:
+                    # delta mode: the main tables stay pristine — the
+                    # add lands in the side-automaton probed alongside
+                    # the main walk (docs/DELTA.md)
+                    self._delta_add_locked(filter_, fid)
+                else:
+                    self._patch_insert(filter_, fid)
                 # bump AFTER the insert interned its words: a batch
                 # encoded concurrently (encode takes _wt_lock only)
                 # then reads the OLD revision and looks stale at
@@ -386,6 +530,31 @@ class Router:
                 self._bump_cache_rev(filter_)
             dests[dest] = dests.get(dest, 0) + 1
             return fid
+
+    def _delta_add_locked(self, filter_: str, fid: int) -> None:
+        d = self._ensure_delta()
+        with self._wt_lock:  # side-patcher insert interns new words
+            d.add(filter_, fid)
+        self._map_set(fid, filter_)
+        self._delta_ver += 1
+        self._delta_filters += 1
+        if d.n_pending >= self.config.delta_max_filters:
+            self._maybe_compact_locked()
+
+    def _delta_delete_locked(self, filter_: str, fid: int) -> None:
+        d = self._ensure_delta()
+        with self._wt_lock:  # retracting a pending add walks words
+            d.delete(filter_, fid)
+        self._map_set(fid, None)
+        self._delta_ver += 1
+        if d.needs_compaction(self.config.delta_max_filters,
+                              len(self._filter_ids)):
+            self._maybe_compact_locked()
+
+    def _maybe_compact_locked(self) -> None:
+        if not self._compacting and not self._dirty \
+                and self._needs_compaction_locked():
+            self._schedule_compaction()
 
     def _patcher_for(self, filter_: str) -> Optional[AutoPatcher]:
         """The patcher owning ``filter_`` (per-shard on a mesh, the
@@ -487,14 +656,25 @@ class Router:
                 # encoding — bumping here would spuriously stale every
                 # in-flight pre-placed batch under unsubscribe churn
                 del self._routes[filter_]
-                self._t_delete(filter_)
-                fid = self._filter_ids.pop(filter_)
-                self._id_to_filter[fid] = None
-                self._retire_id(fid)
-                self._patch_delete(filter_, fid)
-                # cached rows may hold this fid — but only rows whose
-                # topic the filter matched, all inside its partition
-                self._bump_cache_rev(filter_)
+                self._drop_filter_locked(filter_)
+
+    def _drop_filter_locked(self, filter_: str) -> None:
+        """The last route for ``filter_`` went away: tombstone it out
+        of the matcher (delta tombstone mask or patch-in-place,
+        depending on mode) and retire its id. Call under the lock,
+        AFTER removing it from ``_routes``."""
+        self._t_delete_route(filter_, self._filter_ids[filter_])
+        fid = self._filter_ids.pop(filter_)
+        self._id_to_filter[fid] = None
+        self._retire_id(fid)
+        if self._delta_active and self._auto is not None \
+                and not self._dirty:
+            self._delta_delete_locked(filter_, fid)
+        else:
+            self._patch_delete(filter_, fid)
+        # cached rows may hold this fid — but only rows whose
+        # topic the filter matched, all inside its partition
+        self._bump_cache_rev(filter_)
 
     def _retire_id(self, fid: int) -> None:
         """Freed filter id → quarantine or immediate recycle.
@@ -555,12 +735,7 @@ class Router:
                 del dests[node]
                 if not dests:
                     del self._routes[f]
-                    self._t_delete(f)
-                    fid = self._filter_ids.pop(f)
-                    self._id_to_filter[fid] = None
-                    self._retire_id(fid)
-                    self._patch_delete(f, fid)
-                    self._bump_cache_rev(f)
+                    self._drop_filter_locked(f)
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -574,8 +749,13 @@ class Router:
 
     def rebuild(self) -> Automaton:
         """Flatten the trie to a fresh automaton (double-buffered: the
-        previous one stays live for concurrent matchers until swap)."""
+        previous one stays live for concurrent matchers until swap).
+        While an off-lock compaction flatten is in flight the trie is
+        frozen — that compaction IS the rebuild, so return the live
+        automaton instead of racing it."""
         with self._lock:
+            if self._freeze is not None:
+                return self._auto
             return self._rebuild_locked()
 
     def _rebuild_locked(self):
@@ -615,8 +795,17 @@ class Router:
         auto = device_view(host_auto)
         if self.config.use_device:
             auto = jax.device_put(auto)
-        # the mirror copies host arrays (no device→host readback)
-        self._patcher = AutoPatcher(host_auto, intern)
+        if self._delta_active:
+            # delta mode keeps no main-table mirror (the mirror copies
+            # the full walk tables — dead weight when nothing patches
+            # them); the trie had every mutation applied, so any
+            # pending delta is folded by this flatten
+            self._patcher = None
+            self._delta = None
+            self._delta_ver += 1
+        else:
+            # the mirror copies host arrays (no device→host readback)
+            self._patcher = AutoPatcher(host_auto, intern)
         self._auto = auto
         self._auto_map = list(self._id_to_filter)  # NEW object: old
         # snapshots freeze, so quarantined ids may recycle now
@@ -628,6 +817,7 @@ class Router:
         self._bump_cache_rev()  # fresh id map: quarantined ids recycle
         self._published = (auto, self._auto_map, self._rebuilds,
                            self._cache_rev)
+        self._publish_pair_locked()
         return auto
 
     def _rebuild_sharded_locked(self):
@@ -684,6 +874,7 @@ class Router:
         self._bump_cache_rev()  # fresh id map: quarantined ids recycle
         self._published = (auto, self._auto_map, self._rebuilds,
                            self._cache_rev)
+        self._publish_pair_locked()
         return auto
 
     def _install_walk_meta(self, host_auto: Automaton,
@@ -731,6 +922,10 @@ class Router:
         return any(p.dirty for p in self._shard_patchers)
 
     def _needs_compaction_locked(self) -> bool:
+        if self._delta_active and self._delta is not None \
+                and self._auto is not None:
+            return self._delta.needs_compaction(
+                self.config.delta_max_filters, len(self._filter_ids))
         if self._patcher is not None:
             return self._patcher.needs_compaction(len(self._filter_ids))
         if self._shard_patchers:
@@ -760,9 +955,16 @@ class Router:
         if self._compacting:
             return
         self._compacting = True
+        offlock = self._delta_active
 
         def _bg():
             try:
+                if offlock:
+                    # delta mode: flatten OFF-lock with the freeze
+                    # protocol — route ops and matchers never wait on
+                    # the multi-second build (docs/DELTA.md)
+                    self._compact_offlock()
+                    return
                 with self._lock:
                     # a sync rebuild may have beaten us to it (fresh
                     # patcher, tombstones gone): re-check, don't
@@ -782,6 +984,94 @@ class Router:
 
         threading.Thread(target=_bg, daemon=True,
                          name="router-compaction").start()
+
+    def _flatten_main(self, cap_s2, nb):
+        """Flatten the persistent trie into a fresh host automaton —
+        the ONLY long step of a compaction, and (under the freeze
+        protocol) the only one that runs off-lock. Split out so tests
+        can interpose a slow build."""
+        if self._native is not None:
+            return self._native.flatten(
+                v2_state_capacity=cap_s2, n_buckets=nb)
+        return build_automaton(
+            self._trie, self._filter_ids, self._table,
+            v2_state_capacity=cap_s2, v2_n_buckets=nb)
+
+    def _compact_offlock(self) -> None:
+        """Delta-mode background compaction: freeze the trie + mark
+        the delta log under a SHORT lock, flatten OFF-lock (the
+        multi-second step at scale — concurrent route ops defer into
+        the freeze log and the next delta generation, concurrent
+        matchers keep the published (main, delta) pair), then swap +
+        replay under another short lock. The lock is held for
+        milliseconds total — `automaton.rebuild.stall_ms` counts
+        exactly that."""
+        import time as _time
+
+        from emqx_tpu.profiling import timer as _ktimer
+
+        t_begin = _time.perf_counter()
+        with self._lock:
+            t0 = _time.perf_counter()
+            if self._dirty or self._auto is None \
+                    or not self._delta_active \
+                    or not self._needs_compaction_locked():
+                return
+            self._freeze = {"log": [], "adds": TrieOracle(),
+                            "add_fids": {}, "dels": set()}
+            self._rebuild_inflight = True
+            mark = self._delta.mark() if self._delta is not None else 0
+            n_pend = len(self._pending_free)
+            prev = self._auto
+            cap_s2 = nb = None
+            if prev is not None and prev.node2 is not None:
+                cap_s2 = prev.node2.shape[0] * self._grow["state"]
+                nb = prev.wt.shape[0] * self._grow["edge"]
+            stall = _time.perf_counter() - t0
+        try:
+            t_fl = _time.perf_counter()
+            host_auto = self._flatten_main(cap_s2, nb)
+            auto = device_view(host_auto)
+            if self.config.use_device:
+                auto = jax.device_put(auto)
+            _ktimer.record("automaton.rebuild",
+                           (_time.perf_counter() - t_fl) * 1000.0)
+        except BaseException:
+            with self._lock:
+                self._unfreeze_locked()
+            raise
+        with self._lock:
+            t1 = _time.perf_counter()
+            self._install_walk_meta(host_auto)
+            self._auto = auto
+            self._patcher = None  # delta mode: no main-table mirror
+            self._auto_map = list(self._id_to_filter)
+            # recycle ONLY ids quarantined before the freeze: an id
+            # freed DURING the flatten may still be emitted by the
+            # new tables (its path was in the snapshot) — it waits a
+            # generation
+            self._free_ids.extend(self._pending_free[:n_pend])
+            del self._pending_free[:n_pend]
+            self._dirty = False
+            self._grow = {"state": 1, "edge": 1}
+            self._rebuilds += 1
+            self._bump_cache_rev()
+            self._published = (auto, self._auto_map, self._rebuilds,
+                               self._cache_rev)
+            # fold: log entries before the mark are in the new tables;
+            # the rest replay into a fresh delta generation
+            if self._delta is not None:
+                self._delta = self._delta.split_after(mark)
+            self._delta_ver += 1
+            self._delta_merges += 1
+            self._unfreeze_locked()
+            self._publish_pair_locked()
+            stall += _time.perf_counter() - t1
+        self._rebuild_stall_ms += stall * 1000.0
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.observe_stage(
+                "rebuild", (_time.perf_counter() - t_begin) * 1000.0)
 
     def automaton(self) -> tuple:
         """(automaton, id→filter snapshot, epoch) — a consistent
@@ -817,12 +1107,64 @@ class Router:
         """Bring the published snapshot current (call under the
         lock). Dirty check FIRST — that ordering is the invariant
         that discards a broken patcher's partial queue via the
-        rebuild before it could ever be applied."""
+        rebuild before it could ever be applied. A frozen trie
+        (off-lock compaction flatten in flight) defers the rebuild to
+        that compaction's swap — the published pair stays exact
+        meanwhile (delta mode never dirties a live automaton)."""
         if self._dirty or self._auto is None:
-            self._rebuild_locked()
+            if self._freeze is None:
+                self._rebuild_locked()
         elif self._patchers_dirty():
             self._apply_patches_locked()
         return self._published
+
+    # -- published (main, delta) pair (delta mode, docs/DELTA.md) ---------
+
+    def _publish_pair_locked(self) -> None:
+        """Re-publish the (main snapshot, delta snapshot, version,
+        k_boost) tuple matchers read in one reference. Call under the
+        lock after any main swap or (lazily, from the match path)
+        after delta mutations."""
+        if not self._delta_active:
+            self._pub2 = None
+            return
+        main = self._published
+        if main is not None and main[3] != self._cache_rev:
+            # re-stamp the published snapshot's cache revision: in
+            # delta mode a mutation never dirties the main tables, so
+            # the 4-tuple would otherwise keep its flatten-time rev
+            # forever and globally-bumped cache entries (root
+            # wildcards, partitions=1) would probe as FRESH — a stale
+            # serve. The pair published below includes the delta, so
+            # the current rev names exactly what matchers see.
+            main = (main[0], main[1], main[2], self._cache_rev)
+            self._published = main
+        d = self._delta
+        snap = None
+        if d is not None and (d.n_pending or d.tombs):
+            k_cap = max(self.config.active_k, self._k_boost)
+            with self._wt_lock:  # a deferred-build flatten may intern
+                snap = d.snapshot(len(self._id_to_filter), k_cap)
+        self._pub2 = (main, snap, self._delta_ver,
+                      self._k_boost)
+
+    def _snapshot_pair(self):
+        """Consistent ``((auto, id_map, epoch, rev), delta_snap)``
+        for the two-probe match path. Fast path is one reference
+        read; the lock is taken only to refresh a stale delta
+        snapshot (small apply/flatten — milliseconds) or to build the
+        first automaton."""
+        pair = self._pub2
+        if pair is not None and not self._dirty \
+                and pair[0] is self._published \
+                and pair[2] == self._delta_ver \
+                and pair[3] == self._k_boost:
+            return pair[0], pair[1]
+        with self._lock:
+            self._sync_locked()
+            self._publish_pair_locked()
+            pair = self._pub2
+            return pair[0], pair[1]
 
     # -- matching (emqx_router:match_routes/1) ----------------------------
 
@@ -837,7 +1179,7 @@ class Router:
     def host_match(self, topic: str) -> List[str]:
         """Host-side exact match (the oracle fallback path)."""
         with self._lock:
-            return self._t_match(topic)
+            return self._host_match_locked(topic)
 
     def use_device_now(self) -> bool:
         """The host/device matching policy for the product publish
@@ -878,10 +1220,20 @@ class Router:
             if self._auto is None or len(self._pending_free) <= \
                     self.config.host_reclaim_pending:
                 return
+            if self._freeze is not None:
+                # an off-lock compaction flatten is mid-flight; its
+                # swap will recycle the quarantine anyway
+                return
             self._auto = None
             self._published = None
             self._patcher = None
             self._shard_patchers = []
+            # the delta's pending adds/deletes are all in the trie
+            # (mutations apply immediately outside a freeze), so the
+            # next flatten re-derives them — drop the side structures
+            self._delta = None
+            self._delta_ver += 1
+            self._pub2 = None
             self._dirty = True  # next device use must re-flatten
             self._free_ids.extend(self._pending_free)
             self._pending_free.clear()
@@ -905,7 +1257,12 @@ class Router:
         cache = self._match_cache()
         if cache is not None:
             return self._match_dispatch_cached(topics, cache)
-        auto, id_map, epoch = self.automaton()
+        dsnap = None
+        if self._delta_active:
+            main, dsnap = self._snapshot_pair()
+            auto, id_map, epoch = main[:3]
+        else:
+            auto, id_map, epoch = self.automaton()
         bucket = cfg.min_batch
         while bucket < len(topics):
             bucket *= 2
@@ -921,7 +1278,17 @@ class Router:
         res = match_batch(auto, ids, n, sysm, k=self.effective_k(),
                           m=cfg.max_matches, pack_ids=False,
                           **self._walk_kw(ids.shape[1]))
-        return res.ids, res.overflow, id_map, epoch
+        out_ids, out_ovf = res.ids, res.overflow
+        if dsnap is not None:
+            # two-probe: union the side-automaton's raw emits +
+            # tombstone-mask deleted fids (ops/delta.py)
+            from emqx_tpu.ops.delta import probe_raw
+
+            self._delta_probes += 1
+            out_ids, out_ovf = probe_raw(dsnap, ids, n, sysm,
+                                         out_ids, out_ovf,
+                                         m=cfg.max_matches)
+        return out_ids, out_ovf, id_map, epoch
 
     # -- publish match cache (ops/match_cache.py) -------------------------
 
@@ -958,7 +1325,12 @@ class Router:
         # snapshot the per-topic keys index into
         part_snap = (tuple(self._part_revs)
                      if cfg.cache_partitions > 1 else None)
-        auto, id_map, epoch, rev = self.snapshot_cached()
+        dsnap = None
+        if self._delta_active:
+            main, dsnap = self._snapshot_pair()
+            auto, id_map, epoch, rev = main
+        else:
+            auto, id_map, epoch, rev = self.snapshot_cached()
         key = (epoch, rev, k_boost)
         keys = None
         if part_snap is not None:
@@ -989,6 +1361,17 @@ class Router:
                               pack_ids=True,
                               **self._walk_kw(ids.shape[1]))
             miss_rows, miss_ovf = res.ids, res.overflow
+            if dsnap is not None:
+                # two-probe: fold the side-automaton + tombstone mask
+                # into the rows the cache stores — a later delta
+                # mutation bumps the partition/global revision, so
+                # these merged rows can never be served stale
+                from emqx_tpu.ops.delta import probe_packed
+
+                self._delta_probes += 1
+                miss_rows, miss_ovf = probe_packed(
+                    dsnap, ids, n, sysm, miss_rows, miss_ovf,
+                    m=cfg.max_matches)
             cache.insert(probe, miss_rows, miss_ovf)
         t2 = time.perf_counter() if timed else 0.0
         ids_dev, ovf_dev, _movf = cache.merge(bucket, probe,
@@ -1114,6 +1497,51 @@ class Router:
             if pool and not self._dirty and not self._compacting \
                     and self._needs_compaction_locked():
                 self._schedule_compaction()
+
+    def set_delta(self, enabled: bool) -> None:
+        """Flip delta mode at runtime with a clean transition (bench
+        A/B on one router/filter set): wait out any in-flight
+        background compaction, then one synchronous rebuild folds
+        whatever the outgoing mode had pending (the trie always has
+        everything) and re-publishes under the new mode."""
+        while self._compacting:
+            time.sleep(0.005)
+        with self._lock:
+            self.config.delta = bool(enabled)
+            if self._auto is not None and self._freeze is None:
+                self._rebuild_locked()
+            else:
+                self._publish_pair_locked()
+
+    def drain_automaton_stats(self) -> Dict[str, int]:
+        """Delta/rebuild counter deltas since the last drain — folded
+        into Metrics by the stats flush under the ``automaton.``
+        prefix (docs/OBSERVABILITY.md)."""
+        cur = (self._delta_probes, self._delta_filters,
+               self._delta_merges, int(self._rebuild_stall_ms))
+        prev = self._auto_drained
+        self._auto_drained = cur
+        return {
+            "delta.probes": cur[0] - prev[0],
+            "delta.filters": cur[1] - prev[1],
+            "delta.merges": cur[2] - prev[2],
+            "rebuild.stall_ms": cur[3] - prev[3],
+        }
+
+    def delta_info(self) -> Dict[str, object]:
+        """Live delta-automaton state for `ctl cache` / bench
+        introspection (cumulative counters, not deltas)."""
+        d = self._delta
+        return {
+            "active": self._delta_active,
+            "pending": d.n_pending if d is not None else 0,
+            "tombstones": d.n_tombstones if d is not None else 0,
+            "probes": self._delta_probes,
+            "filters": self._delta_filters,
+            "merges": self._delta_merges,
+            "rebuild_stall_ms": round(self._rebuild_stall_ms, 3),
+            "rebuild_inflight": self._rebuild_inflight,
+        }
 
     def match_ids(self, topics: Sequence[str]):
         """Device match of a topic batch in snapshot-id space.
@@ -1369,7 +1797,7 @@ class Router:
             return []
         if not self.use_device_now():
             with self._lock:
-                return [self._t_match(t) for t in topics]
+                return [self._host_match_locked(t) for t in topics]
         _, mid, ovf, id_map, _ = self.match_ids(topics)
         out: List[List[str]] = []
         for i in range(len(topics)):

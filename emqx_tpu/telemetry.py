@@ -82,17 +82,28 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
 log = logging.getLogger("emqx_tpu.telemetry")
 
+#: guards direct (cross-thread) stage observes — see
+#: :meth:`Telemetry.observe_stage`; span folds stay lock-free
+#: (single-writer on the event loop)
+_observe_lock = threading.Lock()
+
 #: the publish pipeline's stage names, in pipeline order (ctl and the
-#: $SYS heartbeat render in this order; Prometheus sorts its own)
+#: $SYS heartbeat render in this order; Prometheus sorts its own).
+#: ``rebuild`` is the one non-span stage: automaton compaction /
+#: re-flatten durations (inline and background), observed directly
+#: via :meth:`Telemetry.observe_stage` — it shares the histogram
+#: surfaces so a churn-driven rebuild storm shows up next to the
+#: publish latencies it would otherwise silently explain
 STAGES = ("match", "cache_gather", "pack", "fetch", "dispatch_plan",
           "serialize", "host_fallback", "dispatch", "xloop",
-          "end_to_end")
+          "rebuild", "end_to_end")
 
 #: fixed log-spaced bucket upper bounds, milliseconds (1-2.5-5 per
 #: decade, 10µs..5s). Fixed — not adaptive — so scrapes from
@@ -340,6 +351,19 @@ class Telemetry:
                 message=(f"publish end-to-end latency over "
                          f"{self.config.slow_threshold_ms}ms for "
                          f"{self._slow_streak} consecutive batches"))
+
+    def observe_stage(self, stage: str, ms: float) -> None:
+        """Record one direct (non-span) stage sample — the rebuild
+        histogram's entry point. Unlike span folds this may be called
+        from the background compaction thread, so it takes a small
+        lock (rebuilds are rare and ms-scale; the cost is noise)."""
+        if not self.config.enabled:
+            return
+        h = self.hists.get(stage)
+        if h is None:
+            return
+        with _observe_lock:
+            h.observe(ms)
 
     # -- read surfaces ----------------------------------------------------
 

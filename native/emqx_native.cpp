@@ -130,6 +130,12 @@ struct Trie {
     std::vector<int32_t> free_nodes;  // pruned slots for reuse
     int32_t plus_id;         // interned ids of "+" and "#"
     int32_t hash_id;
+    // live literal-edge count, maintained incrementally on
+    // insert/prune so trie_counts is O(1) instead of a full DFS —
+    // the off-lock compaction flatten pays counts+flatten back to
+    // back, and at 1M filters the DFS prepass was a visible slice
+    // of the rebuild (docs/DELTA.md)
+    int64_t live_edges = 0;
     std::unordered_map<std::string, int32_t> filter_refs;
 
     explicit Trie(WordTable* w) : wt(w) {
@@ -204,6 +210,7 @@ int32_t trie_insert(Trie* t, const char* filter, int32_t len,
             if (e == t->nodes[node].lits.end()) {
                 child = t->alloc_node();
                 t->nodes[node].lits.emplace(w, child);
+                t->live_edges++;
             } else {
                 child = e->second;
             }
@@ -252,10 +259,12 @@ int32_t trie_delete(Trie* t, const char* filter, int32_t len) {
         int32_t child = (w == t->plus_id) ? t->nodes[parent].plus
                                           : t->nodes[parent].lits[w];
         if (t->nodes[child].refcount > 0) break;
-        if (w == t->plus_id)
+        if (w == t->plus_id) {
             t->nodes[parent].plus = -1;
-        else
+        } else {
             t->nodes[parent].lits.erase(w);
+            t->live_edges--;
+        }
         t->release_node(child);
     }
     return 1;
@@ -283,7 +292,18 @@ static void count_live(Trie* t, int32_t ni, int64_t& states,
     }
 }
 
+// O(1): every allocated-and-not-released node is live (the delete
+// prune releases the whole refcount-0 suffix and erases its parent
+// edges), so the DFS reduces to arithmetic over maintained counters
 void trie_counts(Trie* t, int64_t* out_states, int64_t* out_edges) {
+    *out_states = (int64_t)t->nodes.size()
+                  - (int64_t)t->free_nodes.size();
+    *out_edges = t->live_edges;
+}
+
+// the old DFS, kept as the parity oracle for the O(1) counters
+// (tests/test_native.py cross-checks after randomized churn)
+void trie_counts_scan(Trie* t, int64_t* out_states, int64_t* out_edges) {
     int64_t s = 0, e = 0;
     count_live(t, 0, s, e);
     *out_states = s;

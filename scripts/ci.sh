@@ -33,6 +33,25 @@ echo "== partitioned-epoch churn parity (docs/MATCH_CACHE.md) =="
 # stale-serve here is a delivery-correctness bug, fail fast
 python -m pytest tests/test_cache_partition.py -q
 
+echo "== delta-automaton parity + off-lock compaction (docs/DELTA.md) =="
+# delta-on vs delta-off exact-match parity under randomized churn,
+# bounded route-op latency while a background flatten is in flight,
+# and the delta=false legacy pin — a divergence here is a
+# match-correctness bug, fail fast
+python -m pytest tests/test_delta.py -q
+
+echo "== flap-storm guard (flapping.py + scenario smoke) =="
+python -m pytest tests/test_flapping.py -q
+# the BENCH_MODE=flapstorm scenario end-to-end at toy scale: a
+# reconnect storm + crash-looping flappers + cm takeovers must run
+# to completion and emit its JSON row (numbers are not gated here —
+# the driver's real-scale run is)
+BENCH_MODE=flapstorm BENCH_SUBS=1500 BENCH_BATCH=32 FLAP_SECONDS=2 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='flapstorm_match_p99_ms' and rec['value'] is not None, rec"
+
 echo "== dispatch planner parity (docs/DISPATCH.md) =="
 # planner-on vs legacy per-delivery tail: delivery counts, wire
 # bytes, metric deltas must be identical — a divergence here is a

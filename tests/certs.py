@@ -12,6 +12,14 @@ import datetime
 import ipaddress
 import os
 
+import pytest
+
+# optional dependency: importing this helper from a suite without
+# cryptography installed must SKIP that suite at collection, not
+# error it out of the report (tier-1 hygiene — a collection error
+# here masked real regressions in the importing modules)
+pytest.importorskip("cryptography")
+
 from cryptography import x509
 from cryptography.hazmat.primitives import hashes, serialization
 from cryptography.hazmat.primitives.asymmetric import ec

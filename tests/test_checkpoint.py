@@ -30,7 +30,10 @@ def _fill(r):
 
 
 def test_roundtrip_with_tables(tmp_path):
-    r1 = _mk()
+    # the table snapshot is the patch-mode mirror (delta mode keeps
+    # none and saves routes-only — covered below); restoring into a
+    # DELTA-mode router must still install the saved tables
+    r1 = _mk(delta=False)
     _fill(r1)
     path = str(tmp_path / "ckpt.npz")
     info = checkpoint.save(r1, path)
@@ -204,3 +207,26 @@ def test_unknown_format_rejected(tmp_path):
              routes=np.frombuffer(b"[]", dtype=np.uint8))
     with pytest.raises(ValueError):
         checkpoint.load(_mk(), str(tmp_path / "future.npz"))
+
+
+def test_delta_mode_saves_routes_only_and_roundtrips(tmp_path):
+    """Delta mode keeps no main-table mirror, so its snapshot is the
+    route log alone — restore replays it and re-flattens on first
+    match, with exact results (the v1 degradation contract)."""
+    r1 = _mk()  # delta on by default
+    _fill(r1)
+    r1.add_route("delta/pending")  # a live pending add rides the log
+    path = str(tmp_path / "ckpt.npz")
+    info = checkpoint.save(r1, path)
+    assert info["routes"] >= 7 and not info["tables"]
+
+    r2 = _mk()
+    out = checkpoint.load(r2, path)
+    assert not out["tables_restored"]
+    for topic, want in [
+        ("a/b", {"a/b", "a/+"}),
+        ("x/any/depth", {"x/#"}),
+        ("delta/pending", {"delta/pending"}),
+        ("gone/soon", set()),
+    ]:
+        assert set(r2.match_filters([topic])[0]) == want, topic

@@ -10,7 +10,6 @@ from emqx_tpu.config import (ConfigError, boot_from_file, build_node,
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.packet import Connack
 
-from certs import generate_cert_chain
 from mqtt_client import TestClient
 
 
@@ -78,6 +77,10 @@ def test_boot_node_from_file(tmp_path):
     """Integration: node boots from a config file; the listener's
     zone settings bite (max_packet_size rejects an oversized
     publish); a TLS listener comes up from file settings."""
+    # cert generation needs the optional cryptography package; only
+    # this test skips without it — the rest of the config suite runs
+    from certs import generate_cert_chain
+
     certs = generate_cert_chain(str(tmp_path))
     path = _write(tmp_path, f"""
 [node]

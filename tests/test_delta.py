@@ -1,0 +1,376 @@
+"""Online delta automaton + off-lock compaction (ISSUE 7,
+docs/DELTA.md): exact-match parity between delta-on and delta-off
+under randomized interleaved churn (wildcards, tombstones, re-adds,
+overflow topics, $share roots, the 1×1 mesh path), bounded route-op
+latency while a compaction flatten is in flight with exact post-swap
+parity, the ``[matcher] delta = false`` legacy pin, the runtime A/B
+flip, and the new observability surfaces."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.router import MatcherConfig, Router
+
+
+def _mk(**kw):
+    kw.setdefault("device_min_filters", 0)
+    return Router(MatcherConfig(**kw), node="node1")
+
+
+def _assert_parity(r, oracle, topics, tag=""):
+    got = r.match_filters(topics)
+    for t, row in zip(topics, got):
+        assert sorted(row) == sorted(oracle.match(t)), (tag, t)
+
+
+# -- two-probe parity -------------------------------------------------------
+
+
+def test_delta_pending_adds_match_immediately():
+    r = _mk(match_cache=False)
+    for i in range(40):
+        r.add_route(f"base/{i}/x")
+    r.match_filters(["base/0/x"])  # flatten → delta mode armed
+    assert r._patcher is None  # delta mode keeps no main mirror
+    r.add_route("fresh/topic")
+    r.add_route("fresh/+/deep")
+    r.add_route("wild/#")
+    assert r.match_filters(["fresh/topic"]) == [["fresh/topic"]]
+    assert r.match_filters(["fresh/a/deep"]) == [["fresh/+/deep"]]
+    assert sorted(r.match_filters(["wild/x/y"])[0]) == ["wild/#"]
+    # the main automaton was never touched
+    assert r.stats()["rebuilds"] == 1
+    assert r.delta_info()["pending"] == 3
+
+
+def test_delta_tombstone_masks_deleted_fid():
+    r = _mk(match_cache=False)
+    for i in range(40):
+        r.add_route(f"t/{i}/x")
+    r.match_filters(["t/0/x"])
+    r.delete_route("t/3/x")       # main-table fid → tombstone
+    assert r.match_filters(["t/3/x"]) == [[]]
+    assert r.delta_info()["tombstones"] == 1
+    # re-add under a fresh fid: delta add wins over the tombstone
+    r.add_route("t/3/x")
+    assert r.match_filters(["t/3/x"]) == [["t/3/x"]]
+    # delete of a PENDING add retracts it without a tombstone
+    r.add_route("gone/soon")
+    r.delete_route("gone/soon")
+    assert r.match_filters(["gone/soon"]) == [[]]
+
+
+@pytest.mark.parametrize("match_cache", [False, True])
+def test_delta_randomized_churn_parity(match_cache):
+    """Acceptance pin: exact-match parity between delta-on and
+    delta-off under randomized interleaved add/delete/match churn,
+    including wildcard filters, '#'-terminals, $share-rooted verbatim
+    filters, re-adds of tombstoned filters, and topics past
+    max_levels (overflow → host fallback)."""
+    rng = random.Random(42)
+    kw = dict(match_cache=match_cache, max_levels=6, active_k=4,
+              delta_max_filters=10_000)  # no mid-test compaction
+    r_on = _mk(delta=True, **kw)
+    r_off = _mk(delta=False, **kw)
+    oracle = TrieOracle()
+    live = {}
+
+    words = ["a", "b", "w1", "w2", "x"]
+
+    def roll_filter():
+        shape = rng.random()
+        if shape < 0.1:
+            return "$share/g1/%s/%s" % (rng.choice(words),
+                                        rng.choice(words))
+        depth = rng.randint(1, 5)
+        ws = [rng.choice(words + ["+"]) for _ in range(depth)]
+        if rng.random() < 0.2:
+            ws[-1] = "#"
+        return "/".join(ws)
+
+    probe = (["a/b", "w1/w2/x", "a/a/a/a/a", "$share/g1/a/b",
+              "b", "zz/unmatched", "a/b/x/w1/w2/a/b/x"]  # >6 levels
+             + ["x/" + "/".join(rng.choice(words) for _ in range(3))
+                for _ in range(4)])
+
+    warm = set()
+    while len(warm) < 60:
+        warm.add(roll_filter())
+    for f in sorted(warm):  # unique: refcounts stay mirrored
+        r_on.add_route(f)
+        r_off.add_route(f)
+        oracle.insert(f)
+        live[f] = True
+    # both flattened before churn begins
+    r_on.match_filters(probe[:2])
+    r_off.match_filters(probe[:2])
+
+    for step in range(150):
+        if live and rng.random() < 0.45:
+            f = rng.choice(list(live))
+            r_on.delete_route(f)
+            r_off.delete_route(f)
+            oracle.delete(f)
+            del live[f]
+        else:
+            f = roll_filter()
+            if f not in live:
+                r_on.add_route(f)
+                r_off.add_route(f)
+                oracle.insert(f)
+                live[f] = True
+        if step % 15 == 0:
+            _assert_parity(r_on, oracle, probe, tag=f"on@{step}")
+            _assert_parity(r_off, oracle, probe, tag=f"off@{step}")
+            on_rows = r_on.match_filters(probe)
+            off_rows = r_off.match_filters(probe)
+            for t, a, b in zip(probe, on_rows, off_rows):
+                assert sorted(a) == sorted(b), (step, t)
+    # fold the delta and re-check: the compacted tables must agree
+    r_on.rebuild()
+    _assert_parity(r_on, oracle, probe, tag="post-fold")
+
+
+def test_delta_on_1x1_mesh_parity():
+    """The 1×1 mesh path: delta is inactive on a mesh by design
+    (the collective step has no two-probe seam), so delta-on must be
+    indistinguishable from delta-off there — both run per-shard
+    patch-in-place."""
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    oracle = TrieOracle()
+    routers = [
+        _mk(mesh=make_mesh(1, 1), delta=True),
+        _mk(mesh=make_mesh(1, 1), delta=False),
+    ]
+    assert not routers[0]._delta_active
+    rng = random.Random(3)
+    live = {}
+    probe = ["a/b", "a/x/c", "zz"]
+    for step in range(40):
+        if live and rng.random() < 0.4:
+            f = rng.choice(list(live))
+            for r in routers:
+                r.delete_route(f)
+            oracle.delete(f)
+            del live[f]
+        else:
+            depth = rng.randint(1, 3)
+            f = "/".join(rng.choice(["a", "b", "c", "+", "x"])
+                         for _ in range(depth))
+            if f not in live:
+                for r in routers:
+                    r.add_route(f)
+                oracle.insert(f)
+                live[f] = True
+        if step % 10 == 0:
+            for r in routers:
+                _assert_parity(r, oracle, probe, tag=f"mesh@{step}")
+
+
+# -- off-lock compaction ----------------------------------------------------
+
+
+def test_offlock_compaction_bounded_mutation_latency():
+    """Acceptance pin: a route add/delete issued while a compaction
+    flatten is in flight completes in milliseconds (no full-flatten
+    lock hold), and the post-swap automaton is exactly right."""
+    r = _mk(match_cache=False, delta_max_filters=32)
+    oracle = TrieOracle()
+    for i in range(300):
+        f = f"seed/{i}/leaf"
+        r.add_route(f)
+        oracle.insert(f)
+    r.match_filters(["seed/0/leaf"])
+
+    orig = r._flatten_main
+    started = threading.Event()
+
+    def slow_flatten(cap, nb):
+        started.set()
+        time.sleep(0.8)  # a 10M-sub flatten, compressed in time
+        return orig(cap, nb)
+
+    r._flatten_main = slow_flatten
+    # cross delta_max_filters → background compaction kicks off
+    for i in range(33):
+        f = f"burst/{i}/x"
+        r.add_route(f)
+        oracle.insert(f)
+    assert started.wait(5), "compaction never started"
+    lat = []
+    for i in range(40):
+        t0 = time.perf_counter()
+        f = f"during/{i}/y"
+        r.add_route(f)
+        oracle.insert(f)
+        lat.append(time.perf_counter() - t0)
+        if i % 2 == 0:
+            g = f"during/{i}/y"
+            r.delete_route(g)
+            oracle.delete(g)
+            lat.append(0.0)
+    p99 = sorted(lat)[-1] * 1000.0
+    assert p99 < 100.0, f"route op stalled {p99:.1f}ms on the flatten"
+    assert r._compacting, "flatten should still be in flight"
+    # matching DURING the flatten is exact (old main + live delta)
+    probe = ["seed/5/leaf", "burst/3/x", "during/1/y", "during/2/y"]
+    _assert_parity(r, oracle, probe, tag="during")
+    # host oracle fallback during the freeze is exact too
+    assert sorted(r.host_match("during/3/y")) == \
+        sorted(oracle.match("during/3/y"))
+    for _ in range(400):
+        if not r._compacting:
+            break
+        time.sleep(0.02)
+    assert not r._compacting
+    info = r.delta_info()
+    assert info["merges"] >= 1
+    # post-swap exact parity: the folded tables + fresh delta agree
+    _assert_parity(r, oracle, probe + ["zz/none"], tag="post-swap")
+    # the lock was held for ms, not the flatten's 800ms
+    assert info["rebuild_stall_ms"] < 400
+
+
+def test_offlock_compaction_delete_during_flatten():
+    """Deletes landing mid-flatten tombstone against the NEW tables
+    (their paths were in the frozen snapshot) — the log split must
+    carry them across the swap."""
+    r = _mk(match_cache=False, delta_max_filters=8)
+    for i in range(50):
+        r.add_route(f"s/{i}/x")
+    r.match_filters(["s/0/x"])
+    orig = r._flatten_main
+    gate = threading.Event()
+
+    def gated(cap, nb):
+        gate.wait(5)
+        return orig(cap, nb)
+
+    r._flatten_main = gated
+    for i in range(9):
+        r.add_route(f"b/{i}/y")   # trigger compaction (blocked)
+    time.sleep(0.05)
+    assert r._compacting
+    # mid-flatten churn: delete a seed filter AND a burst filter
+    r.delete_route("s/7/x")
+    r.delete_route("b/2/y")
+    r.add_route("mid/flight")
+    gate.set()
+    for _ in range(400):
+        if not r._compacting:
+            break
+        time.sleep(0.02)
+    assert r.match_filters(["s/7/x", "b/2/y", "mid/flight", "b/3/y"]) \
+        == [[], [], ["mid/flight"], ["b/3/y"]]
+
+
+# -- delta-off pin / runtime flip ------------------------------------------
+
+
+def test_delta_off_restores_patch_in_place():
+    """``delta = false`` restores the patch-in-place path: mutations
+    go through the AutoPatcher mirror (patches counter moves, a main
+    mirror exists) and no delta structures ever materialize."""
+    r = _mk(delta=False, match_cache=False)
+    for i in range(20):
+        r.add_route(f"a/{i}")
+    r.match_filters(["a/0"])
+    assert r._patcher is not None
+    base = r.stats()["patches"]
+    r.add_route("churn/x")
+    r.delete_route("a/3")
+    assert r.stats()["patches"] >= base + 2
+    assert r._delta is None
+    assert r.delta_info()["active"] is False
+    assert r.match_filters(["churn/x", "a/3"]) == [["churn/x"], []]
+
+
+def test_set_delta_runtime_flip_is_equivalent():
+    """The bench A/B seam: flipping delta on/off at runtime folds
+    pending state via one rebuild and produces identical match
+    arrays on the same router/filter set."""
+    r = _mk(match_cache=False)
+    for i in range(30):
+        r.add_route(f"f/{i}/x")
+    r.match_filters(["f/0/x"])
+    r.add_route("pending/delta")     # lives in the delta
+    topics = ["f/3/x", "pending/delta", "nope"]
+    before = r.match_filters(topics)
+    r.set_delta(False)
+    assert r._patcher is not None    # legacy mirror re-armed
+    assert r.match_filters(topics) == before
+    r.add_route("legacy/added")
+    r.set_delta(True)
+    assert r._patcher is None
+    assert r.match_filters(topics + ["legacy/added"]) \
+        == before + [["legacy/added"]]
+
+
+# -- config / observability -------------------------------------------------
+
+
+def test_delta_config_validation(tmp_path):
+    from emqx_tpu.config import ConfigError, load_config
+
+    def parse(text):
+        p = tmp_path / "cfg.toml"
+        p.write_text(text)
+        return load_config(str(p))
+
+    with pytest.raises(ValueError):
+        Router(MatcherConfig(delta_max_filters=0))
+    cfg = parse("[matcher]\ndelta = false\ndelta_max_filters = 128\n")
+    assert cfg.matcher.delta is False
+    assert cfg.matcher.delta_max_filters == 128
+    with pytest.raises(ConfigError):
+        parse("[matcher]\ndelta = 1\n")
+
+
+def test_delta_counters_drain_and_fold():
+    from emqx_tpu.metrics import Metrics
+
+    r = _mk(match_cache=False, delta_max_filters=8)
+    for i in range(40):
+        r.add_route(f"c/{i}/x")
+    r.match_filters(["c/0/x"])
+    r.add_route("d/new")
+    r.match_filters(["d/new"])
+    for i in range(9):
+        r.add_route(f"e/{i}/y")  # crosses the bound → compaction
+    for _ in range(400):
+        if not r._compacting and r.delta_info()["merges"] >= 1:
+            break
+        time.sleep(0.02)
+    drained = r.drain_automaton_stats()
+    assert drained["delta.filters"] >= 10
+    assert drained["delta.probes"] >= 1
+    assert drained["delta.merges"] >= 1
+    m = Metrics()
+    m.fold_automaton_stats(drained)
+    assert m.all()["automaton.delta.filters"] == drained["delta.filters"]
+    # second drain is deltas-only
+    assert r.drain_automaton_stats()["delta.merges"] == 0
+
+
+def test_rebuild_stage_histogram_records_compaction():
+    from emqx_tpu.telemetry import Telemetry
+
+    tel = Telemetry()
+    r = _mk(match_cache=False, delta_max_filters=4)
+    r.telemetry = tel
+    for i in range(20):
+        r.add_route(f"h/{i}")
+    r.match_filters(["h/0"])
+    for i in range(5):
+        r.add_route(f"hh/{i}")
+    for _ in range(400):
+        if not r._compacting and r.delta_info()["merges"] >= 1:
+            break
+        time.sleep(0.02)
+    assert tel.hists["rebuild"].count >= 1
+    assert "rebuild" in tel.stage_stats()

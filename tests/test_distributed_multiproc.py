@@ -19,6 +19,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -183,6 +185,12 @@ def test_two_process_distributed_publish_parity():
             sig in out for out in outs for sig in _PORT_RACE_SIGNS)
         if not retryable:
             break  # a real failure: surface it immediately
+    if any("Multiprocess computations aren't implemented" in out
+           for out in outs):
+        # capability gap, not a regression: this jax build's CPU
+        # backend has no multi-process collectives at all, so the
+        # two-host world cannot form regardless of our code
+        pytest.skip("jax CPU backend lacks multiprocess computations")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"WORKER {pid} PARITY OK" in out, out[-3000:]

@@ -9,6 +9,12 @@
   - base62: roundtrip over arbitrary ints (prop_emqx_base62).
 """
 
+import pytest
+
+# optional dependency: skip the property tier cleanly where
+# hypothesis isn't installed (tier-1 hygiene)
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from emqx_tpu import topic as T

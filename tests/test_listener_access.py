@@ -9,7 +9,6 @@ import pytest
 
 from emqx_tpu.connection import check_access, parse_access_rules
 from emqx_tpu.node import Node
-from tests.certs import generate_cert_chain
 from tests.mqtt_client import TestClient
 
 
@@ -83,6 +82,9 @@ async def test_peer_cert_as_username(tmp_path):
     carries no username, yet the channel's username (and ACL/ban
     identity) is the client cert's CN."""
     from emqx_tpu.tls import TlsOptions, make_client_context
+
+    # optional cryptography dep: only this cert-backed test skips
+    from tests.certs import generate_cert_chain
 
     certs = generate_cert_chain(str(tmp_path))
     n = Node(boot_listeners=False)

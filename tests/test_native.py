@@ -173,3 +173,31 @@ def test_native_flatten_capacity_growth():
     a2 = eng.flatten(state_capacity=a1.row_ptr.shape[0] - 1,
                      edge_capacity=a1.edge_word.shape[0])
     assert a2.n_states >= a1.n_states
+
+
+def test_native_o1_counts_match_dfs_oracle():
+    """trie_counts is now O(1) incremental arithmetic (live-node and
+    live-edge counters maintained on insert/delete-prune); the old
+    DFS stays exported as the oracle — they must agree after any
+    randomized churn, since every flatten sizes its capacities from
+    these numbers."""
+    rng = random.Random(11)
+    eng = native.NativeEngine()
+    live = {}
+    for step in range(4000):
+        if live and rng.random() < 0.45:
+            f = rng.choice(list(live))
+            eng.delete(f)
+            del live[f]
+        else:
+            f = _random_filter(rng)
+            if f not in live:
+                eng.insert(f, len(live))
+                live[f] = True
+        if step % 500 == 0:
+            assert eng.counts() == eng.counts_scan()
+    assert eng.counts() == eng.counts_scan()
+    # drain everything: back to the root-only trie
+    for f in list(live):
+        eng.delete(f)
+    assert eng.counts() == eng.counts_scan() == (1, 0)

@@ -285,7 +285,11 @@ def test_router_note_match_fallbacks_schedules_rebuild():
 
     from emqx_tpu.router import MatcherConfig, Router
 
-    r = Router(MatcherConfig(device_min_filters=0), node="n")
+    # stale-hop fallback accounting lives on the patch-in-place
+    # path's mirror — pin it with delta off (delta mode never splits,
+    # so the stale-hop regime cannot arise there)
+    r = Router(MatcherConfig(device_min_filters=0, delta=False),
+               node="n")
     r.add_route("a/b")
     r.match_filters(["a/b"])  # first flatten + live patcher
     rebuilds = r.stats()["rebuilds"]

@@ -113,8 +113,9 @@ def test_sys_topic_routing():
 def test_patches_avoid_rebuild():
     """Route churn after the first flatten goes through the patcher:
     new filters match without a full re-flatten (the round-1 verdict's
-    churn-stall item)."""
-    r = _mk()
+    churn-stall item). Pins the patch-in-place path explicitly
+    (``delta=False``; delta mode has its own suite, test_delta.py)."""
+    r = _mk(delta=False)
     for i in range(20):
         r.add_route(f"seed/{i}")
     r.match_routes("seed/1")  # first flatten (pow2-padded capacity)
@@ -142,8 +143,9 @@ def test_patch_delete_tombstones():
 
 def test_patch_overflow_falls_back_to_rebuild():
     """Exceeding the padded capacity mid-churn re-flattens (with
-    doubled capacity) and keeps matching correct."""
-    r = _mk()
+    doubled capacity) and keeps matching correct (patch-in-place
+    path: ``delta=False``)."""
+    r = _mk(delta=False)
     r.add_route("p/0")
     r.match_routes("p/0")
     # way past the min capacity of the first tiny flatten

@@ -16,6 +16,11 @@ Invariants checked after every step:
 import time
 
 import pytest
+
+# optional dependency: skip the model-based tier cleanly where
+# hypothesis isn't installed (tier-1 hygiene)
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from emqx_tpu.session import PUBREL_MARKER, Session, SessionError
