@@ -1055,6 +1055,15 @@ def live():
     _live(emit=_emit)
 
 
+def overload():
+    """BENCH_MODE=overload — the saturation degradation curve
+    (offered load vs delivered msgs/s vs shed fraction) through a
+    live loopback node with the overload monitor armed
+    (emqx_tpu/bench_live.py, docs/ROBUSTNESS.md)."""
+    from emqx_tpu.bench_live import overload_curve
+    overload_curve(emit=_emit)
+
+
 def latency():
     """BENCH_MODE=latency — the small-batch low-latency operating
     point (VERDICT r4 item 4): per-step device latency of the full
@@ -2201,6 +2210,8 @@ _MODES = {
     "latency": ("latency", "latency_8k_p99_ms", "ms"),
     "churn": ("churn", "churn_match_p99_ms", "ms"),
     "flapstorm": ("flapstorm", "flapstorm_match_p99_ms", "ms"),
+    "overload": ("overload", "overload_delivered_msgs_per_s",
+                 "msgs/sec"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
     "mixed": ("main", "publish_match_fanout_throughput", "msgs/sec"),
     "configs": ("configs", "publish_match_fanout_throughput",
@@ -2219,6 +2230,7 @@ _MODE_WORKLOADS = {
     "churn": "delta_automaton_v1",
     "live": "probe_v1",
     "flapstorm": "flapstorm_v1",
+    "overload": "overload_curve_v1",
 }
 
 

@@ -576,5 +576,145 @@ def live(emit=None) -> None:
         print(json.dumps(rec), flush=True)
 
 
+async def _run_overload() -> dict:
+    """BENCH_MODE=overload body — the degradation curve: a loopback
+    node with the overload monitor on tight thresholds, a stepped
+    offered-load sweep, and per-step delivered-rate + shed-fraction
+    accounting (docs/ROBUSTNESS.md). A detached persistent session
+    rides along so warn-level QoS0 mqueue shedding has a queue to
+    bite (live sockets' QoS0 goes straight to the outbox)."""
+    from emqx_tpu.node import Node
+    from emqx_tpu.overload import LEVEL_NAMES, OverloadConfig
+    from emqx_tpu.session import Session
+
+    n_subs = int(os.environ.get("OVERLOAD_SUBS", "4"))
+    step_secs = float(os.environ.get("OVERLOAD_STEP_SECS", "2"))
+    rates = [float(x) for x in os.environ.get(
+        "OVERLOAD_RATES", "500,2000,8000,32000").split(",")]
+
+    node = Node(boot_listeners=False, batch_size=64,
+                overload=OverloadConfig(
+                    interval_s=0.2, queue_warn=1.0,
+                    queue_critical=4.0, clear_ticks=2))
+    node.add_listener(port=0)
+    await node.start()
+    node.ingress.queue_hiwater = 64
+    port = node.listeners[0].port
+    loop = asyncio.get_running_loop()
+    subs = []
+    tasks = []
+    for i in range(n_subs):
+        p = _Peer(f"ovs{i}")
+        await p.connect(port)
+        await p.subscribe("ov/t", 0)
+        tasks.append(loop.create_task(p.recv_loop()))
+        subs.append(p)
+    ghost = Session("ovghost", broker=node.broker, max_mqueue_len=256,
+                    mqueue_store_qos0=True)
+    ghost.connected = False
+    node.broker.subscribe(ghost, "ov/t")
+    pub = _Peer("ovpub")
+    await pub.connect(port)
+    frame = serialize(Publish(topic="ov/t", payload=b"\x00" * 16,
+                              qos=0), C.MQTT_V4)
+    m = node.metrics
+    keys = ("messages.delivered", "delivery.dropped",
+            "overload.shed.qos0", "overload.shed.ingress_timeout",
+            "overload.shed.connect", "messages.dropped")
+    curve = []
+    for rate in rates:
+        base = {k: m.val(k) for k in keys}
+        lvl_peak = node.overload.level
+        sent = 0
+        burst = max(1, int(rate // 100))
+        t0 = time.perf_counter()
+        next_t = t0
+        while time.perf_counter() - t0 < step_secs:
+            for _ in range(burst):
+                pub.writer.write(frame)
+            sent += burst
+            await pub.writer.drain()
+            lvl_peak = max(lvl_peak, node.overload.level)
+            next_t += burst / rate
+            pause = next_t - time.perf_counter()
+            if pause > 0:
+                await asyncio.sleep(pause)
+            else:
+                next_t = time.perf_counter()
+                await asyncio.sleep(0)
+        # settle: the step's counters must include its own backlog
+        ing = node.ingress
+        deadline = time.perf_counter() + 5.0
+        while (ing._pending or ing._inflight) \
+                and time.perf_counter() < deadline:
+            await asyncio.sleep(0.01)
+        wall = time.perf_counter() - t0
+        d = {k: m.val(k) - base[k] for k in keys}
+        delivered = d["messages.delivered"]
+        shed = d["delivery.dropped"] + d["messages.dropped"]
+        curve.append({
+            "offered_msgs_per_s": round(sent / wall, 1),
+            "delivered_msgs_per_s": round(delivered / wall, 1),
+            "deliver_ratio": round(
+                delivered / max(1.0, sent * (n_subs + 1)), 4),
+            "shed_fraction": round(
+                shed / max(1, delivered + shed), 4),
+            "shed_qos0": d["overload.shed.qos0"],
+            "level_peak": LEVEL_NAMES[lvl_peak],
+        })
+        lvl_peak = max(lvl_peak, node.overload.level)
+    for t in tasks:
+        t.cancel()
+    pub.close()
+    for p in subs:
+        p.close()
+    await node.stop()
+    return {
+        "mode": "overload", "subs": n_subs,
+        "ghost_mqueue": 256, "step_secs": step_secs,
+        "hiwater": 64, "curve": curve,
+        "transitions": m.val("overload.transitions"),
+    }
+
+
+def overload_curve(emit=None) -> None:
+    """BENCH_MODE=overload — offered load vs delivered msgs/s vs shed
+    fraction, one JSON row with the whole curve (scripts/ci.sh gates
+    a toy-scale run of this as the overload smoke)."""
+    import sys
+
+    from emqx_tpu.profiling import enable_compile_cache
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    enable_compile_cache()
+    info = asyncio.run(_run_overload())
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    curve = info["curve"]
+    peak = max(c["delivered_msgs_per_s"] for c in curve)
+    last = curve[-1]
+    rec = {
+        "metric": "overload_delivered_msgs_per_s",
+        "workload": "overload_curve_v1",
+        "value": peak,
+        "unit": "msgs/sec",
+        # retention at the top offered step: delivered there vs the
+        # curve's peak — 1.0 means saturation degrades gracefully
+        # (shedding + backpressure, no collapse)
+        "vs_baseline": round(
+            last["delivered_msgs_per_s"] / max(peak, 1.0), 3),
+        "curve": curve,
+        "shed_fraction_peak": max(c["shed_fraction"] for c in curve),
+        "level_peak": curve[-1]["level_peak"],
+        "overload_transitions": info["transitions"],
+    }
+    if emit is not None:
+        emit(rec)
+    else:
+        print(json.dumps(rec), flush=True)
+
+
 if __name__ == "__main__":
     live()

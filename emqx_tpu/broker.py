@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from emqx_tpu import faults
 from emqx_tpu import topic as T
 from emqx_tpu.broker_helper import FanoutManager, unpack_sids
 from emqx_tpu.hooks import Hooks
@@ -200,6 +201,14 @@ class Broker:
         # publish-path telemetry (telemetry.Telemetry), wired by Node
         # next to router.telemetry; None = uninstrumented
         self.telemetry = None
+        # overload protection (overload.py), wired by Node when
+        # [overload] enabled: the monitor (channel consults it at
+        # CONNECT, sessions at QoS0 enqueue), the device-path circuit
+        # breaker (publish begin/fetch), and the alarm manager.
+        # All None = byte-for-byte the pre-overload build
+        self.overload = None
+        self.breaker = None
+        self.alarms = None
         # multi-loop front door (loops.LoopGroup), set by Node.start
         # when [node] loops > 1; None = single-loop, every multi-loop
         # branch below is skipped entirely
@@ -337,7 +346,13 @@ class Broker:
                 try:
                     lg.post(0, lambda: self.publish_batch([msg]))
                 except RuntimeError:
-                    return 0  # home loop gone (shutdown race)
+                    # home loop gone (shutdown race / dead loop):
+                    # this publish is LOST — count it instead of
+                    # vanishing silently (docs/ROBUSTNESS.md)
+                    self.metrics.inc("delivery.xloop.orphaned")
+                    log.warning("publish of %r dropped: home loop "
+                                "gone", msg.topic)
+                    return 0
             return 0
         return self.publish_batch([msg])[0]
 
@@ -412,16 +427,45 @@ class Broker:
             # hysteresis — an oscillating filter count must not pay a
             # re-flatten per threshold crossing)
             self.router.reclaim_host_regime()
-            if sp is not None:
-                sp.path = "host"
-            if defer_host:
-                pb.host_topics = topics
-            else:
-                self._publish_host(pb, topics)
-                pb.done = True
-                self._span_finish(pb)
-            return pb
+            return self._begin_host(pb, topics, defer_host)
+        br = self.breaker
+        if br is not None and not br.allow_device():
+            # device-path circuit breaker OPEN: exact host-oracle
+            # matching until a half-open probe closes it
+            # (docs/ROBUSTNESS.md). The automaton is NOT reclaimed —
+            # the probe rides it straight back
+            self.metrics.inc("breaker.fallback.batches")
+            return self._begin_host(pb, topics, defer_host)
+        try:
+            return self._begin_device(pb, topics, cfg)
+        except Exception:
+            if br is None:
+                raise
+            # device dispatch died (kernel failure, injected fault):
+            # record for the breaker and serve THIS batch exactly
+            # from the host oracle — no wrong or lost deliveries
+            br.record_failure()
+            log.exception("device publish dispatch failed — "
+                          "host-oracle fallback for this batch")
+            return self._begin_host(pb, topics, defer_host)
 
+    def _begin_host(self, pb: PendingBatch, topics: List[str],
+                    defer_host: bool) -> PendingBatch:
+        """The host-path tail of ``publish_begin`` (true host regime,
+        breaker-forced fallback, or a device dispatch failure)."""
+        sp = pb.span
+        if sp is not None:
+            sp.path = "host"
+        if defer_host:
+            pb.host_topics = topics
+        else:
+            self._publish_host(pb, topics)
+            pb.done = True
+            self._span_finish(pb)
+        return pb
+
+    def _begin_device(self, pb: PendingBatch, topics: List[str],
+                      cfg) -> PendingBatch:
         # device match (HOT LOOP 1) → device fan-out (HOT LOOP 2)
         # → pack (transfer compaction); all async-dispatched.
         # Duplicate topics in the batch (hot topics arrive many times
@@ -433,6 +477,9 @@ class Broker:
         # and misses (walked, then inserted) — transparent here, the
         # merged [B_pad, M] id array feeds the same fan-out/pack
         # kernels either way.
+        sp = pb.span
+        if faults.enabled:
+            faults.fire("device.walk")
         uniq, pb.inv = dedup_topics(topics)
         pb.n_uniq = len(uniq)
         if sp is not None:
@@ -571,13 +618,42 @@ class Broker:
 
         Touches no broker state (except monotonically raising the
         learned pack budgets): safe to run on an executor thread
-        while the event loop keeps serving sockets. On packed-budget
-        overflow re-packs with the next power-of-two bucket (the
-        dispatched dense arrays are still live on device) and
-        remembers the grown budget for the bucket, so a steady-state
-        workload re-packs once, not per batch."""
+        while the event loop keeps serving sockets. With a breaker
+        attached a failed (or, past ``breaker_slow_ms``, stalled)
+        transfer is recorded and the batch converts to the exact
+        host-oracle path — results stay correct, the breaker decides
+        whether the NEXT batch rides the device."""
         if pb.done or pb.host_topics is not None:
             return
+        br = self.breaker
+        if br is None:
+            self._fetch_device(pb)
+            return
+        t0 = time.perf_counter()
+        try:
+            self._fetch_device(pb)
+        except Exception:
+            br.record_failure()
+            log.exception("device fetch failed — host-oracle "
+                          "fallback for this batch")
+            # convert the batch to the deferred-host shape: finish
+            # re-matches every live topic on the host trie (exact),
+            # so nothing is delivered wrong or lost
+            pb.plan = None
+            pb.xgroups = None
+            pb.host_topics = [m.topic for _, m in pb.live]
+            pb.host_matched = None
+            return
+        br.record_success(time.perf_counter() - t0)
+
+    def _fetch_device(self, pb: PendingBatch) -> None:
+        """The device fetch body — on packed-budget overflow re-packs
+        with the next power-of-two bucket (the dispatched dense
+        arrays are still live on device) and remembers the grown
+        budget for the bucket, so a steady-state workload re-packs
+        once, not per batch."""
+        if faults.enabled:
+            faults.fire("device.fetch")
         import jax
 
         sp = pb.span
@@ -1018,6 +1094,15 @@ class Broker:
             return
         ps.folded = True
         counts = ps.counts
+        if ps.xg_set and ps.xloop_left:
+            # folding with handoffs still outstanding (join timed
+            # out, handoff dropped, owning loop died): their groups'
+            # delivery counts are lost — surface the loss instead of
+            # under-reporting silently
+            self.metrics.inc("delivery.xloop.orphaned", ps.xloop_left)
+            log.warning("cross-loop delivery: %d handoff(s) never "
+                        "reported back — folding partial counts",
+                        ps.xloop_left)
         if ps.xg_set:
             # merge the handoff loops' delivered counts (no more
             # writers once xloop_left hit zero)
@@ -1094,6 +1179,11 @@ class Broker:
         ps.xloop_aev = asyncio.Event()
         self.metrics.inc("delivery.xloop.handoffs", len(pb.xgroups))
         for idx, gids in pb.xgroups.items():
+            if faults.enabled and faults.fire("xloop.handoff"):
+                # injected handoff loss: the join bound + orphan
+                # accounting (xloop_fold) take over, exactly as for
+                # a loop that died with the handoff in flight
+                continue
             try:
                 lg.post(idx, self._run_xloop_groups, pb, gids)
             except RuntimeError:
@@ -1136,7 +1226,13 @@ class Broker:
                     try:
                         lg.home.call_soon_threadsafe(aev.set)
                     except RuntimeError:
-                        pass  # home loop gone (sync/shutdown path)
+                        # home loop gone (shutdown race): deliveries
+                        # happened, but the async fold wakeup is
+                        # orphaned (sync joins still see the
+                        # threading event) — count it, don't vanish
+                        self.metrics.inc("delivery.xloop.orphaned")
+                        log.warning("cross-loop handoff result "
+                                    "orphaned: home loop gone")
 
     def xloop_event(self, pb: PendingBatch):
         """The home-loop asyncio event the async ingress awaits before

@@ -222,6 +222,14 @@ class Channel:
             return []
         self.state = CONNECTING
         self.proto_ver = pkt.proto_ver
+        ov = getattr(self.broker, "overload", None)
+        if ov is not None and ov.reject_connects():
+            # critical overload: refuse new work at the front door
+            # (ServerBusy; v3 clients see server-unavailable via
+            # compat) — existing connections keep their service
+            # (docs/ROBUSTNESS.md)
+            self.broker.metrics.inc("overload.shed.connect")
+            return self._connack_error(RC.SERVER_BUSY)
         # TLS-cert-derived username overrides the packet's, and feeds
         # everything downstream (clientid derivation, auth, ACLs,
         # bans) exactly as the reference's setting_peercert_infos

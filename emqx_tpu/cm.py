@@ -15,11 +15,14 @@ sessions are kept for ``session_expiry_interval`` and swept by
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
 from emqx_tpu.session import Session
+
+log = logging.getLogger("emqx_tpu.cm")
 
 TAKEOVER_RC = 0x8E  # session taken over
 
@@ -162,7 +165,22 @@ class ConnectionManager:
         self.cancel_will(client_id)
         sess: Optional[Session] = None
         if old_chan is not None and old_chan is not channel:
-            sess = self._takeover(old_chan)
+            try:
+                sess = self._takeover(old_chan)
+            except RuntimeError as e:
+                # bounded cross-loop takeover wait expired (owning
+                # loop wedged/dead): the old channel is unreachable
+                # from here — unregister it and give the client a
+                # FRESH session rather than failing its CONNECT.
+                # When the wedged loop recovers, the old channel
+                # finds itself unregistered and shuts down alone.
+                log.warning("takeover of %r timed out (%s): "
+                            "starting a fresh session", client_id, e)
+                if self.broker is not None:
+                    self.broker.metrics.inc(
+                        "overload.takeover.timeout")
+                self.unregister_channel(client_id, old_chan)
+                sess = None
         elif client_id in self._detached:
             sess, _ts, _exp = self._detached.pop(client_id)
         elif self.cluster is not None:

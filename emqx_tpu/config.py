@@ -122,6 +122,14 @@ class NodeConfig:
     # egress pre-serialization on/off, docs/DISPATCH.md). None =
     # defaults (planner + preserialize on).
     dispatch: Optional[Any] = None
+    # [overload] section: overload monitor levels/shedding + the
+    # device-path circuit breaker (emqx_tpu.overload.OverloadConfig,
+    # docs/ROBUSTNESS.md). None = defaults (enabled).
+    overload: Optional[Any] = None
+    # [faults] section: deterministic fault injection
+    # (emqx_tpu.faults.FaultsConfig, docs/ROBUSTNESS.md). None = the
+    # registry untouched (disabled).
+    faults: Optional[Any] = None
 
 
 #: zone fields with a closed value set — a typo must be a startup
@@ -235,6 +243,68 @@ def _build_dispatch(raw: Dict[str, Any]):
             raise ConfigError(f"dispatch.{key} must be a boolean")
         kwargs[key] = val
     return DispatchConfig(**kwargs)
+
+
+def _build_overload(raw: Dict[str, Any]):
+    """``[overload]`` table → :class:`~emqx_tpu.overload
+    .OverloadConfig`. Closed schema like zones/matcher: a typo'd
+    ``enabled = false`` silently leaving shedding armed (or off) is
+    the drift this rule catches."""
+    import dataclasses as _dc
+
+    from emqx_tpu.overload import OverloadConfig
+
+    known = {f.name for f in _dc.fields(OverloadConfig)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown overload setting: "
+                              f"overload.{key}")
+        want = OverloadConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"overload.{key} must be a boolean")
+        if want == "int" and (isinstance(val, bool)
+                              or not isinstance(val, int)):
+            raise ConfigError(f"overload.{key} must be an integer")
+        if want == "float":
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise ConfigError(f"overload.{key} must be a number")
+            val = float(val)
+        kwargs[key] = val
+    try:
+        return OverloadConfig(**kwargs)
+    except ValueError as e:
+        # threshold-ordering violations become startup errors with
+        # file-location semantics, like every other section typo
+        raise ConfigError(str(e)) from e
+
+
+def _build_faults(raw: Dict[str, Any]):
+    """``[faults]`` table → :class:`~emqx_tpu.faults.FaultsConfig`.
+    Arm specs are validated against the point catalog here — a typo'd
+    chaos config must fail the boot, not silently test nothing."""
+    from emqx_tpu.faults import FaultsConfig, parse_arm
+
+    known = {"enabled", "seed", "arm"}
+    for key in raw:
+        if key not in known:
+            raise ConfigError(f"unknown faults setting: faults.{key}")
+    if not isinstance(raw.get("enabled", False), bool):
+        raise ConfigError("faults.enabled must be a boolean")
+    seed = raw.get("seed", 0)
+    if isinstance(seed, bool) or not isinstance(seed, int):
+        raise ConfigError("faults.seed must be an integer")
+    arm = raw.get("arm", [])
+    if not isinstance(arm, list) \
+            or not all(isinstance(a, str) for a in arm):
+        raise ConfigError("faults.arm must be a list of spec strings")
+    for spec in arm:
+        try:
+            parse_arm(spec)
+        except ValueError as e:
+            raise ConfigError(f"faults.arm: {e}") from e
+    return FaultsConfig(enabled=raw.get("enabled", False), seed=seed,
+                        arm=list(arm))
 
 
 def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
@@ -356,6 +426,16 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         if not isinstance(draw, dict):
             raise ConfigError("dispatch must be a table")
         cfg.dispatch = _build_dispatch(draw)
+    oraw = raw.get("overload")
+    if oraw is not None:
+        if not isinstance(oraw, dict):
+            raise ConfigError("overload must be a table")
+        cfg.overload = _build_overload(oraw)
+    fraw = raw.get("faults")
+    if fraw is not None:
+        if not isinstance(fraw, dict):
+            raise ConfigError("faults must be a table")
+        cfg.faults = _build_faults(fraw)
     for name, zraw in raw.get("zones", {}).items():
         cfg.zones[name] = _build_zone(name, zraw)
     for i, lraw in enumerate(raw.get("listeners", [])):
@@ -411,6 +491,8 @@ def build_node(cfg: NodeConfig):
                 sys_interval=cfg.sys_interval,
                 load_default_modules=cfg.load_default_modules,
                 loops=cfg.loops,
+                overload=cfg.overload,
+                faults_config=cfg.faults,
                 boot_listeners=False)
     for i, lc in enumerate(cfg.listeners):
         zone = cfg.zones.get(lc.zone)

@@ -20,6 +20,7 @@ import logging
 import time
 from typing import List, Optional
 
+from emqx_tpu import faults
 from emqx_tpu.channel import Channel
 from emqx_tpu.gc import GcPolicy
 from emqx_tpu.limiter import TokenBucket
@@ -108,6 +109,8 @@ class Connection:
 
     def _send_packets(self, pkts) -> None:
         from emqx_tpu.mqtt.packet import Publish
+        if faults.enabled and faults.fire("socket.reset"):
+            raise ConnectionResetError("fault injected: socket.reset")
         max_out = self.channel.client_max_packet
         # counters batched per call on BOTH lanes: a planner batch
         # drains a whole outbox here, and per-frame metric increments
@@ -217,7 +220,15 @@ class Connection:
         self._flush_scheduled = False
         if self._closing:
             return
-        self._send_packets(self.channel.handle_deliver())
+        try:
+            self._send_packets(self.channel.handle_deliver())
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            # socket died mid-flush OUTSIDE the read loop's handler
+            # (this runs as a bare loop callback): close cleanly —
+            # the read loop's EOF then runs the normal shutdown path
+            # — instead of leaking the exception to the event loop
+            self._abort_transport()
+            return
         # slow-consumer guard: the fan-out path writes without
         # draining (one slow subscriber must not stall a broadcast),
         # so a consumer that stops reading would otherwise grow the
@@ -270,8 +281,14 @@ class Connection:
         # Listener.stop) forever. Bound it by send_timeout, then
         # abort. (send_timeout = 0 keeps closes unbounded.)
         if self.zone.send_timeout > 0 and self._loop is not None:
-            self._loop.create_task(
-                self._ensure_closed(self.zone.send_timeout))
+            coro = self._ensure_closed(self.zone.send_timeout)
+            try:
+                self._loop.create_task(coro)
+            except RuntimeError:
+                # serving loop already closed (a dead front-door
+                # loop's connection unwinding at GC): nothing left
+                # to flush to anyway
+                coro.close()
 
     async def _ensure_closed(self, timeout: float) -> None:
         try:
@@ -379,8 +396,33 @@ class Connection:
                         # drains it. The standing queue then lives in
                         # the publisher's TCP buffer, not in the
                         # broker, so delivery tail latency stays
-                        # bounded at saturation.
-                        await ing.wait_ready()
+                        # bounded at saturation. The wait is bounded
+                        # ([overload] ingress_wait_timeout_s): a
+                        # queue that never drains sheds the publisher
+                        # instead of parking it forever
+                        if not await ing.wait_ready(
+                                ing.submit_wait_timeout):
+                            self.broker.metrics.inc(
+                                "overload.shed.ingress_timeout")
+                            alarms = getattr(self.broker, "alarms",
+                                             None)
+                            if alarms is not None:
+                                alarms.activate(
+                                    "ingress_saturated",
+                                    details={"queue": len(
+                                        ing._pending)},
+                                    message="ingress accumulator "
+                                            "saturated past the "
+                                            "submit wait bound; "
+                                            "shedding publishers")
+                            log.warning(
+                                "shedding publisher %s: ingress "
+                                "saturated > %.0fs",
+                                self.channel.peername,
+                                ing.submit_wait_timeout)
+                            self.channel.disconnect_reason = \
+                                "ingress_saturated"
+                            break
                 if self._msg_limiter is not None and pkts:
                     # like the reference, the already-parsed batch is
                     # processed first, then the socket pauses (state
@@ -398,7 +440,10 @@ class Connection:
             pass
         finally:
             for t in self._timers:
-                t.cancel()
+                try:
+                    t.cancel()
+                except RuntimeError:
+                    pass  # serving loop already closed (chaos stop)
             if not self.channel.closed:
                 if self.channel.disconnect_reason is None:
                     self.channel.disconnect_reason = "sock_closed"

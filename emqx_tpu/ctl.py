@@ -85,8 +85,50 @@ class Ctl:
             "cache", self._cache,
             "publish match-cache: hit/miss/stale, epoch-bump split, "
             "partitions, fid quarantine")
+        self.register_command(
+            "overload", self._overload,
+            "overload level, samples, shed counters, breaker state")
+        self.register_command(
+            "faults", self._faults,
+            "list | arm <point[:action[:times[:delay_ms]]]> | "
+            "disarm <point> | clear | on | off")
         from emqx_tpu.profiling import register_ctl
         register_ctl(self)
+
+    def _overload(self, args) -> str:
+        """One-stop overload diagnosis (docs/ROBUSTNESS.md): current
+        level + last sample set, the cumulative shed/heal counters,
+        and the device-path breaker state."""
+        from emqx_tpu.metrics import BREAKER_METRICS, OVERLOAD_METRICS
+        ov = self.node.overload
+        out = {"enabled": ov is not None}
+        if ov is not None:
+            out.update(ov.info())
+        m = self.node.metrics
+        out["counters"] = {
+            k: m.val(k) for k in OVERLOAD_METRICS + BREAKER_METRICS
+            if m.val(k)}
+        out["orphaned_xloop"] = m.val("delivery.xloop.orphaned")
+        br = self.node.broker.breaker
+        out["breaker"] = br.info() if br is not None else "disabled"
+        return json.dumps(out, indent=2)
+
+    def _faults(self, args) -> str:
+        from emqx_tpu import faults
+        if not args or args[0] == "list":
+            return json.dumps(faults.info(), indent=2)
+        if args[0] == "arm" and len(args) > 1:
+            faults.arm_spec(args[1])
+            return "ok"
+        if args[0] == "disarm" and len(args) > 1:
+            return "ok" if faults.disarm(args[1]) else "not armed"
+        if args[0] == "clear":
+            faults.clear()
+            return "ok"
+        if args[0] in ("on", "off"):
+            faults.set_master(args[0] == "on")
+            return "ok"
+        raise ValueError(f"bad subcommand: {args[0]}")
 
     def _cache(self, args) -> str:
         """Everything needed to diagnose a hit-rate collapse from one

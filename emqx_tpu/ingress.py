@@ -42,9 +42,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from emqx_tpu import faults
 from emqx_tpu.types import Message
 
 log = logging.getLogger("emqx_tpu.ingress")
@@ -95,6 +97,16 @@ class IngressBatcher:
         self._plock: Optional[threading.Lock] = None
         self._home: Optional[asyncio.AbstractEventLoop] = None
         self._ready_multi: Dict[int, tuple] = {}
+        # overload protection (overload.py): at critical the monitor
+        # divides the effective high-water mark by this, so publisher
+        # read-pauses engage earlier; 1 = the configured mark, the
+        # hot-path cost is one int compare
+        self._pressure_div = 1
+        # bound on a publisher's wait_ready park (seconds; 0 =
+        # unbounded, the legacy behavior) — set from
+        # [overload] ingress_wait_timeout_s by Node; connections shed
+        # the publisher when it expires (docs/ROBUSTNESS.md)
+        self.submit_wait_timeout = 0.0
         # observability (emqx_batch keeps a counter too)
         self.flushes = 0
         self.submitted = 0
@@ -237,19 +249,51 @@ class IngressBatcher:
 
     def backlogged(self) -> bool:
         """Accumulator at/over the high-water mark — connections
-        should pause reading (the active_n analogue)."""
-        return len(self._pending) >= self.queue_hiwater
+        should pause reading (the active_n analogue). At critical
+        overload the effective mark shrinks (``set_pressure``), so
+        the pause engages earlier."""
+        if faults.enabled and faults.fire("ingress.saturate"):
+            return True
+        hw = self.queue_hiwater
+        if self._pressure_div > 1:
+            hw = max(1, hw // self._pressure_div)
+        return len(self._pending) >= hw
 
-    async def wait_ready(self) -> None:
+    def set_pressure(self, div: int) -> None:
+        """Overload-monitor knob: divide the effective high-water
+        mark by ``div`` (1 restores the configured mark)."""
+        self._pressure_div = max(1, int(div))
+
+    async def wait_ready(self, timeout: float = 0.0) -> bool:
         """Park until a flush takes the backlog below the mark. On a
         multi-loop node each loop parks on its OWN event (an asyncio
-        event belongs to one loop; waking them crosses threads)."""
+        event belongs to one loop; waking them crosses threads).
+
+        ``timeout`` bounds the park (0 = wait forever): returns False
+        if the backlog still stands when it expires — the caller
+        sheds the publisher instead of letting it wedge the read
+        loop indefinitely."""
+        deadline = (time.monotonic() + timeout) if timeout > 0 else None
+
+        async def _wait(ev) -> bool:
+            if deadline is None:
+                await ev.wait()
+                return True
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return False
+            try:
+                await asyncio.wait_for(ev.wait(), remain)
+                return True
+            except asyncio.TimeoutError:
+                return False
         if self._plock is None:
             while self.backlogged():
                 if self._ready is None or self._ready.is_set():
                     self._ready = asyncio.Event()
-                await self._ready.wait()
-            return
+                if not await _wait(self._ready):
+                    return False
+            return True
         loop = asyncio.get_running_loop()
         key = id(loop)
         while self.backlogged():
@@ -257,7 +301,9 @@ class IngressBatcher:
             if ent is None or ent[1].is_set():
                 ent = (loop, asyncio.Event())
                 self._ready_multi[key] = ent
-            await ent[1].wait()
+            if not await _wait(ent[1]):
+                return False
+        return True
 
     def _signal_ready(self) -> None:
         if self.backlogged():
@@ -318,8 +364,29 @@ class IngressBatcher:
         loop = asyncio.get_running_loop()
         try:
             if not pb.done and pb.host_topics is None:
-                await loop.run_in_executor(
-                    self._executor(), self.broker.publish_fetch, pb)
+                if faults.enabled and self._pool is not None \
+                        and faults.fire("executor.death"):
+                    # injected: the fetch pool dies out from under
+                    # this batch — the supervision below must respawn
+                    self._pool.shutdown(wait=False)
+                try:
+                    await loop.run_in_executor(
+                        self._executor(), self.broker.publish_fetch,
+                        pb)
+                except RuntimeError as e:
+                    if "shutdown" not in str(e):
+                        raise
+                    # the fetch executor died (its threads are gone /
+                    # the pool was shut down): respawn it and retry —
+                    # asyncio supervision standing in for the OTP
+                    # restart the reference gets for free
+                    log.warning("ingress fetch executor dead (%s): "
+                                "respawning", e)
+                    self.broker.metrics.inc("overload.heal.executor")
+                    self._pool = None
+                    await loop.run_in_executor(
+                        self._executor(), self.broker.publish_fetch,
+                        pb)
             if prev is not None:
                 # ordered delivery across batches; a failed
                 # predecessor already resolved its own futures
@@ -358,7 +425,21 @@ class IngressBatcher:
                     # a single-loop node
                     ev = self.broker.xloop_event(pb)
                     if ev is not None:
-                        await ev.wait()
+                        # bounded, like the sync join: a wedged or
+                        # dead owning loop must not hang this batch
+                        # (and every batch chained behind it) forever
+                        # — fold partial counts with the loss counted
+                        # (delivery.xloop.orphaned)
+                        try:
+                            await asyncio.wait_for(
+                                ev.wait(),
+                                self.broker.XLOOP_JOIN_TIMEOUT)
+                        except asyncio.TimeoutError:
+                            log.error(
+                                "cross-loop delivery handoff "
+                                "incomplete after %.0fs — folding "
+                                "partial counts",
+                                self.broker.XLOOP_JOIN_TIMEOUT)
                         self.broker.xloop_fold(pb)
                 pb.done = True
                 results = pb.results

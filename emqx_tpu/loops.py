@@ -40,7 +40,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
-from typing import List, Optional
+import time
+from typing import List, Optional, Set
 
 log = logging.getLogger("emqx_tpu.loops")
 
@@ -60,6 +61,9 @@ class LoopGroup:
         self._idx = {}  # id(loop) -> index
         self._home_tid: Optional[int] = None
         self._started = False
+        # peer loops whose thread died (overload monitor heal sweep):
+        # posts to them raise, index_of maps their sessions home
+        self._dead: Set[int] = set()
 
     @property
     def home(self) -> Optional[asyncio.AbstractEventLoop]:
@@ -148,6 +152,59 @@ class LoopGroup:
 
     def post(self, idx: int, cb, *args) -> None:
         """Schedule ``cb(*args)`` on loop ``idx`` (thread-safe).
-        Raises ``RuntimeError`` if that loop is closed — callers fall
-        back to running the work in place."""
+        Raises ``RuntimeError`` if that loop is closed or marked dead
+        — callers fall back to running the work in place. The dead
+        check matters: a loop whose THREAD died but whose loop object
+        was never closed still accepts ``call_soon_threadsafe``, and
+        the callback would silently never run (a hung join)."""
+        if idx in self._dead:
+            raise RuntimeError(f"front-door loop {idx} is dead")
         self.loops[idx].call_soon_threadsafe(cb, *args)
+
+    # -- liveness (overload monitor heal sweep, docs/ROBUSTNESS.md) --------
+
+    def alive(self, idx: int) -> bool:
+        """Is loop ``idx`` serviceable? The home loop always is (it
+        is the caller's); a peer is alive while its thread runs and
+        it is not marked dead."""
+        if idx == 0:
+            return True
+        if idx in self._dead or not self._started:
+            return False
+        t = self._threads[idx - 1] if idx - 1 < len(self._threads) \
+            else None
+        return t is not None and t.is_alive()
+
+    def dead_peer_indices(self) -> List[int]:
+        """Peer loops whose thread died but are not yet marked dead
+        — the monitor marks + heals each exactly once."""
+        if not self._started:
+            return []
+        return [i for i in range(1, len(self._threads) + 1)
+                if i not in self._dead
+                and not self._threads[i - 1].is_alive()]
+
+    def mark_dead(self, idx: int) -> None:
+        """Route around a dead loop: its sessions map home
+        (``index_of`` → 0), future posts to it raise."""
+        self._dead.add(idx)
+        self._idx.pop(id(self.loops[idx]), None)
+
+    # -- chaos helpers (tests/test_chaos.py; NOT part of the fault
+    # registry — these simulate a loop dying/wedging from outside) --------
+
+    def crash(self, idx: int) -> None:
+        """Stop peer loop ``idx``: its run_forever returns and its
+        thread exits, leaving its connection tasks frozen — exactly
+        the state a crashed loop thread leaves behind."""
+        loop = self.loops[idx]
+        try:
+            loop.call_soon_threadsafe(loop.stop)
+        except RuntimeError:
+            pass
+
+    def stall(self, idx: int, seconds: float) -> None:
+        """Wedge peer loop ``idx`` for ``seconds`` (a blocking sleep
+        ON the loop): every task it owns — read loops, keepalive
+        timers, cross-loop marshals — stalls with it."""
+        self.loops[idx].call_soon_threadsafe(time.sleep, seconds)

@@ -72,6 +72,11 @@ DELIVERY_METRICS = [
     # Both stay 0 with [node] loops = 1
     "delivery.xloop.handoffs",
     "delivery.xloop.deliveries",
+    # cross-loop deliveries/results LOST to a gone or wedged loop
+    # (shutdown race, dead loop thread, join timeout): every
+    # formerly-silent `home loop gone` path counts here, with one
+    # warning log per batch (docs/ROBUSTNESS.md)
+    "delivery.xloop.orphaned",
 ]
 CLIENT_METRICS = [
     "client.connect", "client.connack", "client.connected",
@@ -129,10 +134,45 @@ AUTOMATON_METRICS = [
     "automaton.delta.merges", "automaton.rebuild.stall_ms",
 ]
 
+# overload protection + self-healing (overload.py,
+# docs/ROBUSTNESS.md): `shed.*` counts work refused under pressure
+# (QoS0 at mqueue pressure, ServerBusy CONNACKs at critical, ingress
+# publishers shed after the bounded submit wait), `force_shutdown`
+# the per-connection OOM-policy kills, `transitions` the ok/warn/
+# critical level changes, `heal.*` the supervision actions (fetch
+# executor respawned, crashed flatten put on backoff-retry, dead
+# front-door loop routed around), `takeover.timeout` the bounded
+# cross-loop takeover waits that expired (the client got a fresh
+# session instead of a hung CONNECT)
+OVERLOAD_METRICS = [
+    "overload.shed.qos0", "overload.shed.connect",
+    "overload.shed.ingress_timeout", "overload.force_shutdown",
+    "overload.transitions", "overload.heal.executor",
+    "overload.heal.flatten", "overload.heal.loop",
+    "overload.takeover.timeout",
+]
+
+# device-path circuit breaker (overload.DeviceBreaker): `failures` =
+# device steps that failed (or exceeded breaker_slow_ms), `trips` =
+# closed/half-open → open transitions, `probes` = half-open probe
+# batches admitted, `fallback.batches` = publish batches matched on
+# the exact host oracle because the breaker was open
+BREAKER_METRICS = [
+    "breaker.failures", "breaker.trips", "breaker.probes",
+    "breaker.fallback.batches",
+]
+
+# fault injection (faults.py): total armed injection points that
+# actually fired — 0 in any production configuration
+FAULT_METRICS = [
+    "faults.injected",
+]
+
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
-               + AUTOMATON_METRICS + TRANSPORT_METRICS)
+               + AUTOMATON_METRICS + TRANSPORT_METRICS
+               + OVERLOAD_METRICS + BREAKER_METRICS + FAULT_METRICS)
 
 #: registry names that are NOT monotonic — ``Metrics.dec`` runs on
 #: them in steady state (today: the retainer's live-entry count,

@@ -13,6 +13,7 @@ import asyncio
 import logging
 from typing import List, Optional
 
+from emqx_tpu import faults as _faults
 from emqx_tpu.alarm import AlarmManager
 from emqx_tpu.banned import Banned
 from emqx_tpu.broker import Broker, DispatchConfig
@@ -26,6 +27,8 @@ from emqx_tpu.ingress import IngressBatcher
 from emqx_tpu.monitors import OsMon, SysMon, VmMon
 from emqx_tpu.metrics import Metrics
 from emqx_tpu.modules import ModuleRegistry
+from emqx_tpu.overload import (DeviceBreaker, OverloadConfig,
+                               OverloadMonitor)
 from emqx_tpu.modules.acl_file import AclFileModule
 from emqx_tpu.modules.delayed import DelayedModule
 from emqx_tpu.plugins import Plugins
@@ -52,6 +55,8 @@ class Node:
                  batch_size: int = 256,
                  batch_linger_ms: float = 0.0,
                  loops: int = 1,
+                 overload: Optional[OverloadConfig] = None,
+                 faults_config=None,
                  plugin_config_dir: Optional[str] = None) -> None:
         self.name = name
         self.zone = zone or get_zone()
@@ -94,6 +99,38 @@ class Node:
             banned=self.broker.banned, metrics=self.metrics)
         # ops (emqx_sys_sup)
         self.alarms = AlarmManager(broker=self.broker, node=name)
+        self.broker.alarms = self.alarms
+        # overload protection + device-path circuit breaker
+        # (overload.py, docs/ROBUSTNESS.md). [overload] enabled =
+        # false builds NEITHER: the broker/channel/session guards
+        # read None and the hot paths are byte-for-byte the
+        # pre-overload build (pinned by tests/test_chaos.py)
+        ocfg = overload or OverloadConfig()
+        self.overload_config = ocfg
+        if ocfg.enabled:
+            self.overload = OverloadMonitor(self, ocfg)
+            self.broker.overload = self.overload
+            if ocfg.breaker:
+                self.broker.breaker = DeviceBreaker(
+                    self.metrics, alarms=self.alarms,
+                    failures=ocfg.breaker_failures,
+                    cooldown_s=ocfg.breaker_cooldown_s,
+                    slow_ms=ocfg.breaker_slow_ms)
+            if self.ingress is not None:
+                self.ingress.submit_wait_timeout = \
+                    ocfg.ingress_wait_timeout_s
+        else:
+            self.overload = None
+        # fault injection ([faults], faults.py): arm specs applied at
+        # build; no section = the module-level registry is untouched
+        if faults_config is not None:
+            _faults.configure(faults_config)
+        # crashed background compaction: the router's thread records
+        # the error here (plain attribute store — thread-safe); the
+        # monitor/stats tick turns it into the alarm + backoff-retry
+        self._flatten_err: Optional[str] = None
+        self._flatten_alarmed = False
+        self.router.on_bg_error = self._note_flatten_error
         # publish-path telemetry (telemetry.py): stage histograms +
         # slow-publish log. Wired onto broker AND router — the broker
         # stamps the spans, the router's cache-split dispatch leaves
@@ -275,6 +312,9 @@ class Node:
         for mon in (self.os_mon, self.vm_mon, self.sys_mon,
                     self.global_gc):
             self._bg_tasks.append(loop.create_task(mon.run()))
+        if self.overload is not None:
+            self._bg_tasks.append(
+                loop.create_task(self.overload.run()))
         self._started = True
         log.info("node %s started", self.name)
 
@@ -369,10 +409,41 @@ class Node:
                 stats.setstat(f"loop.{i}.connections", c,
                               f"loop.{i}.connections.max")
         self._watch_quarantine(stats)
+        if self.overload is not None:
+            stats.setstat("overload.level", self.overload.level)
+        if self.broker.breaker is not None:
+            stats.setstat("breaker.state", self.broker.breaker.state)
+        inj = _faults.drain_injected()
+        if inj:
+            self.metrics.inc("faults.injected", inj)
+        self.drain_robustness_events()
         stats.setstat("publish.spans.count", self.telemetry.spans_total,
                       "publish.spans.max")
         stats.setstat("publish.slow.count", self.telemetry.slow_total,
                       "publish.slow.max")
+
+    def _note_flatten_error(self, exc) -> None:
+        """Router background-compaction outcome callback — may run ON
+        the compaction thread, so it only stores (alarm/metric work
+        happens on-loop in :meth:`drain_robustness_events`)."""
+        self._flatten_err = repr(exc) if exc is not None else None
+
+    def drain_robustness_events(self) -> None:
+        """Turn thread-recorded robustness events into alarms/metrics
+        — called from the overload monitor tick and the stats flush
+        (whichever runs first; both run on the main loop)."""
+        err = self._flatten_err
+        if err is not None and not self._flatten_alarmed:
+            self._flatten_alarmed = True
+            self.metrics.inc("overload.heal.flatten")
+            self.alarms.activate(
+                "router_compaction_failed",
+                details={"error": err},
+                message="background compaction crashed; "
+                        "backoff retry armed")
+        elif err is None and self._flatten_alarmed:
+            self._flatten_alarmed = False
+            self.alarms.deactivate("router_compaction_failed")
 
     #: consecutive growing stats ticks before the fid-quarantine
     #: alarm fires (with the default 60s sys_interval: ~3 minutes of

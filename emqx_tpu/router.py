@@ -24,6 +24,7 @@ workers, emqx_router.erl:185-186); here a mutex serializes mutations.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import zlib
@@ -34,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from emqx_tpu import faults
 from emqx_tpu import topic as T
 from emqx_tpu.oracle import TrieOracle
 from emqx_tpu.ops.csr import Automaton, build_automaton, device_view
@@ -41,6 +43,8 @@ from emqx_tpu.ops.match import depth_bucket, match_batch
 from emqx_tpu.ops.patch import AutoPatcher, PatchOverflow
 from emqx_tpu.ops.tokenize import WordTable, encode_batch
 from emqx_tpu.types import Route
+
+log = logging.getLogger("emqx_tpu.router")
 
 
 @dataclass
@@ -254,6 +258,14 @@ class Router:
         self._walk_meta = {"slots": 2, "take": 1, "hops": None,
                            "has_plus": True}
         self._compacting = False  # background compaction in flight
+        # crashed-compaction supervision (docs/ROBUSTNESS.md): a
+        # background flatten that raised arms an exponential backoff
+        # before the next attempt; on_bg_error(exc|None) reports the
+        # outcome (Node turns it into the alarm) — the callback may
+        # run ON the compaction thread, so it must only store
+        self._compact_failures = 0
+        self._compact_backoff_until = 0.0
+        self.on_bg_error = None
         self._dummy_fan = None    # sharded publish_step filler fan
         # learned active-set boost: an overflow-storm batch (many
         # topics exceeding active_k) doubles the effective K (bounded)
@@ -954,6 +966,13 @@ class Router:
     def _schedule_compaction(self) -> None:
         if self._compacting:
             return
+        if self._compact_failures \
+                and time.monotonic() < self._compact_backoff_until:
+            # a recent compaction crashed: hold the retry until the
+            # backoff elapses (route ops keep landing in the delta /
+            # patch queue meanwhile — correctness never depends on
+            # the flatten, only memory/latency headroom does)
+            return
         self._compacting = True
         offlock = self._delta_active
 
@@ -964,32 +983,66 @@ class Router:
                     # protocol — route ops and matchers never wait on
                     # the multi-second build (docs/DELTA.md)
                     self._compact_offlock()
-                    return
-                with self._lock:
-                    # a sync rebuild may have beaten us to it (fresh
-                    # patcher, tombstones gone): re-check, don't
-                    # re-flatten for nothing
-                    if not self._dirty and self._needs_compaction_locked():
-                        # drain queued patches FIRST: with the queue
-                        # clean, matchers arriving during the long
-                        # flatten stay on the lock-free fast path
-                        # (patcher.dirty would send them to the
-                        # locked branch — stalling the whole match
-                        # plane for the flatten)
-                        if self._patchers_dirty():
-                            self._apply_patches_locked()
-                        self._rebuild_locked()
+                else:
+                    with self._lock:
+                        # a sync rebuild may have beaten us to it
+                        # (fresh patcher, tombstones gone): re-check,
+                        # don't re-flatten for nothing
+                        if not self._dirty \
+                                and self._needs_compaction_locked():
+                            # drain queued patches FIRST: with the
+                            # queue clean, matchers arriving during
+                            # the long flatten stay on the lock-free
+                            # fast path (patcher.dirty would send
+                            # them to the locked branch — stalling
+                            # the whole match plane for the flatten)
+                            if self._patchers_dirty():
+                                self._apply_patches_locked()
+                            self._rebuild_locked()
+                self._compact_failures = 0
+                cb = self.on_bg_error
+                if cb is not None:
+                    cb(None)
+            except Exception as e:
+                # the compaction thread must not die silently (the
+                # BEAM restarts its crashed workers; here the crash
+                # arms a backoff-retry and surfaces through the
+                # router_compaction_failed alarm). The freeze paths
+                # already unfroze on their own error handling.
+                log.exception("background compaction crashed")
+                self._compact_failures += 1
+                self._compact_backoff_until = time.monotonic() + min(
+                    2.0 ** self._compact_failures, 60.0)
+                cb = self.on_bg_error
+                if cb is not None:
+                    cb(e)
             finally:
                 self._compacting = False
 
         threading.Thread(target=_bg, daemon=True,
                          name="router-compaction").start()
 
+    def retry_compaction(self) -> None:
+        """Re-attempt a crashed background compaction once its
+        backoff elapsed (overload monitor tick) — without this, a
+        traffic lull after the crash would leave the rebuild pending
+        until the next route op."""
+        if not self._compact_failures or self._compacting \
+                or time.monotonic() < self._compact_backoff_until:
+            return
+        with self._lock:
+            need = self._auto is not None \
+                and self._needs_compaction_locked()
+        if need:
+            self._schedule_compaction()
+
     def _flatten_main(self, cap_s2, nb):
         """Flatten the persistent trie into a fresh host automaton —
         the ONLY long step of a compaction, and (under the freeze
         protocol) the only one that runs off-lock. Split out so tests
         can interpose a slow build."""
+        if faults.enabled:
+            faults.fire("compaction.flatten")
         if self._native is not None:
             return self._native.flatten(
                 v2_state_capacity=cap_s2, n_buckets=nb)

@@ -71,6 +71,27 @@ echo "== multi-loop front-door parity (docs/DISPATCH.md) =="
 # divergence here is a delivery-correctness bug, fail fast
 python -m pytest tests/test_frontdoor_loops.py -q
 
+echo "== chaos suite (docs/ROBUSTNESS.md) =="
+# every registered fault-injection point against the shedding/healing
+# behavior it exists to trigger: device failure -> breaker ->
+# host-oracle fallback with zero lost deliveries, executor/flatten
+# death self-heal, dead-loop will firing, bounded joins, the
+# overload-off byte-for-byte pin — a regression here is a
+# production-outage bug, fail fast
+python -m pytest tests/test_chaos.py -q
+
+echo "== overload degradation smoke (docs/ROBUSTNESS.md) =="
+# the BENCH_MODE=overload scenario end-to-end at toy scale: the
+# stepped offered-load sweep must run to completion and emit its
+# curve row (offered vs delivered vs shed fraction — numbers are not
+# gated here, the driver's real-scale run is)
+BENCH_MODE=overload OVERLOAD_RATES="500,4000" OVERLOAD_STEP_SECS=1 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='overload_delivered_msgs_per_s' \
+    and rec['value'] is not None and rec['curve'], rec"
+
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B
 # guard (telemetry off => dispatch byte-identical to the
