@@ -1854,6 +1854,160 @@ def flapstorm():
     })
 
 
+def recovery():
+    """BENCH_MODE=recovery — the durability layer's two costs
+    (ISSUE 9): journal-append overhead on the live publish path
+    (durability on/off A/B msgs/s with a durable QoS1 subscriber
+    fleet — every delivery/ack dirties session state, every batch
+    pays one coalesced journal flush) and crash-recovery time vs
+    route count (``recovery_replay_s`` / ``recovery_routes``: full
+    journal replay + session resurrection + baseline checkpoint,
+    the kill -9 worst case with no checkpoint to shortcut)."""
+    import asyncio
+    import shutil
+    import sys
+    import tempfile
+
+    jax = _jax_with_retry()
+
+    from emqx_tpu.durability import DurabilityConfig
+    from emqx_tpu.node import Node
+    from emqx_tpu.session import Session
+    from emqx_tpu.types import Message, SubOpts
+
+    n_routes = int(os.environ.get(
+        "RECOVERY_ROUTES", os.environ.get("BENCH_SUBS", "100000")))
+    B = int(os.environ.get("BENCH_BATCH", "256"))
+    pub_iters = int(os.environ.get("RECOVERY_PUB_ITERS", "20"))
+    use_fsync = os.environ.get("RECOVERY_FSYNC", "1") == "1"
+    n_sessions = min(int(os.environ.get("RECOVERY_SESSIONS", "1000")),
+                     n_routes)
+    rng = random.Random(0)
+    filters = [f"rb/{i}/s" for i in range(n_routes)]
+    pub_topics = [filters[rng.randrange(n_routes)]
+                  for _ in range(B * 8)]
+    batches = [pub_topics[i * B:(i + 1) * B] for i in range(8)]
+
+    def _drain_acks(sessions):
+        for s in sessions:
+            for pid, item in s.drain_outbox():
+                if isinstance(pid, int):
+                    s.puback(pid)
+
+    async def _build(durable, d):
+        cfg = (DurabilityConfig(enabled=True, dir=d, fsync=use_fsync)
+               if durable else None)
+        node = Node(boot_listeners=False, durability=cfg,
+                    load_default_modules=True)
+        await node.start()
+        sessions = []
+        per = n_routes // n_sessions
+        for i in range(n_sessions):
+            s = Session(f"dev-{i}", broker=node.broker,
+                        clean_start=False, max_inflight=0)
+            if durable:
+                node.durability.session_opened(s, 3600.0)
+
+                class _Ch:
+                    def __init__(self, sess):
+                        self.session = sess
+                node.cm.register_channel(s.client_id, _Ch(s))
+            for f in filters[i * per:(i + 1) * per]:
+                s.subscribe(f, SubOpts(qos=1))
+            sessions.append(s)
+        return node, sessions
+
+    def _window(node, sessions, durable, iters):
+        sent = 0
+        t1 = time.perf_counter()
+        for it in range(iters):
+            b = batches[it % len(batches)]
+            node.broker.publish_batch(
+                [Message(topic=t, payload=b"x", qos=1) for t in b])
+            _drain_acks(sessions)
+            if durable:
+                # the batched journal flush the ingress executor
+                # pays per tick on the socket path
+                node.durability.on_batch()
+            sent += len(b)
+        return sent / max(time.perf_counter() - t1, 1e-9)
+
+    async def _run():
+        out = {}
+        dirs = [tempfile.mkdtemp(prefix="emqx_dur_bench_")
+                for _ in range(2)]
+        # both nodes built and warmed BEFORE either timed window —
+        # process-level XLA compile caching must not subsidize
+        # whichever variant runs second
+        node_off, sess_off = await _build(False, dirs[0])
+        node_on, sess_on = await _build(True, dirs[1])
+        for node, sessions, durable in ((node_off, sess_off, False),
+                                        (node_on, sess_on, True)):
+            _window(node, sessions, durable, len(batches))
+        out["msgs_per_s_off"] = _window(node_off, sess_off, False,
+                                        pub_iters)
+        out["msgs_per_s_on"] = _window(node_on, sess_on, True,
+                                       pub_iters)
+        wi = node_on.durability.wal.info()
+        out["journal_records"] = wi["records"]
+        out["journal_mb"] = round(wi["bytes"] / 1e6, 2)
+        out["last_fsync_ms"] = wi["last_fsync_ms"]
+        # crash the durable node: abandon without graceful shutdown
+        # — the recovery below replays the whole journal
+        node_on.broker.durability = None
+        node_on.cm.durability = None
+        node_on.durability = None
+        crash_dir = dirs[1]
+        await node_off.stop()
+        await node_on.stop()
+
+        t2 = time.perf_counter()
+        node2 = Node(boot_listeners=False,
+                     durability=DurabilityConfig(
+                         enabled=True, dir=crash_dir,
+                         fsync=use_fsync),
+                     load_default_modules=True)
+        await node2.start()
+        out["recovery_total_s"] = round(time.perf_counter() - t2, 3)
+        rec = node2.durability.last_recovery
+        out["recovery_replay_s"] = rec["duration_s"]
+        out["recovered_sessions"] = rec["sessions"]
+        out["replayed_records"] = rec["replayed_records"]
+        out["recovered_routes"] = rec["routes"]
+        await node2.stop()
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+        return out
+
+    r = asyncio.run(_run())
+    on, off = r["msgs_per_s_on"], r["msgs_per_s_off"]
+    info = {"mode": "recovery", "routes": n_routes,
+            "sessions": n_sessions, "fsync": use_fsync,
+            "device": str(jax.devices()[0])}
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    _emit({
+        "metric": "recovery_replay_s",
+        "workload": "durability_v1",
+        "value": r["recovery_replay_s"],
+        "unit": "s",
+        "recovery_routes": r["recovered_routes"],
+        "recovery_sessions": r["recovered_sessions"],
+        "recovery_records": r["replayed_records"],
+        "recovery_total_s": r["recovery_total_s"],
+        "recovery_records_per_s": round(
+            r["replayed_records"] / max(r["recovery_replay_s"],
+                                        1e-9)),
+        "durability_on_msgs_per_s": round(on),
+        "durability_off_msgs_per_s": round(off),
+        "durability_overhead_pct": round(
+            100.0 * (1.0 - on / max(off, 1e-9)), 1),
+        "journal_records": r["journal_records"],
+        "journal_mb": r["journal_mb"],
+        "last_fsync_ms": r["last_fsync_ms"],
+        "fsync": use_fsync,
+    })
+
+
 # The BASELINE.json config matrix (VERDICT r3 item 3): one row per
 # driver-defined config, plus the uniform-traffic variant (no
 # batch-dedup advantage) and a paced live row for per-message p99
@@ -2212,6 +2366,7 @@ _MODES = {
     "flapstorm": ("flapstorm", "flapstorm_match_p99_ms", "ms"),
     "overload": ("overload", "overload_delivered_msgs_per_s",
                  "msgs/sec"),
+    "recovery": ("recovery", "recovery_replay_s", "s"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
     "mixed": ("main", "publish_match_fanout_throughput", "msgs/sec"),
     "configs": ("configs", "publish_match_fanout_throughput",
@@ -2231,6 +2386,7 @@ _MODE_WORKLOADS = {
     "live": "probe_v1",
     "flapstorm": "flapstorm_v1",
     "overload": "overload_curve_v1",
+    "recovery": "durability_v1",
 }
 
 

@@ -209,6 +209,13 @@ class Broker:
         self.overload = None
         self.breaker = None
         self.alarms = None
+        # durability layer (durability.py, docs/DURABILITY.md), wired
+        # by Node when [durability] enabled: route mutations journal
+        # an absolute refcount record, durable-session subscriptions
+        # journal alongside, and publish_fetch flushes the batched
+        # journal from the executor thread. None = byte-for-byte the
+        # pre-durability build (one attribute test per site)
+        self.durability = None
         # multi-loop front door (loops.LoopGroup), set by Node.start
         # when [node] loops > 1; None = single-loop, every multi-loop
         # branch below is skipped entirely
@@ -243,15 +250,20 @@ class Broker:
             resub = topic_filter in subs
             subs[topic_filter] = opts
             if opts.share is not None:
+                dest = (opts.share, self.node)
                 if not resub:
                     self.shared.subscribe(opts.share, flt, sub)
-                    self.router.add_route(
-                        flt, dest=(opts.share, self.node))
+                    self.router.add_route(flt, dest=dest)
             else:
+                dest = self.node
                 self._subscribers.setdefault(flt, {})[sub] = opts
                 if not resub:
                     self.helper.subscribe(flt, sub)
                     self.router.add_route(flt, dest=self.node)
+            d = self.durability
+            if d is not None:
+                d.journal_subscribe(sub, topic_filter, flt, dest,
+                                    opts, resub)
         return opts
 
     def unsubscribe(self, sub: object, topic_filter: str) -> bool:
@@ -265,9 +277,11 @@ class Broker:
                 del self._subscriptions[sub]
             share = popts.get("share", opts.share)
             if share is not None:
+                dest = (share, self.node)
                 self.shared.unsubscribe(share, flt, sub)
-                self.router.delete_route(flt, dest=(share, self.node))
+                self.router.delete_route(flt, dest=dest)
             else:
+                dest = self.node
                 ftab = self._subscribers.get(flt)
                 if ftab is not None:
                     ftab.pop(sub, None)
@@ -277,6 +291,9 @@ class Broker:
                 self.router.delete_route(flt, dest=self.node)
             if sub not in self._subscriptions:
                 self.helper.release(sub)
+            d = self.durability
+            if d is not None:
+                d.journal_unsubscribe(sub, topic_filter, flt, dest)
         return True
 
     def subscriber_down(self, sub: object) -> None:
@@ -316,6 +333,37 @@ class Broker:
             for key in list(self._subscriptions.get(sub, {})):
                 self.unsubscribe(sub, key)
             self.shared.subscriber_down(sub)
+
+    def restore_subscription(self, sub: object, topic_filter: str,
+                             opts: Optional[SubOpts] = None) -> None:
+        """Crash-recovery resubscribe (durability.py): rebuild the
+        subscriber/fanout/shared tables for a resurrected persistent
+        session WITHOUT bumping the router — its route refs were
+        already restored from the checkpoint + journal, and a second
+        ``add_route`` here would leave a stale route behind on the
+        session's eventual unsubscribe. Adds the route only if the
+        restored table somehow lacks it (self-healing a journal
+        gap)."""
+        T.validate(topic_filter, "filter")
+        flt, popts = T.parse(topic_filter)
+        opts = opts or SubOpts()
+        if "share" in popts:
+            opts.share = popts["share"]
+        with self._route_lock:
+            subs = self._subscriptions.setdefault(sub, {})
+            resub = topic_filter in subs
+            subs[topic_filter] = opts
+            if opts.share is not None:
+                dest = (opts.share, self.node)
+                if not resub:
+                    self.shared.subscribe(opts.share, flt, sub)
+            else:
+                dest = self.node
+                self._subscribers.setdefault(flt, {})[sub] = opts
+                if not resub:
+                    self.helper.subscribe(flt, sub)
+            if not self.router.has_dest(flt, dest):
+                self.router.add_route(flt, dest=dest)
 
     def subscribers(self, topic_filter: str) -> List[object]:
         return list(self._subscribers.get(topic_filter, ()))
@@ -623,28 +671,38 @@ class Broker:
         transfer is recorded and the batch converts to the exact
         host-oracle path — results stay correct, the breaker decides
         whether the NEXT batch rides the device."""
-        if pb.done or pb.host_topics is not None:
-            return
-        br = self.breaker
-        if br is None:
-            self._fetch_device(pb)
-            return
-        t0 = time.perf_counter()
         try:
-            self._fetch_device(pb)
-        except Exception:
-            br.record_failure()
-            log.exception("device fetch failed — host-oracle "
-                          "fallback for this batch")
-            # convert the batch to the deferred-host shape: finish
-            # re-matches every live topic on the host trie (exact),
-            # so nothing is delivered wrong or lost
-            pb.plan = None
-            pb.xgroups = None
-            pb.host_topics = [m.topic for _, m in pb.live]
-            pb.host_matched = None
-            return
-        br.record_success(time.perf_counter() - t0)
+            if pb.done or pb.host_topics is not None:
+                return
+            br = self.breaker
+            if br is None:
+                self._fetch_device(pb)
+                return
+            t0 = time.perf_counter()
+            try:
+                self._fetch_device(pb)
+            except Exception:
+                br.record_failure()
+                log.exception("device fetch failed — host-oracle "
+                              "fallback for this batch")
+                # convert the batch to the deferred-host shape:
+                # finish re-matches every live topic on the host trie
+                # (exact), so nothing is delivered wrong or lost
+                pb.plan = None
+                pb.xgroups = None
+                pb.host_topics = [m.topic for _, m in pb.live]
+                pb.host_matched = None
+                return
+            br.record_success(time.perf_counter() - t0)
+        finally:
+            d = self.durability
+            if d is not None:
+                # batched journal flush OFF the event loop: the
+                # previous batch's dirty session states + any buffered
+                # route/retain records hit disk with ONE fsync here,
+                # on the executor thread the fetch already occupies
+                # (docs/DURABILITY.md "one append per batch")
+                d.on_batch()
 
     def _fetch_device(self, pb: PendingBatch) -> None:
         """The device fetch body — on packed-budget overflow re-packs

@@ -356,6 +356,14 @@ class Channel:
             except RuntimeError:
                 loop = None
         self.session.owner_loop = loop
+        # durability (docs/DURABILITY.md): the session knows its own
+        # expiry (to_wire carries it across crash recovery), and a
+        # session-expiry > 0 CONNECT arms journaling — lifecycle +
+        # QoS1/2 window changes survive a kill -9 from here on
+        self.session.expiry_interval = self.expiry_interval
+        dur = getattr(self.broker, "durability", None)
+        if dur is not None:
+            dur.session_opened(self.session, self.expiry_interval)
         # keepalive (server may override via zone)
         interval = pkt.keepalive
         props: Dict[str, Any] = {}
@@ -790,6 +798,10 @@ class Channel:
                 if self.expiry_interval == 0 and exp > 0:
                     return self._disconnect_with(RC.PROTOCOL_ERROR)
                 self.expiry_interval = exp
+                if self.session is not None:
+                    # keep the session's own copy honest — crash
+                    # recovery reads it from the state snapshot
+                    self.session.expiry_interval = exp
         if pkt.reason_code == RC.NORMAL_DISCONNECTION:
             self.will = None  # clean close: discard will
         self.disconnect_reason = "normal"

@@ -19,12 +19,25 @@ no live subscribers yet).
 
 from __future__ import annotations
 
+import binascii
 import json
+import os
 from typing import Optional
 
 import numpy as np
 
 FORMAT = 2  # v2: compressed walk tables (wt/node2), no CSR arrays
+
+#: durability checkpoint manifest format (docs/DURABILITY.md)
+MANIFEST_FORMAT = 1
+MANIFEST = "MANIFEST"
+
+
+class CheckpointError(ValueError):
+    """A snapshot that cannot be restored: unknown format, corrupt or
+    truncated file, undecodable payload. Subclasses ``ValueError`` so
+    pre-durability callers that caught that keep working. Callers
+    surface it as an alarm — never a raw numpy/KeyError traceback."""
 
 
 def save(router, path: str) -> dict:
@@ -92,14 +105,29 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
     from emqx_tpu.ops.csr import Automaton, device_view
     from emqx_tpu.ops.patch import AutoPatcher
 
-    with np.load(path) as data:
-        meta = json.loads(bytes(data["meta"]).decode("utf-8"))
-        routes = json.loads(bytes(data["routes"]).decode("utf-8"))
-        tables_data = ({k: np.array(data[k]) for k in data.files
-                        if k not in ("meta", "routes")}
-                       if meta.get("has_tables") else {})
+    try:
+        with np.load(path) as data:
+            meta = json.loads(bytes(data["meta"]).decode("utf-8"))
+            routes = json.loads(bytes(data["routes"]).decode("utf-8"))
+            tables_data = ({k: np.array(data[k]) for k in data.files
+                            if k not in ("meta", "routes")}
+                           if meta.get("has_tables") else {})
+    except CheckpointError:
+        raise
+    except Exception as e:
+        # a truncated zip, a missing member, undecodable json — the
+        # file is corrupt, and the operator needs ONE clear error
+        # class (and the durability layer one alarm), not a numpy/
+        # KeyError traceback from the middle of the loader
+        raise CheckpointError(
+            f"corrupt or truncated checkpoint {path!r}: {e}") from e
+    if not isinstance(meta, dict) or "filter_ids" not in meta:
+        raise CheckpointError(
+            f"corrupt checkpoint {path!r}: malformed meta")
     if meta.get("format") not in (1, FORMAT):
-        raise ValueError(f"unknown checkpoint format {meta.get('format')}")
+        raise CheckpointError(
+            f"unknown checkpoint format {meta.get('format')} "
+            f"(this build reads {FORMAT} and the v1 route log)")
     if meta.get("format") != FORMAT:
         # older snapshot: its tables predate the compressed walk
         # layout — the route log alone is always sufficient (replay
@@ -149,6 +177,15 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
         # log replay (sharded re-flatten on first match) covers it
         tables = (meta.get("has_tables") and ids_match and vocab_ok
                   and router.config.mesh is None)
+        if tables and not all(
+                k in tables_data for k in
+                ("wt", "node2", "v2_hop", "v2_depth",
+                 "hops_for_level", "seed", "dims")):
+            # has_tables claimed but arrays missing/partial (a hand-
+            # edited or damaged-but-unzip-able file): the route log
+            # just replayed is always sufficient — degrade, don't
+            # KeyError
+            tables = False
         if tables:
             d_ = tables_data
             dims = d_["dims"]
@@ -177,3 +214,114 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
                                  router._cache_rev)
             router._publish_pair_locked()
         return {"routes": len(routes), "tables_restored": bool(tables)}
+
+
+# -- durable-state blob + atomic generation manifest ---------------------
+#
+# The durability layer (durability.py) extends the router snapshot
+# above with everything else a restart must not lose: retained
+# messages and persistent-session state. Both ride one CRC-framed
+# blob encoded by the cluster wire codec (data-only — a corrupt blob
+# can decode to garbage values, never to code), and a generation is
+# committed by writing every segment, fsyncing, then atomically
+# renaming the MANIFEST (tmp-file + rename). The journal truncates
+# only after the manifest lands (docs/DURABILITY.md).
+
+
+def file_crc(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc & 0xFFFFFFFF
+            crc = binascii.crc32(chunk, crc)
+
+
+def save_state(path: str, state: dict) -> None:
+    """Write the retained + session state blob (CRC-framed, fsynced;
+    the caller renames into place)."""
+    from emqx_tpu import wal, wire
+
+    payload = wire.dumps(state)
+    with open(path, "wb") as f:
+        f.write(wal.frame(payload))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def load_state(path: str) -> dict:
+    """Read a :func:`save_state` blob; :class:`CheckpointError` on
+    any corruption (bad frame, CRC mismatch, undecodable payload)."""
+    from emqx_tpu import wal, wire
+
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        hdr = wal._HDR
+        if len(data) < hdr.size:
+            raise CheckpointError(f"truncated state blob {path!r}")
+        magic, length, crc = hdr.unpack_from(data)
+        payload = data[hdr.size:hdr.size + length]
+        if magic != wal.MAGIC or len(payload) < length:
+            raise CheckpointError(f"truncated state blob {path!r}")
+        if binascii.crc32(payload) & 0xFFFFFFFF != crc:
+            raise CheckpointError(f"state blob CRC mismatch {path!r}")
+        state = wire.loads(payload)
+    except CheckpointError:
+        raise
+    except Exception as e:
+        raise CheckpointError(
+            f"corrupt state blob {path!r}: {e}") from e
+    if not isinstance(state, dict):
+        raise CheckpointError(f"malformed state blob {path!r}")
+    return state
+
+
+def _fsync_dir(dirpath: str) -> None:
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_manifest(dirpath: str, manifest: dict) -> None:
+    """Atomically commit a generation: tmp-file + fsync + rename.
+    The ``checkpoint.rename`` fault point (faults.py) fires just
+    before the rename — the crash window in which every new segment
+    exists but the PREVIOUS generation is still authoritative."""
+    from emqx_tpu import faults
+
+    tmp = os.path.join(dirpath, MANIFEST + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    if faults.enabled:
+        faults.fire("checkpoint.rename")
+    os.replace(tmp, os.path.join(dirpath, MANIFEST))
+    _fsync_dir(dirpath)
+
+
+def read_manifest(dirpath: str) -> Optional[dict]:
+    """The committed manifest, or None (fresh directory). A corrupt
+    manifest raises :class:`CheckpointError` — the operator must
+    decide, silently booting empty would look like data loss."""
+    path = os.path.join(dirpath, MANIFEST)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            m = json.load(f)
+    except Exception as e:
+        raise CheckpointError(f"corrupt manifest {path!r}: {e}") from e
+    if not isinstance(m, dict) \
+            or m.get("format") != MANIFEST_FORMAT:
+        raise CheckpointError(
+            f"unknown manifest format in {path!r}: "
+            f"{m.get('format') if isinstance(m, dict) else m!r}")
+    return m

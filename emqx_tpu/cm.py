@@ -33,6 +33,10 @@ class ConnectionManager:
         # set by Cluster: replicated clientid registry + remote
         # takeover/discard (emqx_cm_registry + emqx_rpc seam)
         self.cluster = None
+        # durability layer (durability.py, docs/DURABILITY.md), wired
+        # by Node: persistent-session detach/close transitions
+        # journal through it. None = pre-durability behavior exactly
+        self.durability = None
         self._lock = threading.Lock()
         self._locks: Dict[str, threading.Lock] = {}
         self._channels: Dict[str, object] = {}   # clientid -> live channel
@@ -153,6 +157,10 @@ class ConnectionManager:
             stale = self._detached.pop(client_id, None)
             if stale is not None and self.broker is not None:
                 self.broker.subscriber_down(stale[0])
+            if stale is not None and self.durability is not None:
+                # clean start discards the persistent session for
+                # good — the journal must agree
+                self.durability.session_closed(client_id)
             sess = self._new_session(client_id, True, session_opts)
             if self.broker is not None:
                 self.broker.metrics.inc("session.created")
@@ -291,6 +299,8 @@ class ConnectionManager:
             stale = self._detached.pop(client_id, None)
             if stale is not None and self.broker is not None:
                 self.broker.subscriber_down(stale[0])
+            if stale is not None and self.durability is not None:
+                self.durability.session_closed(client_id)
             if self.cluster is not None:
                 self.cluster.client_down(client_id)
             if self.broker is not None:
@@ -326,6 +336,10 @@ class ConnectionManager:
             session.owner_loop = None
             self._detached[client_id] = (
                 session, time.time(), expiry_interval)
+            if self.durability is not None:
+                # the final pre-detach snapshot: what a crash-while-
+                # detached recovery resumes this session from
+                self.durability.session_detached(session)
         else:
             if self.broker is not None:
                 session.broker = self.broker
@@ -333,6 +347,9 @@ class ConnectionManager:
                 self.broker.metrics.inc("session.terminated")
             if self.cluster is not None:
                 self.cluster.client_down(client_id)
+            if self.durability is not None \
+                    and getattr(session, "durable", False):
+                self.durability.session_closed(client_id)
 
     def expire_sessions(self, now: Optional[float] = None) -> int:
         now = time.time() if now is None else now
@@ -340,6 +357,9 @@ class ConnectionManager:
                 if now - ts >= exp]
         for cid in dead:
             sess, _, _ = self._detached.pop(cid)
+            if self.durability is not None \
+                    and getattr(sess, "durable", False):
+                self.durability.session_closed(cid)
             self.cancel_will(cid, fire=True)  # session end publishes it
             if self.cluster is not None:
                 self.cluster.client_down(cid)

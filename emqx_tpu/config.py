@@ -130,6 +130,11 @@ class NodeConfig:
     # (emqx_tpu.faults.FaultsConfig, docs/ROBUSTNESS.md). None = the
     # registry untouched (disabled).
     faults: Optional[Any] = None
+    # [durability] section: write-ahead journal + atomic checkpoints
+    # + crash recovery (emqx_tpu.durability.DurabilityConfig,
+    # docs/DURABILITY.md). None = disabled (today's in-memory-only
+    # behavior, byte-for-byte).
+    durability: Optional[Any] = None
 
 
 #: zone fields with a closed value set — a typo must be a startup
@@ -307,6 +312,40 @@ def _build_faults(raw: Dict[str, Any]):
                         arm=list(arm))
 
 
+def _build_durability(raw: Dict[str, Any]):
+    """``[durability]`` table → :class:`~emqx_tpu.durability
+    .DurabilityConfig`. Closed schema like zones/matcher: a typo'd
+    ``enabled = true`` silently leaving the broker volatile is the
+    exact drift this rule exists to catch."""
+    import dataclasses as _dc
+
+    from emqx_tpu.durability import DurabilityConfig
+
+    known = {f.name for f in _dc.fields(DurabilityConfig)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown durability setting: "
+                              f"durability.{key}")
+        want = DurabilityConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"durability.{key} must be a boolean")
+        if want == "int" and (isinstance(val, bool)
+                              or not isinstance(val, int)):
+            raise ConfigError(f"durability.{key} must be an integer")
+        if want == "float":
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise ConfigError(f"durability.{key} must be a number")
+            val = float(val)
+        if want == "str" and not isinstance(val, str):
+            raise ConfigError(f"durability.{key} must be a string")
+        kwargs[key] = val
+    try:
+        return DurabilityConfig(**kwargs)
+    except ValueError as e:
+        raise ConfigError(str(e)) from e
+
+
 def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
     raw = dict(raw)
     ltype = raw.pop("type", None)
@@ -436,6 +475,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         if not isinstance(fraw, dict):
             raise ConfigError("faults must be a table")
         cfg.faults = _build_faults(fraw)
+    duraw = raw.get("durability")
+    if duraw is not None:
+        if not isinstance(duraw, dict):
+            raise ConfigError("durability must be a table")
+        cfg.durability = _build_durability(duraw)
     for name, zraw in raw.get("zones", {}).items():
         cfg.zones[name] = _build_zone(name, zraw)
     for i, lraw in enumerate(raw.get("listeners", [])):
@@ -481,8 +525,16 @@ def build_node(cfg: NodeConfig):
     from emqx_tpu.node import Node
     from emqx_tpu.tls import TlsOptions
 
+    import os as _os
+
     for zone in cfg.zones.values():
         set_zone(zone)
+    if cfg.durability is not None and cfg.base_dir \
+            and not _os.path.isabs(cfg.durability.dir):
+        # like module files: a relative data dir anchors at the
+        # config file, not the process cwd
+        cfg.durability.dir = _os.path.join(cfg.base_dir,
+                                           cfg.durability.dir)
     default = cfg.zones.get("default")
     node = Node(name=cfg.name, zone=default,
                 matcher=cfg.matcher,
@@ -493,6 +545,7 @@ def build_node(cfg: NodeConfig):
                 loops=cfg.loops,
                 overload=cfg.overload,
                 faults_config=cfg.faults,
+                durability=cfg.durability,
                 boot_listeners=False)
     for i, lc in enumerate(cfg.listeners):
         zone = cfg.zones.get(lc.zone)

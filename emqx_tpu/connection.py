@@ -678,6 +678,12 @@ class Listener:
         self.loop_group = None
         self._lsock = None
         self._accept_task: Optional[asyncio.Task] = None
+        # graceful shutdown (docs/DURABILITY.md): a v5 reason code to
+        # send in a DISCONNECT before force-closing live connections
+        # at stop() — Node.stop sets Server-Shutting-Down (0x8B) on a
+        # durable node so clients learn to reconnect-and-resume.
+        # None = the legacy silent close
+        self.shutdown_rc: Optional[int] = None
         self._loop_conns: List[int] = []
 
     async def _handshake(self, reader, writer):
@@ -900,12 +906,14 @@ class Listener:
                 except RuntimeError:
                     pass
 
-    @staticmethod
-    def _shutdown_conn(conn) -> None:
+    def _shutdown_conn(self, conn) -> None:
         try:
             if not conn.channel.closed:
                 conn.channel.disconnect_reason = "server_shutdown"
-                conn.channel._shutdown()
+                # graceful stop: v5 clients get DISCONNECT 0x8B
+                # (Server-Shutting-Down) so they reconnect-and-resume
+                # instead of diagnosing a dead socket
+                conn.channel._shutdown(rc=self.shutdown_rc)
             conn._close_transport()
         except Exception:
             pass

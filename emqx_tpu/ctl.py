@@ -92,6 +92,10 @@ class Ctl:
             "faults", self._faults,
             "list | arm <point[:action[:times[:delay_ms]]]> | "
             "disarm <point> | clear | on | off")
+        self.register_command(
+            "durability", self._durability,
+            "journal/checkpoint/recovery state | checkpoint — "
+            "commit a generation now")
         from emqx_tpu.profiling import register_ctl
         register_ctl(self)
 
@@ -112,6 +116,18 @@ class Ctl:
         br = self.node.broker.breaker
         out["breaker"] = br.info() if br is not None else "disabled"
         return json.dumps(out, indent=2)
+
+    def _durability(self, args) -> str:
+        """One-stop durability diagnosis (docs/DURABILITY.md):
+        generation, journal bytes/records/degraded state, last fsync
+        latency, checkpoint age, and the last recovery summary."""
+        dur = self.node.durability
+        if dur is None:
+            return ("durability not enabled "
+                    "([durability] enabled = true in the config)")
+        if args and args[0] == "checkpoint":
+            return json.dumps(dur.checkpoint_now(), indent=2)
+        return json.dumps(dur.info(), indent=2, default=str)
 
     def _faults(self, args) -> str:
         from emqx_tpu import faults

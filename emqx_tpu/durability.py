@@ -1,0 +1,769 @@
+"""Durable broker state: journal + atomic checkpoints + exact crash
+recovery (docs/DURABILITY.md).
+
+The reference keeps routes/retained/session state in Mnesia ram
+copies and survives node death by having OTHER nodes hold replicas
+(PAPER.md §L0/§L2 — ``emqx_cm`` takeover, ``emqx_router`` bag
+tables). This build's durability story is per-node and disk-backed
+instead: a kill -9 at millions of persistent subscriptions restarts
+into the exact pre-crash state — automaton straight back into HBM via
+the checkpoint fast path, retained topics re-armed, persistent
+sessions resurrected so reconnecting clients get session-present
+CONNACKs and DUP redelivery of unacked QoS1/2.
+
+Three planes are durable; everything else deliberately is not
+(docs/DURABILITY.md "What is NOT durable"):
+
+  1. **Routes** — every (filter, dest) refcount change journals an
+     absolute-value record; checkpoints reuse
+     :func:`checkpoint.save`'s table snapshot so restore is a
+     device_put, not a re-flatten.
+  2. **Retained messages** — set/clear journal records +
+     full-store checkpoint (tombstones included, so a restore can't
+     resurrect deletes a peer later syncs against).
+  3. **Persistent sessions** (session-expiry > 0) — lifecycle,
+     subscriptions, and the QoS1/2 inflight window + mqueue as
+     coalesced full-state records: however many transitions a batch
+     caused, ONE ``sess.state`` record per dirty session per flush.
+
+Consistency protocol:
+
+  - journal appends buffer in memory; the ingress executor flushes
+    them with one batched fsync per publish batch (plus a timer);
+  - a checkpoint ROTATES the journal first, then snapshots — records
+    landing in the window live in both the new journal and the
+    snapshot, and every record is idempotent, so replay-on-top is
+    exact;
+  - the generation commits via tmp-file + fsync + MANIFEST rename;
+    old journals/segments are deleted only after the rename lands;
+  - recovery loads the newest intact generation, replays every
+    journal at-or-after its sequence, truncates at the first torn
+    record (``journal_torn_tail`` alarm — a crash mid-append is
+    expected, not fatal), resurrects sessions, and prunes route refs
+    that belonged to crash-dead clean sessions (their connections
+    died with the process, exactly as if they had disconnected).
+
+``[durability] enabled = false`` builds none of this — every hot-path
+site is one ``None`` attribute test (pinned by tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from emqx_tpu import checkpoint
+from emqx_tpu import topic as T
+from emqx_tpu.wal import Wal, replay as wal_replay
+
+log = logging.getLogger("emqx_tpu.durability")
+
+_JOURNAL_RE = re.compile(r"^journal-(\d+)\.wal$")
+
+
+@dataclasses.dataclass
+class DurabilityConfig:
+    """``[durability]`` TOML section (closed schema, like
+    ``[overload]``)."""
+
+    #: master switch — False builds no manager at all: the broker/cm/
+    #: session/retainer guards read None and the hot paths are
+    #: byte-for-byte the pre-durability build
+    enabled: bool = False
+    #: journal + checkpoint directory (created on boot)
+    dir: str = "data/durability"
+    #: False skips the per-flush os.fsync (still write-batched) —
+    #: for tests and throwaway nodes only
+    fsync: bool = True
+    #: background flush/checkpoint tick
+    flush_interval_ms: float = 50.0
+    #: wall-clock checkpoint cadence (journal must be non-empty)
+    checkpoint_interval_s: float = 300.0
+    #: journal records that force a checkpoint before the interval
+    checkpoint_min_records: int = 100_000
+    #: degraded-mode (disk-full) retry backoff
+    retry_backoff_s: float = 1.0
+    retry_backoff_max_s: float = 30.0
+    #: bounded in-memory record buffer while degraded/unarmed
+    max_buffer_records: int = 100_000
+
+    def __post_init__(self) -> None:
+        if self.flush_interval_ms <= 0:
+            raise ValueError("durability.flush_interval_ms must be > 0")
+        if self.checkpoint_interval_s <= 0:
+            raise ValueError(
+                "durability.checkpoint_interval_s must be > 0")
+        if self.checkpoint_min_records <= 0:
+            raise ValueError(
+                "durability.checkpoint_min_records must be > 0")
+
+
+class DurabilityManager:
+    def __init__(self, node, cfg: DurabilityConfig) -> None:
+        self.node = node
+        self.cfg = cfg
+        os.makedirs(cfg.dir, exist_ok=True)
+        self.wal: Optional[Wal] = None
+        #: committed checkpoint generation (0 = none yet)
+        self.gen = 0
+        #: journal sequence the CURRENT segment writes under
+        self._seq = 0
+        #: records buffered before recover() arms the on-disk journal
+        self._pending_ops: List[tuple] = []
+        self._dirty: set = set()
+        #: cid -> detach wall time for detached durable sessions
+        self._detach_ts: Dict[str, float] = {}
+        self._replaying = False
+        self._ckpt_lock = threading.Lock()
+        self.last_checkpoint_ts: Optional[float] = None
+        self.last_recovery: Optional[dict] = None
+        self.counters: Dict[str, int] = {
+            "checkpoint.saves": 0, "checkpoint.errors": 0,
+            "recovery.replayed": 0, "recovery.torn": 0,
+            "recovery.sessions": 0, "recovery.routes.pruned": 0,
+        }
+        self._last_fold: Dict[str, int] = {}
+        #: thread-recorded alarm events, drained on the main loop by
+        #: the stats tick (("activate"|"deactivate", name, details,
+        #: message) — same pattern as Node._note_flatten_error)
+        self._events: List[tuple] = []
+
+    # -- paths ------------------------------------------------------------
+
+    def _journal_path(self, seq: int) -> str:
+        return os.path.join(self.cfg.dir, f"journal-{seq}.wal")
+
+    def _scan_journals(self) -> List[int]:
+        seqs = []
+        try:
+            names = os.listdir(self.cfg.dir)
+        except OSError:
+            return []
+        for name in names:
+            m = _JOURNAL_RE.match(name)
+            if m:
+                seqs.append(int(m.group(1)))
+        return sorted(seqs)
+
+    def _retainer(self):
+        return self.node.modules._loaded.get("retainer")
+
+    # -- journal append side (called from broker/cm/channel/retainer) -----
+
+    def _append(self, op: tuple) -> None:
+        if self._replaying:
+            return
+        w = self.wal
+        if w is not None:
+            w.append(op)
+            return
+        # pre-recovery / library-mode buffering (bounded)
+        self._pending_ops.append(op)
+        if len(self._pending_ops) > self.cfg.max_buffer_records:
+            del self._pending_ops[0]
+
+    def journal_subscribe(self, sub, topic_filter: str, flt: str,
+                          dest, opts, resub: bool) -> None:
+        if self._replaying:
+            return
+        if not resub:
+            self._append(("route", flt, dest,
+                          self.node.router.route_refs(flt, dest)))
+        if getattr(sub, "durable", False):
+            self._append(("sess.sub", sub.client_id, topic_filter,
+                          opts))
+
+    def journal_unsubscribe(self, sub, topic_filter: str, flt: str,
+                            dest) -> None:
+        if self._replaying:
+            return
+        self._append(("route", flt, dest,
+                      self.node.router.route_refs(flt, dest)))
+        if getattr(sub, "durable", False):
+            self._append(("sess.unsub", sub.client_id, topic_filter))
+
+    def journal_retain(self, topic: str, msg,
+                       ts: Optional[float] = None) -> None:
+        if self._replaying:
+            return
+        self._append(("retain", topic, msg,
+                      time.time() if ts is None else float(ts)))
+
+    # -- session lifecycle (called from channel/cm) -----------------------
+
+    def session_opened(self, sess, expiry_interval: float) -> None:
+        """CONNECT accepted: arm (or demote) the session's durability
+        and journal a full-state record — idempotent overwrite, so a
+        resume after recovery re-baselines cleanly."""
+        if self._replaying:
+            return
+        cid = sess.client_id
+        if expiry_interval > 0:
+            sess.durable = True
+            sess._dur = self
+            sess.expiry_interval = expiry_interval
+            self._detach_ts.pop(cid, None)
+            self._append_state(sess, None)
+        elif getattr(sess, "durable", False):
+            # previously-persistent cid reconnected with expiry 0:
+            # the session now dies with the connection
+            sess.durable = False
+            sess._dur = None
+            self._detach_ts.pop(cid, None)
+            self._append(("sess.close", cid))
+
+    def session_detached(self, sess) -> None:
+        """Persistent disconnect: the final pre-detach state (the
+        record a crash-after-disconnect recovery resumes from)."""
+        if not getattr(sess, "durable", False) or self._replaying:
+            return
+        now = time.time()
+        self._detach_ts[sess.client_id] = now
+        self._dirty.discard(sess)
+        self._append_state(sess, now)
+
+    def session_closed(self, cid: str) -> None:
+        """The session ended for good (clean-start discard, expiry,
+        kick, zero-expiry disconnect)."""
+        if self._replaying:
+            return
+        self._detach_ts.pop(cid, None)
+        self._append(("sess.close", cid))
+
+    def _append_state(self, sess,
+                      detached_ts: Optional[float]) -> None:
+        try:
+            d = sess.to_wire()
+        except Exception:
+            # a concurrent mutation on the owning loop mid-walk: skip
+            # this snapshot, retry at the next flush
+            self._dirty.add(sess)
+            return
+        self._append(("sess.state", sess.client_id, detached_ts, d))
+
+    def mark_dirty(self, sess) -> None:
+        self._dirty.add(sess)
+
+    # -- flush side (executor thread / timer) -----------------------------
+
+    def _flush_states(self) -> None:
+        while self._dirty:
+            try:
+                sess = self._dirty.pop()
+            except KeyError:
+                break
+            if not getattr(sess, "durable", False):
+                continue
+            self._append_state(
+                sess, self._detach_ts.get(sess.client_id))
+
+    def on_batch(self) -> None:
+        """The per-publish-batch hook (Broker.publish_fetch, executor
+        thread) and the timer body: coalesce dirty session states,
+        then one batched write+fsync."""
+        w = self.wal
+        if w is None:
+            return
+        if self._dirty:
+            self._flush_states()
+        if w.pending():
+            w.flush()
+
+    flush = on_batch
+
+    # -- checkpoint -------------------------------------------------------
+
+    def _checkpoint_due(self) -> bool:
+        w = self.wal
+        if w is None or (w.records == 0 and not w.pending()):
+            return False
+        if w.records + w.pending() >= self.cfg.checkpoint_min_records:
+            return True
+        last = self.last_checkpoint_ts or 0.0
+        return time.time() - last >= self.cfg.checkpoint_interval_s
+
+    def _snapshot_state(self) -> dict:
+        sessions: List[Tuple[str, Optional[float], dict]] = []
+        seen = set()
+        cm = self.node.cm
+        for cid, (s, ts, _exp) in list(cm._detached.items()):
+            if getattr(s, "durable", False):
+                try:
+                    sessions.append((cid, float(ts), s.to_wire()))
+                    seen.add(cid)
+                except Exception:
+                    log.warning("session %r skipped a checkpoint "
+                                "snapshot (concurrent mutation)", cid)
+        for cid, chan in list(cm._channels.items()):
+            s = getattr(chan, "session", None)
+            if s is None or cid in seen \
+                    or not getattr(s, "durable", False):
+                continue
+            try:
+                sessions.append((cid, None, s.to_wire()))
+            except Exception:
+                log.warning("session %r skipped a checkpoint "
+                            "snapshot (concurrent mutation)", cid)
+        retained: List[tuple] = []
+        tombstones: List[tuple] = []
+        ret = self._retainer()
+        if ret is not None:
+            retained = list(ret._store.items())
+            tombstones = list(ret._tombstones.items())
+        return {"format": 1, "ts": time.time(),
+                "sessions": sessions, "retained": retained,
+                "tombstones": tombstones}
+
+    def checkpoint_now(self, clean_shutdown: bool = False) -> dict:
+        """One atomic generation: rotate the journal, snapshot all
+        three planes, commit via manifest rename, then truncate the
+        superseded journals/segments. Safe from any thread; failures
+        leave the previous generation authoritative."""
+        with self._ckpt_lock:
+            t0 = time.time()
+            gen = self.gen + 1
+            seq = self._seq + 1
+            d = self.cfg.dir
+            try:
+                if self.wal is not None:
+                    # rotate FIRST: records racing the snapshot land
+                    # in the new journal AND the snapshot — replay-
+                    # on-top is idempotent, loss is impossible
+                    self._flush_states()
+                    self.wal.rotate(self._journal_path(seq))
+                self._seq = seq
+                router_file = f"router-{gen}.npz"
+                state_file = f"state-{gen}.bin"
+                rtmp = os.path.join(d, f"router-{gen}.tmp.npz")
+                stmp = os.path.join(d, f"state-{gen}.tmp.bin")
+                info = checkpoint.save(self.node.router, rtmp)
+                _fsync_file(rtmp)
+                os.replace(rtmp, os.path.join(d, router_file))
+                state = self._snapshot_state()
+                checkpoint.save_state(stmp, state)
+                os.replace(stmp, os.path.join(d, state_file))
+                manifest = {
+                    "format": checkpoint.MANIFEST_FORMAT,
+                    "generation": gen,
+                    "journal_seq": seq,
+                    "router": router_file,
+                    "state": state_file,
+                    "crc": {
+                        router_file: checkpoint.file_crc(
+                            os.path.join(d, router_file)),
+                        state_file: checkpoint.file_crc(
+                            os.path.join(d, state_file)),
+                    },
+                    "clean_shutdown": bool(clean_shutdown),
+                    "node": str(self.node.name),
+                    "ts": t0,
+                }
+                # the commit point (checkpoint.rename fault fires
+                # just before the rename inside)
+                checkpoint.write_manifest(d, manifest)
+                self.gen = gen
+                self.last_checkpoint_ts = time.time()
+                self.counters["checkpoint.saves"] += 1
+                self._cleanup(gen, seq)
+                self._event("deactivate", "checkpoint_failed")
+                return {"generation": gen, "routes": info["routes"],
+                        "sessions": len(state["sessions"]),
+                        "retained": len(state["retained"]),
+                        "duration_s": round(time.time() - t0, 3)}
+            except Exception as e:
+                # previous generation stays authoritative; the new
+                # journal segment keeps every record (replayed on top
+                # of the OLD checkpoint at recovery)
+                self.counters["checkpoint.errors"] += 1
+                self._event(
+                    "activate", "checkpoint_failed",
+                    {"error": repr(e), "generation": gen},
+                    "checkpoint commit failed; previous generation "
+                    "still authoritative")
+                log.exception("checkpoint generation %d failed", gen)
+                return {"error": repr(e), "generation": gen}
+
+    def _cleanup(self, gen: int, seq: int) -> None:
+        """After a committed manifest: superseded journals truncate
+        and older/orphaned generation segments are removed."""
+        d = self.cfg.dir
+        for s in self._scan_journals():
+            if s < seq:
+                _unlink(os.path.join(d, f"journal-{s}.wal"))
+        keep = {f"router-{gen}.npz", f"state-{gen}.bin",
+                checkpoint.MANIFEST}
+        for name in os.listdir(d):
+            if name in keep or _JOURNAL_RE.match(name):
+                continue
+            if name.startswith(("router-", "state-", "MANIFEST.")):
+                _unlink(os.path.join(d, name))
+
+    # -- recovery ---------------------------------------------------------
+
+    def recover(self) -> dict:
+        """Boot-time restore: newest intact checkpoint + journal tail
+        replay + session resurrection + orphan-route pruning, then a
+        fresh baseline checkpoint. Corruption degrades plane-by-plane
+        with the ``recovery_degraded`` alarm — a damaged directory
+        costs data, never the boot."""
+        t0 = time.time()
+        node = self.node
+        degraded: List[str] = []
+        summary: Dict[str, Any] = {}
+        rec_sessions: Dict[str, list] = {}  # cid -> [detached_ts, d]
+        rec_retained: Dict[str, Any] = {}
+        rec_tombs: Dict[str, float] = {}
+        self._replaying = True
+        try:
+            manifest = None
+            try:
+                manifest = checkpoint.read_manifest(self.cfg.dir)
+            except checkpoint.CheckpointError as e:
+                degraded.append(f"manifest: {e}")
+            jseq0 = 0
+            if manifest is not None:
+                jseq0 = int(manifest.get("journal_seq", 0))
+                self.gen = int(manifest.get("generation", 0))
+                self._load_generation(manifest, degraded,
+                                      rec_sessions, rec_retained,
+                                      rec_tombs, summary)
+            replayed = torn_files = 0
+            seqs = [s for s in self._scan_journals() if s >= jseq0]
+            for s in seqs:
+                records, torn = wal_replay(self._journal_path(s))
+                for rec in records:
+                    try:
+                        self._apply(rec, rec_sessions, rec_retained,
+                                    rec_tombs)
+                        replayed += 1
+                    except Exception:
+                        log.warning("skipping malformed journal "
+                                    "record %r", rec[:1])
+                if torn:
+                    torn_files += 1
+                    log.warning("journal %s truncated at a torn "
+                                "record (crash mid-append)",
+                                self._journal_path(s))
+            self.counters["recovery.replayed"] += replayed
+            self.counters["recovery.torn"] += torn_files
+            if torn_files:
+                node.alarms.activate(
+                    "journal_torn_tail",
+                    details={"journals": torn_files},
+                    message="journal replay truncated at a torn "
+                            "record; unsynced tail ops lost")
+            resurrected = self._resurrect(rec_sessions)
+            pruned = self._prune_orphan_routes(resurrected)
+            self._install_retained(rec_retained, rec_tombs, degraded)
+            summary.update({
+                "journals": len(seqs),
+                "replayed_records": replayed,
+                "torn_journals": torn_files,
+                "sessions": len(resurrected),
+                "retained": len(rec_retained),
+                "routes": node.router.stats()["routes.count"],
+                "pruned_refs": pruned,
+                "degraded": degraded,
+                "duration_s": round(time.time() - t0, 3),
+                "generation": self.gen,
+            })
+            self.counters["recovery.sessions"] += len(resurrected)
+            self.counters["recovery.routes.pruned"] += pruned
+        finally:
+            self._replaying = False
+        if degraded:
+            node.alarms.activate(
+                "recovery_degraded",
+                details={"planes": degraded},
+                message="recovery skipped corrupt segments; state "
+                        "restored partially")
+        # arm the on-disk journal on a FRESH segment (never append to
+        # a possibly-torn file), drain anything buffered pre-recovery,
+        # and commit a baseline generation so the next crash replays
+        # nothing
+        self._seq = max(self._scan_journals() + [self._seq,
+                                                 jseq0]) + 1
+        self.wal = Wal(
+            self._journal_path(self._seq), fsync=self.cfg.fsync,
+            max_buffer=self.cfg.max_buffer_records,
+            retry_backoff_s=self.cfg.retry_backoff_s,
+            retry_backoff_max_s=self.cfg.retry_backoff_max_s,
+            on_error=self._wal_error)
+        for op in self._pending_ops:
+            self.wal.append(op)
+        self._pending_ops = []
+        self.wal.flush()
+        ck = self.checkpoint_now()
+        summary["baseline"] = ck.get("generation", ck)
+        self.last_recovery = summary
+        log.info("recovery: %s", summary)
+        return summary
+
+    def _load_generation(self, manifest, degraded, rec_sessions,
+                         rec_retained, rec_tombs, summary) -> None:
+        d = self.cfg.dir
+        node = self.node
+        rp = os.path.join(d, manifest.get("router", ""))
+        crcs = manifest.get("crc", {})
+        try:
+            want = crcs.get(manifest.get("router"))
+            if want is not None \
+                    and checkpoint.file_crc(rp) != int(want):
+                raise checkpoint.CheckpointError(
+                    f"router segment CRC mismatch: {rp}")
+            if node.router.has_routes():
+                raise checkpoint.CheckpointError(
+                    "router already has routes (restore needs a "
+                    "fresh node)")
+            info = checkpoint.load(node.router, rp)
+            summary["checkpoint_routes"] = info["routes"]
+            summary["tables_restored"] = info["tables_restored"]
+        except (checkpoint.CheckpointError, OSError) as e:
+            degraded.append(f"router: {e}")
+        sp = os.path.join(d, manifest.get("state", ""))
+        try:
+            want = crcs.get(manifest.get("state"))
+            if want is not None \
+                    and checkpoint.file_crc(sp) != int(want):
+                raise checkpoint.CheckpointError(
+                    f"state segment CRC mismatch: {sp}")
+            state = checkpoint.load_state(sp)
+            for cid, ts, sd in state.get("sessions", []):
+                rec_sessions[cid] = [ts, sd]
+            for topic, msg in state.get("retained", []):
+                rec_retained[topic] = msg
+            for topic, ts in state.get("tombstones", []):
+                rec_tombs[topic] = float(ts)
+        except (checkpoint.CheckpointError, OSError) as e:
+            degraded.append(f"state: {e}")
+
+    def _apply(self, rec, rec_sessions, rec_retained,
+               rec_tombs) -> None:
+        """One journal record, idempotently (absolute refcounts, full
+        state overwrites, keyed set/clear)."""
+        op = rec[0]
+        if op == "route":
+            _, flt, dest, refs = rec
+            self.node.router.set_route_refs(flt, dest, int(refs))
+        elif op == "retain":
+            _, topic, msg, ts = rec
+            if msg is None:
+                rec_retained.pop(topic, None)
+                rec_tombs[topic] = max(rec_tombs.get(topic, 0.0),
+                                       float(ts))
+            else:
+                rec_retained[topic] = msg
+        elif op == "sess.state":
+            _, cid, dts, d = rec
+            rec_sessions[cid] = [dts, d]
+        elif op == "sess.sub":
+            _, cid, key, opts = rec
+            ent = rec_sessions.get(cid)
+            if ent is not None:
+                ent[1]["subscriptions"][key] = opts
+        elif op == "sess.unsub":
+            _, cid, key = rec
+            ent = rec_sessions.get(cid)
+            if ent is not None:
+                ent[1]["subscriptions"].pop(key, None)
+        elif op == "sess.close":
+            rec_sessions.pop(rec[1], None)
+        else:
+            raise ValueError(f"unknown journal op {op!r}")
+
+    def _resurrect(self, rec_sessions) -> list:
+        """Rebuild persistent sessions as DETACHED (the reference's
+        ``disconnected`` state): broker tables re-attach without
+        touching restored route refs; a reconnecting client resumes
+        with session-present and replay()'s DUP redelivery."""
+        from emqx_tpu.session import Session
+
+        node = self.node
+        now = time.time()
+        out = []
+        for cid, (dts, sd) in rec_sessions.items():
+            try:
+                sess = Session.from_wire(sd)
+            except Exception as e:
+                log.warning("session %r unrecoverable: %s", cid, e)
+                continue
+            expiry = float(sd.get("expiry_interval", 0.0) or 0.0)
+            if expiry <= 0:
+                continue  # not persistent — died with the process
+            detach = float(dts) if dts is not None else now
+            if now - detach >= expiry:
+                continue  # expired while the node was down
+            sess.client_id = cid
+            sess.broker = node.broker
+            sess.durable = True
+            sess._dur = self
+            for key, opts in list(sess.subscriptions.items()):
+                try:
+                    node.broker.restore_subscription(sess, key, opts)
+                except Exception:
+                    log.exception("restoring %r of %r failed",
+                                  key, cid)
+            node.cm._detached[cid] = (sess, detach, expiry)
+            self._detach_ts[cid] = detach
+            out.append(sess)
+        return out
+
+    def _prune_orphan_routes(self, sessions) -> int:
+        """Route refs whose owners were clean sessions died with the
+        process — remove them exactly as their disconnects would
+        have. Remote (other-node) dests are left alone: the cluster
+        layer reconciles those on rejoin."""
+        node = self.node
+        router = node.router
+        expected: Dict[tuple, int] = {}
+        for sess in sessions:
+            for key, opts in sess.subscriptions.items():
+                flt, popts = T.parse(key)
+                share = popts.get("share",
+                                  getattr(opts, "share", None))
+                dest = (share, node.broker.node) if share \
+                    else node.broker.node
+                expected[(flt, dest)] = \
+                    expected.get((flt, dest), 0) + 1
+        pruned = 0
+        self_node = node.broker.node
+        for flt, dests in router.route_table().items():
+            for dest, refs in dests.items():
+                local = dest == self_node or (
+                    isinstance(dest, tuple) and len(dest) == 2
+                    and dest[1] == self_node)
+                if not local:
+                    continue
+                want = expected.get((flt, dest), 0)
+                for _ in range(refs - want):
+                    router.delete_route(flt, dest=dest)
+                    pruned += 1
+        return pruned
+
+    def _install_retained(self, rec_retained, rec_tombs,
+                          degraded) -> None:
+        ret = self._retainer()
+        if ret is None:
+            if rec_retained:
+                degraded.append(
+                    f"retained: {len(rec_retained)} recovered "
+                    f"messages but no retainer module loaded")
+            return
+        ret.restore_entries(rec_retained.items(), rec_tombs.items())
+
+    # -- lifecycle / observability ---------------------------------------
+
+    async def run(self) -> None:
+        """Background flush + checkpoint cadence. Disk work runs on
+        the default executor — the event loop never waits on fsync."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self.cfg.flush_interval_ms / 1000.0)
+            try:
+                await loop.run_in_executor(None, self.on_batch)
+                if self._checkpoint_due():
+                    await loop.run_in_executor(
+                        None, self.checkpoint_now)
+            except Exception:
+                log.exception("durability tick failed")
+
+    def shutdown(self) -> None:
+        """Graceful stop: flush everything, one final checkpoint
+        (marked clean), close the journal — restart recovery then
+        starts from the checkpoint instead of a journal replay."""
+        if self.wal is None:
+            return
+        self._flush_states()
+        self.wal.flush()
+        self.checkpoint_now(clean_shutdown=True)
+        self.wal.close()
+
+    def _wal_error(self, exc) -> None:
+        """Wal flush outcome (executor thread): exc degrades to the
+        ``wal_write_failed`` alarm, None clears it — both applied
+        on-loop by drain_events."""
+        if exc is not None:
+            self._event("activate", "wal_write_failed",
+                        {"error": repr(exc)},
+                        "journal flush failed; memory-only with "
+                        "bounded backoff retry (publishes continue)")
+        else:
+            self._event("deactivate", "wal_write_failed")
+
+    def _event(self, kind: str, name: str, details: dict = None,
+               message: str = "") -> None:
+        self._events.append((kind, name, details or {}, message))
+
+    def drain_events(self, alarms) -> None:
+        """Apply thread-recorded alarm transitions (stats tick, main
+        loop)."""
+        while self._events:
+            try:
+                kind, name, details, message = self._events.pop(0)
+            except IndexError:
+                break
+            if kind == "activate":
+                alarms.activate(name, details=details, message=message)
+            else:
+                alarms.deactivate(name)
+
+    def fold_metrics(self, metrics) -> None:
+        """Fold counter DELTAS into the node metrics (stats tick) —
+        the journal's own counters are written from the executor
+        thread, so the lock-free metrics array only ever sees them
+        from here."""
+        cur = dict(self.counters)
+        w = self.wal
+        if w is not None:
+            wi = w.info()
+            cur.update({
+                "wal.appends": wi["appends_total"],
+                "wal.fsyncs": wi["fsyncs"],
+                "wal.fsync_errors": wi["fsync_errors"],
+                "wal.dropped": wi["dropped"],
+            })
+        for name, val in cur.items():
+            delta = val - self._last_fold.get(name, 0)
+            if delta:
+                metrics.inc(name, delta)
+        self._last_fold = cur
+
+    def info(self) -> dict:
+        out = {
+            "enabled": True,
+            "dir": self.cfg.dir,
+            "generation": self.gen,
+            "journal": self.wal.info() if self.wal is not None
+            else {"armed": False,
+                  "pending": len(self._pending_ops)},
+            "dirty_sessions": len(self._dirty),
+            "last_checkpoint_ts": self.last_checkpoint_ts,
+            "checkpoint_age_s": (
+                round(time.time() - self.last_checkpoint_ts, 1)
+                if self.last_checkpoint_ts else None),
+            "last_recovery": self.last_recovery,
+            "counters": dict(self.counters),
+        }
+        return out
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _unlink(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass

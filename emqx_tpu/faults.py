@@ -90,6 +90,19 @@ POINTS: Dict[str, tuple] = {
     "ingress.saturate": ("drop",
                          "IngressBatcher.backlogged — the ingress "
                          "accumulator reports saturation"),
+    "wal.append": ("drop",
+                   "Wal.flush — a journal frame short-writes (torn "
+                   "tail on disk, as if the process crashed "
+                   "mid-append) and the writer degrades"),
+    "wal.fsync": ("raise",
+                  "Wal.flush — the batched fsync fails (disk full): "
+                  "the journal degrades to memory-only with alarm + "
+                  "bounded backoff retry; publishes never wedge"),
+    "checkpoint.rename": ("raise",
+                          "checkpoint.write_manifest — crash before "
+                          "the manifest rename lands (every new "
+                          "segment written, previous generation "
+                          "still authoritative)"),
 }
 
 _ACTIONS = ("raise", "stall", "drop")

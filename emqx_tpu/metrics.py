@@ -168,11 +168,32 @@ FAULT_METRICS = [
     "faults.injected",
 ]
 
+# durability layer (wal.py + durability.py, docs/DURABILITY.md):
+# `wal.appends` = journal records framed, `wal.fsyncs` = batched
+# write+sync cycles (one per ingress batch with dirty state, NOT one
+# per record — the fsync-batching contract), `wal.fsync_errors` =
+# flushes that failed and degraded the journal to memory-only,
+# `wal.dropped` = records shed by the bounded degraded-mode buffer,
+# `checkpoint.saves`/`checkpoint.errors` = atomic generation commits
+# and failed attempts, `recovery.replayed` = journal records applied
+# at boot, `recovery.torn` = journals truncated at a torn tail (a
+# crash mid-append — expected, alarmed, never fatal),
+# `recovery.sessions` = persistent sessions resurrected,
+# `recovery.routes.pruned` = crash-dead clean-session route refs
+# removed after restore
+DURABILITY_METRICS = [
+    "wal.appends", "wal.fsyncs", "wal.fsync_errors", "wal.dropped",
+    "checkpoint.saves", "checkpoint.errors",
+    "recovery.replayed", "recovery.torn", "recovery.sessions",
+    "recovery.routes.pruned",
+]
+
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
                + AUTOMATON_METRICS + TRANSPORT_METRICS
-               + OVERLOAD_METRICS + BREAKER_METRICS + FAULT_METRICS)
+               + OVERLOAD_METRICS + BREAKER_METRICS + FAULT_METRICS
+               + DURABILITY_METRICS)
 
 #: registry names that are NOT monotonic — ``Metrics.dec`` runs on
 #: them in steady state (today: the retainer's live-entry count,

@@ -335,6 +335,10 @@ class RetainerModule(Module):
         # delete tombstones (topic -> delete time): a stale
         # rejoiner's sync must not resurrect a deleted message
         self._tombstones: Dict[str, float] = {}
+        # durability (docs/DURABILITY.md): store/delete journal
+        # through node.durability; True while crash recovery is
+        # refilling the store (those mutations must not re-journal)
+        self._restoring = False
         self.max_retained = 0
         self.max_payload = 0
         # cluster seam: Cluster sets node.retain_replicate so stores/
@@ -392,16 +396,47 @@ class RetainerModule(Module):
         self._index.clear()
 
     # every store mutation goes through these so the reverse index
-    # (device matrix) stays in lockstep with the dict
+    # (device matrix) stays in lockstep with the dict — and, with
+    # durability on, the journal sees exactly the store's mutations
     def _put(self, topic: str, msg: Message) -> None:
         self._store[topic] = msg
         self._index.add(topic)
+        if not self._restoring:
+            dur = getattr(self.node, "durability", None)
+            if dur is not None:
+                dur.journal_retain(topic, msg, msg.timestamp)
 
     def _pop(self, topic: str):
         msg = self._store.pop(topic, None)
         if msg is not None:
             self._index.remove(topic)
+            if not self._restoring:
+                dur = getattr(self.node, "durability", None)
+                if dur is not None:
+                    dur.journal_retain(topic, None)
         return msg
+
+    def restore_entries(self, items, tombstones=()) -> None:
+        """Crash-recovery refill (durability.py): install recovered
+        (topic, Message) pairs + delete tombstones without
+        re-journaling, honoring expiry and the store bounds."""
+        self._restoring = True
+        try:
+            for topic, msg in items:
+                if msg is None or msg.is_expired():
+                    continue
+                if self.max_retained \
+                        and len(self._store) >= self.max_retained:
+                    self.node.metrics.inc("retained.dropped")
+                    continue
+                if topic not in self._store:
+                    self.node.metrics.inc("retained.count")
+                self._put(topic, msg)
+            for topic, ts in tombstones:
+                self._tombstones[topic] = max(
+                    self._tombstones.get(topic, 0.0), float(ts))
+        finally:
+            self._restoring = False
 
     # -- store maintenance -------------------------------------------------
 

@@ -74,3 +74,20 @@ class MQueue:
     def info(self) -> dict:
         return {"store_qos0": self.store_qos0, "max_len": self.max_len,
                 "len": self._len, "dropped": self.dropped}
+
+    # -- serialization (session to_wire / durability checkpoints) ---------
+
+    def snapshot(self):
+        """Per-priority FIFO contents, order-preserving:
+        ``[(priority, [Message, ...]), ...]`` — pure data, encodable
+        by the cluster wire codec."""
+        return [(p, list(q)) for p, q in self._q._qs.items()]
+
+    def restore(self, items) -> None:
+        """Refill from :meth:`snapshot` output (onto an empty queue;
+        bypasses the QoS0/length policies — the messages already
+        passed them when first enqueued)."""
+        for prio, msgs in items:
+            for msg in msgs:
+                self._q.push(msg, prio)
+                self._len += 1

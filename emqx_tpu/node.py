@@ -57,6 +57,7 @@ class Node:
                  loops: int = 1,
                  overload: Optional[OverloadConfig] = None,
                  faults_config=None,
+                 durability=None,
                  plugin_config_dir: Optional[str] = None) -> None:
         self.name = name
         self.zone = zone or get_zone()
@@ -125,6 +126,18 @@ class Node:
         # build; no section = the module-level registry is untouched
         if faults_config is not None:
             _faults.configure(faults_config)
+        # durability layer ([durability], durability.py,
+        # docs/DURABILITY.md): write-ahead journal + atomic
+        # checkpoints + crash recovery. enabled = false (the default)
+        # builds NO manager: broker/cm/channel/session/retainer
+        # guards read None and the hot paths are byte-for-byte the
+        # pre-durability build
+        self.durability = None
+        if durability is not None and durability.enabled:
+            from emqx_tpu.durability import DurabilityManager
+            self.durability = DurabilityManager(self, durability)
+            self.broker.durability = self.durability
+            self.cm.durability = self.durability
         # crashed background compaction: the router's thread records
         # the error here (plain attribute store — thread-safe); the
         # monitor/stats tick turns it into the alarm + backoff-retry
@@ -275,6 +288,13 @@ class Node:
             return
         if self._load_default_modules:
             self.load_default_modules()
+        if self.durability is not None:
+            # crash recovery BEFORE any listener accepts: newest
+            # intact checkpoint into HBM, journal tail replayed,
+            # retained topics re-armed, persistent sessions
+            # resurrected (docs/DURABILITY.md). Runs with modules
+            # loaded so the retainer can take its store back
+            self.durability.recover()
         if self.boot_listeners and not self.listeners:
             self.add_listener()
         if self.loop_group is not None:
@@ -315,6 +335,9 @@ class Node:
         if self.overload is not None:
             self._bg_tasks.append(
                 loop.create_task(self.overload.run()))
+        if self.durability is not None:
+            self._bg_tasks.append(
+                loop.create_task(self.durability.run()))
         self._started = True
         log.info("node %s started", self.name)
 
@@ -336,12 +359,28 @@ class Node:
         # quiesce module background tasks (scrape sockets, timers)
         # without unloading — start() re-kicks them
         self.modules.on_loop_stop()
+        if self.durability is not None:
+            # graceful shutdown (docs/DURABILITY.md): v5 clients get
+            # DISCONNECT Server-Shutting-Down (0x8B) before their
+            # sockets close, so fleets reconnect-and-resume instead
+            # of diagnosing a dead peer
+            from emqx_tpu.mqtt import reason_codes as RC
+            for lst in self.listeners:
+                lst.shutdown_rc = RC.SERVER_SHUTTING_DOWN
         # listeners first: drain() loops until quiescent, which never
         # happens while live connections keep submitting publishes
         for lst in self.listeners:
             await lst.stop()
         if self.ingress is not None:
             await self.ingress.drain()
+        if self.durability is not None:
+            # after listeners closed (sessions detached, final state
+            # records written) and the ingress drained: flush the
+            # journal and commit a clean-shutdown checkpoint — the
+            # next boot recovers from the checkpoint, not a replay
+            loop = asyncio.get_event_loop()
+            await loop.run_in_executor(None,
+                                       self.durability.shutdown)
         if self.cluster is not None and self._cluster_cfg is not None:
             close = getattr(self.cluster.transport, "close", None)
             if close is not None:
@@ -416,6 +455,22 @@ class Node:
         inj = _faults.drain_injected()
         if inj:
             self.metrics.inc("faults.injected", inj)
+        if self.durability is not None:
+            # journal/checkpoint counters are written off-loop —
+            # fold their deltas here, apply thread-recorded alarm
+            # transitions, and publish the operator gauges
+            # (docs/OBSERVABILITY.md)
+            self.durability.fold_metrics(self.metrics)
+            self.durability.drain_events(self.alarms)
+            dinfo = self.durability.info()
+            j = dinfo["journal"]
+            stats.setstat("journal.bytes", int(j.get("bytes", 0)))
+            stats.setstat("journal.records", int(j.get("records", 0)))
+            stats.setstat("durability.generation",
+                          dinfo["generation"])
+            age = dinfo.get("checkpoint_age_s")
+            if age is not None:
+                stats.setstat("checkpoint.age_s", int(age))
         self.drain_robustness_events()
         stats.setstat("publish.spans.count", self.telemetry.spans_total,
                       "publish.spans.max")

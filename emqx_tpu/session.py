@@ -116,6 +116,14 @@ class Session:
         # None = deliver from the main loop (single-loop build,
         # detached sessions, loop-less sync callers).
         self.owner_loop = None
+        # durability (docs/DURABILITY.md): True once the channel
+        # opened this session with a session-expiry > 0 — its
+        # lifecycle, subscriptions and QoS1/2 window then journal
+        # through `_dur` (the node's DurabilityManager). Both stay
+        # None/False on a non-durable build: every `_mark_dirty`
+        # below is one attribute test
+        self.durable = False
+        self._dur = None
 
     # -- info --------------------------------------------------------------
 
@@ -163,8 +171,7 @@ class Session:
             "mq_default_p": self.mqueue.default_p,
             "mq_dropped": self.mqueue.dropped,
             # per-priority FIFO order preserved
-            "mq_items": [(p, list(q))
-                         for p, q in self.mqueue._q._qs.items()],
+            "mq_items": self.mqueue.snapshot(),
         }
 
     @classmethod
@@ -190,16 +197,12 @@ class Session:
         s.created_at = d["created_at"]
         s.subscriptions = dict(d["subscriptions"])
         s._rebuild_share_keys()
-        for pid, val in d["inflight"]:
-            s.inflight.insert(pid, val)
+        s.inflight.restore(d["inflight"])
         s.next_pkt_id = int(d["next_pkt_id"])
         s.awaiting_rel = dict(d["awaiting_rel"])
         s.outbox = list(d["outbox"])
         s.mqueue.dropped = int(d["mq_dropped"])
-        for prio, items in d["mq_items"]:
-            for msg in items:
-                s.mqueue._q.push(msg, prio)
-                s.mqueue._len += 1
+        s.mqueue.restore(d["mq_items"])
         s.connected = False
         return s
 
@@ -265,11 +268,13 @@ class Session:
 
     def record_awaiting_rel(self, packet_id: Optional[int]) -> None:
         self.awaiting_rel[packet_id] = time.time()
+        self._mark_dirty()
 
     def pubrel(self, packet_id: int) -> None:
         if packet_id not in self.awaiting_rel:
             raise SessionError(RC_PACKET_IDENTIFIER_NOT_FOUND)
         del self.awaiting_rel[packet_id]
+        self._mark_dirty()
 
     # -- outbound acks (client acks our deliveries) -----------------------
 
@@ -282,6 +287,7 @@ class Session:
             raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
         self.inflight.delete(packet_id)
         self.dequeue()
+        self._mark_dirty()
         return msg
 
     def discard_delivery(self, packet_id: int) -> None:
@@ -293,6 +299,7 @@ class Session:
         if self.inflight.lookup(packet_id) is not None:
             self.inflight.delete(packet_id)
             self.dequeue()
+            self._mark_dirty()
 
     def pubrec(self, packet_id: int) -> Message:
         val = self.inflight.lookup(packet_id)
@@ -302,6 +309,7 @@ class Session:
         if msg == PUBREL_MARKER:
             raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
         self.inflight.update(packet_id, (PUBREL_MARKER, time.time()))
+        self._mark_dirty()
         return msg
 
     def pubcomp(self, packet_id: int) -> None:
@@ -312,16 +320,33 @@ class Session:
             raise SessionError(RC_PACKET_IDENTIFIER_IN_USE)
         self.inflight.delete(packet_id)
         self.dequeue()
+        self._mark_dirty()
 
     # -- outbound delivery (broker -> client) -----------------------------
+
+    def _mark_dirty(self) -> None:
+        """QoS1/2 window / mqueue / awaiting-rel state changed: tell
+        the durability layer this session needs a journal snapshot at
+        the next batched flush (docs/DURABILITY.md — ONE state record
+        per flush however many transitions happened, so the hot path
+        pays an attribute test here and serialization off-loop)."""
+        d = self._dur
+        if d is not None:
+            d.mark_dirty(self)
 
     def deliver(self, topic_filter: str, msg: Message) -> None:
         """Broker subscriber protocol: enrich, window, queue."""
         m = self._enrich(topic_filter, msg)
         if not self.connected:
             self.enqueue(m)
+            self._mark_dirty()
             return
         self._deliver_msg(m)
+        if m.qos != QOS_0:
+            # QoS0 to a live connection is transient by contract
+            # (recovery may lose it) — only window/queue state
+            # journals
+            self._mark_dirty()
         if self.outbox and self.notify is not None:
             self.notify()
 
@@ -337,6 +362,7 @@ class Session:
         whole group — the batch-wide wakeup coalescing that turns
         N-deliveries-per-batch into one flush per connection."""
         now = None  # one inflight timestamp per delivery group
+        dirty = False
         for flt, msg, opts, fast in items:
             if fast and self.connected:
                 # the _enrich fast path, pre-decided: nothing to
@@ -346,10 +372,16 @@ class Session:
             m = msg if fast else self._enrich(flt, msg, opts)
             if not self.connected:
                 self.enqueue(m)
+                dirty = True
             else:
                 if now is None:
                     now = time.time()
                 self._deliver_msg(m, now)
+                dirty = dirty or m.qos != QOS_0
+        if dirty:
+            # one mark per delivery group, not per message — the
+            # durability flush then writes ONE state record per batch
+            self._mark_dirty()
         if self.outbox and self.notify is not None:
             self.notify()
 
@@ -490,6 +522,7 @@ class Session:
                 msg.set_flag("dup", True)
                 self.inflight.update(pid, (msg, now))
                 self.outbox.append((pid, msg))
+        self._mark_dirty()  # retry stamped new timestamps/dup flags
         return next_delay
 
     def expire_awaiting_rel(self, now: Optional[float] = None) -> None:

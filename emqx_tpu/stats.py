@@ -34,6 +34,12 @@ STATS_KEYS = [
     # between-heartbeats burst visible even after a reset)
     "publish.spans.count", "publish.spans.max",
     "publish.slow.count", "publish.slow.max",
+    # durability layer (docs/DURABILITY.md): current journal segment
+    # size, committed checkpoint generation, and seconds since the
+    # last committed checkpoint (an ever-growing age with a non-empty
+    # journal means checkpoints are failing — see checkpoint_failed)
+    "journal.bytes", "journal.records",
+    "durability.generation", "checkpoint.age_s",
 ]
 
 

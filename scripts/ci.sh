@@ -92,6 +92,29 @@ rec=json.loads(sys.stdin.readlines()[-1]); \
 assert rec['metric']=='overload_delivered_msgs_per_s' \
     and rec['value'] is not None and rec['curve'], rec"
 
+echo "== crash recovery (docs/DURABILITY.md) =="
+# journal framing/torn-tail/degrade semantics, the kill-point matrix
+# (every armed storage fault x crash stage must recover routes /
+# retained / persistent sessions exactly), checkpoint-format
+# hardening, and the durability-off byte-for-byte pin — a regression
+# here is silent data loss after a crash, fail fast
+python -m pytest tests/test_wal.py tests/test_durability.py \
+    tests/test_checkpoint.py -q
+
+echo "== recovery smoke (docs/DURABILITY.md) =="
+# the BENCH_MODE=recovery scenario end-to-end at toy scale: durable
+# QoS1 traffic, a kill -9, and a full journal-replay recovery must
+# run to completion and emit its row (numbers are not gated here —
+# the driver's real-scale run is)
+BENCH_MODE=recovery RECOVERY_ROUTES=1500 RECOVERY_SESSIONS=30 \
+    RECOVERY_PUB_ITERS=4 RECOVERY_FSYNC=0 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='recovery_replay_s' \
+    and rec['value'] is not None \
+    and rec['recovery_routes'] == 1500, rec"
+
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B
 # guard (telemetry off => dispatch byte-identical to the

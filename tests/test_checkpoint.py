@@ -209,6 +209,82 @@ def test_unknown_format_rejected(tmp_path):
         checkpoint.load(_mk(), str(tmp_path / "future.npz"))
 
 
+def test_corrupt_and_truncated_files_raise_checkpoint_error(tmp_path):
+    """Satellite (ISSUE 9): a damaged snapshot must surface ONE
+    clear error class, never a raw numpy/KeyError/zipfile
+    traceback."""
+    garbage = tmp_path / "garbage.npz"
+    garbage.write_bytes(b"not a zip at all \x00\x01\x02" * 16)
+    with pytest.raises(checkpoint.CheckpointError):
+        checkpoint.load(_mk(), str(garbage))
+
+    r1 = _mk()
+    _fill(r1)
+    good = tmp_path / "good.npz"
+    checkpoint.save(r1, str(good))
+    data = good.read_bytes()
+    for frac in (0.25, 0.6, 0.95):
+        cut = tmp_path / f"cut{frac}.npz"
+        cut.write_bytes(data[:int(len(data) * frac)])
+        with pytest.raises(checkpoint.CheckpointError):
+            checkpoint.load(_mk(), str(cut))
+    # CheckpointError subclasses ValueError: pre-durability callers
+    # that caught ValueError keep working
+    assert issubclass(checkpoint.CheckpointError, ValueError)
+
+
+def test_has_tables_without_arrays_degrades_to_route_log(tmp_path):
+    """has_tables claimed but table arrays missing (hand-damaged
+    file that still unzips): the route log replays instead of a
+    KeyError mid-install."""
+    import json
+
+    import numpy as np
+
+    r1 = _mk(delta=False)
+    _fill(r1)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(r1, path)
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["meta"]).decode())
+        routes = np.array(data["routes"])
+    assert meta["has_tables"]
+    np.savez(str(tmp_path / "damaged.npz"),
+             meta=np.frombuffer(json.dumps(meta).encode(),
+                                dtype=np.uint8),
+             routes=routes)  # arrays stripped, claim kept
+    r2 = _mk()
+    out = checkpoint.load(r2, str(tmp_path / "damaged.npz"))
+    assert not out["tables_restored"]
+    assert set(r2.match_filters(["a/b"])[0]) == {"a/b", "a/+"}
+
+
+def test_delta_onoff_roundtrip_parity(tmp_path):
+    """Satellite (ISSUE 9): round-trip parity across [matcher] delta
+    on/off — delta-mode saves are routes-only, and a restore (into
+    either mode) re-flattens to the IDENTICAL match results as the
+    patch-mode table snapshot."""
+    probes = ["a/b", "a/q", "x/deep/er", "late/comer", "gone/soon",
+              "deep/1/2/3", "$share-less/t", "no/match"]
+    results = {}
+    for save_delta in (False, True):
+        r1 = _mk(delta=save_delta)
+        _fill(r1)
+        path = str(tmp_path / f"d{save_delta}.npz")
+        info = checkpoint.save(r1, path)
+        # the delta pin: delta mode keeps no mirror → routes-only
+        assert info["tables"] == (not save_delta)
+        for load_delta in (False, True):
+            r2 = _mk(delta=load_delta)
+            out = checkpoint.load(r2, path)
+            assert out["tables_restored"] == (not save_delta)
+            results[(save_delta, load_delta)] = [
+                sorted(r2.match_filters([t])[0]) for t in probes]
+    want = results[(False, False)]
+    for key, got in results.items():
+        assert got == want, key
+
+
 def test_delta_mode_saves_routes_only_and_roundtrips(tmp_path):
     """Delta mode keeps no main-table mirror, so its snapshot is the
     route log alone — restore replays it and re-flattens on first

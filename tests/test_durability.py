@@ -1,0 +1,511 @@
+"""Durable broker: journal + atomic checkpoints + exact crash
+recovery (docs/DURABILITY.md).
+
+The acceptance property: for every armed storage fault point in the
+kill matrix, restart recovers routes, retained messages and
+persistent sessions exactly — QoS1/2 unacked redelivered with DUP,
+only in-flight QoS0 may be lost — and ``[durability] enabled =
+false`` is pinned to today's behavior.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from emqx_tpu import checkpoint, faults
+from emqx_tpu.durability import DurabilityConfig
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.packet import Disconnect
+from emqx_tpu.node import Node
+from emqx_tpu.session import Session
+from emqx_tpu.types import Message, SubOpts
+
+from tests.mqtt_client import TestClient
+
+
+def dcfg(tmp_path, **kw):
+    kw.setdefault("fsync", False)  # tmpfs-friendly; fsync path has
+    # its own fault-injection coverage in tests/test_wal.py
+    return DurabilityConfig(enabled=True,
+                            dir=str(tmp_path / "dur"), **kw)
+
+
+def mknode(tmp_path, **kw):
+    kw.setdefault("durability", dcfg(tmp_path))
+    kw.setdefault("load_default_modules", True)
+    kw.setdefault("boot_listeners", False)
+    return Node(**kw)
+
+
+async def crash(node):
+    """kill -9 analogue: tear the in-process node down WITHOUT the
+    graceful durability path — no final checkpoint, no detach
+    records; only what already reached the journal survives."""
+    node.broker.durability = None
+    node.cm.durability = None
+    node.durability = None
+    await node.stop()
+
+
+class _Chan:
+    """Minimal channel holder so the cm registry (and therefore
+    checkpoint snapshots) see the session as live."""
+
+    def __init__(self, s):
+        self.session = s
+        self.client_id = s.client_id
+
+
+def durable_session(node, cid, expiry=300.0):
+    s = Session(cid, broker=node.broker, clean_start=False)
+    node.durability.session_opened(s, expiry)
+    node.cm.register_channel(cid, _Chan(s))
+    return s
+
+
+def state_model(node):
+    """Comparable durable-state fingerprint of a node."""
+    sessions = {}
+    for cid, (s, _ts, _exp) in node.cm._detached.items():
+        sessions[cid] = {
+            "subs": {k: (o.qos, o.nl, o.share)
+                     for k, o in s.subscriptions.items()},
+            "inflight": sorted(
+                (pid, (v[0] if isinstance(v[0], str)
+                       else (v[0].topic, bytes(v[0].payload))))
+                for pid, v in s.inflight.to_list()),
+            "mqueue": [(m.topic, bytes(m.payload))
+                       for _p, q in s.mqueue.snapshot() for m in q],
+            "awaiting_rel": sorted(s.awaiting_rel),
+            "next_pkt_id": s.next_pkt_id,
+        }
+    ret = node.modules._loaded.get("retainer")
+    retained = {t: bytes(m.payload)
+                for t, m in (ret._store.items() if ret else ())}
+    return {"routes": node.router.route_table(),
+            "retained": retained, "sessions": sessions}
+
+
+# -- disabled-mode pin ----------------------------------------------------
+
+async def test_disabled_mode_is_inert(tmp_path):
+    n = Node(boot_listeners=False,
+             durability=DurabilityConfig(
+                 enabled=False, dir=str(tmp_path / "off")))
+    assert n.durability is None
+    assert n.broker.durability is None and n.cm.durability is None
+    await n.start()
+    s = Session("c", broker=n.broker)
+    s.subscribe("a/b", SubOpts(qos=1))
+    assert n.broker.publish(Message(topic="a/b", qos=1)) == 1
+    assert s._dur is None and not s.durable
+    await n.stop()
+    assert not os.path.exists(str(tmp_path / "off"))
+    for m in ("wal.appends", "wal.fsyncs", "checkpoint.saves",
+              "recovery.replayed"):
+        assert n.metrics.val(m) == 0
+
+
+async def test_durability_on_delivery_parity(tmp_path):
+    """Durability ON must not change what subscribers receive."""
+    got = {}
+    for mode in ("off", "on"):
+        n = mknode(tmp_path / mode) if mode == "on" else Node(
+            boot_listeners=False, load_default_modules=True)
+        await n.start()
+        s = Session("c", broker=n.broker)
+        if mode == "on":
+            n.durability.session_opened(s, 300.0)
+        s.subscribe("p/+", SubOpts(qos=1))
+        counts = [n.broker.publish(
+            Message(topic=f"p/{i}", payload=bytes([i]), qos=q))
+            for i, q in enumerate((0, 1, 2))]
+        got[mode] = (counts,
+                     [(pid, m.topic) for pid, m in s.outbox],
+                     sorted(p for p, _ in s.inflight.to_list()))
+        await n.stop()
+    assert got["on"] == got["off"]
+
+
+# -- the full crash round-trip -------------------------------------------
+
+async def test_crash_recovers_routes_retained_sessions(tmp_path):
+    n = mknode(tmp_path)
+    await n.start()
+    # live durable session with unacked QoS1/2 inflight + QoS2 recv
+    live = durable_session(n, "live")
+    live.subscribe("fleet/+/state", SubOpts(qos=1))
+    live.subscribe("$share/g/fleet/cmd", SubOpts(qos=2))
+    # detached durable session accumulating an mqueue
+    det = durable_session(n, "away")
+    det.subscribe("fleet/9/state", SubOpts(qos=1))
+    n.cm._detached["away"] = (det, 1e18, 300.0)  # placed manually,
+    det.connected = False                        # then detached
+    n.durability.session_detached(det)
+    # a clean (non-durable) subscriber whose refs must prune away
+    class Clean:
+        client_id = "clean"
+
+        def deliver(self, f, m):
+            pass
+    n.broker.subscribe(Clean(), "fleet/+/state")
+    # retained store + a delete (tombstone must survive too)
+    n.broker.publish(Message(topic="fleet/1/state", payload=b"up",
+                             qos=1, flags={"retain": True}))
+    n.broker.publish(Message(topic="fleet/2/state", payload=b"x",
+                             flags={"retain": True}))
+    n.broker.publish(Message(topic="fleet/2/state", payload=b"",
+                             flags={"retain": True}))  # clear
+    # QoS1 into the live window + the detached mqueue
+    n.broker.publish(Message(topic="fleet/9/state", payload=b"q",
+                             qos=1))
+    live.record_awaiting_rel(7)  # inbound QoS2 two-phase state
+    assert len(live.inflight) == 2  # retained pub + fleet/9
+    n.durability.on_batch()
+
+    # expected model: live session compares as it will look DETACHED
+    n.cm._detached["live"] = (live, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["live"]
+    # prune expectation: clean's extra ref on fleet/+/state goes
+    want["routes"]["fleet/+/state"][n.broker.node] -= 1
+
+    await crash(n)
+    n2 = mknode(tmp_path)
+    await n2.start()
+    got = state_model(n2)
+    assert got == want
+    rec = n2.durability.last_recovery
+    assert rec["sessions"] == 2 and rec["pruned_refs"] == 1
+    assert not rec["degraded"]
+    # matching actually works against the restored automaton/trie
+    assert set(n2.router.match_filters(["fleet/5/state"])[0]) == \
+        {"fleet/+/state"}
+    ret = n2.modules._loaded.get("retainer")
+    assert "fleet/2/state" in ret._tombstones
+    await n2.stop()
+
+
+async def test_double_recovery_is_idempotent(tmp_path):
+    """Recover → crash again with NO new ops → recover: identical
+    state (every journal record idempotent, baseline checkpoint
+    exact)."""
+    n = mknode(tmp_path)
+    await n.start()
+    s = durable_session(n, "c1")
+    s.subscribe("a/+", SubOpts(qos=1))
+    s.subscribe("a/+", SubOpts(qos=2))  # resubscribe: opts change
+    n.broker.publish(Message(topic="a/x", payload=b"r", qos=1,
+                             flags={"retain": True}))
+    n.durability.on_batch()
+    n.cm._detached["c1"] = (s, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["c1"]
+    await crash(n)
+    models = []
+    for _ in range(2):
+        n2 = mknode(tmp_path)
+        await n2.start()
+        models.append(state_model(n2))
+        await crash(n2)
+    assert models[0] == want
+    assert models[1] == want
+
+
+# -- the kill matrix ------------------------------------------------------
+
+def _matrix_workload(n, phase2=False):
+    """Deterministic durable workload; ``phase2`` adds the ops whose
+    survival depends on the armed fault."""
+    s = durable_session(n, "m1")
+    s.subscribe("w/+", SubOpts(qos=1))
+    n.broker.publish(Message(topic="w/1", payload=b"a", qos=1,
+                             flags={"retain": True}))
+    n.durability.on_batch()
+    if phase2:
+        # pid-neutral phase-2 ops (no QoS>0 delivery): their survival
+        # is exactly what each armed fault decides
+        s.subscribe("w2/#", SubOpts(qos=1))
+        n.broker.publish(Message(topic="r/2", payload=b"b",
+                                 flags={"retain": True}))
+    return s
+
+
+@pytest.mark.parametrize("scenario", [
+    "clean", "before_flush", "torn_tail", "fsync_error_recovers",
+    "mid_checkpoint", "stale_journal_ignored"])
+async def test_kill_matrix(tmp_path, scenario):
+    n = mknode(tmp_path)
+    await n.start()
+    lose_phase2 = scenario in ("before_flush", "torn_tail")
+    s = _matrix_workload(n, phase2=(scenario != "clean"))
+    if scenario == "clean":
+        pass
+    elif scenario == "before_flush":
+        pass  # phase-2 ops stay in the unflushed buffer — lost
+    elif scenario == "torn_tail":
+        # the flush that would land phase 2 short-writes (crash
+        # mid-append): the torn tail truncates at replay, alarmed
+        with faults.injected("wal.append", times=1):
+            n.durability.on_batch()
+    elif scenario == "fsync_error_recovers":
+        with faults.injected("wal.fsync", times=1):
+            n.durability.on_batch()
+        assert n.durability.wal.degraded
+        n.durability.wal._retry_at = 0.0
+        n.durability.on_batch()  # backoff elapsed: retry lands all
+        assert not n.durability.wal.degraded
+    elif scenario == "mid_checkpoint":
+        n.durability.on_batch()
+        with faults.injected("checkpoint.rename", times=1):
+            out = n.durability.checkpoint_now()
+        assert "error" in out
+        assert n.durability.counters["checkpoint.errors"] == 1
+    elif scenario == "stale_journal_ignored":
+        n.durability.on_batch()
+        n.durability.checkpoint_now()  # commits; journals truncate
+        # a leftover pre-manifest journal (crash mid-truncate) must
+        # be ignored by recovery, not replayed over newer state
+        stale = os.path.join(n.durability.cfg.dir, "journal-0.wal")
+        from emqx_tpu import wal as _w
+        w = _w.Wal(stale, fsync=False)
+        w.append(("route", "stale/#", n.broker.node, 9))
+        w.flush()
+        w.close()
+    # expected durable state (session compares as detached)
+    if lose_phase2:
+        # the phase-2 records never reached disk: expectation rolls
+        # back to the phase-1 flush point
+        s.unsubscribe("w2/#")
+        ret = n.modules._loaded.get("retainer")
+        ret._restoring = True
+        ret._pop("r/2")
+        ret._restoring = False
+    n.cm._detached["m1"] = (s, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["m1"]
+    want["routes"].pop("stale/#", None)
+    await crash(n)
+
+    n2 = mknode(tmp_path)
+    await n2.start()
+    got = state_model(n2)
+    assert got == want, scenario
+    rec = n2.durability.last_recovery
+    if scenario == "torn_tail":
+        assert rec["torn_journals"] == 1
+        assert any(a.name == "journal_torn_tail"
+                   for a in n2.alarms.get_alarms("activated"))
+    else:
+        assert rec["torn_journals"] == 0
+    await n2.stop()
+
+
+# -- live socket paths ----------------------------------------------------
+
+async def test_reconnect_after_crash_session_present_dup(tmp_path):
+    n = mknode(tmp_path, boot_listeners=True)
+    n.add_listener(port=0)
+    await n.start()
+    port = n.listeners[0].port
+    sub = TestClient("dev", version=C.MQTT_V5, clean_start=True,
+                     auto_ack=False,
+                     properties={"Session-Expiry-Interval": 300})
+    await sub.connect(port=port)
+    await sub.subscribe("d/t", qos=1)
+    pub = TestClient("pub", version=C.MQTT_V5)
+    await pub.connect(port=port)
+    for i in range(3):
+        await pub.publish("d/t", str(i).encode(), qos=1, timeout=60)
+    for _ in range(3):
+        await sub.recv(30)  # delivered, deliberately unacked
+    await asyncio.sleep(0)
+    n.durability.on_batch()  # the batch flush a crash can't outrun
+    await crash(n)
+    await sub.close()
+    await pub.close()
+
+    n2 = mknode(tmp_path, boot_listeners=True)
+    n2.add_listener(port=0)
+    await n2.start()
+    sub2 = TestClient("dev", version=C.MQTT_V5, clean_start=False,
+                      properties={"Session-Expiry-Interval": 300})
+    ack = await sub2.connect(port=n2.listeners[0].port, timeout=30)
+    assert ack.session_present, \
+        "recovered persistent session must CONNACK session-present"
+    got = {}
+    for _ in range(3):
+        m = await sub2.recv(30)
+        got[m.payload] = m.dup
+    assert sorted(got) == [b"0", b"1", b"2"]
+    assert all(got.values()), f"redelivery must set DUP: {got}"
+    await sub2.close()
+    await n2.stop()
+
+
+async def test_graceful_shutdown_0x8b_and_clean_recovery(tmp_path):
+    n = mknode(tmp_path, boot_listeners=True)
+    n.add_listener(port=0)
+    await n.start()
+    cli = TestClient("gs", version=C.MQTT_V5, clean_start=True,
+                     properties={"Session-Expiry-Interval": 300})
+    await cli.connect(port=n.listeners[0].port)
+    await cli.subscribe("g/t", qos=1)
+    stop = asyncio.create_task(n.stop())
+    pkt = await asyncio.wait_for(cli.acks.get(), 30)
+    assert isinstance(pkt, Disconnect)
+    assert pkt.reason_code == 0x8B  # Server-Shutting-Down
+    await stop
+    await cli.close()
+    m = checkpoint.read_manifest(n.durability.cfg.dir)
+    assert m is not None and m["clean_shutdown"]
+
+    n2 = mknode(tmp_path)
+    await n2.start()
+    rec = n2.durability.last_recovery
+    # a graceful stop checkpointed everything: nothing to replay
+    assert rec["replayed_records"] == 0 and rec["sessions"] == 1
+    assert "gs" in n2.cm._detached
+    await n2.stop()
+
+
+# -- expiry / lifecycle edges --------------------------------------------
+
+async def test_session_expired_while_down_not_resurrected(tmp_path):
+    n = mknode(tmp_path)
+    await n.start()
+    s = durable_session(n, "gone", expiry=0.05)
+    s.expiry_interval = 0.05
+    s.subscribe("e/+", SubOpts(qos=1))
+    s.connected = False
+    n.cm._detached["gone"] = (s, 0, 0.05)
+    n.durability.session_detached(s)
+    n.durability.on_batch()
+    await crash(n)
+    await asyncio.sleep(0.1)
+    n2 = mknode(tmp_path)
+    await n2.start()
+    assert "gone" not in n2.cm._detached
+    assert n2.durability.last_recovery["sessions"] == 0
+    # its route refs pruned with it
+    assert n2.router.route_refs("e/+", n2.broker.node) == 0
+    await n2.stop()
+
+
+async def test_session_close_is_durable(tmp_path):
+    n = mknode(tmp_path)
+    await n.start()
+    s = durable_session(n, "bye")
+    s.subscribe("b/+", SubOpts(qos=1))
+    n.durability.on_batch()
+    n.cm._detached["bye"] = (s, 0, 300.0)
+    n.cm.discard_session("bye")  # clean-start discard journals close
+    n.durability.on_batch()
+    await crash(n)
+    n2 = mknode(tmp_path)
+    await n2.start()
+    assert "bye" not in n2.cm._detached
+    assert n2.router.route_refs("b/+", n2.broker.node) == 0
+    await n2.stop()
+
+
+async def test_checkpoint_truncates_journal_and_bounds_replay(
+        tmp_path):
+    n = mknode(tmp_path)
+    await n.start()
+    s = durable_session(n, "c")
+    for i in range(8):
+        s.subscribe(f"t/{i}", SubOpts(qos=1))
+    n.durability.on_batch()
+    gen0 = n.durability.gen
+    out = n.durability.checkpoint_now()
+    assert out["generation"] == gen0 + 1
+    d = n.durability.cfg.dir
+    journals = [f for f in os.listdir(d) if f.startswith("journal-")]
+    assert len(journals) == 1  # superseded segments truncated
+    m = checkpoint.read_manifest(d)
+    assert m["generation"] == out["generation"]
+    assert os.path.exists(os.path.join(d, m["router"]))
+    assert os.path.exists(os.path.join(d, m["state"]))
+    n.cm._detached["c"] = (s, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["c"]
+    await crash(n)
+    n2 = mknode(tmp_path)
+    await n2.start()
+    assert n2.durability.last_recovery["replayed_records"] == 0
+    assert state_model(n2) == want
+    await n2.stop()
+
+
+async def test_wal_write_failed_alarm_raises_and_clears(tmp_path):
+    n = mknode(tmp_path)
+    await n.start()
+    s = durable_session(n, "a1")
+    with faults.injected("wal.fsync", times=1):
+        s.subscribe("x/+", SubOpts(qos=1))
+        n.durability.on_batch()
+    n.durability.drain_events(n.alarms)
+    assert any(a.name == "wal_write_failed"
+               for a in n.alarms.get_alarms("activated"))
+    n.durability.wal._retry_at = 0.0
+    n.durability.on_batch()  # recovery flush
+    n.durability.drain_events(n.alarms)
+    assert not any(a.name == "wal_write_failed"
+                   for a in n.alarms.get_alarms("activated"))
+    await n.stop()
+
+
+# -- config / ctl surfaces ------------------------------------------------
+
+def test_config_durability_section():
+    from emqx_tpu.config import ConfigError, parse_config
+    cfg = parse_config({"durability": {
+        "enabled": True, "dir": "data/d", "fsync": False,
+        "flush_interval_ms": 20, "checkpoint_interval_s": 60,
+        "checkpoint_min_records": 1000}})
+    assert cfg.durability.enabled and cfg.durability.dir == "data/d"
+    assert cfg.durability.flush_interval_ms == 20.0
+    with pytest.raises(ConfigError):
+        parse_config({"durability": {"enabeld": True}})
+    with pytest.raises(ConfigError):
+        parse_config({"durability": {"enabled": "yes"}})
+    with pytest.raises(ConfigError):
+        parse_config({"durability": {"flush_interval_ms": 0}})
+    with pytest.raises(ConfigError):
+        parse_config({"durability": {"dir": 7}})
+
+
+async def test_ctl_durability_command(tmp_path):
+    import json
+    n = mknode(tmp_path)
+    await n.start()
+    s = durable_session(n, "c")
+    s.subscribe("q/+", SubOpts(qos=1))
+    n.durability.on_batch()
+    out = json.loads(n.ctl.run(["durability"]))
+    assert out["enabled"] and out["generation"] >= 1
+    assert out["journal"]["records"] >= 1
+    assert out["last_recovery"]["generation"] >= 0
+    out2 = json.loads(n.ctl.run(["durability", "checkpoint"]))
+    assert out2["generation"] == out["generation"] + 1
+    off = Node(boot_listeners=False)
+    assert "not enabled" in off.ctl.run(["durability"])
+    await n.stop()
+
+
+async def test_stats_gauges_and_metric_fold(tmp_path):
+    n = mknode(tmp_path)
+    await n.start()
+    s = durable_session(n, "c")
+    s.subscribe("s/+", SubOpts(qos=1))
+    n.durability.on_batch()
+    n.stats.tick()
+    assert n.metrics.val("wal.appends") >= 2  # state + sub + route
+    assert n.metrics.val("checkpoint.saves") >= 1
+    allstats = n.stats.all()
+    assert allstats["journal.records"] >= 1
+    assert "checkpoint.age_s" in allstats
+    assert allstats["durability.generation"] >= 1
+    await n.stop()
