@@ -2008,6 +2008,138 @@ def recovery():
     })
 
 
+def partition():
+    """BENCH_MODE=partition — the cluster plane's three failure
+    numbers (ISSUE 10, docs/CLUSTER.md): detection latency (partition
+    armed → both sides observe the membership split via the heartbeat
+    detector), heal-to-convergence time (partition disarmed → all
+    five replicated plane digests byte-equal across members, zero
+    manual rejoin), and data-plane forwards dropped during a timed
+    partition window with route churn on BOTH sides of the split.
+
+    3 nodes in one process over real sockets, the partition injected
+    through the net.partition fault point scoped per transport —
+    the same machinery the chaos matrix (tests/test_cluster_heal.py)
+    gates, at bench scale."""
+    import sys
+
+    jax = _jax_with_retry()
+
+    from emqx_tpu import faults
+    from emqx_tpu.cluster import Cluster, ClusterConfig
+    from emqx_tpu.cluster_net import SocketTransport
+    from emqx_tpu.node import Node
+
+    n_routes = int(os.environ.get(
+        "PARTITION_ROUTES", os.environ.get("BENCH_SUBS", "3000")))
+    window_s = float(os.environ.get("PARTITION_SECONDS", "3"))
+    cfg = ClusterConfig(
+        heartbeat_interval_s=0.1, heartbeat_timeout_s=0.5,
+        suspect_after=1, down_after=3, ok_after=1,
+        anti_entropy_interval_s=0.5, call_timeout_s=2.0,
+        redial_backoff_s=0.1, redial_backoff_max_s=0.5)
+
+    class _Sub:
+        def __init__(self, cid):
+            self.client_id = cid
+
+        def deliver(self, t, m):
+            pass
+
+    def _wait(pred, timeout, what):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if pred():
+                return time.perf_counter()
+            time.sleep(0.02)
+        raise RuntimeError(f"partition bench: {what} not reached "
+                           f"within {timeout}s")
+
+    def _converged(cls):
+        digests = [c.plane_digests() for c in cls]
+        return all(d == digests[0] for d in digests[1:])
+
+    nodes, trs, cls = [], [], []
+    try:
+        for i in range(3):
+            node = Node(name=f"bn{i}", boot_listeners=False)
+            tr = SocketTransport(f"bn{i}", cookie="bench-part",
+                                 config=cfg)
+            tr.serve()
+            cls.append(Cluster(node, transport=tr, config=cfg))
+            nodes.append(node)
+            trs.append(tr)
+        for i in (1, 2):
+            cls[i].join_remote("127.0.0.1", trs[0].port)
+        subs = []
+        for i in range(n_routes):
+            s = _Sub(f"bsub-{i}")
+            nodes[i % 3].broker.subscribe(s, f"bench/p/{i}")
+            subs.append(s)
+        _wait(lambda: _converged(cls), 60, "pre-partition sync")
+        for c in cls:
+            c.drain_counters()  # window counters start clean
+
+        # -- partition {bn0, bn1} | {bn2}, churn on both sides -----
+        trs[0].fault_peers = trs[1].fault_peers = {"bn2"}
+        trs[2].fault_peers = {"bn0", "bn1"}
+        faults.set_master(True)
+        t0 = time.perf_counter()
+        faults.arm("net.partition", times=0)
+        t_detect = _wait(
+            lambda: cls[0].members == ["bn0", "bn1"]
+            and cls[2].members == ["bn2"], 30, "detection")
+        detect_s = t_detect - t0
+        churn = 0
+        t_end = time.perf_counter() + window_s
+        while time.perf_counter() < t_end:
+            i = churn % n_routes
+            side = nodes[0] if churn % 2 else nodes[2]
+            s = _Sub(f"churn-{churn}")
+            side.broker.subscribe(s, f"bench/c/{i}")
+            side.broker.unsubscribe(s, f"bench/c/{i}")
+            churn += 1
+            time.sleep(0.002)
+
+        # -- heal: zero manual rejoin --------------------------------
+        t1 = time.perf_counter()
+        faults.disarm("net.partition")
+        _wait(lambda: all(sorted(c.members) == ["bn0", "bn1", "bn2"]
+                          for c in cls), 60, "membership re-merge")
+        t_conv = _wait(lambda: _converged(cls), 60,
+                       "plane-digest convergence")
+        heal_s = t_conv - t1
+        counters = {}
+        for c in cls:
+            for k, v in c.drain_counters().items():
+                counters[k] = counters.get(k, 0) + v
+    finally:
+        faults.clear()
+        for c in cls:
+            c.close()
+        for tr in trs:
+            tr.close()
+
+    info = {"mode": "partition", "routes": n_routes,
+            "window_s": window_s, "churn_ops": churn,
+            "device": str(jax.devices()[0])}
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    _emit({
+        "metric": "partition_heal_converge_s",
+        "workload": "cluster_heal_v1",
+        "value": round(heal_s, 3),
+        "unit": "s",
+        "partition_detect_s": round(detect_s, 3),
+        "partition_window_s": window_s,
+        "partition_churn_ops": churn,
+        "forwards_dropped": counters.get("forward.dropped", 0),
+        "heal_rejoins": counters.get("heal.rejoins", 0),
+        "ae_repairs": counters.get("ae.repairs", 0),
+        "hb_downs": counters.get("hb.downs", 0),
+        "routes": n_routes,
+    })
+
+
 # The BASELINE.json config matrix (VERDICT r3 item 3): one row per
 # driver-defined config, plus the uniform-traffic variant (no
 # batch-dedup advantage) and a paced live row for per-message p99
@@ -2367,6 +2499,7 @@ _MODES = {
     "overload": ("overload", "overload_delivered_msgs_per_s",
                  "msgs/sec"),
     "recovery": ("recovery", "recovery_replay_s", "s"),
+    "partition": ("partition", "partition_heal_converge_s", "s"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
     "mixed": ("main", "publish_match_fanout_throughput", "msgs/sec"),
     "configs": ("configs", "publish_match_fanout_throughput",
@@ -2387,6 +2520,7 @@ _MODE_WORKLOADS = {
     "flapstorm": "flapstorm_v1",
     "overload": "overload_curve_v1",
     "recovery": "durability_v1",
+    "partition": "cluster_heal_v1",
 }
 
 

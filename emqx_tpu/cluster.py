@@ -30,13 +30,96 @@ filter set, so any node matches locally in one device call and only
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import logging
+import queue
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from emqx_tpu.types import Message
 
 log = logging.getLogger("emqx_tpu.cluster")
+
+
+class PeerUnavailableError(ConnectionError):
+    """A call was refused WITHOUT touching the wire because the
+    failure detector holds the peer suspect/down (docs/CLUSTER.md).
+    Distinct from a plain ConnectionError on purpose: a suspect peer
+    is *unconfirmed* — callers must degrade (skip the vote, hand out
+    a fresh session) but never purge, which is exactly what the
+    generic ``except ConnectionError: handle_nodedown`` sites do."""
+
+    def __init__(self, node: str, state: str) -> None:
+        super().__init__(f"peer {node} is {state} (fast-fail)")
+        self.node = node
+        self.state = state
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """``[cluster]`` TOML section: failure detector + auto-heal knobs
+    (docs/CLUSTER.md). ``detector = false`` reproduces the EOF-only
+    legacy behavior byte-for-byte — no heartbeats, no suspect state,
+    no fast-fail, no bounded-coroutine calls, no auto-heal."""
+
+    #: heartbeat failure detector (ok → suspect → down state machine
+    #: over periodic per-peer pings). Off = legacy link-EOF detection.
+    detector: bool = True
+    #: seconds between heartbeat rounds
+    heartbeat_interval_s: float = 1.0
+    #: per-ping RTT deadline; a reply slower than this is a miss
+    heartbeat_timeout_s: float = 1.0
+    #: consecutive misses before ok → suspect (casts park, nothing
+    #: is purged)
+    suspect_after: int = 2
+    #: consecutive misses before suspect → down (nodedown dispatched)
+    down_after: int = 5
+    #: consecutive successes before suspect → ok (hysteresis up)
+    ok_after: int = 2
+    #: a downed peer that reappears triggers an automatic rejoin
+    #: handshake + anti-entropy reconciliation
+    auto_heal: bool = True
+    #: background anti-entropy sweep period (repairs missed
+    #: at-most-once casts); 0 disables the sweep (heal-triggered
+    #: syncs still run)
+    anti_entropy_interval_s: float = 30.0
+    #: per-peer RPC deadline — bounds the CALLER's wait and the
+    #: in-flight coroutine (the link is dropped on expiry so a stale
+    #: late reply can never desync the frame stream)
+    call_timeout_s: float = 10.0
+    #: calls to a suspect/down member raise PeerUnavailableError
+    #: immediately instead of dialing into the timeout
+    suspect_fast_fail: bool = True
+    #: redial backoff to a peer whose dials keep failing
+    #: (exponential from the base, capped at the max)
+    redial_backoff_s: float = 0.5
+    redial_backoff_max_s: float = 5.0
+
+    def __post_init__(self) -> None:
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError("cluster.heartbeat_interval_s must be > 0")
+        if self.heartbeat_timeout_s <= 0:
+            raise ValueError("cluster.heartbeat_timeout_s must be > 0")
+        if self.suspect_after < 1:
+            raise ValueError("cluster.suspect_after must be >= 1")
+        if self.down_after < self.suspect_after:
+            raise ValueError(
+                "cluster.down_after must be >= suspect_after")
+        if self.ok_after < 1:
+            raise ValueError("cluster.ok_after must be >= 1")
+        if self.anti_entropy_interval_s < 0:
+            raise ValueError(
+                "cluster.anti_entropy_interval_s must be >= 0")
+        if self.call_timeout_s <= 0:
+            raise ValueError("cluster.call_timeout_s must be > 0")
+        if self.redial_backoff_s <= 0:
+            raise ValueError("cluster.redial_backoff_s must be > 0")
+        if self.redial_backoff_max_s < self.redial_backoff_s:
+            raise ValueError(
+                "cluster.redial_backoff_max_s must be >= "
+                "redial_backoff_s")
 
 
 class Transport:
@@ -47,6 +130,26 @@ class Transport:
 
     def call(self, node: str, op: str, *args):
         raise NotImplementedError
+
+    # -- failure-detector seam (no-ops for transports without one) --------
+
+    def peer_state(self, node: str) -> str:
+        """``ok`` | ``suspect`` | ``down`` — transports without a
+        detector report every peer healthy."""
+        return "ok"
+
+    def health_info(self) -> Dict[str, dict]:
+        """Per-peer detector state for operators (ctl/stats)."""
+        return {}
+
+    def drain_counters(self) -> Dict[str, int]:
+        """Transport-level event counters since the last drain."""
+        return {}
+
+    def set_departed(self, node: str) -> None:
+        """Mark a peer as having LEFT deliberately: the detector must
+        stop probing it for reappearance (a left node answering pings
+        must not be dragged back into the cluster)."""
 
 
 class LocalTransport(Transport):
@@ -80,12 +183,38 @@ class Cluster:
     """Per-node cluster agent: wires a Node's broker/router into the
     membership + replication + forwarding protocol."""
 
-    def __init__(self, node, transport: Optional[Transport] = None) -> None:
+    def __init__(self, node, transport: Optional[Transport] = None,
+                 config: Optional[ClusterConfig] = None) -> None:
         self.node = node            # emqx_tpu.node.Node
         self.name = node.name
         self.transport = transport or LocalTransport()
+        self.config = config
         self.members: List[str] = [self.name]
         self._lock = threading.Lock()
+        # cluster-plane event counters, drained into Metrics by the
+        # node's stats tick (names land as ``cluster.<key>``)
+        self._counters: Dict[str, int] = {}
+        # anti-entropy bookkeeping for ctl/stats (docs/CLUSTER.md)
+        self._ae_info: Dict[str, object] = {
+            "sweeps": 0, "repairs": 0, "last_sweep_ts": None,
+            "last_repairs": 0, "last_peer": None}
+        # auto-heal / background-sweep worker: heal requests from the
+        # failure detector queue here; queue timeouts pace the sweep.
+        # Only a configured cluster WITH the detector on spawns the
+        # thread — the bare Cluster(node, transport) construction
+        # every existing test uses stays thread-free, and
+        # ``detector = false`` reproduces the legacy EOF-only build
+        # in full (no heal worker, no background sweep)
+        self._heal_q: "queue.Queue" = queue.Queue()
+        self._healing: set = set()
+        self._stopping = False
+        self._heal_thread: Optional[threading.Thread] = None
+        if config is not None and config.detector and (
+                config.auto_heal or config.anti_entropy_interval_s > 0):
+            self._heal_thread = threading.Thread(
+                target=self._heal_main, daemon=True,
+                name=f"cluster-heal-{self.name}")
+            self._heal_thread.start()
         self._shared_rr: Dict[Tuple[str, str], int] = {}
         # replicated per-node shared-group member counts: the
         # reference picks over the full replicated member table
@@ -177,10 +306,13 @@ class Cluster:
         self._propagate_union(union, addrs)
 
     def _propagate_union(self, union: List[str],
-                         addrs: Optional[Dict] = None) -> None:
+                         addrs: Optional[Dict] = None,
+                         sync_routes: bool = True) -> None:
         """Tell every member the merged membership (and, over a
         socket transport, the address book), then sync routes all
         around — shared by in-process join and join_remote.
+        ``sync_routes=False`` (the auto-heal path) skips the blunt
+        full route push: anti-entropy re-pushes only the diff.
 
         A member that died moments ago may still be in the book its
         peers handed us (their probe hasn't declared nodedown yet):
@@ -189,6 +321,7 @@ class Cluster:
         restarted worker crashed joining through a survivor because
         the book still listed its own dead predecessor)."""
         unreachable: List[str] = []
+        suspect: List[str] = []
         for m in union:
             if m == self.name:
                 self._set_members(union)
@@ -199,16 +332,27 @@ class Cluster:
                                         addrs)
                 else:
                     self.transport.call(m, "set_members", union)
+            except PeerUnavailableError as e:
+                # suspect ≠ dead: skip it (the heal/anti-entropy
+                # machinery re-merges once the detector clears it)
+                # but NEVER purge on suspicion
+                log.warning("join: member %s suspect (%s); skipping",
+                            m, e)
+                suspect.append(m)
             except ConnectionError as e:
                 log.warning("join: member %s unreachable (%s); "
                             "skipping", m, e)
                 unreachable.append(m)
-        for m in union:
+        for m in union if sync_routes else ():
             if m == self.name:
                 self._push_owned_routes()
-            elif m not in unreachable:
+            elif m not in unreachable and m not in suspect:
                 try:
                     self.transport.call(m, "push_routes")
+                except PeerUnavailableError as e:
+                    log.warning("join: member %s suspect (%s); "
+                                "skipping push", m, e)
+                    suspect.append(m)
                 except ConnectionError as e:
                     log.warning("join: push_routes to %s failed (%s)",
                                 m, e)
@@ -216,7 +360,7 @@ class Cluster:
         # reap what we just proved dead, the way every other
         # ConnectionError site here does — the dead name must not
         # linger as a member/broadcast target until some later cast
-        # happens to fail
+        # happens to fail. Suspect members are NOT reaped.
         for m in unreachable:
             self.handle_nodedown(m)
 
@@ -265,11 +409,15 @@ class Cluster:
 
     def leave(self) -> None:
         """Leave the cluster: tell everyone, purge every ex-member's
-        routes locally (the symmetric half of nodedown)."""
+        routes locally (the symmetric half of nodedown). The
+        ``leaving`` announcement (vs a detector-observed death) also
+        tells each peer's failure detector to stop probing us for
+        reappearance — a deliberately departed node answering pings
+        must not be auto-healed back in."""
         ex = [m for m in self.members if m != self.name]
         for m in ex:
             try:
-                self.transport.cast(m, "nodedown", self.name)
+                self.transport.cast(m, "leaving", self.name)
             except ConnectionError:
                 pass
         self.members = [self.name]
@@ -313,6 +461,12 @@ class Cluster:
         """Old session on another node must die (clean start)."""
         try:
             self.transport.call(node, "discard_client", client_id)
+        except PeerUnavailableError:
+            # suspect owner: proceed without the discard (the CONNECT
+            # must not block); anti-entropy reconciles the registry
+            # once the peer recovers or is confirmed down
+            log.warning("remote discard of %s skipped: owner %s "
+                        "suspect", client_id, node)
         except ConnectionError:
             self.handle_nodedown(node)
 
@@ -321,6 +475,14 @@ class Cluster:
         (emqx_cm:takeover_session RPC, src/emqx_cm.erl:263-272)."""
         try:
             return self.transport.call(node, "takeover_client", client_id)
+        except PeerUnavailableError:
+            # suspect owner: hand out a fresh session NOW instead of
+            # blocking the CONNECT into call_timeout — the same
+            # availability choice the bounded cross-loop takeover
+            # makes (overload.takeover.timeout)
+            log.warning("remote takeover of %s skipped: owner %s "
+                        "suspect — fresh session", client_id, node)
+            return None
         except ConnectionError:
             self.handle_nodedown(node)
             return None
@@ -507,6 +669,365 @@ class Cluster:
             rest = [x for x in nodes if x != target]
             return self._route_shared(group, flt, rest, msg)
 
+    # -- counters / observability -----------------------------------------
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def drain_counters(self) -> Dict[str, int]:
+        """Cluster + transport event-counter deltas since the last
+        drain; the node's stats tick folds them into Metrics as
+        ``cluster.<key>`` (docs/OBSERVABILITY.md)."""
+        with self._lock:
+            out = dict(self._counters)
+            self._counters.clear()
+        for k, v in self.transport.drain_counters().items():
+            out[k] = out.get(k, 0) + v
+        return out
+
+    def ae_info(self) -> dict:
+        """Anti-entropy sweep/repair summary for ctl + stats."""
+        with self._lock:
+            return dict(self._ae_info)
+
+    # -- auto-heal + anti-entropy (docs/CLUSTER.md) -----------------------
+    #
+    # The replication casts above are at-most-once (gen_rpc async
+    # cast semantics): a dropped cast silently diverges the replica
+    # planes FOREVER in the pre-heal design. Anti-entropy closes the
+    # loop: per-plane digests are exchanged and diffed, and only the
+    # differing entries cross the wire again. It runs (a) as the
+    # reconciliation half of an auto-heal rejoin and (b) as a
+    # low-frequency background sweep (one peer per round).
+    #
+    # Consistency contract per plane:
+    #   routes / registry / weights — OWNER-authoritative: each
+    #     node's view of node X's entries is replaced by X's own set
+    #     (adds AND stale deletes repaired, no tombstones needed);
+    #   bans      — longest-ban-wins merge (Banned.apply sync rules);
+    #   retained  — timestamp LWW with delete tombstones (the
+    #     retainer's join-sync rules).
+
+    def schedule_heal(self, name: str) -> None:
+        """Queue an auto-heal rejoin with a reappeared peer.
+        Thread-safe — called from the transport's IO loop."""
+        if self._heal_thread is None or self.config is None \
+                or not self.config.auto_heal or self._stopping:
+            return
+        self._heal_q.put(name)
+
+    def close(self) -> None:
+        """Stop the heal/anti-entropy worker (Node.stop)."""
+        self._stopping = True
+        if self._heal_thread is not None:
+            self._heal_q.put(None)
+            self._heal_thread.join(timeout=5)
+            self._heal_thread = None
+
+    def _heal_main(self) -> None:
+        interval = self.config.anti_entropy_interval_s or None
+        while True:
+            try:
+                item = self._heal_q.get(timeout=interval)
+            except queue.Empty:
+                item = None  # sweep tick
+            if self._stopping:
+                return
+            try:
+                if item is None:
+                    self._ae_sweep_once()
+                else:
+                    self._heal_rejoin(item)
+            except Exception:
+                log.exception("cluster heal/anti-entropy pass failed")
+
+    def _heal_rejoin(self, name: str) -> None:
+        """The auto-heal handshake with a reappeared peer: re-merge
+        membership (the join protocol, minus its blunt full route
+        push) and reconcile every replicated plane via anti-entropy.
+        Both sides typically run this concurrently — every step is
+        idempotent."""
+        if name in self._healing:
+            return
+        self._healing.add(name)
+        try:
+            addr = getattr(self.transport, "_peers", {}).get(name)
+            call_addr = getattr(self.transport, "call_addr", None)
+            if addr is None or call_addr is None:
+                return
+            info = call_addr(addr, "cluster_info")
+            addrs = dict(info["addrs"])
+            addrs[info["name"]] = addr
+            addrs.update(self.transport.addr_book())
+            union = sorted(set(self.members) | set(info["members"]))
+            for m, a in addrs.items():
+                if m != self.name:
+                    self.transport.register_peer(m, *a)
+            self._propagate_union(union, addrs, sync_routes=False)
+            n = self.anti_entropy_sync(name)
+            self._count("heal.rejoins")
+            with self._lock:
+                self._ae_info["repairs"] += n
+                self._ae_info["last_sweep_ts"] = time.time()
+                self._ae_info["last_repairs"] = n
+                self._ae_info["last_peer"] = name
+            log.warning("cluster auto-heal: rejoined %s "
+                        "(%d entries repaired)", name, n)
+        except ConnectionError as e:
+            log.warning("cluster auto-heal with %s failed: %s",
+                        name, e)
+        finally:
+            self._healing.discard(name)
+
+    def _ae_sweep_once(self) -> None:
+        """One background anti-entropy round: sync with ONE live
+        peer (round-robin) — N nodes sweeping all-to-all every
+        interval would be O(N²) traffic for no extra convergence."""
+        peers = sorted(m for m in list(self.members)
+                       if m != self.name
+                       and self.transport.peer_state(m) == "ok")
+        if not peers:
+            return
+        self._ae_rr = getattr(self, "_ae_rr", -1) + 1
+        peer = peers[self._ae_rr % len(peers)]
+        try:
+            n = self.anti_entropy_sync(peer)
+        except ConnectionError as e:
+            log.debug("anti-entropy with %s failed: %s", peer, e)
+            return
+        self._count("ae.sweeps")
+        with self._lock:
+            self._ae_info["sweeps"] += 1
+            self._ae_info["repairs"] += n
+            self._ae_info["last_sweep_ts"] = time.time()
+            self._ae_info["last_repairs"] = n
+            self._ae_info["last_peer"] = peer
+
+    #: planes where each entry has an authoritative owner node
+    _OWNER_PLANES = ("routes", "registry", "weights")
+
+    def anti_entropy_sync(self, peer: str) -> int:
+        """Reconcile all five replicated planes with ``peer``; returns
+        the number of entries repaired (pushed + pulled). One digest
+        round-trip when everything already matches."""
+        tr = self.transport
+        mine = {p: self._plane_digest(p, self.name)
+                for p in self._OWNER_PLANES}
+        merged = {"bans": self._plane_digest("bans", None),
+                  "retained": self._plane_digest("retained", None)}
+        reply = tr.call(peer, "ae_digests", self.name, mine, merged)
+        repairs = 0
+        # push: planes where the peer's replica of OUR entries drifted
+        for plane in reply.get("want", ()):
+            entries = self._plane_entries(plane, self.name)
+            n = tr.call(peer, "ae_apply", self.name, plane, entries)
+            repairs += int(n or 0)
+        # pull: planes where our replica of the PEER's entries drifted
+        for plane, dg in reply.get("mine", {}).items():
+            if dg != self._plane_digest(plane, peer):
+                entries = tr.call(peer, "ae_entries", plane)
+                repairs += self._ae_reconcile(plane, peer, entries)
+        pm = reply.get("merged", {})
+        if pm.get("bans") != merged["bans"]:
+            repairs += self._ae_reconcile(
+                "bans", peer, tr.call(peer, "ae_entries", "bans"))
+            n = tr.call(peer, "ae_apply", self.name, "bans",
+                        self._plane_entries("bans", None))
+            repairs += int(n or 0)
+        if pm.get("retained") != merged["retained"]:
+            repairs += self._retained_sync(peer)
+        if repairs:
+            self._count("ae.repairs", repairs)
+        return repairs
+
+    @staticmethod
+    def _digest(entries) -> str:
+        """Stable digest over a canonically ordered entry list."""
+        h = hashlib.sha1()
+        for e in entries:
+            h.update(repr(e).encode())
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    def plane_digests(self) -> Dict[str, str]:
+        """Whole-table digest per replicated plane — equal digests
+        across members == converged cluster (the chaos matrix's and
+        the partition bench's convergence predicate)."""
+        return {"routes": self._plane_digest("routes", None),
+                "registry": self._plane_digest("registry", None),
+                "weights": self._plane_digest("weights", None),
+                "bans": self._plane_digest("bans", None),
+                "retained": self._plane_digest("retained", None)}
+
+    def _route_entries(self, owner: Optional[str]) -> list:
+        out = []
+        for flt in self.node.router.topics():
+            for r in self.node.router.lookup_routes(flt):
+                if owner is None or self._owned(r.dest, owner):
+                    out.append((flt, r.dest))
+        out.sort(key=repr)
+        return out
+
+    def _plane_entries(self, plane: str, owner: Optional[str]):
+        """Canonical transferable entry list for one plane. ``owner``
+        scopes the owner-authoritative planes; None = whole table
+        (merge planes + digest oracles)."""
+        if plane == "routes":
+            return self._route_entries(owner)
+        if plane == "registry":
+            with self._lock:
+                if owner is None:
+                    return sorted(self._registry.items())
+                return sorted(c for c, n in self._registry.items()
+                              if n == owner)
+        if plane == "weights":
+            local = {(g, f): len(m) for (g, f), m in
+                     self.node.broker.shared._subs.items() if m}
+            if owner == self.name:
+                return sorted((g, f, c) for (g, f), c in local.items())
+            with self._lock:
+                if owner is not None:
+                    return sorted((g, f, c) for (g, f, n), c in
+                                  self._shared_weights.items()
+                                  if n == owner)
+                out = [(g, f, self.name, c)
+                       for (g, f), c in local.items()]
+                out += [(g, f, n, c) for (g, f, n), c in
+                        self._shared_weights.items() if n != self.name]
+                return sorted(out)
+        if plane == "bans":
+            banned = self.node.broker.banned
+            if banned is None:
+                return []
+            banned.expire()
+            return sorted(
+                (r.who[0], r.who[1], r.by, r.reason, r.until)
+                for r in banned.info())
+        raise ValueError(f"bad anti-entropy plane: {plane}")
+
+    def _retained_idx(self) -> Dict[str, tuple]:
+        """topic -> (timestamp, payload hash): the retained plane's
+        per-entry diff index (full messages only cross the wire for
+        topics whose index entry differs)."""
+        ret = self._retainer()
+        if ret is None:
+            return {}
+        return {t: (float(m.timestamp),
+                    hashlib.sha1(bytes(m.payload)).hexdigest())
+                for t, m in ret.entries()}
+
+    def _plane_digest(self, plane: str, owner: Optional[str]) -> str:
+        if plane == "retained":
+            ret = self._retainer()
+            tombs = sorted(ret.tombstones()) if ret is not None else []
+            return self._digest(
+                sorted(self._retained_idx().items()) + tombs)
+        return self._digest(self._plane_entries(plane, owner))
+
+    def _ae_reconcile(self, plane: str, owner: str, entries) -> int:
+        """Apply a peer's authoritative entry set for one plane;
+        returns the number of local entries changed. Owner planes
+        REPLACE our replica of ``owner``'s entries (repairing stale
+        survivors of missed deletes); bans MERGE."""
+        if owner == self.name:
+            return 0  # nobody rewrites our view of our own entries
+        repairs = 0
+        if plane == "routes":
+            want = {(flt, tuple(d) if isinstance(d, (list, tuple))
+                     else d) for flt, d in entries}
+            cur = {(flt, tuple(d) if isinstance(d, (list, tuple))
+                    else d) for flt, d in self._route_entries(owner)}
+            for flt, dest in want - cur:
+                self._apply_route("add", flt, dest)
+                repairs += 1
+            for flt, dest in cur - want:
+                self._apply_route("del", flt, dest)
+                repairs += 1
+            return repairs
+        if plane == "registry":
+            want = set(entries)
+            with self._lock:
+                stale = [c for c, n in self._registry.items()
+                         if n == owner and c not in want]
+                for c in stale:
+                    del self._registry[c]
+                    repairs += 1
+                for c in want:
+                    if self._registry.get(c) != owner:
+                        self._registry[c] = owner
+                        repairs += 1
+            return repairs
+        if plane == "weights":
+            want = {(g, f): int(c) for g, f, c in entries}
+            with self._lock:
+                stale = [k for k in self._shared_weights
+                         if k[2] == owner and (k[0], k[1]) not in want]
+                for k in stale:
+                    del self._shared_weights[k]
+                    repairs += 1
+                for (g, f), c in want.items():
+                    if c > 0 and \
+                            self._shared_weights.get((g, f, owner)) != c:
+                        self._shared_weights[(g, f, owner)] = c
+                        repairs += 1
+            return repairs
+        if plane == "bans":
+            banned = self.node.broker.banned
+            if banned is None:
+                return 0
+            for kind, value, by, reason, until in entries:
+                cur = banned.look_up(kind, value)
+                banned.apply(kind, value, by, reason, until,
+                             overwrite=False)
+                if banned.look_up(kind, value) is not cur:
+                    repairs += 1
+            return repairs
+        if plane == "retained":
+            ret = self._retainer()
+            if ret is None or not isinstance(entries, dict):
+                return 0
+            for topic, ts in entries.get("tombs", ()):
+                ret.apply_tombstone(topic, float(ts))
+            for topic, msg in entries.get("entries", ()):
+                ret.apply_remote(topic, msg, sync=True)
+                repairs += 1
+            return repairs
+        raise ValueError(f"bad anti-entropy plane: {plane}")
+
+    def _retained_sync(self, peer: str) -> int:
+        """Entry-level retained reconciliation: exchange (timestamp,
+        payload-hash) indexes, transfer full messages only for
+        differing topics, merge tombstones both ways — LWW on both
+        sides makes over-transfer harmless and order irrelevant."""
+        ret = self._retainer()
+        if ret is None:
+            return 0
+        tr = self.transport
+        remote = tr.call(peer, "ae_retained_idx")
+        if not isinstance(remote, dict):
+            return 0  # peer has no retainer loaded
+        ridx = {t: (float(ts), ph) for t, ts, ph in remote["idx"]}
+        mine = self._retained_idx()
+        repairs = 0
+        for t, ts in remote.get("tombs", ()):
+            ret.apply_tombstone(t, float(ts))
+        pull = [t for t, v in ridx.items() if mine.get(t) != v]
+        if pull:
+            for topic, msg in tr.call(peer, "ae_fetch_retained", pull):
+                if msg is not None:
+                    ret.apply_remote(topic, msg, sync=True)
+                    repairs += 1
+        push = [t for t, v in mine.items() if ridx.get(t) != v]
+        entries = [(t, ret._store[t]) for t in push
+                   if t in ret._store]
+        tombs = ret.tombstones()
+        if entries or tombs:
+            n = tr.call(peer, "ae_apply", self.name, "retained",
+                        {"entries": entries, "tombs": tombs})
+            repairs += int(n or 0)
+        return repairs
+
     def handle_rpc(self, op: str, *args):
         if op == "route_add":
             return self._apply_route("add", args[0], args[1])
@@ -597,4 +1118,44 @@ class Cluster:
             return self._push_owned_routes()
         if op == "nodedown":
             return self.handle_nodedown(args[0])
+        if op == "leaving":
+            # a DELIBERATE departure (vs a detector-observed death):
+            # same purge, but the failure detector must also stop
+            # probing the leaver for reappearance
+            self.transport.set_departed(args[0])
+            return self.handle_nodedown(args[0])
+        if op == "ae_digests":
+            from_name, owned, merged = args
+            want = [p for p, dg in owned.items()
+                    if p in self._OWNER_PLANES
+                    and dg != self._plane_digest(p, from_name)]
+            return {
+                "want": want,
+                "mine": {p: self._plane_digest(p, self.name)
+                         for p in self._OWNER_PLANES},
+                "merged": {
+                    "bans": self._plane_digest("bans", None),
+                    "retained": self._plane_digest("retained", None)},
+            }
+        if op == "ae_entries":
+            plane = args[0]
+            return self._plane_entries(
+                plane, self.name if plane in self._OWNER_PLANES
+                else None)
+        if op == "ae_apply":
+            from_name, plane, entries = args
+            return self._ae_reconcile(plane, from_name, entries)
+        if op == "ae_retained_idx":
+            ret = self._retainer()
+            if ret is None:
+                return None
+            return {"idx": [(t, ts, ph) for t, (ts, ph) in
+                            self._retained_idx().items()],
+                    "tombs": ret.tombstones()}
+        if op == "ae_fetch_retained":
+            ret = self._retainer()
+            if ret is None:
+                return []
+            return [(t, ret._store.get(t)) for t in args[0]
+                    if t in ret._store]
         raise ValueError(f"bad rpc op: {op}")

@@ -42,16 +42,49 @@ import asyncio
 import logging
 import struct
 import threading
+import time
+from concurrent import futures as _futures
 from typing import Dict, Optional, Tuple
 
+from emqx_tpu import faults as _faults
 from emqx_tpu import wire
-from emqx_tpu.cluster import Transport
+from emqx_tpu.cluster import (ClusterConfig, PeerUnavailableError,
+                              Transport)
 
 log = logging.getLogger("emqx_tpu.cluster_net")
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 * 1024 * 1024
 _HELLO, _CAST, _CALL, _REPLY, _ERR = "hello", "cast", "call", "reply", "err"
+
+
+#: failure-detector states (docs/CLUSTER.md): ok → suspect on missed
+#: heartbeats or a link drop (casts park, NOTHING is purged) → down
+#: after the full miss window (nodedown dispatched) → back to ok via
+#: reappearance (down) or consecutive heartbeat successes (suspect)
+_OK, _SUSPECT, _DOWN = "ok", "suspect", "down"
+
+_STATE_RANK = {_OK: 0, _SUSPECT: 1, _DOWN: 2}
+
+
+class _PeerHealth:
+    """Per-peer detector state. Written only by the transport's IO
+    loop; read lock-free from other threads (single-field loads are
+    atomic under the GIL — readers may see a state one transition
+    old, which every consumer tolerates)."""
+
+    __slots__ = ("state", "misses", "oks", "rtt_ms", "since",
+                 "dial_fails", "next_dial", "departed")
+
+    def __init__(self) -> None:
+        self.state = _OK
+        self.misses = 0
+        self.oks = 0
+        self.rtt_ms: Optional[float] = None
+        self.since = time.time()
+        self.dial_fails = 0     # consecutive failed (re)dials
+        self.next_dial = 0.0    # monotonic gate for the next redial
+        self.departed = False   # left deliberately: never auto-heal
 
 
 async def _send_frame(writer: asyncio.StreamWriter, obj) -> None:
@@ -84,12 +117,33 @@ class SocketTransport(Transport):
 
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: int = 0, cookie: str = "emqxtpu",
-                 call_timeout: float = 10.0) -> None:
+                 call_timeout: float = 10.0,
+                 config: Optional[ClusterConfig] = None) -> None:
         self.name = name
         self.host = host
         self.port = port           # actual port known after serve()
         self.cookie = cookie
+        self.config = config
+        if config is not None:
+            call_timeout = config.call_timeout_s
         self.call_timeout = call_timeout
+        # heartbeat failure detector (docs/CLUSTER.md). None config
+        # or detector=false keeps EVERY legacy path byte-for-byte:
+        # no detector task, no suspect state, no fast-fail, no
+        # bounded-coroutine calls, no redial backoff
+        self._hb_enabled = bool(config is not None and config.detector)
+        self._health: Dict[str, _PeerHealth] = {}
+        self._hb_inflight: set = set()
+        # event counters drained by Cluster.drain_counters → Metrics
+        self._counters: Dict[str, int] = {}
+        self._counters_lock = threading.Lock()
+        # chaos scoping for the net.* fault points: a multi-node-in-
+        # one-process test severs SPECIFIC links by naming the peers
+        # this transport's net faults apply to (None = all peers —
+        # the production one-node-per-process case), and picks which
+        # node a peer.wedge arm wedges via fault_local
+        self.fault_peers: Optional[set] = None
+        self.fault_local = True
         self.cluster = None        # set by Cluster.attach_transport
         self._peers: Dict[str, Tuple[str, int]] = {}
         self._conns: Dict[Tuple[str, int], tuple] = {}  # addr -> (r, w, lock)
@@ -146,6 +200,10 @@ class SocketTransport(Transport):
             self._server = await asyncio.start_server(
                 self._on_peer, self.host, self.port)
             self.port = self._server.sockets[0].getsockname()[1]
+            if self._hb_enabled:
+                self._track(
+                    self._loop.create_task(self._detector_loop()),
+                    self._probe_tasks)
             self._started.set()
 
         self._loop.run_until_complete(boot())
@@ -234,6 +292,11 @@ class SocketTransport(Transport):
             # casts buffered for the old one
             with self._cast_lock:
                 self._cast_buf.pop(prev, None)
+        # an explicit (re)registration — a join — clears any departed
+        # mark and gives the detector a clean slate for the peer
+        h = self._health.get(node)
+        if h is not None and (h.departed or prev != (host, port)):
+            self._health[node] = _PeerHealth()
 
     def addr_book(self) -> Dict[str, Tuple[str, int]]:
         book = dict(self._peers)
@@ -255,6 +318,206 @@ class SocketTransport(Transport):
             # CancelledError (BaseException): shutdown's task sweep —
             # same best-effort None as any other failure here
             return None
+
+    # -- failure detector (docs/CLUSTER.md) --------------------------------
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._counters_lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def drain_counters(self) -> Dict[str, int]:
+        with self._counters_lock:
+            out = dict(self._counters)
+            self._counters.clear()
+        return out
+
+    def _health_of(self, name: str) -> _PeerHealth:
+        h = self._health.get(name)
+        if h is None:
+            h = self._health[name] = _PeerHealth()
+        return h
+
+    def peer_state(self, node: str) -> str:
+        if not self._hb_enabled:
+            return _OK
+        h = self._health.get(node)
+        return h.state if h is not None else _OK
+
+    def health_info(self) -> Dict[str, dict]:
+        return {name: {"state": h.state, "rtt_ms": h.rtt_ms,
+                       "misses": h.misses, "since": h.since,
+                       "departed": h.departed}
+                for name, h in self._health.items()}
+
+    def set_departed(self, node: str) -> None:
+        if not self._hb_enabled:
+            return
+        h = self._health_of(node)
+        h.departed = True
+
+    def _fault_on(self, name) -> bool:
+        """Does an armed net.* fault apply to this peer? (chaos
+        scoping for multi-node-in-one-process tests)"""
+        return self.fault_peers is None or name in self.fault_peers
+
+    def _name_of_addr(self, addr) -> Optional[str]:
+        for n, a in self._peers.items():
+            if a == addr:
+                return n
+        return None
+
+    def _drop_conn(self, addr) -> None:
+        """Drop the cached link so the next writer redials — a call
+        abandoned by its deadline may receive its reply LATE, and a
+        stale reply left in the stream would desync the next call."""
+        ent = self._conns.pop(addr, None)
+        if ent is not None:
+            try:
+                ent[1].close()
+            except Exception:
+                pass
+
+    async def _detector_loop(self) -> None:
+        """One heartbeat round per interval: ping every member peer
+        over the existing link; probe DOWN peers (bounded by redial
+        backoff) for reappearance."""
+        cfg = self.config
+        try:
+            while not self._closing:
+                await asyncio.sleep(cfg.heartbeat_interval_s)
+                if self._closing:
+                    return
+                cl = self.cluster
+                if cl is None:
+                    continue
+                members = set(getattr(cl, "members", ()))
+                for name, addr in list(self._peers.items()):
+                    h = self._health_of(name)
+                    if h.departed or name in self._hb_inflight:
+                        continue
+                    if name not in members and h.state != _DOWN:
+                        continue  # not a member, nothing to watch
+                    self._hb_inflight.add(name)
+                    self._track(self._loop.create_task(
+                        self._heartbeat(name, addr)),
+                        self._probe_tasks)
+        except asyncio.CancelledError:
+            pass
+
+    async def _heartbeat(self, name: str, addr) -> None:
+        cfg = self.config
+        try:
+            h = self._health_of(name)
+            if h.state == _DOWN:
+                # reappearance probe, paced by exponential backoff
+                if self._loop.time() < h.next_dial:
+                    return
+                if await self._probe_once(addr, name=name):
+                    self._peer_reappeared(name, addr)
+                else:
+                    h.dial_fails += 1
+                    h.next_dial = self._loop.time() + min(
+                        cfg.redial_backoff_max_s,
+                        cfg.redial_backoff_s
+                        * (2 ** min(h.dial_fails, 6)))
+                return
+            t0 = time.perf_counter()
+            try:
+                res = await asyncio.wait_for(
+                    self._request(addr, "ping", ()),
+                    cfg.heartbeat_timeout_s)
+                ok = res == "pong"
+            except asyncio.TimeoutError:
+                # the reply may still arrive later; a stale reply in
+                # the stream would desync the next call on this link
+                self._drop_conn(addr)
+                ok = False
+            except (ConnectionError, OSError, EOFError,
+                    asyncio.IncompleteReadError):
+                ok = False
+            if ok:
+                self._note_hb_ok(name, (time.perf_counter() - t0)
+                                 * 1000.0)
+            else:
+                self._note_hb_miss(name)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("heartbeat to %s failed unexpectedly", name)
+        finally:
+            self._hb_inflight.discard(name)
+
+    def _note_hb_ok(self, name: str, rtt_ms: float) -> None:
+        h = self._health_of(name)
+        h.rtt_ms = rtt_ms
+        h.misses = 0
+        h.dial_fails = 0
+        if h.state == _SUSPECT:
+            h.oks += 1
+            if h.oks >= self.config.ok_after:
+                h.state = _OK
+                h.since = time.time()
+                h.oks = 0
+                log.warning("peer %s recovered: suspect -> ok", name)
+                # unpark any casts buffered while suspect
+                self._spawn_cast_flush()
+
+    def _note_hb_miss(self, name: str) -> None:
+        cfg = self.config
+        h = self._health_of(name)
+        h.oks = 0
+        h.misses += 1
+        if h.state == _OK and h.misses >= cfg.suspect_after:
+            h.state = _SUSPECT
+            h.since = time.time()
+            self._count("hb.suspects")
+            log.warning("peer %s missed %d heartbeats: ok -> suspect "
+                        "(casts parked, nothing purged)", name,
+                        h.misses)
+        if h.state == _SUSPECT and h.misses >= cfg.down_after:
+            self._track(self._loop.create_task(
+                self._declare_down(name)), self._probe_tasks)
+
+    async def _declare_down(self, name: str) -> None:
+        h = self._health_of(name)
+        if h.state == _DOWN:
+            return
+        h.state = _DOWN
+        h.since = time.time()
+        h.oks = 0
+        h.dial_fails = 0
+        h.next_dial = self._loop.time() + self.config.redial_backoff_s
+        self._count("hb.downs")
+        addr = self._peers.get(name)
+        # the dead peer's buffered casts are state mutations from
+        # BEFORE the death — replaying them into a rejoined
+        # incarnation would resurrect what nodedown purges (same
+        # contract as the legacy probe path)
+        with self._cast_lock:
+            self._cast_buf.pop(addr, None)
+        log.warning("peer %s declared DOWN by the failure detector",
+                    name)
+        try:
+            await self._dispatch("nodedown", (name,))
+        except Exception:
+            log.exception("nodedown dispatch for %s failed", name)
+
+    def _peer_reappeared(self, name: str, addr) -> None:
+        """A downed peer answered a probe (or dialed in): clear the
+        detector state and hand the rejoin to the cluster's auto-heal
+        worker (membership re-merge + anti-entropy)."""
+        h = self._health_of(name)
+        h.state = _OK
+        h.since = time.time()
+        h.misses = h.oks = h.dial_fails = 0
+        self._count("hb.reappears")
+        log.warning("peer %s reappeared; scheduling auto-heal", name)
+        cl = self.cluster
+        if cl is not None:
+            try:
+                cl.schedule_heal(name)
+            except Exception:
+                log.exception("heal scheduling for %s failed", name)
 
     # -- outbound ----------------------------------------------------------
 
@@ -279,7 +542,12 @@ class SocketTransport(Transport):
                 # shed new casts instead of growing without bound
                 # (gen_rpc's async cast is at-most-once the same way;
                 # QoS1 recovers via client retransmit, and the link
-                # monitor will declare nodedown)
+                # monitor will declare nodedown). Counted: at-most-
+                # once loss must be observable, not a log line —
+                # the stats tick folds this into
+                # ``cluster.forward.dropped`` + the
+                # ``cluster_forward_dropped`` alarm
+                self._count("forward.dropped")
                 log.warning("cast buffer to %s full; dropping %s",
                             addr, op)
                 return
@@ -311,6 +579,16 @@ class SocketTransport(Transport):
                      if a not in self._cast_flushing]
             self._cast_flushing.update(addrs)
             self._cast_flush_scheduled = False
+        if self._hb_enabled:
+            # suspect peers PARK their casts: the buffer holds (the
+            # blip may clear) instead of burning redials — flushed by
+            # the suspect → ok transition; dropped whole on → down
+            parked = [a for a in addrs
+                      if self.peer_state(self._name_of_addr(a)) != _OK]
+            if parked:
+                with self._cast_lock:
+                    self._cast_flushing.difference_update(parked)
+                addrs = [a for a in addrs if a not in parked]
         for addr in addrs:
             self._track(self._loop.create_task(self._flush_addr(addr)),
                         self._probe_tasks)
@@ -376,6 +654,21 @@ class SocketTransport(Transport):
                     pending = self._take_cast_buf(addr)
                     if not pending:
                         return True  # a call on this link drained us
+                    if _faults.enabled \
+                            and self._fault_on(self._name_of_addr(addr)):
+                        # net.delay (stall) slows the write; net.drop
+                        # discards the claimed burst as if sent — the
+                        # at-most-once loss the anti-entropy sweep
+                        # exists to repair; net.partition fails the
+                        # established link
+                        _faults.fire("net.delay")
+                        if _faults.fire("net.drop"):
+                            self._count("forward.dropped")
+                            return True
+                        if _faults.fire("net.partition"):
+                            self._requeue_cast_buf(addr, pending)
+                            raise ConnectionError(
+                                f"injected partition to {addr}")
                     try:
                         writer.write(pending)
                         await writer.drain()
@@ -408,17 +701,36 @@ class SocketTransport(Transport):
         addr = self._peers.get(node)
         if addr is None:
             raise ConnectionError(f"unknown node: {node}")
+        if self._hb_enabled and self.config.suspect_fast_fail:
+            # suspect-aware fast-fail: no broker path (locker quorum,
+            # takeover, discard) ever blocks call_timeout on a peer
+            # the detector already holds unhealthy. Raised WITHOUT
+            # touching the wire; heal/probe traffic goes via
+            # call_addr/_probe_once, which bypass this gate
+            st = self.peer_state(node)
+            if st != _OK:
+                self._count("rpc.fastfail")
+                raise PeerUnavailableError(node, st)
         return self.call_addr(addr, op, *args)
 
     def call_addr(self, addr: Tuple[str, int], op: str, *args):
         """Call a peer by raw address (used before its name is known
-        — the join handshake)."""
-        fut = asyncio.run_coroutine_threadsafe(
-            self._request(addr, op, args), self._loop)
+        — the join handshake — and by heal/anti-entropy traffic,
+        which must reach peers the fast-fail gate would refuse)."""
+        if self._hb_enabled:
+            # bounded cluster RPC: the deadline also cancels the
+            # COROUTINE (releasing the link lock + dropping the conn)
+            # — the bare fut.result timeout below leaves it holding
+            # the per-link lock forever against a wedged peer
+            coro = self._request_bounded(addr, op, args)
+        else:
+            coro = self._request(addr, op, args)
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         try:
             return fut.result(timeout=self.call_timeout)
         except (ConnectionError, asyncio.TimeoutError, OSError,
                 asyncio.IncompleteReadError, TimeoutError,
+                _futures.TimeoutError,  # ≠ builtin TimeoutError <3.11
                 asyncio.CancelledError) as e:
             # CancelledError: close()'s all-task sweep cancelled the
             # in-flight request — callers were promised a
@@ -426,11 +738,40 @@ class SocketTransport(Transport):
             # BaseException that would sail through their handlers
             raise ConnectionError(f"call {op} to {addr} failed: {e}") from e
 
+    async def _request_bounded(self, addr, op, args):
+        """``_request`` under the per-peer deadline: on expiry the
+        cached link is dropped (a late reply must never desync the
+        next call's frame stream) and the caller gets the promised
+        ConnectionError."""
+        try:
+            return await asyncio.wait_for(
+                self._request(addr, op, args), self.call_timeout)
+        except asyncio.TimeoutError:
+            self._drop_conn(addr)
+            raise ConnectionError(
+                f"call {op} to {addr} timed out "
+                f"after {self.call_timeout}s") from None
+
     async def _connect(self, addr: Tuple[str, int]):
         ent = self._conns.get(addr)
         if ent is not None and not ent[1].is_closing():
             return ent
-        reader, writer = await asyncio.open_connection(*addr)
+        if _faults.enabled and self._fault_on(self._name_of_addr(addr)) \
+                and _faults.fire("net.partition"):
+            raise ConnectionError(f"injected partition to {addr}")
+        if self._hb_enabled:
+            # exponential redial backoff: a dead peer costs one dial
+            # per backoff window, not one per caller
+            h = self._health.get(self._name_of_addr(addr) or "")
+            if h is not None and h.dial_fails \
+                    and self._loop.time() < h.next_dial:
+                raise ConnectionError(
+                    f"redial to {addr} backing off")
+        try:
+            reader, writer = await asyncio.open_connection(*addr)
+        except (ConnectionError, OSError):
+            self._note_dial_failed(addr)
+            raise
         # data-plane hello: 2-tuple (the probe flag defaults False
         # receiver-side; only probe dials carry the third field)
         await _send_frame(writer, (_HELLO, 0, (self.name, self.cookie)))
@@ -440,7 +781,24 @@ class SocketTransport(Transport):
             raise ConnectionError(f"cluster hello rejected by {addr}")
         ent = (reader, writer, asyncio.Lock())
         self._conns[addr] = ent
+        if self._hb_enabled:
+            h = self._health.get(self._name_of_addr(addr) or "")
+            if h is not None:
+                h.dial_fails = 0
         return ent
+
+    def _note_dial_failed(self, addr) -> None:
+        if not self._hb_enabled:
+            return
+        name = self._name_of_addr(addr)
+        if name is None:
+            return
+        h = self._health_of(name)
+        h.dial_fails += 1
+        h.next_dial = self._loop.time() + min(
+            self.config.redial_backoff_max_s,
+            self.config.redial_backoff_s
+            * (2 ** min(h.dial_fails, 6)))
 
     async def _send(self, addr, frame) -> None:
         reader, writer, lock = await self._connect(addr)
@@ -453,6 +811,15 @@ class SocketTransport(Transport):
 
     async def _request(self, addr, op, args):
         reader, writer, lock = await self._connect(addr)
+        if _faults.enabled and self._fault_on(self._name_of_addr(addr)):
+            _faults.fire("net.delay")
+            if _faults.fire("net.partition"):
+                self._conns.pop(addr, None)
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                raise ConnectionError(f"injected partition to {addr}")
         try:
             async with lock:  # one in-flight call per link: serialize
                 pending = self._take_cast_buf(addr)
@@ -483,19 +850,46 @@ class SocketTransport(Transport):
         name = None
         try:
             kind, _, hello = await _recv_frame(reader)
+            if _faults.enabled and self.fault_local \
+                    and _faults.fire("peer.wedge"):
+                # wedged-but-connected: TCP stays up, frames are
+                # swallowed, nothing ever replies — the failure mode
+                # only a heartbeat detector can see
+                while True:
+                    await _recv_frame(reader)
             name, cookie = hello[0], hello[1]
             is_probe = bool(hello[2]) if len(hello) > 2 else False
             if kind != _HELLO or cookie != self.cookie:
                 name = None
                 await _send_frame(writer, (_REPLY, 0, False))
                 return
+            if _faults.enabled and name is not None \
+                    and self._fault_on(name) \
+                    and _faults.fire("net.partition"):
+                name = None
+                return  # inbound side of an injected partition
             if is_probe:
                 # a liveness probe's disconnect is expected, never a
                 # link-drop signal
                 name = None
             await _send_frame(writer, (_REPLY, 0, True))
+            if name is not None and self._hb_enabled \
+                    and name in self._peers:
+                # an incoming data link from a DOWN peer is a
+                # reappearance: trigger auto-heal without waiting for
+                # our own probe cycle to find it
+                h = self._health_of(name)
+                if h.state == _DOWN and not h.departed:
+                    self._peer_reappeared(name, self._peers.get(name))
             while True:
                 kind, req, (op, args) = await _recv_frame(reader)
+                if _faults.enabled and self.fault_local \
+                        and _faults.fire("peer.wedge"):
+                    continue  # swallow the frame: wedged, no reply
+                if _faults.enabled and self._fault_on(name) \
+                        and name is not None \
+                        and _faults.fire("net.partition"):
+                    return  # sever the inbound link mid-stream
                 if kind == _CAST:
                     try:
                         if not self._dispatch_cast(op, args, peer):
@@ -525,16 +919,36 @@ class SocketTransport(Transport):
             # dead peer doesn't error until the retransmit gives up,
             # so cast failure alone detects death far too late). But
             # a transient drop (idle middlebox reset) must NOT purge
-            # a live member — probe before declaring death.
+            # a live member — probe before declaring death. With the
+            # heartbeat detector on, the drop only marks the peer
+            # SUSPECT (casts park, nothing purged) and the detector's
+            # own miss window decides down.
             if name is not None and self.cluster is not None \
-                    and name in self._peers \
-                    and name not in self._probing and not self._closing:
-                coro = self._probe_then_nodedown(name)
-                try:
-                    self._track(self._loop.create_task(coro),
-                                self._probe_tasks)
-                except RuntimeError:  # transport shutting down
-                    coro.close()
+                    and name in self._peers and not self._closing:
+                if self._hb_enabled:
+                    self._note_link_drop(name)
+                elif name not in self._probing:
+                    coro = self._probe_then_nodedown(name)
+                    try:
+                        self._track(self._loop.create_task(coro),
+                                    self._probe_tasks)
+                    except RuntimeError:  # transport shutting down
+                        coro.close()
+
+    def _note_link_drop(self, name: str) -> None:
+        """Detector-mode link-drop handling: an established link
+        dying demotes the peer straight to suspect (hysteresis down
+        would be wasted on a signal this strong) but NEVER to down —
+        a transient blip must not purge a live member."""
+        h = self._health_of(name)
+        if h.state == _OK:
+            h.oks = 0
+            h.misses = max(h.misses, self.config.suspect_after)
+            h.state = _SUSPECT
+            h.since = time.time()
+            self._count("hb.suspects")
+            log.warning("link to %s dropped: ok -> suspect "
+                        "(casts parked, nothing purged)", name)
 
     async def _probe_then_nodedown(self, name: str) -> None:
         if name in self._probing:
@@ -544,7 +958,7 @@ class SocketTransport(Transport):
         try:
             addr = self._peers.get(name)
             for attempt in range(3):
-                if await self._probe_once(addr):
+                if await self._probe_once(addr, name=name):
                     return  # alive: the drop was transient
                 await asyncio.sleep(0.3 * (attempt + 1))
             # the peer is dead: its buffered casts are state
@@ -561,7 +975,7 @@ class SocketTransport(Transport):
         finally:
             self._probing.discard(name)
 
-    async def _probe_once(self, addr) -> bool:
+    async def _probe_once(self, addr, name: Optional[str] = None) -> bool:
         """Liveness ping over a DEDICATED throwaway connection. The
         cached data connection must not be touched: closing it to
         force a fresh dial would drop the peer's inbound link, firing
@@ -578,6 +992,10 @@ class SocketTransport(Transport):
         one reintroduced a probe storm or doubled dead-peer detection
         latency)."""
         writer = None
+        if _faults.enabled and self._fault_on(
+                name if name is not None else self._name_of_addr(addr)) \
+                and _faults.fire("net.partition"):
+            return False
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*addr), timeout=3.0)

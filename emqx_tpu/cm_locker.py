@@ -36,6 +36,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Tuple
 
+from emqx_tpu.cluster import PeerUnavailableError
+
 log = logging.getLogger("emqx_tpu.cm_locker")
 
 LEASE = 60.0            # backstop expiry for a leaked same-node grant
@@ -77,6 +79,10 @@ class ClusterLocker:
         try:
             return m, bool(self.cluster.transport.call(
                 m, "lock_acquire", client_id, me))
+        except PeerUnavailableError:
+            # suspect ≠ dead: no vote, no nodedown — the member is
+            # skipped this round (subclass check must come first)
+            return m, PeerUnavailableError
         except ConnectionError:
             return m, ConnectionError
         except Exception:
@@ -89,12 +95,21 @@ class ClusterLocker:
         Blocks while another LIVE owner holds it — that wait IS the
         serialization that prevents double-owned sessions; a crashed
         holder's grants drop on its nodedown, so the wait tracks the
-        holder's actual critical section, not a timer."""
+        holder's actual critical section, not a timer.
+
+        A suspect/down member (PeerUnavailableError from the failure
+        detector's fast-fail gate, docs/CLUSTER.md) is neither a vote
+        nor a death: it is excluded from the quorum denominator for
+        this attempt, so a CONNECT never blocks ``call_timeout`` on a
+        peer the detector already holds unhealthy — quorum proceeds
+        over the responsive membership and ``cluster.locker.degraded``
+        counts the degradation."""
         me = self.cluster.name
         deadline = time.monotonic() + ACQUIRE_TIMEOUT
         while True:
             peers = [m for m in list(self.cluster.members) if m != me]
             granted = []
+            suspect = []
             if self.grant(client_id, me):
                 granted.append(me)
             # concurrent fan-out (ekka_locker multicall): one
@@ -106,11 +121,28 @@ class ClusterLocker:
                     # shrinks the membership — the quorum is over
                     # live members
                     self.cluster.handle_nodedown(m)
+                elif res is PeerUnavailableError:
+                    # suspect ≠ dead: no vote, no nodedown, no wait
+                    suspect.append(m)
                 elif res:
                     granted.append(m)
             live = set(self.cluster.members)
             votes = len([g for g in granted if g in live])
             if votes * 2 > len(live):
+                return True
+            responsive = live - set(suspect)
+            if suspect and responsive and votes * 2 > len(responsive):
+                # majority of the members that can answer at all:
+                # proceed (availability over a full quorum — the
+                # suspect peer is either dead, in which case nodedown
+                # will shrink the membership anyway, or partitioned,
+                # in which case anti-entropy reconciles the registry
+                # after heal), but make the degradation observable
+                self.cluster._count("locker.degraded")
+                log.warning(
+                    "cluster lock on %r granted by %d/%d with %r "
+                    "suspect — degraded quorum", client_id, votes,
+                    len(live), suspect)
                 return True
             # held elsewhere: release partial grants so the competing
             # owner can win, then retry until the deadline

@@ -135,6 +135,12 @@ class NodeConfig:
     # docs/DURABILITY.md). None = disabled (today's in-memory-only
     # behavior, byte-for-byte).
     durability: Optional[Any] = None
+    # [cluster] section: heartbeat failure detector + auto-heal /
+    # anti-entropy knobs (emqx_tpu.cluster.ClusterConfig,
+    # docs/CLUSTER.md). None = the legacy EOF-only failure story,
+    # byte-for-byte. Only takes effect on a node with a cluster
+    # transport ([node] cluster_port).
+    cluster: Optional[Any] = None
 
 
 #: zone fields with a closed value set — a typo must be a startup
@@ -346,6 +352,39 @@ def _build_durability(raw: Dict[str, Any]):
         raise ConfigError(str(e)) from e
 
 
+def _build_cluster(raw: Dict[str, Any]):
+    """``[cluster]`` table → :class:`~emqx_tpu.cluster
+    .ClusterConfig`. Closed schema like zones/matcher: a typo'd
+    ``detector = false`` silently leaving the failure detector armed
+    (or off) is the drift this rule catches; knob-ordering violations
+    (down_after < suspect_after) become startup errors."""
+    import dataclasses as _dc
+
+    from emqx_tpu.cluster import ClusterConfig
+
+    known = {f.name for f in _dc.fields(ClusterConfig)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown cluster setting: "
+                              f"cluster.{key}")
+        want = ClusterConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"cluster.{key} must be a boolean")
+        if want == "int" and (isinstance(val, bool)
+                              or not isinstance(val, int)):
+            raise ConfigError(f"cluster.{key} must be an integer")
+        if want == "float":
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise ConfigError(f"cluster.{key} must be a number")
+            val = float(val)
+        kwargs[key] = val
+    try:
+        return ClusterConfig(**kwargs)
+    except ValueError as e:
+        raise ConfigError(str(e)) from e
+
+
 def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
     raw = dict(raw)
     ltype = raw.pop("type", None)
@@ -480,6 +519,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         if not isinstance(duraw, dict):
             raise ConfigError("durability must be a table")
         cfg.durability = _build_durability(duraw)
+    craw = raw.get("cluster")
+    if craw is not None:
+        if not isinstance(craw, dict):
+            raise ConfigError("cluster must be a table")
+        cfg.cluster = _build_cluster(craw)
     for name, zraw in raw.get("zones", {}).items():
         cfg.zones[name] = _build_zone(name, zraw)
     for i, lraw in enumerate(raw.get("listeners", [])):
@@ -588,7 +632,8 @@ def build_node(cfg: NodeConfig):
         # socket transport + cluster agent come up inside
         # node.start() (the transport needs the serving loop)
         node.enable_cluster(port=cfg.cluster_port,
-                            cookie=cfg.cookie or "emqxtpu")
+                            cookie=cfg.cookie or "emqxtpu",
+                            config=cfg.cluster)
     return node
 
 

@@ -245,9 +245,26 @@ class Ctl:
             book = getattr(cl.transport, "addr_book", None)
             if book is not None:
                 peers = {k: f"{v[0]}:{v[1]}" for k, v in book().items()}
+            # per-member failure-detector health (docs/CLUSTER.md):
+            # state (ok/suspect/down), last heartbeat RTT, detector
+            # transitions since state entry; plus the anti-entropy
+            # sweep/repair summary
+            health = {}
+            for name, h in cl.transport.health_info().items():
+                rtt = h.get("rtt_ms")
+                health[name] = {
+                    "state": h["state"],
+                    "rtt_ms": round(rtt, 3) if rtt else None,
+                    "misses": h.get("misses", 0),
+                    "since": h.get("since"),
+                    "departed": h.get("departed", False),
+                }
+            ae = cl.ae_info()
             return json.dumps({"node": cl.name,
                                "members": sorted(cl.members),
-                               "addresses": peers}, indent=2)
+                               "addresses": peers,
+                               "health": health,
+                               "anti_entropy": ae}, indent=2)
         if args[0] == "join":
             import asyncio
             import threading

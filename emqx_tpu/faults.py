@@ -103,6 +103,26 @@ POINTS: Dict[str, tuple] = {
                           "the manifest rename lands (every new "
                           "segment written, previous generation "
                           "still authoritative)"),
+    # cluster plane (cluster_net.py, docs/CLUSTER.md). Scope per
+    # transport via SocketTransport.fault_peers / fault_local when
+    # several nodes share one process (the chaos matrix).
+    "net.partition": ("drop",
+                      "SocketTransport dial/call/flush/inbound — the "
+                      "link to a peer is severed both ways (arm "
+                      "times=0 for the partition window, disarm to "
+                      "heal)"),
+    "net.delay": ("stall",
+                  "SocketTransport call/flush — frames to a peer are "
+                  "delayed delay_ms before the write"),
+    "net.drop": ("drop",
+                 "SocketTransport cast flush — a claimed cast burst "
+                 "is discarded as if sent (at-most-once loss; the "
+                 "anti-entropy sweep's repair target)"),
+    "peer.wedge": ("drop",
+                   "SocketTransport._on_peer — this node's inbound "
+                   "frame loop swallows frames without replying: "
+                   "wedged-but-connected, visible only to the "
+                   "heartbeat detector"),
 }
 
 _ACTIONS = ("raise", "stall", "drop")

@@ -188,12 +188,32 @@ DURABILITY_METRICS = [
     "recovery.routes.pruned",
 ]
 
+# cluster plane (cluster.py + cluster_net.py, docs/CLUSTER.md),
+# folded from the per-node Cluster/transport event counters on the
+# stats tick: `cluster.hb.*` = failure-detector transitions
+# (ok→suspect, suspect→down, down→reappeared), `cluster.rpc.fastfail`
+# = calls refused WITHOUT touching the wire because the detector held
+# the peer suspect/down, `cluster.forward.dropped` = at-most-once
+# data-plane casts shed (cast buffer full, or net.drop chaos) — the
+# loss anti-entropy exists to repair, `cluster.heal.rejoins` =
+# auto-heal handshakes completed, `cluster.ae.sweeps`/
+# `cluster.ae.repairs` = anti-entropy rounds run / entries re-pushed,
+# `cluster.locker.degraded` = lock quorums that proceeded without a
+# suspect member's vote
+CLUSTER_METRICS = [
+    "cluster.hb.suspects", "cluster.hb.downs",
+    "cluster.hb.reappears", "cluster.rpc.fastfail",
+    "cluster.forward.dropped", "cluster.heal.rejoins",
+    "cluster.ae.sweeps", "cluster.ae.repairs",
+    "cluster.locker.degraded",
+]
+
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
                + AUTOMATON_METRICS + TRANSPORT_METRICS
                + OVERLOAD_METRICS + BREAKER_METRICS + FAULT_METRICS
-               + DURABILITY_METRICS)
+               + DURABILITY_METRICS + CLUSTER_METRICS)
 
 #: registry names that are NOT monotonic — ``Metrics.dec`` runs on
 #: them in steady state (today: the retainer's live-entry count,
@@ -291,6 +311,17 @@ class Metrics:
         (Router.drain_automaton_stats)."""
         for key, val in stats.items():
             self.inc(f"automaton.{key}", int(val))
+
+    def fold_cluster_stats(self, stats: Dict[str, int]) -> None:
+        """Fold drained cluster-plane event counters
+        (Cluster.drain_counters). Keys outside CLUSTER_METRICS are
+        registered on first sight — the cluster/transport layers may
+        grow event names without a registry edit here."""
+        for key, val in stats.items():
+            name = f"cluster.{key}"
+            if name not in self._index:
+                self.new(name)
+            self.inc(name, int(val))
 
 
 _QOS_RECV = ("messages.qos0.received", "messages.qos1.received",

@@ -67,7 +67,12 @@ def render(metrics: dict, stats: dict,
     for key in sorted(stats):
         name = prom_name(key)
         out.append(f"# TYPE {name} gauge")
-        out.append(f"{name} {int(stats[key])}")
+        val = stats[key]
+        if isinstance(val, float) and not val.is_integer():
+            # sub-unit gauges (cluster.hb.rtt_ms) must not floor to 0
+            out.append(f"{name} {val}")
+        else:
+            out.append(f"{name} {int(val)}")
     for name in sorted(histograms or ()):
         snap = histograms[name]
         out.append(f"# TYPE {name} histogram")

@@ -179,6 +179,12 @@ class Node:
         # router_ids_quarantined alarm (_update_stats)
         self._quar_prev = 0
         self._quar_streak = 0
+        # cluster-plane observability state (stats tick): cumulative
+        # forward-drop count at the last tick (alarm edge detection)
+        # + the per-member gauge rows published last tick (departed
+        # peers' rows are deleted, not left stale)
+        self._fwd_dropped_prev = 0
+        self._cluster_stat_keys: set = set()
         self.stats.register_update(self._update_stats)
 
     # convenience accessors
@@ -276,12 +282,15 @@ class Node:
                                     max_connections=max_connections)
 
     def enable_cluster(self, port: int = 0, host: str = "127.0.0.1",
-                       cookie: str = "emqxtpu") -> None:
+                       cookie: str = "emqxtpu", config=None) -> None:
         """Arrange for a socket cluster transport + Cluster agent to
         come up during :meth:`start` (the transport captures the
         serving loop). ``node.cluster.join_remote(host, port)`` joins
-        a peer once started."""
-        self._cluster_cfg = (host, port, cookie)
+        a peer once started. ``config`` is the ``[cluster]``
+        :class:`~emqx_tpu.cluster.ClusterConfig` (failure detector +
+        auto-heal, docs/CLUSTER.md); None = legacy EOF-only failure
+        detection."""
+        self._cluster_cfg = (host, port, cookie, config)
 
     async def start(self) -> None:
         if self._started:
@@ -312,11 +321,11 @@ class Node:
         if self._cluster_cfg is not None and self.cluster is None:
             from emqx_tpu.cluster import Cluster
             from emqx_tpu.cluster_net import SocketTransport
-            host, port, cookie = self._cluster_cfg
+            host, port, cookie, ccfg = self._cluster_cfg
             tr = SocketTransport(self.name, host=host, port=port,
-                                 cookie=cookie)
+                                 cookie=cookie, config=ccfg)
             tr.serve()
-            self.cluster = Cluster(self, transport=tr)
+            self.cluster = Cluster(self, transport=tr, config=ccfg)
             log.info("cluster transport on %s:%s", tr.host, tr.port)
         # vm_mon watches the node-wide connection count, so the
         # watermark denominator is the summed listener capacity
@@ -381,10 +390,14 @@ class Node:
             loop = asyncio.get_event_loop()
             await loop.run_in_executor(None,
                                        self.durability.shutdown)
-        if self.cluster is not None and self._cluster_cfg is not None:
-            close = getattr(self.cluster.transport, "close", None)
-            if close is not None:
-                close()
+        if self.cluster is not None:
+            # heal/anti-entropy worker first (it calls through the
+            # transport), then the transport itself
+            self.cluster.close()
+            if self._cluster_cfg is not None:
+                close = getattr(self.cluster.transport, "close", None)
+                if close is not None:
+                    close()
         if self.loop_group is not None:
             # after listeners + ingress drain: in-flight cross-loop
             # handoffs have reported back, peer loops are idle
@@ -471,11 +484,58 @@ class Node:
             age = dinfo.get("checkpoint_age_s")
             if age is not None:
                 stats.setstat("checkpoint.age_s", int(age))
+        if self.cluster is not None:
+            self._fold_cluster_stats(stats)
         self.drain_robustness_events()
         stats.setstat("publish.spans.count", self.telemetry.spans_total,
                       "publish.spans.max")
         stats.setstat("publish.slow.count", self.telemetry.slow_total,
                       "publish.slow.max")
+
+    #: failure-detector state → gauge value (docs/OBSERVABILITY.md)
+    _MEMBER_STATE_RANK = {"ok": 0, "suspect": 1, "down": 2}
+
+    def _fold_cluster_stats(self, stats: Stats) -> None:
+        """Cluster-plane observability, off the hot path: fold the
+        drained event counters into Metrics as ``cluster.<key>``,
+        publish the membership/health gauges, and edge-detect the
+        ``cluster_forward_dropped`` alarm (docs/CLUSTER.md)."""
+        cl = self.cluster
+        self.metrics.fold_cluster_stats(cl.drain_counters())
+        dropped = self.metrics.val("cluster.forward.dropped")
+        if dropped > self._fwd_dropped_prev:
+            self.alarms.activate(
+                "cluster_forward_dropped",
+                details={"dropped_total": dropped},
+                message="cluster data-plane forwards dropped "
+                        "(at-most-once loss; anti-entropy repairs "
+                        "replicated state, QoS0 deliveries are gone)")
+        elif dropped == self._fwd_dropped_prev:
+            self.alarms.deactivate("cluster_forward_dropped")
+        self._fwd_dropped_prev = dropped
+        stats.setstat("cluster.members.count", len(cl.members))
+        health = cl.transport.health_info()
+        worst = 0
+        slowest = 0.0
+        keys = set()
+        for name, info in health.items():
+            rank = self._MEMBER_STATE_RANK.get(info["state"], 0)
+            worst = max(worst, rank)
+            rtt = info.get("rtt_ms")
+            if rtt:
+                slowest = max(slowest, float(rtt))
+            for key, val in ((f"cluster.member.{name}.state", rank),
+                             (f"cluster.member.{name}.rtt_ms",
+                              round(float(rtt), 3) if rtt else 0)):
+                keys.add(key)
+                stats.setstat(key, val)
+        # the named aggregate gauges: worst member state + slowest
+        # heartbeat RTT (a single scrapeable signal per cluster)
+        stats.setstat("cluster.member.state", worst)
+        stats.setstat("cluster.hb.rtt_ms", round(slowest, 3))
+        for stale in self._cluster_stat_keys - keys:
+            stats.delstat(stale)
+        self._cluster_stat_keys = keys
 
     def _note_flatten_error(self, exc) -> None:
         """Router background-compaction outcome callback — may run ON

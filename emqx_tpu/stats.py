@@ -40,6 +40,13 @@ STATS_KEYS = [
     # journal means checkpoints are failing — see checkpoint_failed)
     "journal.bytes", "journal.records",
     "durability.generation", "checkpoint.age_s",
+    # cluster plane (docs/CLUSTER.md): membership size, worst
+    # failure-detector state across peers (0 ok / 1 suspect / 2
+    # down — any non-zero means a peer is unhealthy right now), and
+    # the slowest peer heartbeat RTT. Per-peer rows land as
+    # ``cluster.member.<name>.state`` / ``.rtt_ms`` dynamically.
+    "cluster.members.count",
+    "cluster.member.state", "cluster.hb.rtt_ms",
 ]
 
 
@@ -56,6 +63,11 @@ class Stats:
 
     def getstat(self, key: str) -> int:
         return self._vals.get(key, 0)
+
+    def delstat(self, key: str) -> None:
+        """Drop a dynamically-created row (a departed cluster peer's
+        per-member gauges must not linger at their last value)."""
+        self._vals.pop(key, None)
 
     def all(self) -> Dict[str, int]:
         return dict(self._vals)

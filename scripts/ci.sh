@@ -115,6 +115,27 @@ assert rec['metric']=='recovery_replay_s' \
     and rec['value'] is not None \
     and rec['recovery_routes'] == 1500, rec"
 
+echo "== cluster heal matrix (docs/CLUSTER.md) =="
+# failure detector (wedged-peer detection, suspect-parks-not-purges,
+# fast-fail + degraded locker quorum), auto-heal + anti-entropy
+# (partition/heal convergence of all five replicated planes vs a
+# never-partitioned oracle), and the detector-off legacy pin — a
+# regression here is silent cluster divergence, fail fast
+python -m pytest tests/test_cluster_heal.py -q
+
+echo "== partition-heal smoke (docs/CLUSTER.md) =="
+# the BENCH_MODE=partition scenario end-to-end at toy scale: a
+# 3-node partition with churn on both sides must detect, heal, and
+# reconverge all plane digests with zero manual rejoin (numbers are
+# not gated here — the driver's real-scale run is)
+BENCH_MODE=partition PARTITION_ROUTES=300 PARTITION_SECONDS=1 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='partition_heal_converge_s' \
+    and rec['value'] is not None \
+    and rec['partition_detect_s'] is not None, rec"
+
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B
 # guard (telemetry off => dispatch byte-identical to the

@@ -1,0 +1,520 @@
+"""Partition-tolerant cluster: failure detector, auto-heal,
+anti-entropy (docs/CLUSTER.md).
+
+The chaos matrix the tentpole promises: a wedged-but-connected peer
+is declared down within the detector window (the failure mode the
+legacy EOF-only monitor can never see), a transient blip parks casts
+without purging anything, suspect peers fast-fail instead of
+blocking CONNECTs into ``call_timeout``, and a healed partition
+reconverges all five replicated planes byte-exactly against a
+never-partitioned oracle cluster — with zero manual rejoin.
+
+Multi-node-in-one-process over real sockets: each node gets its own
+SocketTransport (private IO thread). The module-global fault
+registry is scoped per transport via ``fault_peers``/``fault_local``
+so a partition severs exactly the links the scenario names.
+"""
+
+import time
+
+import pytest
+
+from emqx_tpu import faults
+from emqx_tpu.cluster import (Cluster, ClusterConfig,
+                              PeerUnavailableError)
+from emqx_tpu.cluster_net import SocketTransport
+from emqx_tpu.modules.retainer import RetainerModule
+from emqx_tpu.node import Node
+from emqx_tpu.types import Message
+
+#: recent-but-fixed timestamp base: retained LWW and tombstones are
+#: timestamp-ordered, so byte-exact oracle comparison needs the SAME
+#: timestamps in both clusters — but the retainer sweeps tombstones
+#: older than an hour, so they must also be *current*
+TS = float(int(time.time()))
+
+
+def _fast_cfg(**kw) -> ClusterConfig:
+    base = dict(heartbeat_interval_s=0.1, heartbeat_timeout_s=0.5,
+                suspect_after=1, down_after=3, ok_after=1,
+                anti_entropy_interval_s=0.5, call_timeout_s=2.0,
+                redial_backoff_s=0.1, redial_backoff_max_s=0.5)
+    base.update(kw)
+    return ClusterConfig(**base)
+
+
+class Sub:
+    def __init__(self, cid):
+        self.client_id = cid
+        self.inbox = []
+
+    def deliver(self, t, m):
+        self.inbox.append((t, m))
+
+
+def _mk_net(n, config, cookie, retainer=False, immune=False):
+    nodes, trs, cls = [], [], []
+    for i in range(n):
+        node = Node(name=f"hn{i}", boot_listeners=False)
+        if retainer:
+            node.modules.load(RetainerModule)
+        tr = SocketTransport(f"hn{i}", cookie=cookie, config=config)
+        if immune:
+            # a second cluster in this process must not feel the
+            # chaos armed for the first one
+            tr.fault_peers = set()
+            tr.fault_local = False
+        tr.serve()
+        cl = Cluster(node, transport=tr, config=config)
+        nodes.append(node)
+        trs.append(tr)
+        cls.append(cl)
+    for i in range(1, n):
+        cls[i].join_remote("127.0.0.1", trs[0].port)
+    return nodes, trs, cls
+
+
+def _teardown(trs, cls):
+    for cl in cls:
+        cl.close()
+    for tr in trs:
+        tr.close()
+
+
+def _wait(pred, timeout=20.0, msg="condition not met in time"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+def _partition(trs, side_a, side_b):
+    """Sever every link between the two index sets, both ways."""
+    for i in side_a:
+        trs[i].fault_peers = {f"hn{j}" for j in side_b}
+    for j in side_b:
+        trs[j].fault_peers = {f"hn{i}" for i in side_a}
+    faults.set_master(True)
+    faults.arm("net.partition", times=0)
+
+
+def _converged(clusters):
+    digests = [cl.plane_digests() for cl in clusters]
+    return all(d == digests[0] for d in digests[1:])
+
+
+# -- failure detector ------------------------------------------------------
+
+
+def test_wedged_peer_declared_down_then_autoheals():
+    """A wedged-but-connected peer (TCP up, frames swallowed, no
+    replies — peer.wedge) is declared down within the detector
+    window; un-wedging triggers the reappearance probe → auto-heal →
+    membership and routes re-merge with zero manual rejoin."""
+    cfg = _fast_cfg()
+    nodes, trs, cls = _mk_net(2, cfg, "wedge-heal")
+    try:
+        s = Sub("w1")
+        nodes[1].broker.subscribe(s, "wedge/+")
+        _wait(lambda: nodes[0].router.has_dest("wedge/+", "hn1"),
+              5, "route never replicated")
+        # wedge ONLY hn1's inbound loop: hn0 keeps answering, so the
+        # failure is asymmetric — exactly what EOF detection misses
+        trs[0].fault_local = False
+        faults.set_master(True)
+        t0 = time.time()
+        faults.arm("peer.wedge", times=0)
+        try:
+            # detector window: suspect_after(1) + down_after(3)
+            # misses at interval 0.1s / timeout 0.5s ≈ 2s nominal
+            _wait(lambda: cls[0].members == ["hn0"], 10,
+                  "wedged peer never declared down")
+            detect_s = time.time() - t0
+            assert detect_s < 8.0, f"detection took {detect_s:.1f}s"
+            assert trs[0].peer_state("hn1") == "down"
+            # nodedown purged the wedged peer's routes (the legacy
+            # contract, now reachable for wedged peers at all)
+            _wait(lambda: not nodes[0].router.has_dest(
+                "wedge/+", "hn1"), 5, "down peer's routes not purged")
+        finally:
+            faults.disarm("peer.wedge")
+        # reappearance probe → auto-heal: members re-merge and
+        # anti-entropy restores the purged routes, no manual rejoin
+        _wait(lambda: sorted(cls[0].members) == ["hn0", "hn1"]
+              and nodes[0].router.has_dest("wedge/+", "hn1"), 15,
+              "auto-heal never reconverged the wedged peer")
+        _wait(lambda: _converged(cls), 10,
+              "digests did not converge after heal")
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
+def test_transient_blip_suspect_parks_nothing_purged():
+    """A link blip shorter than the down window only demotes the
+    peer to suspect: membership and routes stay, casts park in the
+    buffer, and recovery flushes them — nothing is purged on
+    suspicion."""
+    cfg = _fast_cfg(down_after=1000)  # suspect is a stable state
+    nodes, trs, cls = _mk_net(2, cfg, "blip")
+    try:
+        s = Sub("b1")
+        nodes[1].broker.subscribe(s, "blip/pre")
+        _wait(lambda: nodes[0].router.has_dest("blip/pre", "hn1"), 5)
+        _partition(trs, [0], [1])
+        try:
+            _wait(lambda: trs[0].peer_state("hn1") == "suspect", 10,
+                  "blip never became suspect")
+            # suspect ≠ dead: NOTHING is purged
+            assert sorted(cls[0].members) == ["hn0", "hn1"]
+            assert nodes[0].router.has_dest("blip/pre", "hn1")
+            # a route added while suspect parks in the cast buffer
+            s0 = Sub("b0")
+            nodes[0].broker.subscribe(s0, "blip/during")
+            time.sleep(0.3)
+            assert not nodes[1].router.has_dest("blip/during", "hn0")
+        finally:
+            faults.disarm("net.partition")
+        _wait(lambda: trs[0].peer_state("hn1") == "ok", 10,
+              "suspect never recovered to ok")
+        # recovery unparks the buffered cast: the route lands late,
+        # not lost
+        _wait(lambda: nodes[1].router.has_dest("blip/during", "hn0"),
+              10, "parked cast never flushed after recovery")
+        assert sorted(cls[0].members) == ["hn0", "hn1"]
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
+def test_suspect_fast_fail_and_degraded_locker_quorum():
+    """No broker path blocks ``call_timeout`` on a suspect peer:
+    transport calls raise PeerUnavailableError without touching the
+    wire, and the CM locker's quorum proceeds degraded (majority of
+    the responsive membership) instead of stalling a CONNECT."""
+    cfg = _fast_cfg(down_after=1000)
+    nodes, trs, cls = _mk_net(2, cfg, "fastfail")
+    try:
+        _partition(trs, [0], [1])
+        try:
+            _wait(lambda: trs[0].peer_state("hn1") == "suspect", 10)
+            t0 = time.time()
+            with pytest.raises(PeerUnavailableError):
+                trs[0].call("hn1", "ping")
+            assert time.time() - t0 < 1.0, "fast-fail touched the wire"
+            # locker: 1 of 2 votes is no full majority, but the only
+            # non-voter is suspect — degraded grant, fast
+            t0 = time.time()
+            assert cls[0].locker.acquire("ff-client") is True
+            elapsed = time.time() - t0
+            assert elapsed < 2.0, \
+                f"CONNECT-path lock blocked {elapsed:.1f}s on suspect"
+            cls[0].locker.release_local("ff-client", "hn0")
+            drained = cls[0].drain_counters()
+            assert drained.get("locker.degraded", 0) >= 1
+            assert drained.get("rpc.fastfail", 0) >= 1
+            assert drained.get("hb.suspects", 0) >= 1
+        finally:
+            faults.disarm("net.partition")
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
+def test_bounded_call_on_wedged_peer():
+    """With the detector on, a call into a wedged peer is bounded by
+    the per-peer deadline even while the peer still counts as ok —
+    and the deadline cancels the coroutine, so the link lock is
+    released (a second call doesn't inherit a wedged lock)."""
+    cfg = _fast_cfg(heartbeat_interval_s=5.0, suspect_after=1000,
+                    down_after=2000, call_timeout_s=1.0)
+    nodes, trs, cls = _mk_net(2, cfg, "bounded")
+    try:
+        trs[0].fault_local = False
+        faults.set_master(True)
+        faults.arm("peer.wedge", times=0)
+        try:
+            for _ in range(2):  # second call pins the lock release
+                t0 = time.time()
+                with pytest.raises(ConnectionError):
+                    trs[0].call("hn1", "ping")
+                assert time.time() - t0 < 3.0
+        finally:
+            faults.disarm("peer.wedge")
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
+# -- anti-entropy ----------------------------------------------------------
+
+
+def test_net_drop_loss_repaired_by_anti_entropy():
+    """net.drop discards a claimed cast burst as if sent — the
+    at-most-once loss that silently diverged route tables forever
+    pre-heal. The loss is counted, and one anti-entropy sync repairs
+    it."""
+    cfg = _fast_cfg(heartbeat_interval_s=1.0,
+                    anti_entropy_interval_s=0)  # manual sync below
+    nodes, trs, cls = _mk_net(2, cfg, "drop")
+    try:
+        trs[1].fault_peers = set()  # only hn0's outbound drops
+        trs[0].fault_peers = {"hn1"}
+        faults.set_master(True)
+        faults.arm("net.drop", times=1)
+        s = Sub("d0")
+        nodes[0].broker.subscribe(s, "drop/lost")
+        time.sleep(0.5)
+        assert not nodes[1].router.has_dest("drop/lost", "hn0"), \
+            "cast was not dropped — arm raced a call drain"
+        drained = cls[0].drain_counters()
+        assert drained.get("forward.dropped", 0) == 1
+        repaired = cls[0].anti_entropy_sync("hn1")
+        assert repaired >= 1
+        assert nodes[1].router.has_dest("drop/lost", "hn0")
+        assert _converged(cls)
+        # a second sync on converged tables repairs nothing (one
+        # digest round-trip, no entry transfer)
+        assert cls[0].anti_entropy_sync("hn1") == 0
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
+def test_cast_buffer_full_drop_is_counted():
+    """The cast-buffer-full shed (previously a log line only) counts
+    into ``forward.dropped`` so at-most-once loss is observable."""
+    tr = SocketTransport("solo", cookie="full")
+    try:
+        tr.serve()
+        tr.register_peer("ghost", "127.0.0.1", 1)  # nothing listens
+        tr._CAST_BUF_MAX = 64
+        tr.cast("ghost", "forward", "f", "x" * 64)  # fills the buffer
+        tr.cast("ghost", "forward", "f", "y")       # shed + counted
+        assert tr.drain_counters().get("forward.dropped", 0) == 1
+    finally:
+        tr.close()
+
+
+# -- the heal matrix -------------------------------------------------------
+
+
+def _apply_phase1(nodes, cls, subs):
+    """Pre-partition state on all five planes."""
+    n0, n1, n2 = nodes
+    subs["a"] = Sub("pa")
+    n0.broker.subscribe(subs["a"], "heal/a/#")
+    subs["b"] = Sub("pb")
+    n1.broker.subscribe(subs["b"], "heal/b/+")
+    subs["c"] = Sub("pc")
+    n2.broker.subscribe(subs["c"], "heal/c")
+    subs["s"] = Sub("ps")
+    n2.broker.subscribe(subs["s"], "$share/g/heal/s")
+    cls[1].client_up("c-base-1")
+    cls[2].client_up("c-base-2")
+    n2.broker.banned.create("clientid", "bad-guy", by="op",
+                            reason="matrix")
+    n0.broker.publish(Message(topic="keep/x", payload=b"v1",
+                              flags={"retain": True}, timestamp=TS))
+
+
+def _apply_phase2(nodes, cls, subs):
+    """Route/registry/weight/ban/retained churn — run DURING the
+    partition on the chaos cluster, partition-free on the oracle."""
+    n0, n1, n2 = nodes
+    # majority side mutates...
+    subs["d"] = Sub("pd")
+    n0.broker.subscribe(subs["d"], "heal/d/#")
+    n1.broker.unsubscribe(subs["b"], "heal/b/+")  # stale-delete repair
+    cls[0].client_up("c-major")
+    n0.broker.banned.create("username", "evil", by="op", reason="p2")
+    n0.broker.publish(Message(topic="keep/y", payload=b"v2",
+                              flags={"retain": True},
+                              timestamp=TS + 1))
+    n0.broker.publish(Message(topic="keep/x", payload=b"",
+                              flags={"retain": True},
+                              timestamp=TS + 2))  # delete + tombstone
+    # ...and so does the isolated minority side
+    subs["e"] = Sub("pe")
+    n2.broker.subscribe(subs["e"], "heal/e/+")
+    subs["t"] = Sub("pt")
+    n2.broker.subscribe(subs["t"], "$share/g2/heal/t")
+    cls[2].client_up("c-minor")
+
+
+def test_partition_heal_converges_all_planes_vs_oracle():
+    """The headline chaos scenario: a 3-node cluster partitions
+    {hn0,hn1} | {hn2} during churn on BOTH sides, heals, and every
+    replicated plane (routes, registry, shared weights, bans,
+    retained + tombstones) reconverges byte-exactly to what a
+    never-partitioned oracle cluster computes for the same operation
+    sequence — with zero manual rejoin."""
+    cfg = _fast_cfg()
+    nodes, trs, cls = _mk_net(3, cfg, "matrix", retainer=True)
+    onodes, otrs, ocls = _mk_net(3, cfg, "oracle", retainer=True,
+                                 immune=True)
+    subs, osubs = {}, {}
+    try:
+        _apply_phase1(nodes, cls, subs)
+        _apply_phase1(onodes, ocls, osubs)
+        _wait(lambda: _converged(cls) and _converged(ocls), 20,
+              "pre-partition state never converged")
+
+        _partition(trs, [0, 1], [2])
+        try:
+            # both sides must actually observe the split
+            _wait(lambda: cls[0].members == ["hn0", "hn1"]
+                  and cls[2].members == ["hn2"], 15,
+                  "partition never detected")
+            _apply_phase2(nodes, cls, subs)
+            _apply_phase2(onodes, ocls, osubs)
+            time.sleep(0.5)  # let the split sides settle mid-churn
+            # divergence is real: the isolated side is missing the
+            # majority's churn and vice versa
+            assert cls[0].plane_digests() != cls[2].plane_digests()
+        finally:
+            faults.disarm("net.partition")
+
+        # zero manual rejoin: reappearance probes → auto-heal →
+        # anti-entropy, background sweep mops up residual drift
+        _wait(lambda: all(sorted(c.members) == ["hn0", "hn1", "hn2"]
+                          for c in cls), 30,
+              "membership never re-merged after heal")
+        _wait(lambda: _converged(cls), 30,
+              "plane digests never converged after heal")
+        _wait(lambda: _converged(ocls), 20,
+              "oracle cluster never converged")
+        healed = cls[0].plane_digests()
+        oracle = ocls[0].plane_digests()
+        assert healed == oracle, (
+            f"healed cluster != never-partitioned oracle:\n"
+            f"  healed: {healed}\n  oracle: {oracle}")
+        # spot-check semantics behind the digests: the tombstoned
+        # topic is gone everywhere, the minority's routes are back
+        for n in nodes:
+            ret = n.modules._loaded["retainer"]
+            assert "keep/x" not in ret._store
+            assert "keep/y" in ret._store
+            assert n.router.has_dest("heal/e/+", "hn2")
+            assert not n.router.has_dest("heal/b/+", "hn1")
+            assert n.broker.banned.look_up("username", "evil")
+        # heal left its audit trail
+        total = {}
+        for c in cls:
+            for k, v in c.drain_counters().items():
+                total[k] = total.get(k, 0) + v
+        assert total.get("heal.rejoins", 0) >= 1
+        assert total.get("hb.downs", 0) >= 1
+        assert total.get("hb.reappears", 0) >= 1
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+        _teardown(otrs, ocls)
+
+
+# -- legacy parity ---------------------------------------------------------
+
+
+def test_detector_off_is_legacy_build():
+    """``detector = false`` (and no config at all) reproduce the
+    EOF-only failure story: no heartbeat task, no heal worker, no
+    suspect state, no fast-fail — and a wedged-but-connected peer is
+    never declared down (the gap the detector exists to close)."""
+    cfg = ClusterConfig(detector=False)
+    nodes, trs, cls = _mk_net(2, cfg, "legacy")
+    try:
+        assert trs[0]._hb_enabled is False
+        assert cls[0]._heal_thread is None
+        assert trs[0].peer_state("hn1") == "ok"
+        assert trs[0].health_info() == {}
+        trs[0].fault_local = False
+        faults.set_master(True)
+        faults.arm("peer.wedge", times=0)
+        try:
+            time.sleep(1.5)
+            # TCP is up, frames vanish — the legacy link monitor
+            # sees nothing and membership never changes
+            assert sorted(cls[0].members) == ["hn0", "hn1"]
+        finally:
+            faults.disarm("peer.wedge")
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
+def test_no_config_transport_has_no_detector():
+    tr = SocketTransport("lone", cookie="none")
+    try:
+        tr.serve()
+        assert tr._hb_enabled is False
+        assert tr.peer_state("whoever") == "ok"
+    finally:
+        tr.close()
+
+
+# -- config + observability surfaces ---------------------------------------
+
+
+def test_cluster_config_section_parses_and_validates():
+    from emqx_tpu.config import ConfigError, parse_config
+
+    cfg = parse_config({"cluster": {"detector": True,
+                                    "heartbeat_interval_s": 0.5,
+                                    "down_after": 7}})
+    assert cfg.cluster.heartbeat_interval_s == 0.5
+    assert cfg.cluster.down_after == 7
+    with pytest.raises(ConfigError):
+        parse_config({"cluster": {"heartbeat_intervall_s": 1.0}})
+    with pytest.raises(ConfigError):
+        parse_config({"cluster": {"suspect_after": 5, "down_after": 2}})
+    with pytest.raises(ConfigError):
+        parse_config({"cluster": {"detector": "yes"}})
+
+
+def test_ctl_and_stats_surface_cluster_health():
+    cfg = _fast_cfg(anti_entropy_interval_s=0)
+    nodes, trs, cls = _mk_net(2, cfg, "obs")
+    try:
+        nodes[0].cluster = cls[0]
+        _wait(lambda: trs[0].health_info().get("hn1", {})
+              .get("rtt_ms") is not None, 10,
+              "no heartbeat RTT recorded")
+        import json
+
+        out = json.loads(nodes[0].ctl.run(["cluster", "status"]))
+        assert out["health"]["hn1"]["state"] == "ok"
+        assert out["health"]["hn1"]["rtt_ms"] > 0
+        assert "anti_entropy" in out
+        # the stats tick publishes the gauges + folds the counters
+        nodes[0].stats.tick()
+        assert nodes[0].stats.getstat("cluster.members.count") == 2
+        assert nodes[0].stats.getstat("cluster.member.state") == 0
+        assert nodes[0].stats.getstat("cluster.hb.rtt_ms") > 0
+        assert nodes[0].stats.getstat("cluster.member.hn1.state") == 0
+        assert nodes[0].metrics.val("cluster.hb.suspects") == 0
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
+def test_forward_drop_alarm_via_stats_tick():
+    """cluster.forward.dropped raises the cluster_forward_dropped
+    alarm on the tick that observes new drops and clears it on the
+    first quiet tick."""
+    cfg = _fast_cfg(anti_entropy_interval_s=0)
+    nodes, trs, cls = _mk_net(2, cfg, "alarm")
+    try:
+        nodes[0].cluster = cls[0]
+        trs[0]._count("forward.dropped", 3)
+        nodes[0].stats.tick()
+        active = {a.name for a in nodes[0].alarms.get_alarms("activated")}
+        assert "cluster_forward_dropped" in active
+        assert nodes[0].metrics.val("cluster.forward.dropped") == 3
+        nodes[0].stats.tick()  # quiet tick clears
+        active = {a.name for a in nodes[0].alarms.get_alarms("activated")}
+        assert "cluster_forward_dropped" not in active
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
