@@ -1880,6 +1880,8 @@ def recovery():
     B = int(os.environ.get("BENCH_BATCH", "256"))
     pub_iters = int(os.environ.get("RECOVERY_PUB_ITERS", "20"))
     use_fsync = os.environ.get("RECOVERY_FSYNC", "1") == "1"
+    wal_shards = int(os.environ.get("RECOVERY_SHARDS", "4"))
+    ckpt_churn = int(os.environ.get("RECOVERY_CKPT_CHURN", "64"))
     n_sessions = min(int(os.environ.get("RECOVERY_SESSIONS", "1000")),
                      n_routes)
     rng = random.Random(0)
@@ -1895,7 +1897,8 @@ def recovery():
                     s.puback(pid)
 
     async def _build(durable, d):
-        cfg = (DurabilityConfig(enabled=True, dir=d, fsync=use_fsync)
+        cfg = (DurabilityConfig(enabled=True, dir=d, fsync=use_fsync,
+                                wal_shards=wal_shards)
                if durable else None)
         node = Node(boot_listeners=False, durability=cfg,
                     load_default_modules=True)
@@ -1952,6 +1955,7 @@ def recovery():
         out["journal_records"] = wi["records"]
         out["journal_mb"] = round(wi["bytes"] / 1e6, 2)
         out["last_fsync_ms"] = wi["last_fsync_ms"]
+        out["group_commits"] = wi["group_commits"]
         # crash the durable node: abandon without graceful shutdown
         # — the recovery below replays the whole journal
         node_on.broker.durability = None
@@ -1974,6 +1978,23 @@ def recovery():
         out["recovered_sessions"] = rec["sessions"]
         out["replayed_records"] = rec["replayed_records"]
         out["recovered_routes"] = rec["routes"]
+        # incremental-checkpoint cost A/B on the recovered node (it
+        # holds the full-scale table): a FULL rebase pays the whole
+        # table; a DELTA after a small churn burst must cost ~the
+        # churn — the acceptance gate is that delta time tracks
+        # churn, not route count (docs/DURABILITY.md)
+        t_f0 = time.perf_counter()
+        node2.durability.checkpoint_now(full=True)
+        out["ckpt_full_s"] = round(time.perf_counter() - t_f0, 4)
+        det = [ent[0] for ent in node2.cm._detached.values()]
+        for i in range(ckpt_churn if det else 0):
+            det[i % len(det)].subscribe(
+                f"ckpt/churn/{i}", SubOpts(qos=1))
+        node2.durability.on_batch()
+        t_d0 = time.perf_counter()
+        ck = node2.durability.checkpoint_now(full=False)
+        out["ckpt_delta_s"] = round(time.perf_counter() - t_d0, 4)
+        out["ckpt_delta_records"] = ck.get("records")
         await node2.stop()
         for d in dirs:
             shutil.rmtree(d, ignore_errors=True)
@@ -1983,11 +2004,12 @@ def recovery():
     on, off = r["msgs_per_s_on"], r["msgs_per_s_off"]
     info = {"mode": "recovery", "routes": n_routes,
             "sessions": n_sessions, "fsync": use_fsync,
+            "wal_shards": wal_shards,
             "device": str(jax.devices()[0])}
     print(json.dumps(info), file=sys.stderr, flush=True)
     _emit({
         "metric": "recovery_replay_s",
-        "workload": "durability_v1",
+        "workload": "durability_sharded_v1",
         "value": r["recovery_replay_s"],
         "unit": "s",
         "recovery_routes": r["recovered_routes"],
@@ -2005,7 +2027,132 @@ def recovery():
         "journal_mb": r["journal_mb"],
         "last_fsync_ms": r["last_fsync_ms"],
         "fsync": use_fsync,
+        "wal_shards": wal_shards,
+        "group_commits": r["group_commits"],
+        "ckpt_full_s": r["ckpt_full_s"],
+        "ckpt_delta_s": r["ckpt_delta_s"],
+        "ckpt_delta_records": r["ckpt_delta_records"],
+        "ckpt_churn": ckpt_churn,
+        "ckpt_speedup": round(
+            r["ckpt_full_s"] / max(r["ckpt_delta_s"], 1e-9), 2),
     })
+
+
+def _failover_probe():
+    """The BENCH_MODE=partition failover row (docs/DURABILITY.md
+    "Replicated durability"): a durable primary journals sessions +
+    retained + routes and ships the stream to a warm standby; the
+    primary is killed (kill -9 analogue: durability hooks severed,
+    transport dropped) and the standby's heartbeat detector drives
+    promotion. Measures failover time (kill → promoted), RPO in
+    records for acked traffic (must be 0), and digest-verifies the
+    promoted durable planes against the primary's pre-kill state."""
+    import shutil
+    import tempfile
+
+    from emqx_tpu.cluster import Cluster, ClusterConfig
+    from emqx_tpu.cluster_net import SocketTransport
+    from emqx_tpu.durability import DurabilityConfig
+    from emqx_tpu.modules.retainer import RetainerModule
+    from emqx_tpu.node import Node
+    from emqx_tpu.replication import durable_digest
+    from emqx_tpu.session import Session
+    from emqx_tpu.types import Message, SubOpts
+
+    n_sess = int(os.environ.get("FAILOVER_SESSIONS", "50"))
+    n_ret = int(os.environ.get("FAILOVER_RETAINED", "100"))
+    cfg = ClusterConfig(
+        heartbeat_interval_s=0.1, heartbeat_timeout_s=0.5,
+        suspect_after=1, down_after=3, ok_after=1,
+        anti_entropy_interval_s=30.0, call_timeout_s=2.0,
+        redial_backoff_s=0.1, redial_backoff_max_s=0.5)
+
+    def _wait(pred, timeout, what):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if pred():
+                return
+            time.sleep(0.02)
+        raise RuntimeError(f"failover probe: {what} not reached "
+                           f"within {timeout}s")
+
+    class _Ch:
+        def __init__(self, s):
+            self.session = s
+            self.client_id = s.client_id
+
+    tmp = tempfile.mkdtemp(prefix="emqx_failover_")
+    nodes, trs, cls = [], [], []
+    try:
+        for i in range(2):
+            dcfg = None
+            if i == 0:
+                dcfg = DurabilityConfig(
+                    enabled=True, dir=os.path.join(tmp, "d0"),
+                    fsync=False, standby="fb1", wal_shards=4)
+            node = Node(name=f"fb{i}", boot_listeners=False,
+                        durability=dcfg)
+            node.modules.load(RetainerModule)
+            if node.durability is not None:
+                node.durability.recover()
+            tr = SocketTransport(f"fb{i}", cookie="bench-failover",
+                                 config=cfg)
+            tr.serve()
+            cls.append(Cluster(node, transport=tr, config=cfg))
+            nodes.append(node)
+            trs.append(tr)
+        cls[1].join_remote("127.0.0.1", trs[0].port)
+        n0 = nodes[0]
+        sessions = []
+        for i in range(n_sess):
+            s = Session(f"fdev-{i}", broker=n0.broker,
+                        clean_start=False)
+            n0.durability.session_opened(s, 3600.0)
+            n0.cm.register_channel(s.client_id, _Ch(s))
+            s.subscribe(f"fb/{i}/+", SubOpts(qos=1))
+            sessions.append(s)
+        for i in range(n_ret):
+            n0.broker.publish(Message(
+                topic=f"fb/{i % max(n_sess, 1)}/state",
+                payload=b"v%d" % i, qos=1, flags={"retain": True}))
+        n0.durability.on_batch()  # flush + ship: this is the acked set
+        r = n0.replication
+        _wait(lambda: r.state == "replicating"
+              and r.acked_seq >= r.offered_seq, 60, "journal sync")
+        acked = r.acked_seq
+        for s in sessions:  # digest compares the sessions detached
+            n0.cm._detached[s.client_id] = (s, 0, 3600.0)
+        want = durable_digest(n0)
+        # kill -9: no graceful path, no final ship
+        n0.broker.durability = None
+        n0.cm.durability = None
+        t_kill = time.perf_counter()
+        trs[0].close()
+        rep1 = nodes[1].replication
+        _wait(lambda: "fb0" in rep1.replicas
+              and rep1.replicas["fb0"].promoted, 60, "promotion")
+        failover_s = time.perf_counter() - t_kill
+        got = durable_digest(nodes[1])
+        lp = rep1.last_promotion
+        return {
+            "failover_s": round(failover_s, 3),
+            "failover_promote_s": lp["failover_s"],
+            "failover_sessions": lp["sessions"],
+            "failover_routes": lp["routes"],
+            "rpo_records": max(
+                0, acked - rep1.replicas["fb0"].applied_seq),
+            "failover_digest_ok": bool(got == want),
+        }
+    finally:
+        for node in nodes:
+            d = node.durability
+            if d is not None and d.wal is not None:
+                d.wal.close()
+        for c in cls:
+            c.close()
+        for tr in trs:
+            tr.close()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def partition():
@@ -2016,6 +2163,10 @@ def partition():
     five replicated plane digests byte-equal across members, zero
     manual rejoin), and data-plane forwards dropped during a timed
     partition window with route churn on BOTH sides of the split.
+    Plus (ISSUE 11) the warm-standby FAILOVER row: primary kill →
+    standby promotion time, RPO records for acked traffic (0), and a
+    digest-verified byte-exactness check — ``PARTITION_FAILOVER=0``
+    skips it.
 
     3 nodes in one process over real sockets, the partition injected
     through the net.partition fault point scoped per transport —
@@ -2120,13 +2271,18 @@ def partition():
         for tr in trs:
             tr.close()
 
+    failover = {"failover_s": None, "rpo_records": None,
+                "failover_digest_ok": None}
+    if os.environ.get("PARTITION_FAILOVER", "1") == "1":
+        failover = _failover_probe()
+
     info = {"mode": "partition", "routes": n_routes,
             "window_s": window_s, "churn_ops": churn,
             "device": str(jax.devices()[0])}
     print(json.dumps(info), file=sys.stderr, flush=True)
-    _emit({
+    _emit(dict({
         "metric": "partition_heal_converge_s",
-        "workload": "cluster_heal_v1",
+        "workload": "cluster_failover_v1",
         "value": round(heal_s, 3),
         "unit": "s",
         "partition_detect_s": round(detect_s, 3),
@@ -2137,7 +2293,7 @@ def partition():
         "ae_repairs": counters.get("ae.repairs", 0),
         "hb_downs": counters.get("hb.downs", 0),
         "routes": n_routes,
-    })
+    }, **failover))
 
 
 # The BASELINE.json config matrix (VERDICT r3 item 3): one row per

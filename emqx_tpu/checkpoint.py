@@ -28,8 +28,12 @@ import numpy as np
 
 FORMAT = 2  # v2: compressed walk tables (wt/node2), no CSR arrays
 
-#: durability checkpoint manifest format (docs/DURABILITY.md)
-MANIFEST_FORMAT = 1
+#: durability checkpoint manifest format (docs/DURABILITY.md). v2
+#: adds the incremental-checkpoint fields (``base_generation``,
+#: ``deltas``, ``wal_shards``); v1 manifests (full-snapshot only)
+#: are still read — ``deltas`` just defaults empty
+MANIFEST_FORMAT = 2
+MANIFEST_FORMATS = (1, 2)
 MANIFEST = "MANIFEST"
 
 
@@ -320,7 +324,7 @@ def read_manifest(dirpath: str) -> Optional[dict]:
     except Exception as e:
         raise CheckpointError(f"corrupt manifest {path!r}: {e}") from e
     if not isinstance(m, dict) \
-            or m.get("format") != MANIFEST_FORMAT:
+            or m.get("format") not in MANIFEST_FORMATS:
         raise CheckpointError(
             f"unknown manifest format in {path!r}: "
             f"{m.get('format') if isinstance(m, dict) else m!r}")

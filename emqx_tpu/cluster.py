@@ -232,6 +232,16 @@ class Cluster:
         node.cm.cluster = self
         if hasattr(node, "cluster"):
             node.cluster = self  # node-level accessor (ctl, config)
+        # replicated durability (replication.py, docs/DURABILITY.md):
+        # every clustered node can hold warm standby replicas for its
+        # peers; a node whose [durability] standby names a peer also
+        # arms the journal shipper
+        from emqx_tpu.replication import ReplicationManager
+        self.replication = ReplicationManager(node, self)
+        node.replication = self.replication
+        dur = getattr(node, "durability", None)
+        if dur is not None and dur.cfg.standby:
+            self.replication.arm_shipper(dur)
         # intercept local route mutations for replication
         self._orig_add = node.router.add_route
         self._orig_del = node.router.delete_route
@@ -440,6 +450,15 @@ class Cluster:
         # monitored-lock cleanup) — waiters unblock immediately
         self.locker.drop_owner(name)
         self._purge_node_routes(name)
+        # warm-standby failover (replication.py): AFTER the purge —
+        # the promotion re-installs the dead primary's durable state
+        # remapped to this node with exact refcounts
+        if self.replication is not None:
+            try:
+                self.replication.maybe_promote(name)
+            except Exception:
+                log.exception("standby promotion check for %s failed",
+                              name)
 
     # -- clientid registry + cross-node takeover (emqx_cm_registry) -------
 
@@ -718,8 +737,11 @@ class Cluster:
         self._heal_q.put(name)
 
     def close(self) -> None:
-        """Stop the heal/anti-entropy worker (Node.stop)."""
+        """Stop the heal/anti-entropy worker and the journal shipper
+        (Node.stop)."""
         self._stopping = True
+        if self.replication is not None:
+            self.replication.close()
         if self._heal_thread is not None:
             self._heal_q.put(None)
             self._heal_thread.join(timeout=5)
@@ -1158,4 +1180,14 @@ class Cluster:
                 return []
             return [(t, ret._store.get(t)) for t in args[0]
                     if t in ret._store]
+        if op == "repl_hello":
+            # replicated durability (replication.py): arm/resync the
+            # warm standby replica for the calling primary
+            return self.replication.handle_hello(args[0], args[1],
+                                                 int(args[2]))
+        if op == "repl_ship":
+            return self.replication.handle_ship(args[0], int(args[1]),
+                                                args[2])
+        if op == "repl_bye":
+            return self.replication.handle_bye(args[0], bool(args[1]))
         raise ValueError(f"bad rpc op: {op}")

@@ -119,15 +119,28 @@ class Ctl:
 
     def _durability(self, args) -> str:
         """One-stop durability diagnosis (docs/DURABILITY.md):
-        generation, journal bytes/records/degraded state, last fsync
-        latency, checkpoint age, and the last recovery summary."""
+        generation, journal shards/bytes/records/degraded state, last
+        fsync latency, checkpoint chain + age, the last recovery
+        summary, and the replication block (role, standby peer,
+        shipped/acked offsets, lag, last ack age; warm replicas this
+        node holds for its peers)."""
         dur = self.node.durability
+        repl = getattr(self.node, "replication", None)
         if dur is None:
+            if repl is not None and repl.replicas:
+                # a pure standby: durability off locally, but warm
+                # replicas for peers are operator-relevant state
+                return json.dumps({"enabled": False,
+                                   "replication": repl.info()},
+                                  indent=2, default=str)
             return ("durability not enabled "
                     "([durability] enabled = true in the config)")
         if args and args[0] == "checkpoint":
             return json.dumps(dur.checkpoint_now(), indent=2)
-        return json.dumps(dur.info(), indent=2, default=str)
+        out = dur.info()
+        if repl is not None and "replication" not in out:
+            out["replication"] = repl.info()
+        return json.dumps(out, indent=2, default=str)
 
     def _faults(self, args) -> str:
         from emqx_tpu import faults

@@ -59,11 +59,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from emqx_tpu import checkpoint
 from emqx_tpu import topic as T
-from emqx_tpu.wal import Wal, replay as wal_replay
+from emqx_tpu.wal import WalGroup, replay as wal_replay
 
 log = logging.getLogger("emqx_tpu.durability")
 
 _JOURNAL_RE = re.compile(r"^journal-(\d+)\.wal$")
+#: sharded segment: journal-<shard>-<seq>.wal (docs/DURABILITY.md)
+_JOURNAL_SHARD_RE = re.compile(r"^journal-(\d+)-(\d+)\.wal$")
+_DELTA_RE = re.compile(r"^delta-(\d+)\.bin$")
 
 
 @dataclasses.dataclass
@@ -91,6 +94,35 @@ class DurabilityConfig:
     retry_backoff_max_s: float = 30.0
     #: bounded in-memory record buffer while degraded/unarmed
     max_buffer_records: int = 100_000
+    #: journal shards (docs/DURABILITY.md "Sharded WAL"): 0 = auto
+    #: (one shard per front-door loop), 1 = the single-journal legacy
+    #: layout byte-for-byte, N > 1 = explicit shard count. Records
+    #: route by key (filter / topic / client-id) so every key's
+    #: stream lives in one shard in true order
+    wal_shards: int = 0
+    #: group-commit coalescing window: a flush leader sleeps this
+    #: long so concurrent loops' flushes ride one fsync pass (0 =
+    #: no added latency; leader-based coalescing still applies)
+    group_commit_window_ms: float = 0.0
+    #: full-checkpoint rebase cadence: at most this many generations
+    #: between FULL snapshots; the generations in between write
+    #: differential deltas whose cost tracks churn, not table size.
+    #: 1 = every checkpoint full (the pre-incremental cost shape)
+    checkpoint_full_every: int = 8
+    #: journal-shipping warm standby (docs/DURABILITY.md
+    #: "Replicated durability"): peer NODE NAME to stream the journal
+    #: to over the cluster transport; "" = no replication
+    standby: str = ""
+    #: bounded wait for the standby's ack (shutdown tail hand-off,
+    #: per-ship call deadline)
+    repl_ack_timeout_s: float = 5.0
+    #: replication_lagging alarm thresholds (records), with
+    #: hysteresis: raise above the first, clear at/below the second
+    repl_lag_alarm_records: int = 100_000
+    repl_lag_clear_records: int = 10_000
+    #: bounded outbound ship queue; exceeding it drops to local-only
+    #: and schedules a full resync on the next standby contact
+    repl_queue_max_records: int = 500_000
 
     def __post_init__(self) -> None:
         if self.flush_interval_ms <= 0:
@@ -101,6 +133,39 @@ class DurabilityConfig:
         if self.checkpoint_min_records <= 0:
             raise ValueError(
                 "durability.checkpoint_min_records must be > 0")
+        if self.wal_shards < 0:
+            raise ValueError(
+                "durability.wal_shards must be >= 0 (0 = per loop)")
+        if self.group_commit_window_ms < 0:
+            raise ValueError(
+                "durability.group_commit_window_ms must be >= 0")
+        if self.checkpoint_full_every < 1:
+            raise ValueError(
+                "durability.checkpoint_full_every must be >= 1")
+        if self.repl_ack_timeout_s <= 0:
+            raise ValueError(
+                "durability.repl_ack_timeout_s must be > 0")
+        if self.repl_lag_clear_records > self.repl_lag_alarm_records:
+            raise ValueError(
+                "durability.repl_lag_clear_records must be <= "
+                "repl_lag_alarm_records")
+        if self.repl_queue_max_records <= 0:
+            raise ValueError(
+                "durability.repl_queue_max_records must be > 0")
+
+
+def journal_key(op: tuple) -> str:
+    """The sharding key of a journal record (docs/DURABILITY.md
+    "Merge rule"): routes key by (filter, dest), retained by topic,
+    session records by client-id — every key's records land in ONE
+    shard in true order, which is what makes any per-shard-ordered
+    replay merge converge."""
+    kind = op[0]
+    if kind == "route":
+        return f"r|{op[1]}|{op[2]!r}"
+    if kind == "retain":
+        return f"t|{op[1]}"
+    return f"s|{op[1]}"
 
 
 class DurabilityManager:
@@ -108,22 +173,48 @@ class DurabilityManager:
         self.node = node
         self.cfg = cfg
         os.makedirs(cfg.dir, exist_ok=True)
-        self.wal: Optional[Wal] = None
+        self.wal: Optional[WalGroup] = None
+        #: resolved shard count: 0 = auto (one per front-door loop)
+        lg = getattr(node, "loop_group", None)
+        self.shards = cfg.wal_shards or (lg.n if lg is not None else 1)
         #: committed checkpoint generation (0 = none yet)
         self.gen = 0
         #: journal sequence the CURRENT segment writes under
         self._seq = 0
         #: records buffered before recover() arms the on-disk journal
         self._pending_ops: List[tuple] = []
+        #: pre-arm buffer records shed by the drop-oldest bound —
+        #: folded into ``wal.degraded.dropped`` (they used to vanish)
+        self._pending_dropped = 0
         self._dirty: set = set()
         #: cid -> detach wall time for detached durable sessions
         self._detach_ts: Dict[str, float] = {}
         self._replaying = False
         self._ckpt_lock = threading.Lock()
+        # incremental-checkpoint dirty-key tracking (docs/DURABILITY
+        # "Incremental checkpoints"): keys touched since the last
+        # checkpoint. _mark_lock orders (dirty-add + journal append)
+        # against (set swap + journal rotate) so every record in a
+        # truncated journal is provably covered by the delta blob
+        self._mark_lock = threading.Lock()
+        self._delta_routes: set = set()      # (flt, dest)
+        self._delta_retained: set = set()    # topic
+        self._delta_sessions: set = set()    # cid
+        #: generation of the last FULL snapshot + the delta chain
+        #: (generation numbers) committed on top of it
+        self._full_gen = 0
+        self._delta_chain: List[int] = []
+        #: filename -> crc32 for the live base + delta chain (carried
+        #: forward so a delta commit never re-reads the base)
+        self._crc_map: Dict[str, int] = {}
+        #: journal shipper (replication.py), armed by the cluster
+        #: layer when [durability] standby names a peer
+        self.repl = None
         self.last_checkpoint_ts: Optional[float] = None
         self.last_recovery: Optional[dict] = None
         self.counters: Dict[str, int] = {
             "checkpoint.saves": 0, "checkpoint.errors": 0,
+            "checkpoint.delta.saves": 0,
             "recovery.replayed": 0, "recovery.torn": 0,
             "recovery.sessions": 0, "recovery.routes.pruned": 0,
         }
@@ -135,20 +226,38 @@ class DurabilityManager:
 
     # -- paths ------------------------------------------------------------
 
-    def _journal_path(self, seq: int) -> str:
-        return os.path.join(self.cfg.dir, f"journal-{seq}.wal")
-
     def _scan_journals(self) -> List[int]:
-        seqs = []
+        """Distinct journal sequences present on disk (legacy
+        single-journal AND sharded segment names)."""
+        return sorted(self._scan_journal_files())
+
+    def _scan_journal_files(self) -> Dict[int, List[str]]:
+        """seq -> ordered segment file names for that sequence
+        (legacy file first, then shards ascending — replay order
+        within a sequence; per-key shard affinity makes any fixed
+        order correct, see docs/DURABILITY.md "Merge rule")."""
+        out: Dict[int, List[str]] = {}
         try:
             names = os.listdir(self.cfg.dir)
         except OSError:
-            return []
+            return {}
+        legacy: Dict[int, str] = {}
+        sharded: Dict[int, List[Tuple[int, str]]] = {}
         for name in names:
             m = _JOURNAL_RE.match(name)
             if m:
-                seqs.append(int(m.group(1)))
-        return sorted(seqs)
+                legacy[int(m.group(1))] = name
+                continue
+            m = _JOURNAL_SHARD_RE.match(name)
+            if m:
+                sharded.setdefault(int(m.group(2)), []).append(
+                    (int(m.group(1)), name))
+        for seq, name in legacy.items():
+            out.setdefault(seq, []).append(name)
+        for seq, pairs in sharded.items():
+            out.setdefault(seq, []).extend(
+                n for _s, n in sorted(pairs))
+        return out
 
     def _retainer(self):
         return self.node.modules._loaded.get("retainer")
@@ -158,14 +267,36 @@ class DurabilityManager:
     def _append(self, op: tuple) -> None:
         if self._replaying:
             return
-        w = self.wal
-        if w is not None:
-            w.append(op)
-            return
-        # pre-recovery / library-mode buffering (bounded)
-        self._pending_ops.append(op)
-        if len(self._pending_ops) > self.cfg.max_buffer_records:
-            del self._pending_ops[0]
+        # dirty-mark BEFORE the journal append, both under _mark_lock:
+        # checkpoint_now swaps the dirty sets and rotates the journal
+        # under the same lock, so a record can never land in a
+        # to-be-truncated segment while its dirty mark lands in the
+        # post-swap set (which would lose it from the delta blob)
+        with self._mark_lock:
+            self._note_delta(op)
+            w = self.wal
+            if w is not None:
+                w.append(op, journal_key(op))
+            else:
+                # pre-recovery / library-mode buffering (bounded)
+                self._pending_ops.append(op)
+                if len(self._pending_ops) > self.cfg.max_buffer_records:
+                    del self._pending_ops[0]
+                    self._pending_dropped += 1
+        r = self.repl
+        if r is not None:
+            r.offer(op)
+
+    def _note_delta(self, op: tuple) -> None:
+        """Track the key this record touches for the next incremental
+        checkpoint (set.add — cheap enough for the journal path)."""
+        kind = op[0]
+        if kind == "route":
+            self._delta_routes.add((op[1], op[2]))
+        elif kind == "retain":
+            self._delta_retained.add(op[1])
+        else:  # sess.* — keyed by client-id
+            self._delta_sessions.add(op[1])
 
     def journal_subscribe(self, sub, topic_filter: str, flt: str,
                           dest, opts, resub: bool) -> None:
@@ -265,7 +396,9 @@ class DurabilityManager:
     def on_batch(self) -> None:
         """The per-publish-batch hook (Broker.publish_fetch, executor
         thread) and the timer body: coalesce dirty session states,
-        then one batched write+fsync."""
+        then one batched group commit (concurrent loops' flushes
+        coalesce through the WalGroup leader), then wake the journal
+        shipper — only locally-durable records ship."""
         w = self.wal
         if w is None:
             return
@@ -273,6 +406,9 @@ class DurabilityManager:
             self._flush_states()
         if w.pending():
             w.flush()
+        r = self.repl
+        if r is not None:
+            r.notify_flush()
 
     flush = on_batch
 
@@ -319,46 +455,101 @@ class DurabilityManager:
                 "sessions": sessions, "retained": retained,
                 "tombstones": tombstones}
 
-    def checkpoint_now(self, clean_shutdown: bool = False) -> dict:
-        """One atomic generation: rotate the journal, snapshot all
-        three planes, commit via manifest rename, then truncate the
-        superseded journals/segments. Safe from any thread; failures
-        leave the previous generation authoritative."""
+    def checkpoint_now(self, clean_shutdown: bool = False,
+                       full: Optional[bool] = None) -> dict:
+        """One atomic generation: rotate the journal (swapping the
+        incremental dirty sets under the mark lock), snapshot, commit
+        via manifest rename, then truncate the superseded journals/
+        segments. ``full=None`` picks: a FULL rebase when the delta
+        chain reached ``checkpoint_full_every``, on the first
+        checkpoint, or at clean shutdown; otherwise an INCREMENTAL
+        generation — a ``delta-<gen>.bin`` blob of journal-style
+        records covering only the keys touched since the last
+        generation, so the cost tracks churn, not table size. Safe
+        from any thread; failures leave the previous generation
+        authoritative (and merge the swapped dirty sets back)."""
         with self._ckpt_lock:
             t0 = time.time()
             gen = self.gen + 1
             seq = self._seq + 1
             d = self.cfg.dir
+            if full is None:
+                full = (clean_shutdown or self._full_gen == 0
+                        or len(self._delta_chain)
+                        >= self.cfg.checkpoint_full_every - 1)
+            droutes = dret = dsess = None
             try:
                 if self.wal is not None:
-                    # rotate FIRST: records racing the snapshot land
-                    # in the new journal AND the snapshot — replay-
-                    # on-top is idempotent, loss is impossible
                     self._flush_states()
-                    self.wal.rotate(self._journal_path(seq))
+                # swap the dirty sets + rotate under ONE lock: every
+                # record in the segments this generation will truncate
+                # has its dirty mark in the swapped sets (see _append)
+                with self._mark_lock:
+                    droutes, self._delta_routes = \
+                        self._delta_routes, set()
+                    dret, self._delta_retained = \
+                        self._delta_retained, set()
+                    dsess, self._delta_sessions = \
+                        self._delta_sessions, set()
+                    if self.wal is not None:
+                        self.wal.rotate_to(seq)
                 self._seq = seq
-                router_file = f"router-{gen}.npz"
-                state_file = f"state-{gen}.bin"
-                rtmp = os.path.join(d, f"router-{gen}.tmp.npz")
-                stmp = os.path.join(d, f"state-{gen}.tmp.bin")
-                info = checkpoint.save(self.node.router, rtmp)
-                _fsync_file(rtmp)
-                os.replace(rtmp, os.path.join(d, router_file))
-                state = self._snapshot_state()
-                checkpoint.save_state(stmp, state)
-                os.replace(stmp, os.path.join(d, state_file))
-                manifest = {
-                    "format": checkpoint.MANIFEST_FORMAT,
-                    "generation": gen,
-                    "journal_seq": seq,
-                    "router": router_file,
-                    "state": state_file,
-                    "crc": {
+                if full:
+                    router_file = f"router-{gen}.npz"
+                    state_file = f"state-{gen}.bin"
+                    rtmp = os.path.join(d, f"router-{gen}.tmp.npz")
+                    stmp = os.path.join(d, f"state-{gen}.tmp.bin")
+                    info = checkpoint.save(self.node.router, rtmp)
+                    _fsync_file(rtmp)
+                    os.replace(rtmp, os.path.join(d, router_file))
+                    state = self._snapshot_state()
+                    checkpoint.save_state(stmp, state)
+                    os.replace(stmp, os.path.join(d, state_file))
+                    base_gen, deltas = gen, []
+                    self._crc_map = {
                         router_file: checkpoint.file_crc(
                             os.path.join(d, router_file)),
                         state_file: checkpoint.file_crc(
                             os.path.join(d, state_file)),
-                    },
+                    }
+                    result = {"generation": gen, "kind": "full",
+                              "routes": info["routes"],
+                              "sessions": len(state["sessions"]),
+                              "retained": len(state["retained"])}
+                else:
+                    records = self._snapshot_delta(droutes, dret,
+                                                   dsess)
+                    delta_file = f"delta-{gen}.bin"
+                    dtmp = os.path.join(d, f"delta-{gen}.tmp.bin")
+                    checkpoint.save_state(dtmp, {
+                        "format": 1, "kind": "delta",
+                        "generation": gen, "records": records,
+                        "ts": t0})
+                    os.replace(dtmp, os.path.join(d, delta_file))
+                    base_gen = self._full_gen
+                    deltas = self._delta_chain + [gen]
+                    router_file = f"router-{base_gen}.npz"
+                    state_file = f"state-{base_gen}.bin"
+                    # base/prior-delta CRCs carry forward — re-reading
+                    # the table-sized base every generation would
+                    # defeat the churn-cost contract
+                    self._crc_map[delta_file] = checkpoint.file_crc(
+                        os.path.join(d, delta_file))
+                    result = {"generation": gen, "kind": "delta",
+                              "records": len(records)}
+                delta_names = [f"delta-{g}.bin" for g in deltas]
+                manifest = {
+                    "format": checkpoint.MANIFEST_FORMAT,
+                    "generation": gen,
+                    "journal_seq": seq,
+                    "base_generation": base_gen,
+                    "router": router_file,
+                    "state": state_file,
+                    "deltas": delta_names,
+                    "crc": {k: v for k, v in self._crc_map.items()
+                            if k in (router_file, state_file)
+                            or k in delta_names},
+                    "wal_shards": self.shards,
                     "clean_shutdown": bool(clean_shutdown),
                     "node": str(self.node.name),
                     "ts": t0,
@@ -367,18 +558,27 @@ class DurabilityManager:
                 # just before the rename inside)
                 checkpoint.write_manifest(d, manifest)
                 self.gen = gen
+                self._full_gen = base_gen
+                self._delta_chain = deltas
                 self.last_checkpoint_ts = time.time()
                 self.counters["checkpoint.saves"] += 1
-                self._cleanup(gen, seq)
+                if not full:
+                    self.counters["checkpoint.delta.saves"] += 1
+                self._cleanup(manifest, seq)
                 self._event("deactivate", "checkpoint_failed")
-                return {"generation": gen, "routes": info["routes"],
-                        "sessions": len(state["sessions"]),
-                        "retained": len(state["retained"]),
-                        "duration_s": round(time.time() - t0, 3)}
+                result["duration_s"] = round(time.time() - t0, 3)
+                return result
             except Exception as e:
                 # previous generation stays authoritative; the new
                 # journal segment keeps every record (replayed on top
-                # of the OLD checkpoint at recovery)
+                # of the OLD checkpoint at recovery). The swapped
+                # dirty sets merge back so the keys stay covered by
+                # the NEXT generation's delta
+                if droutes is not None:
+                    with self._mark_lock:
+                        self._delta_routes |= droutes
+                        self._delta_retained |= dret
+                        self._delta_sessions |= dsess
                 self.counters["checkpoint.errors"] += 1
                 self._event(
                     "activate", "checkpoint_failed",
@@ -388,19 +588,76 @@ class DurabilityManager:
                 log.exception("checkpoint generation %d failed", gen)
                 return {"error": repr(e), "generation": gen}
 
-    def _cleanup(self, gen: int, seq: int) -> None:
-        """After a committed manifest: superseded journals truncate
-        and older/orphaned generation segments are removed."""
-        d = self.cfg.dir
-        for s in self._scan_journals():
-            if s < seq:
-                _unlink(os.path.join(d, f"journal-{s}.wal"))
-        keep = {f"router-{gen}.npz", f"state-{gen}.bin",
-                checkpoint.MANIFEST}
-        for name in os.listdir(d):
-            if name in keep or _JOURNAL_RE.match(name):
+    def _snapshot_delta(self, droutes, dret, dsess) -> List[tuple]:
+        """The incremental generation's payload: journal-style
+        records (absolute refcounts, LWW retained, full session
+        state) for exactly the keys the swapped dirty sets name —
+        read from CURRENT memory, so any later journal record replays
+        idempotently on top."""
+        node = self.node
+        recs: List[tuple] = []
+        for flt, dest in droutes:
+            recs.append(("route", flt, dest,
+                         node.router.route_refs(flt, dest)))
+        ret = self._retainer()
+        now = time.time()
+        for topic in dret:
+            if ret is not None and topic in ret._store:
+                msg = ret._store[topic]
+                recs.append(("retain", topic, msg,
+                             float(getattr(msg, "timestamp", now))))
+            else:
+                ts = (ret._tombstones.get(topic, now)
+                      if ret is not None else now)
+                recs.append(("retain", topic, None, float(ts)))
+        cm = node.cm
+        for cid in dsess:
+            sess = None
+            dts: Optional[float] = None
+            ent = cm._detached.get(cid)
+            if ent is not None and getattr(ent[0], "durable", False):
+                sess = ent[0]
+                dts = float(ent[1])
+            else:
+                chan = cm._channels.get(cid)
+                s = getattr(chan, "session", None) \
+                    if chan is not None else None
+                if s is not None and getattr(s, "durable", False):
+                    sess = s
+            if sess is None:
+                recs.append(("sess.close", cid))
                 continue
-            if name.startswith(("router-", "state-", "MANIFEST.")):
+            try:
+                recs.append(("sess.state", cid, dts, sess.to_wire()))
+            except Exception:
+                # concurrent mutation mid-walk: re-dirty so the NEW
+                # journal + next delta carry the state instead
+                self._dirty.add(sess)
+                with self._mark_lock:
+                    self._delta_sessions.add(cid)
+        return recs
+
+    def _cleanup(self, manifest: dict, seq: int) -> None:
+        """After a committed manifest: superseded journals truncate
+        and generation segments outside the manifest's base + delta
+        chain are removed."""
+        d = self.cfg.dir
+        files = self._scan_journal_files()
+        for s, names in files.items():
+            if s < seq:
+                for name in names:
+                    _unlink(os.path.join(d, name))
+        keep = {manifest["router"], manifest["state"],
+                checkpoint.MANIFEST}
+        keep.update(manifest.get("deltas", ()))
+        self._crc_map = {k: v for k, v in self._crc_map.items()
+                         if k in keep}
+        for name in os.listdir(d):
+            if name in keep or _JOURNAL_RE.match(name) \
+                    or _JOURNAL_SHARD_RE.match(name):
+                continue
+            if name.startswith(("router-", "state-", "delta-",
+                                "MANIFEST.")):
                 _unlink(os.path.join(d, name))
 
     # -- recovery ---------------------------------------------------------
@@ -432,23 +689,31 @@ class DurabilityManager:
                 self._load_generation(manifest, degraded,
                                       rec_sessions, rec_retained,
                                       rec_tombs, summary)
-            replayed = torn_files = 0
-            seqs = [s for s in self._scan_journals() if s >= jseq0]
+            replayed = torn_files = nfiles = 0
+            seq_files = self._scan_journal_files()
+            seqs = sorted(s for s in seq_files if s >= jseq0)
             for s in seqs:
-                records, torn = wal_replay(self._journal_path(s))
-                for rec in records:
-                    try:
-                        self._apply(rec, rec_sessions, rec_retained,
-                                    rec_tombs)
-                        replayed += 1
-                    except Exception:
-                        log.warning("skipping malformed journal "
-                                    "record %r", rec[:1])
-                if torn:
-                    torn_files += 1
-                    log.warning("journal %s truncated at a torn "
-                                "record (crash mid-append)",
-                                self._journal_path(s))
+                # sequences replay in order; within one sequence the
+                # shard files replay in any fixed order — per-key
+                # shard affinity (journal_key) makes the merge
+                # converge regardless (docs/DURABILITY.md "Merge
+                # rule")
+                for name in seq_files[s]:
+                    path = os.path.join(self.cfg.dir, name)
+                    records, torn = wal_replay(path)
+                    nfiles += 1
+                    for rec in records:
+                        try:
+                            self._apply(rec, rec_sessions,
+                                        rec_retained, rec_tombs)
+                            replayed += 1
+                        except Exception:
+                            log.warning("skipping malformed journal "
+                                        "record %r", rec[:1])
+                    if torn:
+                        torn_files += 1
+                        log.warning("journal %s truncated at a torn "
+                                    "record (crash mid-append)", path)
             self.counters["recovery.replayed"] += replayed
             self.counters["recovery.torn"] += torn_files
             if torn_files:
@@ -461,7 +726,7 @@ class DurabilityManager:
             pruned = self._prune_orphan_routes(resurrected)
             self._install_retained(rec_retained, rec_tombs, degraded)
             summary.update({
-                "journals": len(seqs),
+                "journals": nfiles,
                 "replayed_records": replayed,
                 "torn_journals": torn_files,
                 "sessions": len(resurrected),
@@ -488,14 +753,16 @@ class DurabilityManager:
         # nothing
         self._seq = max(self._scan_journals() + [self._seq,
                                                  jseq0]) + 1
-        self.wal = Wal(
-            self._journal_path(self._seq), fsync=self.cfg.fsync,
+        self.wal = WalGroup(
+            self.cfg.dir, self._seq, shards=self.shards,
+            fsync=self.cfg.fsync,
             max_buffer=self.cfg.max_buffer_records,
             retry_backoff_s=self.cfg.retry_backoff_s,
             retry_backoff_max_s=self.cfg.retry_backoff_max_s,
-            on_error=self._wal_error)
+            on_error=self._wal_error,
+            group_window_ms=self.cfg.group_commit_window_ms)
         for op in self._pending_ops:
-            self.wal.append(op)
+            self.wal.append(op, journal_key(op))
         self._pending_ops = []
         self.wal.flush()
         ck = self.checkpoint_now()
@@ -541,6 +808,36 @@ class DurabilityManager:
                 rec_tombs[topic] = float(ts)
         except (checkpoint.CheckpointError, OSError) as e:
             degraded.append(f"state: {e}")
+        # incremental delta chain (docs/DURABILITY.md "Incremental
+        # checkpoints"): journal-style records applied in generation
+        # order on top of the base. A corrupt link degrades (keys
+        # touched ONLY in it are lost) but later deltas still apply —
+        # absolute values keep the best-effort merge consistent
+        applied = 0
+        for name in manifest.get("deltas", []):
+            p = os.path.join(d, name)
+            try:
+                want = crcs.get(name)
+                if want is not None \
+                        and checkpoint.file_crc(p) != int(want):
+                    raise checkpoint.CheckpointError(
+                        f"delta segment CRC mismatch: {p}")
+                blob = checkpoint.load_state(p)
+                if blob.get("kind") != "delta":
+                    raise checkpoint.CheckpointError(
+                        f"not a delta blob: {p}")
+                for rec in blob.get("records", []):
+                    try:
+                        self._apply(tuple(rec), rec_sessions,
+                                    rec_retained, rec_tombs)
+                        applied += 1
+                    except Exception:
+                        log.warning("skipping malformed delta "
+                                    "record %r", rec[:1])
+            except (checkpoint.CheckpointError, OSError) as e:
+                degraded.append(f"delta {name}: {e}")
+        if manifest.get("deltas"):
+            summary["delta_records"] = applied
 
     def _apply(self, rec, rec_sessions, rec_retained,
                rec_tombs) -> None:
@@ -675,13 +972,20 @@ class DurabilityManager:
                 log.exception("durability tick failed")
 
     def shutdown(self) -> None:
-        """Graceful stop: flush everything, one final checkpoint
-        (marked clean), close the journal — restart recovery then
-        starts from the checkpoint instead of a journal replay."""
+        """Graceful stop: flush everything, hand the journal tail to
+        the standby (bounded wait for its ack, then the clean-
+        departure announcement — failback never replays a torn tail),
+        one final FULL checkpoint stamped ``clean_shutdown``, close
+        the journal. Restart recovery then starts from the checkpoint
+        instead of a journal replay."""
         if self.wal is None:
             return
         self._flush_states()
         self.wal.flush()
+        r = self.repl
+        if r is not None:
+            r.ship_sync(self.cfg.repl_ack_timeout_s)
+            r.bye(clean=True)
         self.checkpoint_now(clean_shutdown=True)
         self.wal.close()
 
@@ -727,7 +1031,13 @@ class DurabilityManager:
                 "wal.appends": wi["appends_total"],
                 "wal.fsyncs": wi["fsyncs"],
                 "wal.fsync_errors": wi["fsync_errors"],
-                "wal.dropped": wi["dropped"],
+                # records shed by the memory-only degrade path's
+                # drop-oldest buffer — shard buffers AND the pre-arm
+                # pending buffer (used to vanish silently)
+                "wal.degraded.dropped":
+                    wi["dropped"] + self._pending_dropped,
+                "wal.group.commits": wi["group_commits"],
+                "wal.group.coalesced": wi["group_coalesced"],
             })
         for name, val in cur.items():
             delta = val - self._last_fold.get(name, 0)
@@ -740,10 +1050,20 @@ class DurabilityManager:
             "enabled": True,
             "dir": self.cfg.dir,
             "generation": self.gen,
+            "wal_shards": self.shards,
             "journal": self.wal.info() if self.wal is not None
             else {"armed": False,
-                  "pending": len(self._pending_ops)},
+                  "pending": len(self._pending_ops),
+                  "pending_dropped": self._pending_dropped},
             "dirty_sessions": len(self._dirty),
+            "checkpoint_chain": {
+                "base_generation": self._full_gen,
+                "deltas": list(self._delta_chain),
+                "full_every": self.cfg.checkpoint_full_every,
+                "dirty_keys": (len(self._delta_routes)
+                               + len(self._delta_retained)
+                               + len(self._delta_sessions)),
+            },
             "last_checkpoint_ts": self.last_checkpoint_ts,
             "checkpoint_age_s": (
                 round(time.time() - self.last_checkpoint_ts, 1)
@@ -751,6 +1071,8 @@ class DurabilityManager:
             "last_recovery": self.last_recovery,
             "counters": dict(self.counters),
         }
+        if self.repl is not None:
+            out["replication"] = self.repl.info()
         return out
 
 

@@ -102,7 +102,13 @@ POINTS: Dict[str, tuple] = {
                           "checkpoint.write_manifest — crash before "
                           "the manifest rename lands (every new "
                           "segment written, previous generation "
-                          "still authoritative)"),
+                          "still authoritative; covers full AND "
+                          "incremental generations)"),
+    "repl.ship": ("drop",
+                  "ReplicationManager ship/hello — the journal-ship "
+                  "call to the warm standby is dropped (the shipper "
+                  "falls back to local-only + resync) or, with "
+                  "stall, delayed (replication lag)"),
     # cluster plane (cluster_net.py, docs/CLUSTER.md). Scope per
     # transport via SocketTransport.fault_peers / fault_local when
     # several nodes share one process (the chaos matrix).

@@ -168,24 +168,41 @@ FAULT_METRICS = [
     "faults.injected",
 ]
 
-# durability layer (wal.py + durability.py, docs/DURABILITY.md):
-# `wal.appends` = journal records framed, `wal.fsyncs` = batched
-# write+sync cycles (one per ingress batch with dirty state, NOT one
-# per record — the fsync-batching contract), `wal.fsync_errors` =
-# flushes that failed and degraded the journal to memory-only,
-# `wal.dropped` = records shed by the bounded degraded-mode buffer,
+# durability layer (wal.py + durability.py + replication.py,
+# docs/DURABILITY.md): `wal.appends` = journal records framed,
+# `wal.fsyncs` = batched write+sync cycles (one per shard per group
+# commit with dirty state, NOT one per record — the fsync-batching
+# contract), `wal.fsync_errors` = flushes that failed and degraded a
+# shard to memory-only, `wal.degraded.dropped` = records shed by the
+# memory-only degrade path's bounded drop-oldest buffers (per-shard
+# AND the pre-recovery pending buffer — they used to vanish
+# silently), `wal.group.commits`/`wal.group.coalesced` = leader
+# group-commit passes / follower flushes that rode one,
 # `checkpoint.saves`/`checkpoint.errors` = atomic generation commits
-# and failed attempts, `recovery.replayed` = journal records applied
-# at boot, `recovery.torn` = journals truncated at a torn tail (a
-# crash mid-append — expected, alarmed, never fatal),
-# `recovery.sessions` = persistent sessions resurrected,
-# `recovery.routes.pruned` = crash-dead clean-session route refs
-# removed after restore
+# and failed attempts, `checkpoint.delta.saves` = the subset that
+# were incremental (differential) generations, `recovery.replayed` =
+# journal records applied at boot, `recovery.torn` = journals
+# truncated at a torn tail (a crash mid-append — expected, alarmed,
+# never fatal), `recovery.sessions` = persistent sessions
+# resurrected, `recovery.routes.pruned` = crash-dead clean-session
+# route refs removed after restore. Replication (journal-shipped
+# warm standby): `durability.repl.shipped`/`.acked` = records
+# shipped to / acknowledged by the standby, `.ship_errors` = ship
+# calls that failed (shipper drops to local-only), `.resyncs` = full
+# snapshot re-syncs (first contact, gap repair, queue overflow),
+# `.dropped` = queued-but-unshipped records discarded by the bounded
+# ship queue (triggers a resync), `.promotions` = standby
+# promotions executed after a primary death
 DURABILITY_METRICS = [
-    "wal.appends", "wal.fsyncs", "wal.fsync_errors", "wal.dropped",
-    "checkpoint.saves", "checkpoint.errors",
+    "wal.appends", "wal.fsyncs", "wal.fsync_errors",
+    "wal.degraded.dropped", "wal.group.commits",
+    "wal.group.coalesced",
+    "checkpoint.saves", "checkpoint.errors", "checkpoint.delta.saves",
     "recovery.replayed", "recovery.torn", "recovery.sessions",
     "recovery.routes.pruned",
+    "durability.repl.shipped", "durability.repl.acked",
+    "durability.repl.ship_errors", "durability.repl.resyncs",
+    "durability.repl.dropped", "durability.repl.promotions",
 ]
 
 # cluster plane (cluster.py + cluster_net.py, docs/CLUSTER.md),

@@ -173,6 +173,11 @@ class Node:
         # cluster agent (set by enable_cluster + start, or by an
         # externally constructed Cluster attaching itself)
         self.cluster = None
+        # replicated-durability agent (replication.py): set by
+        # Cluster.__init__ on clustered nodes — journal shipper when
+        # [durability] standby names a peer, warm standby replicas
+        # for peers that ship here
+        self.replication = None
         self._cluster_cfg: Optional[tuple] = None
         # fid-quarantine growth watch (stats tick): depth at the last
         # tick + consecutive-growth streak behind the
@@ -486,6 +491,10 @@ class Node:
                 stats.setstat("checkpoint.age_s", int(age))
         if self.cluster is not None:
             self._fold_cluster_stats(stats)
+        if self.replication is not None:
+            # replication counters/lag gauges + the
+            # replication_lagging alarm hysteresis
+            self.replication.fold(self.metrics, self.alarms, stats)
         self.drain_robustness_events()
         stats.setstat("publish.spans.count", self.telemetry.spans_total,
                       "publish.spans.max")

@@ -1,0 +1,729 @@
+"""Replicated durability: journal shipping + warm standby failover
+(docs/DURABILITY.md "Replicated durability").
+
+PR 9's durability layer makes a node crash-consistent against its
+OWN disk; PR 10's cluster replicates routes but not sessions — a
+node death still loses its live persistent sessions until that disk
+comes back. This module closes the gap the reference broker never
+did (mnesia ram tables + takeover, PAPER.md L7/L8): the primary
+streams its journal records over the cluster transport to a
+designated STANDBY peer, which continuously replays them into a warm
+*detached* replica state (never into its live broker tables). When
+the heartbeat failure detector declares the primary down, the
+standby PROMOTES — resurrecting the primary's persistent sessions,
+retained messages, and routes exactly, with RPO = 0 for every record
+the primary flushed and the standby acked.
+
+Roles (one :class:`ReplicationManager` per clustered node plays
+both):
+
+  - **Shipper** (primary side, armed when ``[durability] standby``
+    names a peer): journal appends are offered to a bounded queue;
+    after each local group commit the shipper thread drains the
+    queue — only locally-durable records ship — and calls
+    ``repl_ship`` on the standby with a contiguous sequence range.
+    The standby's reply is the acked offset; lag is
+    ``offered − acked``. A suspect/down standby (the transport
+    fast-fails), a ship error, or a full queue drops the shipper to
+    **local-only** mode: local durability is unaffected, the
+    ``replication_lagging`` alarm raises (hysteresis on the lag
+    thresholds), and the next successful contact runs a full RESYNC
+    (``repl_hello`` with a fresh snapshot) before incremental
+    shipping resumes.
+  - **Replica** (standby side, one per primary): applies shipped
+    records into staging dicts keyed exactly like recovery's
+    (sessions / retained / tombstones / absolute route refcounts).
+    Contiguity is enforced — a sequence gap answers ``resync`` and
+    the primary re-snapshots. The replica is WARM state, not live
+    state: zero interference with the standby's own traffic.
+
+Promotion (``Cluster.handle_nodedown`` → :meth:`maybe_promote`):
+runs after the cluster's normal dead-node purge, so the primary's
+replicated route entries are gone and the replica re-installs them
+remapped to the standby's own name (exact refcounts via
+``Router.set_route_refs``, broadcast to the surviving members);
+persistent sessions resurrect DETACHED (expiry evaluated against
+detach time, reconnecting clients get session-present + DUP
+redelivery); retained messages re-arm through the retainer's
+restore path. If the standby runs its own durability, a full
+checkpoint immediately journals the adopted state.
+
+Fault point ``repl.ship`` (docs/ROBUSTNESS.md): drop discards the
+ship call (the standby never sees it — the resync path's repair
+target), stall delays it (lag visible to the alarm).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu import faults as _faults
+from emqx_tpu import topic as T
+
+log = logging.getLogger("emqx_tpu.replication")
+
+#: ship batch bound: one repl_ship call carries at most this many
+#: records (a huge tail ships as several bounded calls)
+SHIP_BATCH_RECORDS = 2048
+
+
+class StandbyReplica:
+    """Warm detached replica of one primary's durable state."""
+
+    def __init__(self, primary: str) -> None:
+        self.primary = primary
+        self.lock = threading.Lock()
+        #: staging dicts — the same shapes recovery stages into
+        self.sessions: Dict[str, list] = {}   # cid -> [dts, state]
+        self.retained: Dict[str, object] = {}
+        self.tombs: Dict[str, float] = {}
+        self.routes: Dict[Tuple, int] = {}    # (flt, dest) -> refs
+        self.applied_seq = 0
+        self.applied_records = 0
+        self.clean = False        # primary said goodbye cleanly
+        self.promoted = False
+        self.last_ship_ts: Optional[float] = None
+
+    def reset(self, start_seq: int) -> None:
+        with self.lock:
+            self.sessions.clear()
+            self.retained.clear()
+            self.tombs.clear()
+            self.routes.clear()
+            self.applied_seq = start_seq - 1
+            self.clean = False
+            self.promoted = False
+
+    def apply(self, rec: tuple) -> None:
+        """One journal record into the warm state — the replica-side
+        mirror of ``DurabilityManager._apply`` (absolute refcounts,
+        LWW retained, full-state session overwrites)."""
+        op = rec[0]
+        if op == "route":
+            _, flt, dest, refs = rec
+            key = (flt, tuple(dest) if isinstance(dest, list)
+                   else dest)
+            if int(refs) > 0:
+                self.routes[key] = int(refs)
+            else:
+                self.routes.pop(key, None)
+        elif op == "retain":
+            _, topic, msg, ts = rec
+            if msg is None:
+                self.retained.pop(topic, None)
+                self.tombs[topic] = max(self.tombs.get(topic, 0.0),
+                                        float(ts))
+            else:
+                self.retained[topic] = msg
+        elif op == "sess.state":
+            _, cid, dts, d = rec
+            self.sessions[cid] = [dts, d]
+        elif op == "sess.sub":
+            _, cid, key, opts = rec
+            ent = self.sessions.get(cid)
+            if ent is not None:
+                ent[1]["subscriptions"][key] = opts
+        elif op == "sess.unsub":
+            _, cid, key = rec
+            ent = self.sessions.get(cid)
+            if ent is not None:
+                ent[1]["subscriptions"].pop(key, None)
+        elif op == "sess.close":
+            self.sessions.pop(rec[1], None)
+        else:
+            raise ValueError(f"unknown replicated record {op!r}")
+
+    def apply_batch(self, seq0: int, records: list) -> dict:
+        with self.lock:
+            if seq0 != self.applied_seq + 1:
+                # sequence gap (dropped ship, replica restarted):
+                # refuse — the primary re-snapshots via repl_hello
+                return {"resync": True, "applied": self.applied_seq}
+            for rec in records:
+                try:
+                    self.apply(tuple(rec))
+                except Exception:
+                    log.warning("skipping malformed shipped record "
+                                "%r", rec[:1] if rec else rec)
+            self.applied_seq = seq0 + len(records) - 1
+            self.applied_records += len(records)
+            self.last_ship_ts = time.time()
+            return {"applied": self.applied_seq}
+
+    def info(self) -> dict:
+        with self.lock:
+            return {
+                "primary": self.primary,
+                "applied_seq": self.applied_seq,
+                "applied_records": self.applied_records,
+                "sessions": len(self.sessions),
+                "retained": len(self.retained),
+                "routes": len(self.routes),
+                "clean": self.clean,
+                "promoted": self.promoted,
+                "last_ship_age_s": (
+                    round(time.time() - self.last_ship_ts, 1)
+                    if self.last_ship_ts else None),
+            }
+
+
+class ReplicationManager:
+    """Per-node replication agent: the shipper half (when this node
+    is a primary with a configured standby) plus any standby replicas
+    this node holds for its peers. Attached by ``Cluster.__init__``
+    as ``node.replication``; RPC ops route here via
+    ``Cluster.handle_rpc``."""
+
+    def __init__(self, node, cluster) -> None:
+        self.node = node
+        self.cluster = cluster
+        self.replicas: Dict[str, StandbyReplica] = {}
+        # shipper state (armed by arm_shipper)
+        self.durability = None
+        self.standby: Optional[str] = None
+        self._q: List[tuple] = []         # offered, not yet shipped
+        self._q_lock = threading.Lock()
+        #: one ship pass at a time: the shipper thread and a
+        #: shutdown's synchronous ship_sync must not interleave
+        #: batches (the replica would see a sequence regression and
+        #: force a pointless resync)
+        self._ship_lock = threading.Lock()
+        self._flush_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self.offered_seq = 0              # last seq assigned
+        self.shipped_seq = 0              # last seq sent
+        self.acked_seq = 0                # last seq the standby acked
+        self._flushed_seq = 0             # locally durable watermark
+        self.offered_bytes = 0
+        self.acked_bytes = 0
+        self._q_bytes = 0
+        #: "replicating" | "syncing" | "local_only"
+        self.state = "syncing"
+        self._need_hello = True
+        self._lag_alarmed = False
+        self.counters: Dict[str, int] = {
+            "repl.shipped": 0, "repl.acked": 0, "repl.ship_errors": 0,
+            "repl.resyncs": 0, "repl.dropped": 0,
+            "repl.promotions": 0,
+        }
+        self._last_fold: Dict[str, int] = {}
+        #: thread-recorded alarm transitions, drained on the stats
+        #: tick (same pattern as DurabilityManager._events)
+        self._events: List[tuple] = []
+
+    # -- shipper arming ----------------------------------------------------
+
+    def arm_shipper(self, durability) -> None:
+        """Become a replicating primary: ship the journal stream to
+        ``[durability] standby``. Called by Cluster.__init__ when the
+        config names a standby peer."""
+        if self._thread is not None:
+            return
+        self.durability = durability
+        self.standby = durability.cfg.standby
+        durability.repl = self
+        self._thread = threading.Thread(
+            target=self._ship_main, daemon=True,
+            name=f"repl-ship-{self.node.name}")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stopping = True
+        self._flush_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- primary side ------------------------------------------------------
+
+    def offer(self, op: tuple) -> None:
+        """Queue one journal record for shipping (called from
+        DurabilityManager._append, any thread). Bounded: overflow
+        drops the queue whole and schedules a full resync — local
+        durability is never affected."""
+        with self._q_lock:
+            self.offered_seq += 1
+            size = _op_size(op)
+            self.offered_bytes += size
+            if len(self._q) >= \
+                    self.durability.cfg.repl_queue_max_records:
+                self.counters["repl.dropped"] += len(self._q)
+                self._q.clear()
+                self._q_bytes = 0
+                self._need_hello = True
+                self.state = "local_only"
+                return
+            self._q.append((self.offered_seq, size, op))
+            self._q_bytes += size
+
+    def notify_flush(self) -> None:
+        """The local group commit landed: everything offered so far
+        is durable and may ship (called from on_batch, executor
+        thread)."""
+        with self._q_lock:
+            self._flushed_seq = self.offered_seq
+        self._flush_evt.set()
+
+    def _ship_main(self) -> None:
+        while not self._stopping:
+            fired = self._flush_evt.wait(timeout=1.0)
+            if self._stopping:
+                return
+            if fired:
+                self._flush_evt.clear()
+            try:
+                self._ship_pass()
+            except Exception:
+                log.exception("journal ship pass failed")
+
+    def _peer_ok(self) -> bool:
+        tr = self.cluster.transport
+        return tr.peer_state(self.standby) == "ok" \
+            and self.standby in getattr(tr, "_peers", {self.standby})
+
+    def _ship_pass(self) -> None:
+        """Ship everything durable and pending, bounded per call.
+        Suspect-aware: a standby the failure detector holds unhealthy
+        is not dialed at all — the queue holds (bounded) and the
+        shipper stays/goes local-only until the peer recovers."""
+        with self._ship_lock:
+            if self.standby not in self.cluster.members \
+                    and self.state != "replicating":
+                return  # standby not joined yet
+            if not self._peer_ok():
+                if self.state == "replicating":
+                    self.state = "local_only"
+                return
+            if self._need_hello:
+                if not self._hello():
+                    return
+            while True:
+                with self._q_lock:
+                    batch = [e for e in self._q
+                             if e[0] <= self._flushed_seq]
+                    batch = batch[:SHIP_BATCH_RECORDS]
+                    if not batch:
+                        return
+                if not self._ship_batch(batch):
+                    return
+
+    def _hello(self) -> bool:
+        """Full resync: snapshot the primary's durable planes and
+        hand the replica a fresh baseline + the next stream seq."""
+        d = self.durability
+        with self._q_lock:
+            # records already queued re-ship after the snapshot (they
+            # are idempotent over it); the stream restarts contiguous
+            start_seq = self._q[0][0] if self._q else \
+                self.offered_seq + 1
+        snapshot = _primary_snapshot(self.node, d)
+        try:
+            if _faults.enabled and _faults.fire("repl.ship"):
+                raise ConnectionError("injected repl.ship drop")
+            self.cluster.transport.call(
+                self.standby, "repl_hello", self.node.name,
+                snapshot, start_seq)
+        except (ConnectionError, OSError) as e:
+            self.counters["repl.ship_errors"] += 1
+            self.state = "local_only"
+            log.warning("replication hello to %s failed: %s",
+                        self.standby, e)
+            return False
+        self.counters["repl.resyncs"] += 1
+        self._need_hello = False
+        self.state = "replicating"
+        with self._q_lock:
+            self.acked_seq = max(self.acked_seq, start_seq - 1)
+        log.info("replication resync with %s complete (%d sessions, "
+                 "%d routes)", self.standby,
+                 len(snapshot["sessions"]), len(snapshot["routes"]))
+        return True
+
+    def _ship_batch(self, batch: List[tuple]) -> bool:
+        seq0 = batch[0][0]
+        records = [op for _s, _b, op in batch]
+        nbytes = sum(b for _s, b, _op in batch)
+        try:
+            if _faults.enabled and _faults.fire("repl.ship"):
+                raise ConnectionError("injected repl.ship drop")
+            reply = self.cluster.transport.call(
+                self.standby, "repl_ship", self.node.name, seq0,
+                records)
+        except (ConnectionError, OSError) as e:
+            self.counters["repl.ship_errors"] += 1
+            self.state = "local_only"
+            log.warning("journal ship to %s failed (%s); local-only "
+                        "until the peer recovers", self.standby, e)
+            return False
+        if isinstance(reply, dict) and reply.get("resync"):
+            self._need_hello = True
+            return self._hello()
+        acked = int(reply["applied"] if isinstance(reply, dict)
+                    else reply)
+        with self._q_lock:
+            self.shipped_seq = max(self.shipped_seq, batch[-1][0])
+            self.acked_seq = max(self.acked_seq, acked)
+            self.acked_bytes += nbytes
+            self._q = [e for e in self._q if e[0] > self.acked_seq]
+            self._q_bytes = sum(e[1] for e in self._q)
+        self.counters["repl.shipped"] += len(records)
+        self.counters["repl.acked"] += len(records)
+        self.last_ack_ts = time.time()
+        self.state = "replicating"
+        return True
+
+    last_ack_ts: Optional[float] = None
+
+    def ship_sync(self, timeout: float) -> bool:
+        """Drain + ship the tail synchronously (graceful shutdown's
+        bounded hand-off). True when the standby acked everything."""
+        if self._thread is None:
+            return True
+        with self._q_lock:
+            self._flushed_seq = self.offered_seq
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                self._ship_pass()
+            except Exception:
+                log.exception("shutdown ship pass failed")
+                return False
+            with self._q_lock:
+                if self.acked_seq >= self.offered_seq:
+                    return True
+            if self.state == "local_only":
+                return False
+            time.sleep(0.02)
+        return False
+
+    def bye(self, clean: bool = False) -> None:
+        """Tell the standby this primary is departing deliberately
+        (it keeps the warm replica, stamped clean — failback-safe)."""
+        if self._thread is None:
+            return
+        try:
+            self.cluster.transport.call(
+                self.standby, "repl_bye", self.node.name, bool(clean))
+        except (ConnectionError, OSError):
+            pass
+
+    def lag(self) -> Tuple[int, int]:
+        """(records, bytes) the standby is behind."""
+        with self._q_lock:
+            return (max(0, self.offered_seq - self.acked_seq),
+                    self._q_bytes)
+
+    # -- standby side ------------------------------------------------------
+
+    def handle_hello(self, primary: str, snapshot: dict,
+                     start_seq: int):
+        rep = self.replicas.get(primary)
+        if rep is None:
+            rep = self.replicas[primary] = StandbyReplica(primary)
+        rep.reset(start_seq)
+        with rep.lock:
+            for cid, dts, sd in snapshot.get("sessions", []):
+                rep.sessions[cid] = [dts, sd]
+            for topic, msg in snapshot.get("retained", []):
+                rep.retained[topic] = msg
+            for topic, ts in snapshot.get("tombstones", []):
+                rep.tombs[topic] = float(ts)
+            for flt, dest, refs in snapshot.get("routes", []):
+                key = (flt, tuple(dest) if isinstance(dest, list)
+                       else dest)
+                rep.routes[key] = int(refs)
+            rep.last_ship_ts = time.time()
+        log.info("warm standby armed for %s (%d sessions, %d routes,"
+                 " %d retained)", primary, len(rep.sessions),
+                 len(rep.routes), len(rep.retained))
+        return {"applied": rep.applied_seq}
+
+    def handle_ship(self, primary: str, seq0: int, records: list):
+        rep = self.replicas.get(primary)
+        if rep is None:
+            return {"resync": True, "applied": 0}
+        return rep.apply_batch(int(seq0), records)
+
+    def handle_bye(self, primary: str, clean: bool):
+        rep = self.replicas.get(primary)
+        if rep is not None:
+            rep.clean = bool(clean)
+        return None
+
+    # -- failover ----------------------------------------------------------
+
+    def maybe_promote(self, dead: str) -> bool:
+        """``dead`` went down (heartbeat detector). If this node is
+        its warm standby, promote the replica — runs AFTER the
+        cluster's normal nodedown purge, so the dead primary's
+        replicated route entries are already gone and re-install
+        remapped to this node."""
+        rep = self.replicas.get(dead)
+        if rep is None or rep.promoted:
+            return False
+        t0 = time.perf_counter()
+        try:
+            summary = self._promote(rep)
+        except Exception:
+            log.exception("standby promotion for %s failed", dead)
+            return False
+        rep.promoted = True
+        self.counters["repl.promotions"] += 1
+        failover_s = time.perf_counter() - t0
+        self.last_promotion = dict(summary, primary=dead,
+                                   failover_s=round(failover_s, 4),
+                                   clean=rep.clean)
+        self._events.append((
+            "activate", "standby_promoted",
+            dict(self.last_promotion),
+            f"standby promoted for {dead}: "
+            f"{summary['sessions']} sessions, "
+            f"{summary['routes']} routes resurrected"))
+        log.warning("standby PROMOTED for %s in %.1fms: %s",
+                    dead, failover_s * 1000.0, summary)
+        return True
+
+    last_promotion: Optional[dict] = None
+
+    def _promote(self, rep: StandbyReplica) -> dict:
+        node = self.node
+        me = node.broker.node
+        primary = rep.primary
+        down_ts = time.time()
+        with rep.lock:
+            routes = dict(rep.routes)
+            sessions = {c: list(v) for c, v in rep.sessions.items()}
+            retained = dict(rep.retained)
+            tombs = dict(rep.tombs)
+        # 1. routes: the dead primary's dests remap to this node with
+        # exact refcounts; other nodes' dests are live replication's
+        # problem, not the replica's
+        installed = 0
+        for (flt, dest), refs in routes.items():
+            if dest == primary:
+                dest2 = me
+            elif isinstance(dest, tuple) and len(dest) == 2 \
+                    and dest[1] == primary:
+                dest2 = (dest[0], me)
+            else:
+                continue
+            have = node.router.route_refs(flt, dest2)
+            node.router.set_route_refs(flt, dest2, have + int(refs))
+            installed += 1
+            # surviving members need the adopted route (set_route_refs
+            # bypasses the replicated add wrapper on purpose)
+            self.cluster._broadcast("route_add", flt, dest2)
+        # 2. retained messages re-arm through the restore path (LWW
+        # + tombstone-monotone, no re-broadcast storm; anti-entropy
+        # reconciles peers)
+        mods = getattr(node, "modules", None)
+        ret = mods._loaded.get("retainer") if mods is not None else None
+        if ret is not None and (retained or tombs):
+            ret.restore_entries(retained.items(), tombs.items())
+        # 3. persistent sessions resurrect DETACHED (recovery's exact
+        # contract: reconnecting clients resume with session-present
+        # and DUP redelivery)
+        from emqx_tpu.session import Session
+
+        resurrected = 0
+        for cid, (dts, sd) in sessions.items():
+            if cid in node.cm._channels or cid in node.cm._detached:
+                continue  # the client already lives here — keep it
+            try:
+                sess = Session.from_wire(sd)
+            except Exception as e:
+                log.warning("replicated session %r unrecoverable: %s",
+                            cid, e)
+                continue
+            expiry = float(sd.get("expiry_interval", 0.0) or 0.0)
+            if expiry <= 0:
+                continue
+            detach = float(dts) if dts is not None else down_ts
+            if down_ts - detach >= expiry:
+                continue  # expired before the failover
+            sess.client_id = cid
+            sess.broker = node.broker
+            d = node.durability
+            if d is not None:
+                sess.durable = True
+                sess._dur = d
+                d._detach_ts[cid] = detach
+            for key, opts in list(sess.subscriptions.items()):
+                try:
+                    self._restore_sub(sess, key, opts)
+                except Exception:
+                    log.exception("restoring %r of %r failed",
+                                  key, cid)
+            node.cm._detached[cid] = (sess, detach, expiry)
+            if self.cluster is not None:
+                self.cluster.client_up(cid)
+            resurrected += 1
+        # 4. the adopted state becomes durable here too: one full
+        # checkpoint captures routes + sessions + retained at once
+        if node.durability is not None \
+                and node.durability.wal is not None:
+            node.durability.checkpoint_now(full=True)
+        return {"sessions": resurrected, "routes": installed,
+                "retained": len(retained)}
+
+    def _restore_sub(self, sess, key: str, opts) -> None:
+        """Rebuild subscriber/fanout/shared tables WITHOUT bumping
+        the router (refs were installed from the replica) — the
+        promotion-side analogue of Broker.restore_subscription."""
+        self.node.broker.restore_subscription(sess, key, opts)
+
+    # -- observability -----------------------------------------------------
+
+    def fold(self, metrics, alarms, stats) -> None:
+        """Stats-tick fold: counter deltas, lag gauges, and the
+        ``replication_lagging`` alarm with hysteresis. Runs on the
+        main loop."""
+        cur = dict(self.counters)
+        for name, val in cur.items():
+            delta = val - self._last_fold.get(name, 0)
+            if delta:
+                metrics.inc(f"durability.{name}", delta)
+        self._last_fold = cur
+        while self._events:
+            try:
+                kind, name, details, message = self._events.pop(0)
+            except IndexError:
+                break
+            if kind == "activate":
+                alarms.activate(name, details=details,
+                                message=message)
+            else:
+                alarms.deactivate(name)
+        if self._thread is not None and self.durability is not None:
+            lag_r, lag_b = self.lag()
+            stats.setstat("durability.repl.lag_records", lag_r)
+            stats.setstat("durability.repl.lag_bytes", lag_b)
+            if self.last_ack_ts is not None:
+                stats.setstat(
+                    "durability.repl.last_ack_age_s",
+                    int(time.time() - self.last_ack_ts))
+            cfg = self.durability.cfg
+            if not self._lag_alarmed \
+                    and lag_r > cfg.repl_lag_alarm_records:
+                self._lag_alarmed = True
+                alarms.activate(
+                    "replication_lagging",
+                    details={"lag_records": lag_r,
+                             "lag_bytes": lag_b,
+                             "state": self.state,
+                             "standby": self.standby},
+                    message="journal shipping is behind the "
+                            "configured lag bound; durability is "
+                            "local-only beyond the acked offset")
+            elif self._lag_alarmed \
+                    and lag_r <= cfg.repl_lag_clear_records:
+                self._lag_alarmed = False
+                alarms.deactivate("replication_lagging")
+
+    def info(self) -> dict:
+        out: dict = {"counters": dict(self.counters)}
+        if self._thread is not None:
+            lag_r, lag_b = self.lag()
+            out["role"] = "primary"
+            out["state"] = self.state
+            out["standby"] = self.standby
+            out["shipped_seq"] = self.shipped_seq
+            out["acked_seq"] = self.acked_seq
+            out["offered_seq"] = self.offered_seq
+            out["lag_records"] = lag_r
+            out["lag_bytes"] = lag_b
+            out["last_ack_age_s"] = (
+                round(time.time() - self.last_ack_ts, 1)
+                if self.last_ack_ts else None)
+        if self.replicas:
+            out["standby_for"] = {p: r.info()
+                                  for p, r in self.replicas.items()}
+        if self.last_promotion is not None:
+            out["last_promotion"] = self.last_promotion
+        return out
+
+
+def _op_size(op: tuple) -> int:
+    """Cheap (allocation-free-ish) record size estimate for lag
+    accounting — exact byte counts would re-encode every record."""
+    try:
+        if op[0] == "retain" and op[2] is not None:
+            return 64 + len(getattr(op[2], "payload", b""))
+        if op[0] == "sess.state":
+            return 256
+        return 64
+    except Exception:
+        return 64
+
+
+def _primary_snapshot(node, durability) -> dict:
+    """The resync baseline: every durable plane as transferable
+    data, same shapes the recovery checkpoint stages."""
+    state = durability._snapshot_state()
+    routes = []
+    for flt, dests in node.router.route_table().items():
+        for dest, refs in dests.items():
+            routes.append((flt, dest, int(refs)))
+    return {"sessions": state["sessions"],
+            "retained": state["retained"],
+            "tombstones": state["tombstones"],
+            "routes": routes}
+
+
+def durable_digest(node) -> str:
+    """Order-independent digest of a node's durable planes — routes
+    (own-node dests normalized to ``@self`` so a primary and its
+    promoted standby compare equal), retained payloads, and
+    persistent-session state. The failover bench's RPO/byte-exactness
+    predicate; handy in tests."""
+    me = node.broker.node
+    h = hashlib.sha1()
+    entries = []
+    for flt, dests in node.router.route_table().items():
+        for dest, refs in dests.items():
+            if dest == me:
+                dest = "@self"
+            elif isinstance(dest, tuple) and len(dest) == 2 \
+                    and dest[1] == me:
+                dest = (dest[0], "@self")
+            entries.append(("route", flt, repr(dest), int(refs)))
+    mods = getattr(node, "modules", None)
+    ret = mods._loaded.get("retainer") if mods is not None else None
+    if ret is not None:
+        for t, m in ret._store.items():
+            entries.append(("retain", t, bytes(m.payload).hex(),
+                            int(m.qos)))
+    # durable sessions, live OR detached — a primary's live session
+    # failovers into the standby's detached table, and the digest
+    # must not care which side of that line it sits on
+    sessions = {cid: s for cid, (s, _ts, _exp)
+                in node.cm._detached.items()}
+    for cid, chan in node.cm._channels.items():
+        s = getattr(chan, "session", None)
+        if s is not None and cid not in sessions \
+                and getattr(s, "durable", False):
+            sessions[cid] = s
+    for cid, s in sessions.items():
+        subs = []
+        for key, o in sorted(s.subscriptions.items()):
+            flt, popts = T.parse(key)
+            subs.append((key, int(o.qos), int(o.nl),
+                         popts.get("share", o.share)))
+        inflight = sorted(
+            (pid, (v[0] if isinstance(v[0], str)
+                   else (v[0].topic, bytes(v[0].payload).hex())))
+            for pid, v in s.inflight.to_list())
+        mq = [(m.topic, bytes(m.payload).hex())
+              for _p, q in s.mqueue.snapshot() for m in q]
+        entries.append(("sess", cid, tuple(subs), tuple(inflight),
+                        tuple(mq), sorted(s.awaiting_rel),
+                        s.next_pkt_id))
+    for e in sorted(entries, key=repr):
+        h.update(repr(e).encode())
+        h.update(b"\x00")
+    return h.hexdigest()

@@ -42,7 +42,24 @@ record is a no-op; see DurabilityManager._apply):
 
 Fault points (docs/ROBUSTNESS.md): ``wal.append`` short-writes one
 frame (torn tail) and degrades the writer; ``wal.fsync`` fails the
-sync (the disk-full path).
+sync (the disk-full path). Both fire inside :meth:`Wal.flush`, so in
+sharded mode they are naturally PER SHARD — one shard degrades or
+tears while its siblings keep committing.
+
+Sharding (:class:`WalGroup`, docs/DURABILITY.md "Sharded WAL"):
+``[durability] wal_shards`` splits the journal into per-loop shards
+(``journal-<shard>-<seq>.wal``). Every record is routed by a stable
+KEY (the route filter, the retained topic, the session client-id), so
+all records for one key live in one shard in true order — which is
+what makes recovery's per-shard-ordered merge converge regardless of
+how the shards interleave (absolute refcounts, full-state session
+records, LWW retained). ``wal_shards = 1`` keeps the single
+``journal-<seq>.wal`` byte-for-byte. Concurrent flushes (N front-door
+loops + the timer + shutdown) coalesce through a leader-based GROUP
+COMMIT: the first flusher becomes the leader, optionally sleeps the
+``group_commit_window_ms`` window to pick up stragglers, and pays one
+write+fsync pass per shard for everything buffered; followers wait on
+the leader's commit instead of issuing their own.
 """
 
 from __future__ import annotations
@@ -53,7 +70,7 @@ import os
 import struct
 import threading
 import time
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 from emqx_tpu import faults, wire
 
@@ -307,3 +324,204 @@ class Wal:
                 "degraded": self.degraded,
                 "last_fsync_ms": round(self.last_fsync_ms, 3),
             }
+
+
+def shard_path(dirpath: str, shard: Optional[int], seq: int) -> str:
+    """Segment file name: ``journal-<seq>.wal`` for the single-journal
+    build (shard None), ``journal-<shard>-<seq>.wal`` for sharded
+    mode — the legacy layout stays byte-for-byte when shards == 1."""
+    if shard is None:
+        return os.path.join(dirpath, f"journal-{seq}.wal")
+    return os.path.join(dirpath, f"journal-{shard}-{seq}.wal")
+
+
+def shard_of(key: str, n: int) -> int:
+    """Stable key → shard assignment (the merge-rule anchor: every
+    record for one key lands in one shard, in true order)."""
+    if n <= 1:
+        return 0
+    return binascii.crc32(key.encode("utf-8", "surrogatepass")) % n
+
+
+class WalGroup:
+    """``n`` per-loop WAL shards behind one appender/flush surface,
+    with leader-based batched group commit.
+
+    Appends route by key (:func:`shard_of`); flush runs the group-
+    commit protocol: concurrent flushers elect the first as leader,
+    the leader optionally sleeps ``group_window_ms`` to coalesce
+    stragglers, then pays ONE write+fsync pass over the shards with
+    pending records; followers block on the leader's commit covering
+    their appends instead of issuing their own fsyncs. With
+    ``shards == 1`` the on-disk layout (name, framing, rotation) is
+    byte-for-byte the single-journal :class:`Wal` build.
+    """
+
+    def __init__(self, dirpath: str, seq: int, shards: int = 1,
+                 fsync: bool = True, max_buffer: int = 100_000,
+                 retry_backoff_s: float = 1.0,
+                 retry_backoff_max_s: float = 30.0,
+                 on_error=None,
+                 group_window_ms: float = 0.0) -> None:
+        if shards < 1:
+            raise ValueError(f"wal shards must be >= 1, got {shards}")
+        self.dir = dirpath
+        self.n = shards
+        self.seq = seq
+        self.group_window_ms = group_window_ms
+        #: manager alarm callback — the group arbitrates shard
+        #: callbacks so a recovering shard can't clear the alarm
+        #: while a sibling is still degraded
+        self.on_error = on_error
+        self.shards: List[Wal] = [
+            Wal(shard_path(dirpath, i if shards > 1 else None, seq),
+                fsync=fsync, max_buffer=max_buffer,
+                retry_backoff_s=retry_backoff_s,
+                retry_backoff_max_s=retry_backoff_max_s,
+                on_error=self._shard_error)
+            for i in range(shards)]
+        # group-commit coordinator state (guarded by the condition)
+        self._cv = threading.Condition()
+        self._req = 0          # flush requests issued
+        self._done = 0         # highest request covered by a commit
+        self._leader = False
+        self._last_ok = False
+        #: leader commit passes / follower flushes satisfied by one
+        self.commits = 0
+        self.coalesced = 0
+
+    # -- shard routing -----------------------------------------------------
+
+    def append(self, op: Tuple[Any, ...],
+               key: Optional[str] = None) -> None:
+        """Frame + buffer one record into its key's shard (no I/O).
+        ``key=None`` routes to shard 0 (single-journal semantics)."""
+        idx = shard_of(key, self.n) if key is not None else 0
+        self.shards[idx].append(op)
+
+    def _shard_error(self, exc) -> None:
+        cb = self.on_error
+        if cb is None:
+            return
+        if exc is not None:
+            cb(exc)
+        elif not any(w.degraded for w in self.shards):
+            # clear only once EVERY shard recovered
+            cb(None)
+
+    # -- group-commit flush ------------------------------------------------
+
+    def flush(self) -> bool:
+        """Group commit: everything buffered across all shards at the
+        time of the call reaches disk before this returns (or the
+        write degrades — never raises). Concurrent callers coalesce
+        into one leader pass per round."""
+        with self._cv:
+            self._req += 1
+            my_req = self._req
+            if self._leader:
+                # a leader is committing: wait for a round that
+                # covers appends made before this call
+                self.coalesced += 1
+                while self._done < my_req and self._leader:
+                    self._cv.wait(timeout=0.05)
+                if self._done >= my_req:
+                    return self._last_ok
+                # leader exited without covering us — take over
+            self._leader = True
+        try:
+            while True:
+                if self.group_window_ms > 0:
+                    # the coalescing window: stragglers' appends land
+                    # in the buffers this pass is about to commit
+                    time.sleep(self.group_window_ms / 1000.0)
+                with self._cv:
+                    upto = self._req
+                ok = False
+                any_pending = False
+                for w in self.shards:
+                    if w.pending():
+                        any_pending = True
+                        ok = w.flush() or ok
+                if any_pending:
+                    self.commits += 1
+                with self._cv:
+                    self._done = upto
+                    self._last_ok = ok
+                    self._cv.notify_all()
+                    if self._req == upto:
+                        return ok
+                # more flush requests arrived mid-commit: go again
+        finally:
+            with self._cv:
+                self._leader = False
+                self._cv.notify_all()
+
+    def pending(self) -> int:
+        return sum(w.pending() for w in self.shards)
+
+    # -- rotation / lifecycle ---------------------------------------------
+
+    def rotate_to(self, seq: int) -> List[str]:
+        """Flush, then switch every shard to its ``seq`` segment
+        (checkpoint commit protocol). Returns the OLD paths."""
+        self.flush()
+        old = []
+        for i, w in enumerate(self.shards):
+            old.append(w.rotate(shard_path(
+                self.dir, i if self.n > 1 else None, seq)))
+        self.seq = seq
+        return old
+
+    def close(self) -> None:
+        self.flush()
+        for w in self.shards:
+            w.close()
+
+    # -- aggregate surface (the manager/tests' single-Wal view) -----------
+
+    @property
+    def records(self) -> int:
+        return sum(w.records for w in self.shards)
+
+    @property
+    def bytes(self) -> int:
+        return sum(w.bytes for w in self.shards)
+
+    @property
+    def dropped(self) -> int:
+        return sum(w.dropped for w in self.shards)
+
+    @property
+    def degraded(self) -> bool:
+        return any(w.degraded for w in self.shards)
+
+    @property
+    def _retry_at(self) -> float:
+        return max(w._retry_at for w in self.shards)
+
+    @_retry_at.setter
+    def _retry_at(self, v: float) -> None:
+        for w in self.shards:
+            w._retry_at = v
+
+    def info(self) -> dict:
+        per = [w.info() for w in self.shards]
+        out = {
+            "shards": self.n,
+            "path": per[0]["path"] if self.n == 1 else self.dir,
+            "records": sum(p["records"] for p in per),
+            "bytes": sum(p["bytes"] for p in per),
+            "pending": sum(p["pending"] for p in per),
+            "appends_total": sum(p["appends_total"] for p in per),
+            "fsyncs": sum(p["fsyncs"] for p in per),
+            "fsync_errors": sum(p["fsync_errors"] for p in per),
+            "dropped": sum(p["dropped"] for p in per),
+            "degraded": any(p["degraded"] for p in per),
+            "last_fsync_ms": max(p["last_fsync_ms"] for p in per),
+            "group_commits": self.commits,
+            "group_coalesced": self.coalesced,
+        }
+        if self.n > 1:
+            out["per_shard"] = per
+        return out

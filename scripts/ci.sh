@@ -93,13 +93,23 @@ assert rec['metric']=='overload_delivered_msgs_per_s' \
     and rec['value'] is not None and rec['curve'], rec"
 
 echo "== crash recovery (docs/DURABILITY.md) =="
-# journal framing/torn-tail/degrade semantics, the kill-point matrix
-# (every armed storage fault x crash stage must recover routes /
-# retained / persistent sessions exactly), checkpoint-format
+# journal framing/torn-tail/degrade semantics (per shard), the
+# kill-point matrix (every armed storage fault x crash stage must
+# recover routes / retained / persistent sessions exactly), sharded
+# group-commit WAL + order-insensitive merge property, incremental
+# checkpoint chains (incl. crash mid-delta), checkpoint-format
 # hardening, and the durability-off byte-for-byte pin — a regression
 # here is silent data loss after a crash, fail fast
 python -m pytest tests/test_wal.py tests/test_durability.py \
     tests/test_checkpoint.py -q
+
+echo "== replicated durability (docs/DURABILITY.md) =="
+# journal shipping to the warm standby: ship/ack offsets, standby
+# promotion byte-exactness + RPO 0, suspect-aware local-only
+# fallback + resync, repl.ship chaos, graceful tail hand-off, and
+# the promoted-standby double-recovery pin — a regression here is
+# silent data loss at failover, fail fast
+python -m pytest tests/test_replication.py -q
 
 echo "== recovery smoke (docs/DURABILITY.md) =="
 # the BENCH_MODE=recovery scenario end-to-end at toy scale: durable
@@ -123,18 +133,24 @@ echo "== cluster heal matrix (docs/CLUSTER.md) =="
 # regression here is silent cluster divergence, fail fast
 python -m pytest tests/test_cluster_heal.py -q
 
-echo "== partition-heal smoke (docs/CLUSTER.md) =="
+echo "== partition-heal + failover smoke (docs/CLUSTER.md) =="
 # the BENCH_MODE=partition scenario end-to-end at toy scale: a
 # 3-node partition with churn on both sides must detect, heal, and
-# reconverge all plane digests with zero manual rejoin (numbers are
-# not gated here — the driver's real-scale run is)
+# reconverge all plane digests with zero manual rejoin — AND the
+# warm-standby failover row must promote with RPO 0 and a
+# digest-verified byte-exact durable state (numbers are not gated
+# here — the driver's real-scale run is; the RPO/digest booleans ARE)
 BENCH_MODE=partition PARTITION_ROUTES=300 PARTITION_SECONDS=1 \
+    FAILOVER_SESSIONS=30 FAILOVER_RETAINED=60 \
     BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
     python bench.py | python -c "import json,sys; \
 rec=json.loads(sys.stdin.readlines()[-1]); \
 assert rec['metric']=='partition_heal_converge_s' \
     and rec['value'] is not None \
-    and rec['partition_detect_s'] is not None, rec"
+    and rec['partition_detect_s'] is not None \
+    and rec['failover_s'] is not None \
+    and rec['rpo_records'] == 0 \
+    and rec['failover_digest_ok'] is True, rec"
 
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B

@@ -504,8 +504,340 @@ async def test_stats_gauges_and_metric_fold(tmp_path):
     n.stats.tick()
     assert n.metrics.val("wal.appends") >= 2  # state + sub + route
     assert n.metrics.val("checkpoint.saves") >= 1
+    assert n.metrics.val("wal.group.commits") >= 1
     allstats = n.stats.all()
     assert allstats["journal.records"] >= 1
     assert "checkpoint.age_s" in allstats
     assert allstats["durability.generation"] >= 1
     await n.stop()
+
+
+# -- sharded WAL: full-node round trips (docs/DURABILITY.md) --------------
+
+
+async def test_sharded_crash_recovery_exact_and_idempotent(tmp_path):
+    """The full crash round-trip with 4 journal shards: routes,
+    retained (incl. a tombstone), and persistent sessions recover
+    byte-exactly, and a second recovery with no new ops is a no-op."""
+    n = mknode(tmp_path, durability=dcfg(tmp_path, wal_shards=4))
+    await n.start()
+    assert n.durability.wal.n == 4
+    s = durable_session(n, "sh1")
+    for i in range(12):
+        s.subscribe(f"sh/{i}/+", SubOpts(qos=1))
+    n.broker.publish(Message(topic="sh/1/r", payload=b"keep", qos=1,
+                             flags={"retain": True}))
+    n.broker.publish(Message(topic="sh/2/r", payload=b"x",
+                             flags={"retain": True}))
+    n.broker.publish(Message(topic="sh/2/r", payload=b"",
+                             flags={"retain": True}))  # tombstone
+    n.durability.on_batch()
+    # records actually spread over several shard files
+    d = n.durability.cfg.dir
+    shard_files = [f for f in os.listdir(d)
+                   if f.startswith("journal-") and f.count("-") == 2]
+    assert len(shard_files) == 4
+    n.cm._detached["sh1"] = (s, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["sh1"]
+    await crash(n)
+    models = []
+    for _ in range(2):
+        n2 = mknode(tmp_path, durability=dcfg(tmp_path, wal_shards=4))
+        await n2.start()
+        models.append(state_model(n2))
+        await crash(n2)
+    assert models[0] == want and models[1] == want
+
+
+async def test_sharded_torn_tail_loses_only_that_shard(tmp_path):
+    """A torn tail (crash mid-append) in ONE shard truncates that
+    shard's unsynced records; sibling shards' records from the same
+    batch survive — per-shard kill semantics. Retained topics carry
+    the probe (they have no cross-record coupling; route loss would
+    also legitimately cascade through session-consistency pruning)."""
+    from emqx_tpu.durability import journal_key
+    from emqx_tpu.wal import shard_of
+
+    n = mknode(tmp_path, durability=dcfg(tmp_path, wal_shards=2))
+    await n.start()
+    n.broker.publish(Message(topic="base/r", payload=b"p1",
+                             flags={"retain": True}))
+    n.durability.on_batch()
+    # two phase-2 retained topics whose journal keys hash apart
+    t_a = t_b = None
+    i = 0
+    while t_a is None or t_b is None:
+        t = f"t2/{i}"
+        idx = shard_of(journal_key(("retain", t, None, 0.0)), 2)
+        if idx == 0 and t_a is None:
+            t_a = t
+        elif idx == 1 and t_b is None:
+            t_b = t
+        i += 1
+    n.broker.publish(Message(topic=t_a, payload=b"a",
+                             flags={"retain": True}))
+    n.broker.publish(Message(topic=t_b, payload=b"b",
+                             flags={"retain": True}))
+    # the flush short-writes ONE frame: exactly one shard tears and
+    # re-buffers its whole batch; the sibling's batch commits
+    with faults.injected("wal.append", times=1):
+        n.durability.on_batch()
+    await crash(n)
+    n2 = mknode(tmp_path, durability=dcfg(tmp_path, wal_shards=2))
+    await n2.start()
+    rec = n2.durability.last_recovery
+    assert rec["torn_journals"] == 1
+    ret = n2.modules._loaded.get("retainer")
+    assert bytes(ret._store["base/r"].payload) == b"p1"
+    survived = [t for t in (t_a, t_b) if t in ret._store]
+    # one shard tore, the other committed — sharded mode must not
+    # lose the whole batch to one torn shard
+    assert len(survived) == 1, survived
+    await n2.stop()
+
+
+def test_replay_order_insensitive_across_shard_interleavings(
+        tmp_path):
+    """Property: per-key shard affinity + absolute refcounts +
+    full-state sessions + LWW retained make ANY merge of per-shard-
+    ordered streams converge to the same state (docs/DURABILITY.md
+    "Merge rule")."""
+    import random as _random
+
+    from emqx_tpu.durability import journal_key
+    from emqx_tpu.wal import shard_of
+
+    rng = _random.Random(42)
+    ops = []
+    refs = {}
+    for i in range(300):
+        kind = rng.choice(["route", "route", "retain", "sess"])
+        if kind == "route":
+            flt = f"p/{rng.randrange(12)}/+"
+            dest = rng.choice(["n1", ("g", "n1")])
+            key = (flt, dest)
+            refs[key] = max(0, refs.get(key, 0)
+                            + rng.choice([1, 1, -1]))
+            ops.append(("route", flt, dest, refs[key]))
+        elif kind == "retain":
+            t = f"r/{rng.randrange(8)}"
+            if rng.random() < 0.25:
+                ops.append(("retain", t, None, float(i)))
+            else:
+                ops.append(("retain", t,
+                            Message(topic=t, payload=bytes([i % 251])),
+                            float(i)))
+        else:
+            cid = f"c{rng.randrange(6)}"
+            ops.append(("sess.state", cid, None,
+                        {"subscriptions": {}, "seq": i}))
+    for shards in (1, 2, 4, 8):
+        # split into per-shard streams by journal key…
+        streams = [[] for _ in range(shards)]
+        for op in ops:
+            streams[shard_of(journal_key(op), shards)].append(op)
+        outcomes = set()
+        for trial in range(6):
+            # …and re-merge in a random interleaving that preserves
+            # only per-shard order (what recovery's file-order replay
+            # and any crash-rotation split can produce)
+            mrng = _random.Random(trial)
+            cursors = [0] * shards
+            sessions, retained, tombs = {}, {}, {}
+            route_state = {}
+            live = [s for s in range(shards) if streams[s]]
+            while live:
+                s = mrng.choice(live)
+                op = streams[s][cursors[s]]
+                cursors[s] += 1
+                if cursors[s] >= len(streams[s]):
+                    live.remove(s)
+                if op[0] == "route":
+                    route_state[(op[1], op[2])] = op[3]
+                elif op[0] == "retain":
+                    if op[2] is None:
+                        retained.pop(op[1], None)
+                        tombs[op[1]] = max(tombs.get(op[1], 0.0),
+                                           op[3])
+                    else:
+                        retained[op[1]] = op[2]
+                else:
+                    sessions[op[1]] = op[3]["seq"]
+            outcomes.add(repr((
+                sorted(route_state.items(), key=repr),
+                sorted((t, bytes(m.payload)) for t, m
+                       in retained.items()),
+                sorted(tombs.items()), sorted(sessions.items()))))
+        assert len(outcomes) == 1, \
+            f"shards={shards}: merge order changed the outcome"
+
+
+# -- incremental checkpoints (docs/DURABILITY.md) -------------------------
+
+
+async def test_incremental_checkpoint_tracks_churn_not_table(
+        tmp_path):
+    """A delta generation carries only the keys touched since the
+    last generation — the structural form of the 'cost tracks churn,
+    not table size' contract."""
+    n = mknode(tmp_path, durability=dcfg(tmp_path,
+                                         checkpoint_full_every=8))
+    await n.start()
+    s = durable_session(n, "big")
+    for i in range(200):
+        s.subscribe(f"tbl/{i}", SubOpts(qos=1))
+    n.durability.on_batch()
+    out_full = n.durability.checkpoint_now(full=True)
+    assert out_full["kind"] == "full"
+    # small churn against the big table
+    for i in range(5):
+        s.subscribe(f"churn/{i}", SubOpts(qos=1))
+    n.broker.publish(Message(topic="churn/r", payload=b"v",
+                             flags={"retain": True}))
+    n.durability.on_batch()
+    out = n.durability.checkpoint_now()
+    assert out["kind"] == "delta"
+    # the delta names only the churned keys: 5 routes + 1 retained +
+    # 1 dirty session state — nowhere near the 200-route table
+    assert out["records"] <= 12, out
+    d = n.durability.cfg.dir
+    blob = checkpoint.load_state(
+        os.path.join(d, f"delta-{out['generation']}.bin"))
+    assert blob["kind"] == "delta"
+    kinds = [r[0] for r in blob["records"]]
+    assert kinds.count("route") == 5
+    assert kinds.count("retain") == 1
+    # recovery from base + delta + journal is exact
+    n.cm._detached["big"] = (s, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["big"]
+    await crash(n)
+    n2 = mknode(tmp_path, durability=dcfg(tmp_path,
+                                          checkpoint_full_every=8))
+    await n2.start()
+    assert state_model(n2) == want
+    assert n2.durability.last_recovery.get("delta_records", 0) >= 6
+    await n2.stop()
+
+
+async def test_incremental_chain_rebases_to_full(tmp_path):
+    """checkpoint_full_every bounds the chain: the Nth generation is
+    a full rebase and the delta files are cleaned up."""
+    n = mknode(tmp_path, durability=dcfg(tmp_path,
+                                         checkpoint_full_every=3))
+    await n.start()
+    s = durable_session(n, "c")
+    gens = []
+    for i in range(6):
+        s.subscribe(f"g/{i}", SubOpts(qos=1))
+        n.durability.on_batch()
+        gens.append(n.durability.checkpoint_now())
+    kinds = [g["kind"] for g in gens]
+    # recovery baseline was full; chain: delta, delta, FULL, delta…
+    assert kinds == ["delta", "delta", "full", "delta", "delta",
+                     "full"]
+    d = n.durability.cfg.dir
+    leftover = [f for f in os.listdir(d) if f.startswith("delta-")]
+    assert leftover == []  # last gen was full: chain cleaned
+    n.cm._detached["c"] = (s, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["c"]
+    await crash(n)
+    n2 = mknode(tmp_path, durability=dcfg(tmp_path,
+                                          checkpoint_full_every=3))
+    await n2.start()
+    assert state_model(n2) == want
+    await n2.stop()
+
+
+async def test_crash_during_incremental_checkpoint(tmp_path):
+    """checkpoint.rename during a DELTA generation: the previous
+    manifest stays authoritative, the rotated journal still holds
+    every record, the swapped dirty keys re-merge — recovery AND the
+    next delta are both exact."""
+    n = mknode(tmp_path, durability=dcfg(tmp_path,
+                                         checkpoint_full_every=8))
+    await n.start()
+    s = durable_session(n, "mc")
+    s.subscribe("a/1", SubOpts(qos=1))
+    n.durability.on_batch()
+    n.durability.checkpoint_now(full=True)
+    s.subscribe("a/2", SubOpts(qos=1))
+    n.durability.on_batch()
+    with faults.injected("checkpoint.rename", times=1):
+        out = n.durability.checkpoint_now()
+    assert "error" in out
+    assert n.durability.counters["checkpoint.errors"] == 1
+    # the dirty keys merged back: the NEXT delta still carries a/2
+    out2 = n.durability.checkpoint_now()
+    assert out2["kind"] == "delta"
+    blob = checkpoint.load_state(os.path.join(
+        n.durability.cfg.dir, f"delta-{out2['generation']}.bin"))
+    assert any(r[0] == "route" and r[1] == "a/2"
+               for r in blob["records"])
+    n.cm._detached["mc"] = (s, 0, 300.0)
+    want = state_model(n)
+    del n.cm._detached["mc"]
+    await crash(n)
+    n2 = mknode(tmp_path, durability=dcfg(tmp_path))
+    await n2.start()
+    assert state_model(n2) == want
+    await n2.stop()
+
+
+async def test_clean_shutdown_checkpoint_is_full(tmp_path):
+    """Graceful stop always rebases: the final manifest is a full
+    generation with no delta chain (failback never walks a chain)."""
+    n = mknode(tmp_path, durability=dcfg(tmp_path,
+                                         checkpoint_full_every=8))
+    await n.start()
+    s = durable_session(n, "fs")
+    s.subscribe("f/+", SubOpts(qos=1))
+    n.durability.on_batch()
+    n.durability.checkpoint_now()  # a delta in the chain
+    await n.stop()
+    m = checkpoint.read_manifest(str(tmp_path / "dur"))
+    assert m["clean_shutdown"] and m["deltas"] == []
+    assert m["base_generation"] == m["generation"]
+
+
+def test_config_new_durability_knobs():
+    from emqx_tpu.config import ConfigError, parse_config
+    cfg = parse_config({"durability": {
+        "enabled": True, "wal_shards": 4,
+        "group_commit_window_ms": 2.5, "checkpoint_full_every": 4,
+        "standby": "peer@host", "repl_ack_timeout_s": 2.0,
+        "repl_lag_alarm_records": 500,
+        "repl_lag_clear_records": 50}})
+    assert cfg.durability.wal_shards == 4
+    assert cfg.durability.group_commit_window_ms == 2.5
+    assert cfg.durability.standby == "peer@host"
+    for bad in ({"wal_shards": -1}, {"checkpoint_full_every": 0},
+                {"group_commit_window_ms": -1},
+                {"repl_ack_timeout_s": 0},
+                {"repl_lag_alarm_records": 10,
+                 "repl_lag_clear_records": 100},
+                {"standby": 7}, {"wal_shards": True}):
+        with pytest.raises(ConfigError):
+            parse_config({"durability": dict({"enabled": True},
+                                             **bad)})
+
+
+async def test_pre_arm_buffer_drops_are_counted(tmp_path):
+    """Satellite: records shed by the pre-recovery bounded buffer
+    fold into wal.degraded.dropped instead of vanishing."""
+    from emqx_tpu.durability import DurabilityManager
+
+    n = Node(boot_listeners=False, load_default_modules=True)
+    cfg = dcfg(tmp_path, max_buffer_records=3)
+    dur = DurabilityManager(n, cfg)
+    n.durability = dur
+    for i in range(8):
+        dur._append(("sess.close", f"c{i}"))
+    assert len(dur._pending_ops) == 3
+    assert dur._pending_dropped == 5
+    dur.recover()  # arms the journal; drained buffer is bounded
+    dur.fold_metrics(n.metrics)
+    assert n.metrics.val("wal.degraded.dropped") == 5
+    dur.wal.close()

@@ -1,0 +1,434 @@
+"""Replicated durability: journal shipping, warm standby, failover
+(docs/DURABILITY.md "Replicated durability").
+
+The acceptance property: a primary that flushed-and-shipped its
+journal can die at any moment and its warm standby promotes with
+RPO = 0 for acked records — routes, retained messages, and
+persistent sessions byte-exact (digest-verified against the
+primary's pre-kill state). Degradation is suspect-aware: an
+unreachable standby drops the shipper to local-only (durability
+itself unaffected) and the next contact resyncs.
+
+Multi-node-in-one-process over real sockets, same harness shape as
+tests/test_cluster_heal.py.
+"""
+
+import time
+
+import pytest
+
+from emqx_tpu import faults
+from emqx_tpu.cluster import Cluster, ClusterConfig
+from emqx_tpu.cluster_net import SocketTransport
+from emqx_tpu.durability import DurabilityConfig
+from emqx_tpu.modules.retainer import RetainerModule
+from emqx_tpu.node import Node
+from emqx_tpu.replication import durable_digest
+from emqx_tpu.session import Session
+from emqx_tpu.types import Message, SubOpts
+
+
+def _fast_cfg(**kw) -> ClusterConfig:
+    base = dict(heartbeat_interval_s=0.1, heartbeat_timeout_s=0.5,
+                suspect_after=1, down_after=3, ok_after=1,
+                anti_entropy_interval_s=30.0, call_timeout_s=2.0,
+                redial_backoff_s=0.1, redial_backoff_max_s=0.5)
+    base.update(kw)
+    return ClusterConfig(**base)
+
+
+def _wait(pred, timeout=20.0, msg="condition not met in time"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(msg)
+
+
+class _Chan:
+    def __init__(self, s):
+        self.session = s
+        self.client_id = s.client_id
+
+
+def _durable_session(node, cid, expiry=300.0):
+    s = Session(cid, broker=node.broker, clean_start=False)
+    node.durability.session_opened(s, expiry)
+    node.cm.register_channel(cid, _Chan(s))
+    return s
+
+
+def _mk_pair(tmp_path, cookie, dur0_kw=None, dur1=False,
+             cluster_kw=None):
+    """Two socket-clustered nodes; rn0 is a durable primary shipping
+    to rn1. Returns (nodes, transports, clusters)."""
+    cfg = _fast_cfg(**(cluster_kw or {}))
+    nodes, trs, cls = [], [], []
+    for i in range(2):
+        dkw = None
+        if i == 0:
+            dkw = dict(enabled=True, dir=str(tmp_path / f"d{i}"),
+                       fsync=False, standby="rn1", wal_shards=2,
+                       repl_ack_timeout_s=2.0)
+            dkw.update(dur0_kw or {})
+        elif dur1:
+            dkw = dict(enabled=True, dir=str(tmp_path / f"d{i}"),
+                       fsync=False)
+        node = Node(name=f"rn{i}", boot_listeners=False,
+                    durability=(DurabilityConfig(**dkw)
+                                if dkw else None))
+        node.modules.load(RetainerModule)
+        if node.durability is not None:
+            node.durability.recover()
+        tr = SocketTransport(f"rn{i}", cookie=cookie, config=cfg)
+        tr.serve()
+        cl = Cluster(node, transport=tr, config=cfg)
+        nodes.append(node)
+        trs.append(tr)
+        cls.append(cl)
+    cls[1].join_remote("127.0.0.1", trs[0].port)
+    return nodes, trs, cls
+
+
+def _teardown(nodes, trs, cls):
+    for node in nodes:
+        if node.durability is not None \
+                and node.durability.wal is not None:
+            node.durability.wal.close()
+    for cl in cls:
+        cl.close()
+    for tr in trs:
+        tr.close()
+
+
+def _populate(n0):
+    """The canonical durable workload: a durable session with plain +
+    shared subs and unacked QoS1 inflight, retained set + clear."""
+    s = _durable_session(n0, "dev1")
+    s.subscribe("fleet/+/state", SubOpts(qos=1))
+    s.subscribe("$share/g/fleet/cmd", SubOpts(qos=2))
+    n0.broker.publish(Message(topic="fleet/1/state", payload=b"up",
+                              qos=1, flags={"retain": True}))
+    n0.broker.publish(Message(topic="fleet/2/state", payload=b"x",
+                              flags={"retain": True}))
+    n0.broker.publish(Message(topic="fleet/2/state", payload=b"",
+                              flags={"retain": True}))  # tombstone
+    n0.broker.publish(Message(topic="fleet/9/state", payload=b"q",
+                              qos=1))
+    n0.durability.on_batch()
+    return s
+
+
+def _repl(n0):
+    return n0.replication
+
+
+def _synced(n0):
+    r = _repl(n0)
+    return (r.state == "replicating"
+            and r.acked_seq >= r.offered_seq)
+
+
+def _kill_primary(nodes, trs):
+    """kill -9 analogue for the clustered primary: drop its
+    durability hooks and sever its transport so the peer's detector
+    declares it down."""
+    nodes[0].broker.durability = None
+    nodes[0].cm.durability = None
+    trs[0].close()
+
+
+# -- shipping --------------------------------------------------------------
+
+
+def test_ship_and_ack_reach_warm_replica(tmp_path):
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-ship")
+    try:
+        _populate(nodes[0])
+        _wait(lambda: _synced(nodes[0]), msg="journal never acked")
+        rep = nodes[1].replication.replicas["rn0"]
+        assert rep.sessions and "dev1" in rep.sessions
+        assert "fleet/1/state" in rep.retained
+        assert "fleet/2/state" in rep.tombs
+        assert any(k[0] == "fleet/+/state" for k in rep.routes)
+        assert not rep.promoted
+        r = _repl(nodes[0])
+        assert r.info()["role"] == "primary"
+        assert r.lag() == (0, 0)
+        assert r.counters["repl.resyncs"] == 1  # the initial hello
+    finally:
+        _teardown(nodes, trs, cls)
+
+
+def test_incremental_ship_after_hello(tmp_path):
+    """Records journaled after the initial snapshot ship as the
+    incremental stream (no re-hello)."""
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-inc")
+    try:
+        _populate(nodes[0])
+        _wait(lambda: _synced(nodes[0]), msg="initial sync")
+        resyncs = _repl(nodes[0]).counters["repl.resyncs"]
+        s2 = _durable_session(nodes[0], "dev2")
+        s2.subscribe("late/+", SubOpts(qos=1))
+        nodes[0].broker.publish(Message(
+            topic="late/r", payload=b"v", flags={"retain": True}))
+        nodes[0].durability.on_batch()
+        _wait(lambda: _synced(nodes[0]), msg="incremental sync")
+        rep = nodes[1].replication.replicas["rn0"]
+        assert "dev2" in rep.sessions
+        assert "late/r" in rep.retained
+        assert _repl(nodes[0]).counters["repl.resyncs"] == resyncs
+    finally:
+        _teardown(nodes, trs, cls)
+
+
+# -- failover --------------------------------------------------------------
+
+
+def test_promote_on_primary_down_byte_exact_rpo_zero(tmp_path):
+    """The headline property: primary dies, standby promotes —
+    durable planes digest-equal to the primary's pre-kill state
+    (routes remapped to the standby), RPO = 0 for acked records."""
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-promote")
+    try:
+        s = _populate(nodes[0])
+        assert len(s.inflight) == 2
+        _wait(lambda: _synced(nodes[0]), msg="sync before kill")
+        r = _repl(nodes[0])
+        acked_at_kill = r.acked_seq
+        offered_at_kill = r.offered_seq
+        assert acked_at_kill == offered_at_kill  # RPO = 0 premise
+        # the digest compares the session DETACHED on both sides
+        nodes[0].cm._detached["dev1"] = (s, 0, 300.0)
+        want = durable_digest(nodes[0])
+        del nodes[0].cm._detached["dev1"]
+        _kill_primary(nodes, trs)
+        _wait(lambda: nodes[1].replication.replicas["rn0"].promoted,
+              msg="standby never promoted")
+        rep = nodes[1].replication.replicas["rn0"]
+        assert rep.applied_seq >= acked_at_kill  # nothing acked lost
+        assert "dev1" in nodes[1].cm._detached
+        got = durable_digest(nodes[1])
+        assert got == want, "promoted state diverged from primary"
+        # the resurrected window still carries the unacked QoS1s
+        s2 = nodes[1].cm._detached["dev1"][0]
+        assert len(s2.inflight) == 2
+        assert nodes[1].router.route_refs(
+            "fleet/+/state", nodes[1].broker.node) == 1
+        lp = nodes[1].replication.last_promotion
+        assert lp["primary"] == "rn0" and lp["failover_s"] < 5.0
+        assert lp["sessions"] == 1
+    finally:
+        _teardown(nodes, trs, cls)
+
+
+def test_promoted_standby_journals_and_survives_its_own_crash(
+        tmp_path):
+    """Double-recovery on the promoted side: a standby with its own
+    durability checkpoints the adopted state, so ITS crash right
+    after failover recovers the inherited sessions exactly."""
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-double", dur1=True)
+    try:
+        _populate(nodes[0])
+        _wait(lambda: _synced(nodes[0]), msg="sync before kill")
+        _kill_primary(nodes, trs)
+        _wait(lambda: nodes[1].replication.replicas["rn0"].promoted,
+              msg="standby never promoted")
+        want = durable_digest(nodes[1])
+        # crash the promoted standby (no graceful path)…
+        nodes[1].broker.durability = None
+        nodes[1].cm.durability = None
+        nodes[1].durability.wal.close()
+        nodes[1].durability = None
+        # …and recover a fresh incarnation from its directory
+        n2 = Node(name="rn1", boot_listeners=False,
+                  durability=DurabilityConfig(
+                      enabled=True, dir=str(tmp_path / "d1"),
+                      fsync=False))
+        n2.modules.load(RetainerModule)
+        n2.durability.recover()
+        assert "dev1" in n2.cm._detached
+        assert durable_digest(n2) == want
+        n2.durability.wal.close()
+    finally:
+        _teardown(nodes, trs, cls)
+
+
+# -- degradation + resync --------------------------------------------------
+
+
+def test_suspect_standby_falls_back_local_only_then_resyncs(
+        tmp_path):
+    """An unreachable standby drops the shipper to local-only (local
+    durability unaffected); when the peer heals, shipping resyncs and
+    lag returns to zero."""
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-fallback")
+    try:
+        _populate(nodes[0])
+        _wait(lambda: _synced(nodes[0]), msg="initial sync")
+        resyncs0 = _repl(nodes[0]).counters["repl.resyncs"]
+        # sever the link both ways
+        trs[0].fault_peers = {"rn1"}
+        trs[1].fault_peers = {"rn0"}
+        faults.set_master(True)
+        faults.arm("net.partition", times=0)
+        s2 = _durable_session(nodes[0], "dev2")
+        s2.subscribe("cut/+", SubOpts(qos=1))
+        nodes[0].durability.on_batch()
+        _wait(lambda: _repl(nodes[0]).state == "local_only",
+              msg="shipper never degraded")
+        # local durability is unaffected: the journal has the records
+        assert nodes[0].durability.wal.records > 0
+        assert _repl(nodes[0]).lag()[0] > 0
+        # heal: detector recovers the peer, shipping resumes
+        faults.disarm("net.partition")
+        _wait(lambda: _synced(nodes[0]), timeout=30.0,
+              msg="shipper never resynced")
+        rep = nodes[1].replication.replicas["rn0"]
+        assert "dev2" in rep.sessions
+        assert _repl(nodes[0]).counters["repl.resyncs"] >= resyncs0
+    finally:
+        faults.clear()
+        _teardown(nodes, trs, cls)
+
+
+def test_repl_ship_fault_point_drop_and_stall(tmp_path):
+    """The repl.ship fault point: drop discards the ship call (the
+    shipper degrades, then resyncs when disarmed); stall only delays
+    it."""
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-fault")
+    try:
+        _populate(nodes[0])
+        _wait(lambda: _synced(nodes[0]), msg="initial sync")
+        errors0 = _repl(nodes[0]).counters["repl.ship_errors"]
+        with faults.injected("repl.ship", times=1):
+            s2 = _durable_session(nodes[0], "dev2")
+            s2.subscribe("drop/+", SubOpts(qos=1))
+            nodes[0].durability.on_batch()
+            _wait(lambda: _repl(nodes[0]).counters["repl.ship_errors"]
+                  > errors0, msg="drop never fired")
+        _wait(lambda: _synced(nodes[0]), msg="post-drop resync")
+        assert "dev2" in \
+            nodes[1].replication.replicas["rn0"].sessions
+        with faults.injected("repl.ship", action="stall", times=1,
+                             delay_ms=50):
+            s3 = _durable_session(nodes[0], "dev3")
+            s3.subscribe("slow/+", SubOpts(qos=1))
+            nodes[0].durability.on_batch()
+            _wait(lambda: _synced(nodes[0]), msg="stalled ship")
+        assert "dev3" in \
+            nodes[1].replication.replicas["rn0"].sessions
+    finally:
+        faults.clear()
+        _teardown(nodes, trs, cls)
+
+
+# -- graceful shutdown hand-off -------------------------------------------
+
+
+def test_graceful_shutdown_ships_tail_and_stamps_clean(tmp_path):
+    """Node.stop on a replicating primary: the journal tail ships,
+    the standby acks it, the replica is stamped clean, and the final
+    checkpoint carries clean_shutdown — failback never replays a
+    torn tail."""
+    from emqx_tpu import checkpoint
+
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-bye")
+    try:
+        _populate(nodes[0])
+        _wait(lambda: _synced(nodes[0]), msg="initial sync")
+        # tail records the shutdown must hand off (never on_batch'd)
+        s2 = _durable_session(nodes[0], "tail")
+        s2.subscribe("tail/+", SubOpts(qos=1))
+        nodes[0].durability.shutdown()
+        rep = nodes[1].replication.replicas["rn0"]
+        assert rep.clean
+        assert "tail" in rep.sessions
+        r = _repl(nodes[0])
+        assert r.acked_seq >= r.offered_seq
+        m = checkpoint.read_manifest(str(tmp_path / "d0"))
+        assert m["clean_shutdown"]
+    finally:
+        _teardown(nodes, trs, cls)
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_ctl_metrics_and_lag_alarm_hysteresis(tmp_path):
+    import json
+
+    nodes, trs, cls = _mk_pair(
+        tmp_path, "rep-obs",
+        dur0_kw=dict(repl_lag_alarm_records=3,
+                     repl_lag_clear_records=0))
+    try:
+        _populate(nodes[0])
+        _wait(lambda: _synced(nodes[0]), msg="initial sync")
+        out = json.loads(nodes[0].ctl.run(["durability"]))
+        blk = out["replication"]
+        assert blk["role"] == "primary" and blk["standby"] == "rn1"
+        assert blk["acked_seq"] == blk["offered_seq"]
+        assert blk["lag_records"] == 0
+        assert blk["last_ack_age_s"] is not None
+        # standby side: the warm replica shows under ctl too
+        out1 = json.loads(nodes[1].ctl.run(["durability"]))
+        assert out1["replication"]["standby_for"]["rn0"][
+            "sessions"] >= 1
+        nodes[0].stats.tick()
+        assert nodes[0].metrics.val("durability.repl.shipped") > 0
+        assert nodes[0].metrics.val("durability.repl.acked") > 0
+        assert nodes[0].stats.all()[
+            "durability.repl.lag_records"] == 0
+        # wedge the standby and outrun the tiny lag bound → alarm
+        trs[0].fault_peers = {"rn1"}
+        trs[1].fault_peers = {"rn0"}
+        faults.set_master(True)
+        faults.arm("net.partition", times=0)
+        s2 = _durable_session(nodes[0], "lagger")
+        for i in range(6):
+            s2.subscribe(f"lag/{i}", SubOpts(qos=1))
+        nodes[0].durability.on_batch()
+        _wait(lambda: _repl(nodes[0]).state == "local_only",
+              msg="never degraded")
+        nodes[0].stats.tick()
+        assert any(a.name == "replication_lagging"
+                   for a in nodes[0].alarms.get_alarms("activated"))
+        # heal → resync → lag back under the clear bound → alarm off
+        faults.disarm("net.partition")
+        _wait(lambda: _synced(nodes[0]), timeout=30.0,
+              msg="never resynced")
+        nodes[0].stats.tick()
+        assert not any(
+            a.name == "replication_lagging"
+            for a in nodes[0].alarms.get_alarms("activated"))
+    finally:
+        faults.clear()
+        _teardown(nodes, trs, cls)
+
+
+def test_no_standby_config_builds_no_shipper(tmp_path):
+    """Replication is opt-in: without [durability] standby the
+    cluster attaches only the (inert) replica-hosting manager."""
+    nodes, trs, cls = _mk_pair(tmp_path, "rep-off",
+                               dur0_kw=dict(standby=""))
+    try:
+        assert _repl(nodes[0])._thread is None
+        assert nodes[0].durability.repl is None
+        _populate(nodes[0])
+        assert nodes[1].replication.replicas == {}
+        # a stray ship to a node with no replica answers resync, not
+        # an error
+        reply = cls[1].handle_rpc("repl_ship", "ghost", 1, [])
+        assert reply["resync"]
+    finally:
+        _teardown(nodes, trs, cls)
+
+
+def test_config_rejects_bad_repl_knobs():
+    with pytest.raises(ValueError):
+        DurabilityConfig(enabled=True, repl_lag_alarm_records=1,
+                         repl_lag_clear_records=2)
+    with pytest.raises(ValueError):
+        DurabilityConfig(enabled=True, repl_queue_max_records=0)
