@@ -30,6 +30,8 @@ import numpy as np
 
 from emqx_tpu import faults
 from emqx_tpu import topic as T
+from emqx_tpu.concurrency import (any_thread, executor_thread,
+                                  owner_loop, shared_state)
 from emqx_tpu.broker_helper import FanoutManager, unpack_sids
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
@@ -159,6 +161,8 @@ class PendingBatch:
         self.bovf = self.sel = self.rows_packed = None
 
 
+@shared_state(lock="_route_lock", attrs=("_subscribers",
+                                          "_subscriptions"))
 class Broker:
     def __init__(
         self,
@@ -234,6 +238,7 @@ class Broker:
 
     # -- subscribe / unsubscribe (emqx_broker.erl:127-196) ----------------
 
+    @any_thread
     def subscribe(self, sub: object, topic_filter: str,
                   opts: Optional[SubOpts] = None) -> SubOpts:
         """Subscribe ``sub`` to ``topic_filter`` (may carry a
@@ -266,6 +271,7 @@ class Broker:
                                     opts, resub)
         return opts
 
+    @any_thread
     def unsubscribe(self, sub: object, topic_filter: str) -> bool:
         flt, popts = T.parse(topic_filter)
         with self._route_lock:
@@ -296,6 +302,7 @@ class Broker:
                 d.journal_unsubscribe(sub, topic_filter, flt, dest)
         return True
 
+    @any_thread
     def subscriber_down(self, sub: object) -> None:
         """Drop all of a dead subscriber's subscriptions
         (emqx_broker.erl:331-348); unacked shared-group messages are
@@ -325,6 +332,7 @@ class Broker:
                 if n:
                     self.metrics.inc("messages.redispatched")
 
+    @any_thread
     def detach_subscriber(self, sub: object) -> None:
         """Remove a subscriber's table entries WITHOUT the death-path
         side effects (no shared redispatch): the session is being
@@ -334,6 +342,7 @@ class Broker:
                 self.unsubscribe(sub, key)
             self.shared.subscriber_down(sub)
 
+    @any_thread
     def restore_subscription(self, sub: object, topic_filter: str,
                              opts: Optional[SubOpts] = None) -> None:
         """Crash-recovery resubscribe (durability.py): rebuild the
@@ -426,6 +435,7 @@ class Broker:
         self.publish_fetch(pb)
         return self.publish_finish(pb)
 
+    @owner_loop
     def publish_begin(self, msgs: Sequence[Message],
                       defer_host: bool = False) -> PendingBatch:
         """Phase 1 — host pre-work + device dispatch, no sync.
@@ -661,6 +671,7 @@ class Broker:
             self.telemetry.finish(pb.span)
             pb.span = None
 
+    @executor_thread
     def publish_fetch(self, pb: PendingBatch) -> None:
         """Phase 2 — the blocking device→host transfer, coalesced.
 
@@ -704,6 +715,7 @@ class Broker:
                 # (docs/DURABILITY.md "one append per batch")
                 d.on_batch()
 
+    @executor_thread
     def _fetch_device(self, pb: PendingBatch) -> None:
         """The device fetch body — on packed-budget overflow re-packs
         with the next power-of-two bucket (the dispatched dense
@@ -898,6 +910,7 @@ class Broker:
                 pb.subs_packed = pb.src_packed = None
             return
 
+    @executor_thread
     def _build_plan(self, pb: PendingBatch, subs_packed, src_packed):
         """Build the batch's subscriber-grouped dispatch plan
         (ops/dispatch_plan.py) from the fetched packed arrays. Runs
@@ -922,6 +935,7 @@ class Broker:
         return build_plan(pb.inv, n_u, pb.ovf, pb.bovf, pb.f_ptr,
                           subs_packed, src_packed, big_map)
 
+    @owner_loop
     def publish_finish(self, pb: PendingBatch) -> List[int]:
         """Phase 3 — the host delivery tail over the packed results
         (must run where broker state is owned, i.e. the event loop)."""
@@ -941,6 +955,7 @@ class Broker:
         pb.done = True
         return pb.results
 
+    @owner_loop
     def _plan_prologue(self, pb: PendingBatch) -> None:
         """Per-batch routing pass before grouped delivery: classify
         every matched filter id ONCE (local / shared / remote —
@@ -1026,6 +1041,7 @@ class Broker:
             # sessions' batches while this loop walks its own groups
             self._post_xloop_handoffs(pb, ps)
 
+    @owner_loop
     def publish_finish_planned(self, pb: PendingBatch, gstart: int,
                                gstop: int) -> None:
         """Deliver subscriber groups ``[gstart, gstop)`` of a planned
@@ -1069,6 +1085,7 @@ class Broker:
             if folded:
                 self._span_finish(pb)
 
+    @owner_loop
     def _deliver_plan_group(self, pb: PendingBatch, ps: _PlanState,
                             g: int):
         """Deliver one plan group — one subscriber's whole batch:
@@ -1142,6 +1159,7 @@ class Broker:
                 log.exception("deliver to %r failed", sub)
         return delivered
 
+    @owner_loop
     def _plan_fold(self, pb: PendingBatch) -> None:
         """Fold the batch's per-(message, filter) delivery counts into
         metrics/hooks/results — the legacy walk's accounting, batched.
@@ -1214,6 +1232,7 @@ class Broker:
             xg.setdefault(idx, []).append(g)
         return xg
 
+    @owner_loop
     def _post_xloop_handoffs(self, pb: PendingBatch,
                              ps: _PlanState) -> None:
         """Post each owning loop its share of the plan — ONE
@@ -1249,6 +1268,7 @@ class Broker:
                 # — a cross-thread enqueue beats dropped messages
                 self._run_xloop_groups(pb, gids)
 
+    @owner_loop
     def _run_xloop_groups(self, pb: PendingBatch, gids) -> None:
         """One cross-loop handoff, running ON the owning loop: deliver
         this loop's subscriber groups (each session still gets its
@@ -1301,6 +1321,7 @@ class Broker:
             return None
         return ps.xloop_aev
 
+    @owner_loop
     def xloop_fold(self, pb: PendingBatch) -> None:
         """Join point once the handoffs completed: merge + fold +
         close the span. No-op when the batch had no handoffs, or the
@@ -1329,6 +1350,7 @@ class Broker:
                           self.XLOOP_JOIN_TIMEOUT)
         self.xloop_fold(pb)
 
+    @owner_loop
     def publish_host_chunk(self, pb: PendingBatch, start: int,
                            stop: int) -> None:
         """Deliver rows ``[start, stop)`` of a deferred HOST-path
@@ -1359,6 +1381,7 @@ class Broker:
             if stop >= len(pb.live):
                 self._span_finish(pb)
 
+    @owner_loop
     def publish_finish_chunk(self, pb: PendingBatch, start: int,
                              stop: int) -> None:
         """Deliver rows ``[start, stop)`` of a fetched batch — the

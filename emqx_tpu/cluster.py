@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from emqx_tpu.concurrency import (any_thread, bg_thread,
+                                  owner_loop, shared_state)
 from emqx_tpu.types import Message
 
 log = logging.getLogger("emqx_tpu.cluster")
@@ -179,6 +181,7 @@ class LocalTransport(Transport):
         return peer.handle_rpc(op, *args)
 
 
+@shared_state(lock="_lock", attrs=("members", "_registry"))
 class Cluster:
     """Per-node cluster agent: wires a Node's broker/router into the
     membership + replication + forwarding protocol."""
@@ -374,6 +377,7 @@ class Cluster:
         for m in unreachable:
             self.handle_nodedown(m)
 
+    @any_thread
     def _set_members(self, members: List[str]) -> None:
         with self._lock:
             self.members = list(members)
@@ -430,10 +434,12 @@ class Cluster:
                 self.transport.cast(m, "leaving", self.name)
             except ConnectionError:
                 pass
-        self.members = [self.name]
+        with self._lock:
+            self.members = [self.name]
         for m in ex:
             self._purge_node_routes(m)
 
+    @any_thread
     def handle_nodedown(self, name: str) -> None:
         """Purge a dead member's routes + registry entries
         (emqx_router_helper cleanup + emqx_cm_registry
@@ -462,11 +468,13 @@ class Cluster:
 
     # -- clientid registry + cross-node takeover (emqx_cm_registry) -------
 
+    @any_thread
     def client_up(self, client_id: str) -> None:
         with self._lock:
             self._registry[client_id] = self.name
         self._broadcast("client_up", client_id, self.name)
 
+    @any_thread
     def client_down(self, client_id: str) -> None:
         with self._lock:
             if self._registry.get(client_id) == self.name:
@@ -747,6 +755,7 @@ class Cluster:
             self._heal_thread.join(timeout=5)
             self._heal_thread = None
 
+    @bg_thread
     def _heal_main(self) -> None:
         interval = self.config.anti_entropy_interval_s or None
         while True:
@@ -829,6 +838,7 @@ class Cluster:
     #: planes where each entry has an authoritative owner node
     _OWNER_PLANES = ("routes", "registry", "weights")
 
+    @any_thread
     def anti_entropy_sync(self, peer: str) -> int:
         """Reconcile all five replicated planes with ``peer``; returns
         the number of entries repaired (pushed + pulled). One digest
@@ -1050,6 +1060,7 @@ class Cluster:
             repairs += int(n or 0)
         return repairs
 
+    @owner_loop
     def handle_rpc(self, op: str, *args):
         if op == "route_add":
             return self._apply_route("add", args[0], args[1])

@@ -1,0 +1,76 @@
+"""Thread/loop-affinity annotation vocabulary (docs/ANALYSIS.md).
+
+The multi-loop front door (PR 6), the ingress fetch executor, the WAL
+group commit and the replication shipper split this broker across
+four execution domains whose hand-offs are hand-enforced rules
+("route mutations serialize on the route lock", "peer-loop publishes
+funnel through the ingress accumulator", "Metrics increments take the
+armed lock off-loop"). The reference gets the equivalent guarantees
+from BEAM for free — a process's state is only ever touched by the
+process. Here the rules live in docstrings, which is exactly where
+drift starts.
+
+This module turns those rules into *zero-cost markers* the static
+gate (``scripts/lint.py``, rules CD101/CD102) can check:
+
+  - :func:`owner_loop` — runs ONLY on an event loop that owns the
+    touched state (the node's home loop, or a session's owning
+    front-door loop). Other domains must reach it through
+    ``call_soon_threadsafe`` / ``run_coroutine_threadsafe`` /
+    ``LoopGroup.post`` / the ingress accumulator — never by direct
+    call.
+  - :func:`executor_thread` — runs on the ingress fetch executor
+    (``ThreadPoolExecutor``): the device transfer, plan build,
+    pre-serialization, journal flush.
+  - :func:`bg_thread` — runs on a dedicated background thread
+    (compaction flatten, replication shipper, cluster heal worker,
+    peer front-door loop bootstrap).
+  - :func:`any_thread` — thread-safe by construction (owns a lock, or
+    touches only immutable/atomic state); callable from anywhere.
+
+Each decorator only sets ``__thread_domain__`` on the function — no
+wrapper, no call-time cost — so annotating a hot seam is free.
+
+:func:`shared_state` registers a class's cross-thread attributes with
+the lock that guards them; the CD102 analyzer then flags any mutation
+of a registered attribute outside a ``with <lock>`` block (deliberate
+lock-free fast paths carry an inline ``# lint: ok-CD102 <why>``
+waiver). It, too, only stamps ``__shared_state__`` on the class.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+#: the closed domain vocabulary, in "how restricted" order
+DOMAINS = ("loop", "executor", "bg", "any")
+
+
+def _mark(domain: str) -> Callable[[F], F]:
+    def deco(fn: F) -> F:
+        fn.__thread_domain__ = domain
+        return fn
+    return deco
+
+
+#: loop-affine: callable only on the owning event loop's thread
+owner_loop = _mark("loop")
+#: runs on the ingress fetch executor pool
+executor_thread = _mark("executor")
+#: runs on a dedicated background thread
+bg_thread = _mark("bg")
+#: thread-safe; callable from any domain
+any_thread = _mark("any")
+
+
+def shared_state(lock: str, attrs: Tuple[str, ...]):
+    """Class decorator: declare that ``attrs`` are mutated from more
+    than one thread and every mutation must hold ``self.<lock>``
+    (a ``threading.Lock``/``RLock``/``Condition`` attribute name).
+    Zero-cost: stamps ``__shared_state__`` for the CD102 analyzer."""
+    def deco(cls):
+        cls.__shared_state__ = (lock, tuple(attrs))
+        return cls
+    return deco

@@ -30,6 +30,18 @@ from emqx_tpu.zone import Zone, get_zone
 
 log = logging.getLogger("emqx_tpu.connection")
 
+#: strong references to fire-and-forget tasks (accepted sockets,
+#: close-bounding flushes): the event loop keeps only a WEAK
+#: reference to a task, so a dropped handle can be garbage-collected
+#: mid-run and its connection silently vanish (lint rule CD104)
+_BG_TASKS: set = set()
+
+
+def _retain_task(task: "asyncio.Task") -> "asyncio.Task":
+    _BG_TASKS.add(task)
+    task.add_done_callback(_BG_TASKS.discard)
+    return task
+
 
 class Connection:
     """One client socket <-> one Channel."""
@@ -283,7 +295,7 @@ class Connection:
         if self.zone.send_timeout > 0 and self._loop is not None:
             coro = self._ensure_closed(self.zone.send_timeout)
             try:
-                self._loop.create_task(coro)
+                _retain_task(self._loop.create_task(coro))
             except RuntimeError:
                 # serving loop already closed (a dead front-door
                 # loop's connection unwinding at GC): nothing left
@@ -812,7 +824,8 @@ class Listener:
             rr += 1
             target = lg.loops[idx]
             if target is loop:
-                loop.create_task(self._serve_sock(sock, idx))
+                _retain_task(
+                    loop.create_task(self._serve_sock(sock, idx)))
             else:
                 try:
                     target.call_soon_threadsafe(
@@ -822,8 +835,8 @@ class Listener:
 
     def _spawn_on_loop(self, sock, idx: int) -> None:
         # runs as a callback ON the owning loop
-        asyncio.get_running_loop().create_task(
-            self._serve_sock(sock, idx))
+        _retain_task(asyncio.get_running_loop().create_task(
+            self._serve_sock(sock, idx)))
 
     async def _serve_sock(self, sock, idx: int) -> None:
         """Wrap a dispatched socket in streams on THIS loop and run

@@ -58,6 +58,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from emqx_tpu import checkpoint
+from emqx_tpu.concurrency import (any_thread, executor_thread,
+                                  owner_loop, shared_state)
 from emqx_tpu import topic as T
 from emqx_tpu.wal import WalGroup, replay as wal_replay
 
@@ -168,6 +170,9 @@ def journal_key(op: tuple) -> str:
     return f"s|{op[1]}"
 
 
+@shared_state(lock="_mark_lock",
+              attrs=("_pending_ops", "_delta_routes",
+                     "_delta_retained", "_delta_sessions"))
 class DurabilityManager:
     def __init__(self, node, cfg: DurabilityConfig) -> None:
         self.node = node
@@ -264,6 +269,7 @@ class DurabilityManager:
 
     # -- journal append side (called from broker/cm/channel/retainer) -----
 
+    @any_thread
     def _append(self, op: tuple) -> None:
         if self._replaying:
             return
@@ -289,15 +295,23 @@ class DurabilityManager:
 
     def _note_delta(self, op: tuple) -> None:
         """Track the key this record touches for the next incremental
-        checkpoint (set.add — cheap enough for the journal path)."""
+        checkpoint (set.add — cheap enough for the journal path).
+        MUST be called with ``_mark_lock`` held (today: only from
+        ``_append``) — the dirty mark must be ordered against
+        ``checkpoint_now``'s set swap, see the comment there."""
         kind = op[0]
         if kind == "route":
+            # lint: ok-CD102 caller holds _mark_lock (_append); see
+            # the docstring's ordering contract
             self._delta_routes.add((op[1], op[2]))
         elif kind == "retain":
+            # lint: ok-CD102 caller holds _mark_lock, as above
             self._delta_retained.add(op[1])
         else:  # sess.* — keyed by client-id
+            # lint: ok-CD102 caller holds _mark_lock, as above
             self._delta_sessions.add(op[1])
 
+    @any_thread
     def journal_subscribe(self, sub, topic_filter: str, flt: str,
                           dest, opts, resub: bool) -> None:
         if self._replaying:
@@ -309,6 +323,7 @@ class DurabilityManager:
             self._append(("sess.sub", sub.client_id, topic_filter,
                           opts))
 
+    @any_thread
     def journal_unsubscribe(self, sub, topic_filter: str, flt: str,
                             dest) -> None:
         if self._replaying:
@@ -318,6 +333,7 @@ class DurabilityManager:
         if getattr(sub, "durable", False):
             self._append(("sess.unsub", sub.client_id, topic_filter))
 
+    @any_thread
     def journal_retain(self, topic: str, msg,
                        ts: Optional[float] = None) -> None:
         if self._replaying:
@@ -382,6 +398,7 @@ class DurabilityManager:
 
     # -- flush side (executor thread / timer) -----------------------------
 
+    @executor_thread
     def _flush_states(self) -> None:
         while self._dirty:
             try:
@@ -393,6 +410,7 @@ class DurabilityManager:
             self._append_state(
                 sess, self._detach_ts.get(sess.client_id))
 
+    @executor_thread
     def on_batch(self) -> None:
         """The per-publish-batch hook (Broker.publish_fetch, executor
         thread) and the timer body: coalesce dirty session states,
@@ -455,6 +473,7 @@ class DurabilityManager:
                 "sessions": sessions, "retained": retained,
                 "tombstones": tombstones}
 
+    @any_thread
     def checkpoint_now(self, clean_shutdown: bool = False,
                        full: Optional[bool] = None) -> dict:
         """One atomic generation: rotate the journal (swapping the
@@ -662,6 +681,7 @@ class DurabilityManager:
 
     # -- recovery ---------------------------------------------------------
 
+    @owner_loop
     def recover(self) -> dict:
         """Boot-time restore: newest intact checkpoint + journal tail
         replay + session resurrection + orphan-route pruning, then a
@@ -763,6 +783,8 @@ class DurabilityManager:
             group_window_ms=self.cfg.group_commit_window_ms)
         for op in self._pending_ops:
             self.wal.append(op, journal_key(op))
+        # lint: ok-CD102 boot-time recovery runs before any listener
+        # or executor exists — the manager is still single-threaded
         self._pending_ops = []
         self.wal.flush()
         ck = self.checkpoint_now()
@@ -955,6 +977,7 @@ class DurabilityManager:
 
     # -- lifecycle / observability ---------------------------------------
 
+    @owner_loop
     async def run(self) -> None:
         """Background flush + checkpoint cadence. Disk work runs on
         the default executor — the event loop never waits on fsync."""
@@ -1005,6 +1028,7 @@ class DurabilityManager:
                message: str = "") -> None:
         self._events.append((kind, name, details or {}, message))
 
+    @owner_loop
     def drain_events(self, alarms) -> None:
         """Apply thread-recorded alarm transitions (stats tick, main
         loop)."""
@@ -1018,6 +1042,7 @@ class DurabilityManager:
             else:
                 alarms.deactivate(name)
 
+    @owner_loop
     def fold_metrics(self, metrics) -> None:
         """Fold counter DELTAS into the node metrics (stats tick) —
         the journal's own counters are written from the executor

@@ -48,6 +48,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from emqx_tpu.concurrency import any_thread, shared_state
+
 log = logging.getLogger("emqx_tpu.faults")
 
 #: module-level fast gate read by every injection site. True only
@@ -161,6 +163,7 @@ class _Arm:
         self.fired = 0
 
 
+@shared_state(lock="_lock", attrs=("_arms",))
 class FaultRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -219,6 +222,7 @@ class FaultRegistry:
         with self._lock:
             self._rng = random.Random(n)
 
+    @any_thread
     def check(self, point: str) -> Optional[_Arm]:
         """One trigger decision for ``point``: None = not armed / RNG
         spared it; otherwise the arm (``times`` accounting applied,
@@ -263,6 +267,7 @@ class FaultRegistry:
 _registry = FaultRegistry()
 
 
+@any_thread
 def fire(point: str) -> bool:
     """Run ``point``'s armed effect, if any. Raises
     :class:`FaultInjected` for ``raise`` arms; sleeps then returns

@@ -47,11 +47,14 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from emqx_tpu import faults
+from emqx_tpu.concurrency import (any_thread, owner_loop,
+                                  shared_state)
 from emqx_tpu.types import Message
 
 log = logging.getLogger("emqx_tpu.ingress")
 
 
+@shared_state(lock="_plock", attrs=("_pending",))
 class IngressBatcher:
     def __init__(self, broker, batch_size: int = 256,
                  linger_ms: float = 0.0, max_inflight: int = 4,
@@ -134,6 +137,7 @@ class IngressBatcher:
                 thread_name_prefix="ingress-fetch")
         return self._pool
 
+    @any_thread
     def submit(self, msg: Message, want_result: bool = True):
         """Queue one message. With ``want_result`` the returned future
         resolves to the delivery count at flush; without (QoS0 — no
@@ -153,10 +157,15 @@ class IngressBatcher:
         if loop is None:
             return None
         fut = loop.create_future() if want_result else None
+        # lint: ok-CD102 single-loop mode: _plock is None and every
+        # submit runs on the node's one event loop (the multi-loop
+        # build takes _submit_threadsafe above instead)
         self._pending.append((msg, fut))
         self.submitted += 1
         self.max_queue = max(self.max_queue, len(self._pending))
         if len(self._pending) >= self.batch_size:
+            # lint: ok-CD101 single-loop mode: this thread IS the
+            # home loop, the direct flush is the legacy fast path
             self._flush()
         elif len(self._pending) == 1:
             if self.linger_ms > 0:
@@ -166,6 +175,7 @@ class IngressBatcher:
                 self._handle = loop.call_soon(self._flush)
         return fut if fut is not None else self._DONE
 
+    @any_thread
     def _submit_threadsafe(self, msg: Message, want_result: bool,
                            loop):
         """Multi-loop submit: append under the lock; flush decisions
@@ -184,6 +194,8 @@ class IngressBatcher:
         home = self._home or loop
         if loop is home:
             if n >= self.batch_size:
+                # lint: ok-CD101 guarded by `loop is home`: this
+                # submit is already running on the home loop
                 self._flush()
             elif n == 1:
                 if self.linger_ms > 0:
@@ -198,6 +210,7 @@ class IngressBatcher:
                 pass  # home loop gone (shutdown race)
         return fut if fut is not None else self._DONE
 
+    @owner_loop
     def _remote_kick(self) -> None:
         """A peer-loop submit's flush request, now ON the home loop:
         the kick itself IS the next-tick callback, so an un-lingered
@@ -216,6 +229,7 @@ class IngressBatcher:
         else:
             self._flush()
 
+    @owner_loop
     def _take_pending(self, cap: int = 0):
         """Shared flush prologue: cancel the linger timer, take up to
         ``cap`` messages (0 = all) off the accumulator, bump the
@@ -236,8 +250,11 @@ class IngressBatcher:
                     pending, self._pending = self._pending, []
         elif cap and len(self._pending) > cap:
             pending = self._pending[:cap]
+            # lint: ok-CD102 single-loop mode (_plock None): flush
+            # and submit both run on the one event loop
             del self._pending[:cap]
         else:
+            # lint: ok-CD102 single-loop mode (_plock None), as above
             pending, self._pending = self._pending, []
         if pending:
             self.flushes += 1
@@ -329,6 +346,7 @@ class IngressBatcher:
                     except RuntimeError:
                         pass
 
+    @owner_loop
     def _flush(self) -> None:
         # a capped take can leave a backlog: keep flushing chunks
         # while pipeline slots are free
@@ -359,6 +377,7 @@ class IngressBatcher:
             task = loop.create_task(self._complete(pb, pending, prev))
             self._chain = task
 
+    @owner_loop
     async def _complete(self, pb, pending, prev) -> None:
         """Fetch off-loop, then deliver in batch order."""
         loop = asyncio.get_running_loop()
@@ -483,6 +502,7 @@ class IngressBatcher:
                 fut.set_exception(e)
 
     @staticmethod
+    @any_thread
     def _set_future(fut, value, exc) -> None:
         """Resolve a submit future on ITS loop (multi-loop: peer-loop
         futures must not be completed from the home thread — the ack

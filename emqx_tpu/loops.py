@@ -43,7 +43,14 @@ import threading
 import time
 from typing import List, Optional, Set
 
+from emqx_tpu.concurrency import any_thread, bg_thread, owner_loop
+
 log = logging.getLogger("emqx_tpu.loops")
+
+#: strong references to in-flight shutdown drains: the loop holds
+#: only a weak reference to a task (lint rule CD104), and the drain
+#: must survive until it stops its own loop
+_DRAIN_TASKS: Set = set()
 
 
 class LoopGroup:
@@ -70,6 +77,7 @@ class LoopGroup:
         """The node's main loop (index 0)."""
         return self.loops[0] if self.loops else None
 
+    @owner_loop
     def start(self, main_loop: asyncio.AbstractEventLoop) -> None:
         if self._started:
             return
@@ -95,6 +103,7 @@ class LoopGroup:
         log.info("front door sharded over %d event loops", self.n)
 
     @staticmethod
+    @bg_thread
     def _run_loop(loop: asyncio.AbstractEventLoop,
                   ready: threading.Event) -> None:
         asyncio.set_event_loop(loop)
@@ -107,6 +116,7 @@ class LoopGroup:
             except Exception:
                 pass
 
+    @owner_loop
     def stop(self, timeout: float = 10.0) -> None:
         """Cancel every peer loop's tasks, stop the loops, join the
         threads. The main loop (index 0) is the caller's — untouched."""
@@ -135,7 +145,9 @@ class LoopGroup:
                 await asyncio.gather(*tasks, return_exceptions=True)
             loop.stop()
 
-        loop.create_task(_drain())
+        t = loop.create_task(_drain())
+        _DRAIN_TASKS.add(t)
+        t.add_done_callback(_DRAIN_TASKS.discard)
 
     # -- addressing --------------------------------------------------------
 
@@ -150,6 +162,7 @@ class LoopGroup:
     def on_home_thread(self) -> bool:
         return threading.get_ident() == self._home_tid
 
+    @any_thread
     def post(self, idx: int, cb, *args) -> None:
         """Schedule ``cb(*args)`` on loop ``idx`` (thread-safe).
         Raises ``RuntimeError`` if that loop is closed or marked dead
@@ -184,6 +197,7 @@ class LoopGroup:
                 if i not in self._dead
                 and not self._threads[i - 1].is_alive()]
 
+    @owner_loop
     def mark_dead(self, idx: int) -> None:
         """Route around a dead loop: its sessions map home
         (``index_of`` → 0), future posts to it raise."""

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from emqx_tpu.concurrency import any_thread, owner_loop, shared_state
+
 MAX_METRICS = 1024
 
 # Pre-registered names (counter kind), reference emqx_metrics.erl:82-183
@@ -244,6 +246,7 @@ GAUGE_METRICS = frozenset({
 })
 
 
+@shared_state(lock="_lock", attrs=("_counters",))
 class Metrics:
     def __init__(self) -> None:
         # a plain list, not numpy: scalar element updates are the
@@ -277,17 +280,23 @@ class Metrics:
             self._index[name] = idx
         return idx
 
+    @any_thread
     def inc(self, name: str, n: int = 1) -> None:
         lock = self._lock
         if lock is None:
+            # lint: ok-CD102 single-writer fast path: the lock stays
+            # None until Node.start arms multi-loop mode, and until
+            # then every increment runs on the one event loop
             self._counters[self._index[name]] += n
         else:
             with lock:
                 self._counters[self._index[name]] += n
 
+    @any_thread
     def dec(self, name: str, n: int = 1) -> None:
         lock = self._lock
         if lock is None:
+            # lint: ok-CD102 single-writer fast path, as in inc()
             self._counters[self._index[name]] -= n
         else:
             with lock:
@@ -311,6 +320,7 @@ class Metrics:
         self.inc("messages.sent")
         self.inc(_QOS_SENT[min(msg.qos, 2)])
 
+    @owner_loop
     def fold_device_stats(self, stats: Dict[str, int]) -> None:
         """Fold a drained device accumulator (matches/deliveries/
         overflows) into the host counters — one transfer per flush."""
@@ -329,6 +339,7 @@ class Metrics:
         for key, val in stats.items():
             self.inc(f"automaton.{key}", int(val))
 
+    @owner_loop
     def fold_cluster_stats(self, stats: Dict[str, int]) -> None:
         """Fold drained cluster-plane event counters
         (Cluster.drain_counters). Keys outside CLUSTER_METRICS are

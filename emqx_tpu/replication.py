@@ -63,6 +63,9 @@ from typing import Dict, List, Optional, Tuple
 
 from emqx_tpu import faults as _faults
 from emqx_tpu import topic as T
+from emqx_tpu.concurrency import (any_thread, bg_thread,
+                                  executor_thread, owner_loop,
+                                  shared_state)
 
 log = logging.getLogger("emqx_tpu.replication")
 
@@ -71,6 +74,8 @@ log = logging.getLogger("emqx_tpu.replication")
 SHIP_BATCH_RECORDS = 2048
 
 
+@shared_state(lock="lock", attrs=("sessions", "retained",
+                                 "tombs", "routes"))
 class StandbyReplica:
     """Warm detached replica of one primary's durable state."""
 
@@ -98,10 +103,13 @@ class StandbyReplica:
             self.clean = False
             self.promoted = False
 
-    def apply(self, rec: tuple) -> None:
+    @any_thread
+    def _apply_locked(self, rec: tuple) -> None:
         """One journal record into the warm state — the replica-side
         mirror of ``DurabilityManager._apply`` (absolute refcounts,
-        LWW retained, full-state session overwrites)."""
+        LWW retained, full-state session overwrites). The ``_locked``
+        suffix is the CD102 convention: the caller holds
+        ``self.lock`` (apply_batch, handle_hello, _promote)."""
         op = rec[0]
         if op == "route":
             _, flt, dest, refs = rec
@@ -137,6 +145,7 @@ class StandbyReplica:
         else:
             raise ValueError(f"unknown replicated record {op!r}")
 
+    @any_thread
     def apply_batch(self, seq0: int, records: list) -> dict:
         with self.lock:
             if seq0 != self.applied_seq + 1:
@@ -145,7 +154,7 @@ class StandbyReplica:
                 return {"resync": True, "applied": self.applied_seq}
             for rec in records:
                 try:
-                    self.apply(tuple(rec))
+                    self._apply_locked(tuple(rec))
                 except Exception:
                     log.warning("skipping malformed shipped record "
                                 "%r", rec[:1] if rec else rec)
@@ -171,6 +180,7 @@ class StandbyReplica:
             }
 
 
+@shared_state(lock="_q_lock", attrs=("_q",))
 class ReplicationManager:
     """Per-node replication agent: the shipper half (when this node
     is a primary with a configured standby) plus any standby replicas
@@ -241,6 +251,7 @@ class ReplicationManager:
 
     # -- primary side ------------------------------------------------------
 
+    @any_thread
     def offer(self, op: tuple) -> None:
         """Queue one journal record for shipping (called from
         DurabilityManager._append, any thread). Bounded: overflow
@@ -261,6 +272,7 @@ class ReplicationManager:
             self._q.append((self.offered_seq, size, op))
             self._q_bytes += size
 
+    @executor_thread
     def notify_flush(self) -> None:
         """The local group commit landed: everything offered so far
         is durable and may ship (called from on_batch, executor
@@ -269,6 +281,7 @@ class ReplicationManager:
             self._flushed_seq = self.offered_seq
         self._flush_evt.set()
 
+    @bg_thread
     def _ship_main(self) -> None:
         while not self._stopping:
             fired = self._flush_evt.wait(timeout=1.0)
@@ -286,6 +299,7 @@ class ReplicationManager:
         return tr.peer_state(self.standby) == "ok" \
             and self.standby in getattr(tr, "_peers", {self.standby})
 
+    @bg_thread
     def _ship_pass(self) -> None:
         """Ship everything durable and pending, bounded per call.
         Suspect-aware: a standby the failure detector holds unhealthy
@@ -312,6 +326,7 @@ class ReplicationManager:
                 if not self._ship_batch(batch):
                     return
 
+    @bg_thread
     def _hello(self) -> bool:
         """Full resync: snapshot the primary's durable planes and
         hand the replica a fresh baseline + the next stream seq."""
@@ -344,6 +359,7 @@ class ReplicationManager:
                  len(snapshot["sessions"]), len(snapshot["routes"]))
         return True
 
+    @bg_thread
     def _ship_batch(self, batch: List[tuple]) -> bool:
         seq0 = batch[0][0]
         records = [op for _s, _b, op in batch]
@@ -379,6 +395,7 @@ class ReplicationManager:
 
     last_ack_ts: Optional[float] = None
 
+    @any_thread
     def ship_sync(self, timeout: float) -> bool:
         """Drain + ship the tail synchronously (graceful shutdown's
         bounded hand-off). True when the standby acked everything."""
@@ -579,6 +596,7 @@ class ReplicationManager:
 
     # -- observability -----------------------------------------------------
 
+    @owner_loop
     def fold(self, metrics, alarms, stats) -> None:
         """Stats-tick fold: counter deltas, lag gauges, and the
         ``replication_lagging`` alarm with hysteresis. Runs on the

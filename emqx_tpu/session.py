@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from emqx_tpu import topic as T
+from emqx_tpu.concurrency import owner_loop
 from emqx_tpu.inflight import Inflight
 from emqx_tpu.mqueue import MQueue
 from emqx_tpu.types import Message, QOS_0, QOS_2, SubOpts
@@ -247,6 +248,7 @@ class Session:
 
     # -- inbound PUBLISH (client -> broker) -------------------------------
 
+    @owner_loop
     def publish(self, packet_id: Optional[int], msg: Message) -> int:
         """Returns the delivery count from the broker."""
         if msg.qos == QOS_2:
@@ -270,6 +272,7 @@ class Session:
         self.awaiting_rel[packet_id] = time.time()
         self._mark_dirty()
 
+    @owner_loop
     def pubrel(self, packet_id: int) -> None:
         if packet_id not in self.awaiting_rel:
             raise SessionError(RC_PACKET_IDENTIFIER_NOT_FOUND)
@@ -278,6 +281,7 @@ class Session:
 
     # -- outbound acks (client acks our deliveries) -----------------------
 
+    @owner_loop
     def puback(self, packet_id: int) -> Message:
         val = self.inflight.lookup(packet_id)
         if val is None:
@@ -301,6 +305,7 @@ class Session:
             self.dequeue()
             self._mark_dirty()
 
+    @owner_loop
     def pubrec(self, packet_id: int) -> Message:
         val = self.inflight.lookup(packet_id)
         if val is None:
@@ -312,6 +317,7 @@ class Session:
         self._mark_dirty()
         return msg
 
+    @owner_loop
     def pubcomp(self, packet_id: int) -> None:
         val = self.inflight.lookup(packet_id)
         if val is None:
@@ -334,6 +340,7 @@ class Session:
         if d is not None:
             d.mark_dirty(self)
 
+    @owner_loop
     def deliver(self, topic_filter: str, msg: Message) -> None:
         """Broker subscriber protocol: enrich, window, queue."""
         m = self._enrich(topic_filter, msg)
@@ -350,6 +357,7 @@ class Session:
         if self.outbox and self.notify is not None:
             self.notify()
 
+    @owner_loop
     def deliver_many(self, items: Iterable[tuple]) -> None:
         """Batched broker→client delivery — the dispatch planner's
         grouped enqueue (docs/DISPATCH.md). Each item is
@@ -452,6 +460,7 @@ class Session:
             pid, (msg, time.time() if now is None else now))
         self.outbox.append((pid, msg))
 
+    @owner_loop
     def enqueue(self, msg: Message) -> None:
         if msg.qos == QOS_0 and self.broker is not None:
             ov = getattr(self.broker, "overload", None)
@@ -471,6 +480,7 @@ class Session:
             else:
                 self.broker.metrics.inc("delivery.dropped.queue_full")
 
+    @owner_loop
     def dequeue(self) -> None:
         """Move queued messages into the freed inflight window
         (emqx_session:dequeue/1 :389-409)."""
@@ -497,6 +507,7 @@ class Session:
 
     # -- timers -----------------------------------------------------------
 
+    @owner_loop
     def retry(self, now: Optional[float] = None) -> float:
         """Re-send timed-out inflight entries (dup=true) / pubrels.
         Returns the next retry delay in seconds."""
@@ -537,6 +548,7 @@ class Session:
 
     # -- takeover / resume / replay (emqx_session:606-629) ----------------
 
+    @owner_loop
     def take_shared_pending(self) -> List[Tuple[str, str, Message, bool]]:
         """Drain unacked/queued shared-group messages for redispatch
         when this session terminates: [(group, topic, original_msg,
@@ -586,6 +598,7 @@ class Session:
             broker.metrics.inc("session.resumed")
             broker.hooks.run("session.resumed", (self.client_id, self.info()))
 
+    @owner_loop
     def replay(self) -> None:
         """Re-emit all inflight entries (dup) then drain the queue."""
         for pid, (msg, _ts) in self.inflight.to_list(
@@ -597,6 +610,7 @@ class Session:
                 self.outbox.append((pid, msg))
         self.dequeue()
 
+    @owner_loop
     def drain_outbox(self) -> List[Tuple[Any, Any]]:
         out, self.outbox = self.outbox, []
         return out

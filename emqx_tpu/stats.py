@@ -47,6 +47,15 @@ STATS_KEYS = [
     # ``cluster.member.<name>.state`` / ``.rtt_ms`` dynamically.
     "cluster.members.count",
     "cluster.member.state", "cluster.hb.rtt_ms",
+    # overload protection (docs/ROBUSTNESS.md): monitor level (0 ok /
+    # 1 warn / 2 critical) and device-path breaker state (0 closed /
+    # 1 half-open / 2 open) — surfaced by lint rule RD204: they were
+    # set dynamically and invisible to registry-built dashboards
+    "overload.level", "breaker.state",
+    # replicated durability (docs/DURABILITY.md): journal-ship lag
+    # and ack age on a replicating primary
+    "durability.repl.lag_records", "durability.repl.lag_bytes",
+    "durability.repl.last_ack_age_s",
 ]
 
 

@@ -73,6 +73,7 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from emqx_tpu import faults, wire
+from emqx_tpu.concurrency import any_thread, shared_state
 
 log = logging.getLogger("emqx_tpu.wal")
 
@@ -152,6 +153,7 @@ def replay(path: str) -> Tuple[List[Tuple[Any, ...]], bool]:
     return records, torn
 
 
+@shared_state(lock="_lock", attrs=("_buf",))
 class Wal:
     """Appender half of the journal: one open segment file, an
     in-memory frame buffer, batched write+fsync, rotation, and the
@@ -191,6 +193,7 @@ class Wal:
 
     # -- append side ------------------------------------------------------
 
+    @any_thread
     def append(self, op: Tuple[Any, ...]) -> None:
         """Frame + buffer one record (no I/O here — the hot path pays
         serialization only; disk happens in :meth:`flush`)."""
@@ -209,6 +212,7 @@ class Wal:
 
     # -- flush side -------------------------------------------------------
 
+    @any_thread
     def flush(self) -> bool:
         """Write + fsync everything buffered (ONE sync for the whole
         batch). Returns True when the buffer reached disk; False when
@@ -343,6 +347,8 @@ def shard_of(key: str, n: int) -> int:
     return binascii.crc32(key.encode("utf-8", "surrogatepass")) % n
 
 
+@shared_state(lock="_cv", attrs=("_req", "_done", "_leader",
+                                 "_last_ok"))
 class WalGroup:
     """``n`` per-loop WAL shards behind one appender/flush surface,
     with leader-based batched group commit.
@@ -392,6 +398,7 @@ class WalGroup:
 
     # -- shard routing -----------------------------------------------------
 
+    @any_thread
     def append(self, op: Tuple[Any, ...],
                key: Optional[str] = None) -> None:
         """Frame + buffer one record into its key's shard (no I/O).
@@ -411,6 +418,7 @@ class WalGroup:
 
     # -- group-commit flush ------------------------------------------------
 
+    @any_thread
     def flush(self) -> bool:
         """Group commit: everything buffered across all shards at the
         time of the call reaches disk before this returns (or the

@@ -1,0 +1,201 @@
+"""Generic Python smells — the original ``scripts/lint.py`` checks,
+carried over into the package (docs/ANALYSIS.md "Core rules").
+
+F401 here handles the two blind spots the single-file linter had:
+imports that are *used only in string annotations* (``x: "Router"``)
+no longer count as unused, and imports living *inside* ``if
+TYPE_CHECKING:`` blocks are now checked at all (previously they were
+invisible to the top-level scan, so a dead typing import could rot
+there forever).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from analysis import FileInfo, Finding
+
+RULES = {
+    "F401": "module-level import never used in the file",
+    "F811": "duplicate def/class name in one scope",
+    "B006": "mutable default argument",
+    "E722": "bare except:",
+    "E711": "comparison to None with ==/!=",
+    "F631": "assert on a non-empty tuple (always true)",
+}
+
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp)
+
+
+def _annotation_names(tree: ast.AST) -> Set[str]:
+    """Names referenced from STRING annotations (``x: "Router"``,
+    ``def f() -> "Node": ...``) — parsed so the F401 pass sees them
+    as uses, exactly like unquoted annotations."""
+    used: Set[str] = set()
+
+    def _harvest(node) -> None:
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                        str):
+            try:
+                sub = ast.parse(node.value, mode="eval")
+            except SyntaxError:
+                return
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name):
+                    used.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    cur = n
+                    while isinstance(cur, ast.Attribute):
+                        cur = cur.value
+                    if isinstance(cur, ast.Name):
+                        used.add(cur.id)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.AnnAssign, ast.arg)) and \
+                node.annotation is not None:
+            _harvest(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.returns is not None:
+            _harvest(node.returns)
+    return used
+
+
+def _names_loaded(tree: ast.AST) -> Set[str]:
+    used: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            cur = node
+            while isinstance(cur, ast.Attribute):
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                used.add(cur.id)
+    # pytest fixtures are *requested* by parameter name — an import
+    # that only appears as a function argument is used
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                used.add(arg.arg)
+    # __all__ re-exports count as uses
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, str):
+                            used.add(elt.value)
+    used |= _annotation_names(tree)
+    return used
+
+
+def _is_type_checking(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") \
+        or (isinstance(test, ast.Attribute)
+            and test.attr == "TYPE_CHECKING")
+
+
+def _import_stmts(tree: ast.Module):
+    """Module-level import statements, including those nested one
+    level down in ``if TYPE_CHECKING:`` blocks."""
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+        elif isinstance(node, ast.If) and _is_type_checking(node.test):
+            for sub in node.body:
+                if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    yield sub
+
+
+def _check_imports(fi: FileInfo, out: List[Finding]) -> None:
+    used = _names_loaded(fi.tree)
+    for node in _import_stmts(fi.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                if name not in used and a.name != "__future__":
+                    out.append(Finding(fi.path, node.lineno, "F401",
+                                       f"unused import '{a.name}'"))
+        else:
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                name = a.asname or a.name
+                if name != "*" and name not in used:
+                    out.append(Finding(fi.path, node.lineno, "F401",
+                                       f"unused import '{name}'"))
+
+
+def check(fi: FileInfo, ctx) -> List[Finding]:
+    out: List[Finding] = []
+    _check_imports(fi, out)
+    path = fi.path
+
+    class V(ast.NodeVisitor):
+        def _scope(self, body, where):
+            seen = {}
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    # decorated redefinition (property setters,
+                    # overloads, dispatch) is deliberate
+                    if node.name in seen and not node.decorator_list:
+                        out.append(Finding(
+                            path, node.lineno, "F811",
+                            f"redefinition of '{node.name}' in "
+                            f"{where}"))
+                    seen[node.name] = node.lineno
+
+        def visit_Module(self, node):
+            self._scope(node.body, "module")
+            self.generic_visit(node)
+
+        def visit_ClassDef(self, node):
+            self._scope(node.body, f"class {node.name}")
+            self.generic_visit(node)
+
+        def _defaults(self, node):
+            for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                if isinstance(d, _MUTABLE):
+                    out.append(Finding(path, d.lineno, "B006",
+                                       "mutable default argument"))
+
+        def visit_FunctionDef(self, node):
+            self._defaults(node)
+            self.generic_visit(node)
+
+        def visit_AsyncFunctionDef(self, node):
+            self._defaults(node)
+            self.generic_visit(node)
+
+        def visit_ExceptHandler(self, node):
+            if node.type is None:
+                out.append(Finding(path, node.lineno, "E722",
+                                   "bare except"))
+            self.generic_visit(node)
+
+        def visit_Compare(self, node):
+            for op, cmp_ in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and \
+                        isinstance(cmp_, ast.Constant) and \
+                        cmp_.value is None:
+                    out.append(Finding(
+                        path, node.lineno, "E711",
+                        "comparison to None with ==/!="))
+            self.generic_visit(node)
+
+        def visit_Assert(self, node):
+            if isinstance(node.test, ast.Tuple) and node.test.elts:
+                out.append(Finding(path, node.lineno, "F631",
+                                   "assert on tuple is always true"))
+            self.generic_visit(node)
+
+    V().visit(fi.tree)
+    return out

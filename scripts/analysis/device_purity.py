@@ -1,0 +1,103 @@
+"""DP301 — host-sync constructs inside ``emqx_tpu/ops/``.
+
+The publish pipeline's one synchronizing point is the coalesced
+device→host transfer in ``publish_fetch`` (docs/OBSERVABILITY.md:
+"the instrumentation adds no device synchronization"). A stray
+``.item()`` / ``block_until_ready()`` / ``float(jnp...)`` deep in a
+kernel module re-introduces a hidden device round-trip per call —
+the exact class of regression that took the round-3 dispatch from
+3.2M to device-stall throughput and is invisible in CPU-backend
+tests (host arrays sync for free).
+
+  DP301  in ``emqx_tpu/ops/``: ``.item()``, ``.block_until_ready()``,
+         ``jax.device_get(...)``, ``jax.block_until_ready(...)``, or
+         ``float()/int()/bool()`` wrapping an expression rooted at
+         ``jnp``/``jax`` — outside a whitelisted fetch seam
+         (``ctx.device_whitelist`` function names) or an inline
+         ``# lint: ok-DP301 <why>`` waiver.
+
+Numpy-side conversions (``int(counts.sum())`` over fetched host
+arrays) are untouched: only expressions that *visibly* reach through
+``jnp``/``jax`` are judged, so the rule stays quiet on the host-side
+planner passes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from analysis import FileInfo, Finding
+
+RULES = {
+    "DP301": "host-sync construct in ops/ outside a fetch seam",
+}
+
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_JAX_FUNCS = {"device_get", "block_until_ready"}
+_CONVERTERS = {"float", "int", "bool"}
+
+
+def _applies(path: str) -> bool:
+    return path.replace("\\", "/").startswith("emqx_tpu/ops/")
+
+
+def _mentions_jax(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "jax"):
+            return True
+    return False
+
+
+def check(fi: FileInfo, ctx) -> List[Finding]:
+    if not _applies(fi.path):
+        return []
+    out: List[Finding] = []
+
+    def _own_nodes(fn_node):
+        """The function's nodes, excluding nested def subtrees (each
+        nested function is scanned under its own name/whitelist)."""
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def scan(fn_node, fname: str) -> None:
+        if fname in ctx.device_whitelist:
+            return
+        for node in _own_nodes(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _SYNC_ATTRS and not node.args:
+                out.append(Finding(
+                    fi.path, node.lineno, "DP301",
+                    f".{f.attr}() in {fname} forces a device sync — "
+                    f"keep kernels async; fetch through the "
+                    f"coalesced transfer seam"))
+            elif isinstance(f, ast.Attribute) and \
+                    f.attr in _JAX_FUNCS and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "jax":
+                out.append(Finding(
+                    fi.path, node.lineno, "DP301",
+                    f"jax.{f.attr}() in {fname} forces a device "
+                    f"sync — keep kernels async; fetch through the "
+                    f"coalesced transfer seam"))
+            elif isinstance(f, ast.Name) and f.id in _CONVERTERS \
+                    and node.args and _mentions_jax(node.args[0]):
+                out.append(Finding(
+                    fi.path, node.lineno, "DP301",
+                    f"{f.id}() over a jnp/jax expression in {fname} "
+                    f"blocks on the device — materialize through "
+                    f"the fetch seam instead"))
+
+    for node in ast.walk(fi.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan(node, node.name)
+    return out

@@ -1,0 +1,178 @@
+"""Concurrency-domain call-graph + async-misuse rules (the headline
+analyzer, docs/ANALYSIS.md "Thread domains").
+
+The hot seams carry zero-cost markers from ``emqx_tpu/concurrency.py``
+(``@owner_loop`` / ``@executor_thread`` / ``@bg_thread`` /
+``@any_thread``). This pass rebuilds the marker table from the AST
+(no imports executed) and walks every annotated function's direct
+calls:
+
+  CD101  a function whose domain is NOT the event loop (executor /
+         bg / any) directly CALLS a loop-only function. Legal
+         bridges never trip this: passing the function as a
+         *reference* to ``call_soon_threadsafe`` /
+         ``run_coroutine_threadsafe`` / ``LoopGroup.post`` /
+         ``run_in_executor`` is not a call. The deliberate fallbacks
+         ("owning loop is gone — run it here") carry a pragma.
+
+  CD103  a locally-defined ``async def`` coroutine is called as a
+         bare statement without ``await`` — the coroutine object is
+         built and dropped, the body never runs (Python warns at
+         runtime *if* GC notices; the gate catches it at diff time).
+
+  CD104  a ``create_task``/``ensure_future`` result is dropped as a
+         bare statement: the event loop holds only a weak reference
+         to tasks, so a dropped handle can be garbage-collected
+         mid-flight and its work silently vanishes. Keep a
+         reference, or pragma the fire-and-forget with the reason it
+         survives GC.
+
+Resolution is deliberately conservative — only ``self.method()``
+within the class, module-level ``name()``, and ``module.name()``
+through an emqx_tpu import are resolved, so an unannotated or
+unresolvable callee never produces a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from analysis import FileInfo, Finding
+
+RULES = {
+    "CD101": "cross-domain direct call into a loop-only function",
+    "CD103": "async coroutine called without await (body never runs)",
+    "CD104": "create_task result dropped (task may be GC'd mid-run)",
+}
+
+_DOMAIN_DECOS = {
+    "owner_loop": "loop",
+    "executor_thread": "executor",
+    "bg_thread": "bg",
+    "any_thread": "any",
+}
+
+#: domains that must not call straight into a loop-only function
+_OFF_LOOP = {"executor", "bg", "any"}
+
+
+def _deco_domain(node) -> Optional[str]:
+    for d in node.decorator_list:
+        name = None
+        if isinstance(d, ast.Name):
+            name = d.id
+        elif isinstance(d, ast.Attribute):
+            name = d.attr
+        if name in _DOMAIN_DECOS:
+            return _DOMAIN_DECOS[name]
+    return None
+
+
+def _applies(path: str) -> bool:
+    return path.replace("\\", "/").startswith("emqx_tpu/")
+
+
+class _Tables:
+    """Per-file marker tables: module-level functions and per-class
+    methods, name -> (domain, is_async)."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.module: Dict[str, Tuple[Optional[str], bool]] = {}
+        self.classes: Dict[str, Dict[str,
+                                     Tuple[Optional[str], bool]]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.module[node.name] = (
+                    _deco_domain(node),
+                    isinstance(node, ast.AsyncFunctionDef))
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        methods[sub.name] = (
+                            _deco_domain(sub),
+                            isinstance(sub, ast.AsyncFunctionDef))
+                self.classes[node.name] = methods
+
+
+def _resolve(call: ast.Call, cls_methods, tables: _Tables):
+    """``(domain, is_async, label)`` of a direct callee, or None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        ent = tables.module.get(f.id)
+        return (ent[0], ent[1], f.id) if ent else None
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name):
+            if f.value.id == "self" and cls_methods is not None:
+                ent = cls_methods.get(f.attr)
+                return (ent[0], ent[1], f"self.{f.attr}") \
+                    if ent else None
+            # module-qualified call within the file's own tables
+            # is already covered; cross-module resolution would
+            # need imports executed — stay conservative
+    return None
+
+
+def check(fi: FileInfo, ctx) -> List[Finding]:
+    if not _applies(fi.path):
+        return []
+    out: List[Finding] = []
+    tables = _Tables(fi.tree)
+
+    def walk_fn(fn, cls_methods, cls_name: str) -> None:
+        domain = _deco_domain(fn)
+        qual = (f"{cls_name}.{fn.name}" if cls_name else fn.name)
+        # -- CD101: only annotated off-loop callers are judged
+        if domain in _OFF_LOOP:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                ent = _resolve(node, cls_methods, tables)
+                if ent is None:
+                    continue
+                callee_domain, _is_async, label = ent
+                if callee_domain == "loop":
+                    out.append(Finding(
+                        fi.path, node.lineno, "CD101",
+                        f"{qual} [{domain}] calls loop-only "
+                        f"{label}() directly — marshal through "
+                        f"call_soon_threadsafe/run_coroutine_"
+                        f"threadsafe/LoopGroup.post or the ingress "
+                        f"accumulator"))
+        # -- CD103/CD104: bare Expr statements dropping results
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Expr) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            ent = _resolve(call, cls_methods, tables)
+            if ent is not None and ent[1]:
+                out.append(Finding(
+                    fi.path, call.lineno, "CD103",
+                    f"coroutine {ent[2]}() called without await — "
+                    f"the coroutine object is discarded and the "
+                    f"body never runs"))
+                continue
+            f = call.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr in ("create_task", "ensure_future"):
+                out.append(Finding(
+                    fi.path, call.lineno, "CD104",
+                    f"{attr}(...) result dropped — the loop keeps "
+                    f"only a weak reference; retain the task or it "
+                    f"can be GC'd mid-run"))
+
+    for node in fi.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node, None, "")
+        elif isinstance(node, ast.ClassDef):
+            methods = tables.classes.get(node.name, {})
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    walk_fn(sub, methods, node.name)
+    return out

@@ -1,0 +1,92 @@
+"""RD21x — fault-injection catalog cross-checks (docs/ROBUSTNESS.md).
+
+The fault registry is a *closed catalog* (arming an unknown point
+raises), but the other three surfaces — fire sites in the code, the
+docs table, the chaos suite — were kept in sync by reviewer
+vigilance alone. Four rules close the loop:
+
+  RD211  ``faults.fire("<point>")`` names a point absent from the
+         POINTS catalog — that site can never fire (arming it is
+         impossible), i.e. dead chaos coverage.
+  RD212  a catalog point is missing from the docs/ROBUSTNESS.md
+         fault table — operators arm from that table.
+  RD213  a catalog point is never referenced by any test — an
+         injection point no chaos test exercises is untested failure
+         handling by definition.
+  RD214  a catalog point has no ``faults.fire`` site at all — a
+         catalog entry whose site was refactored away silently tests
+         nothing (the exact failure mode the closed catalog exists
+         to prevent).
+
+Sites are collected from literal ``<anything>.fire("...")`` calls
+where the receiver chain ends in ``faults`` (the ``faults`` /
+``_faults`` import aliases).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from analysis import FileInfo, Finding
+
+RULES = {
+    "RD211": "faults.fire() names a point not in the POINTS catalog",
+    "RD212": "fault point missing from the docs/ROBUSTNESS.md table",
+    "RD213": "fault point not referenced by any test",
+    "RD214": "catalog fault point with no fire() site in the code",
+}
+
+
+def _applies(path: str) -> bool:
+    return path.replace("\\", "/").startswith("emqx_tpu/")
+
+
+def check(fi: FileInfo, ctx) -> List[Finding]:
+    if not _applies(fi.path):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute) or \
+                node.func.attr != "fire":
+            continue
+        recv = node.func.value
+        if not (isinstance(recv, ast.Name)
+                and recv.id.lstrip("_") == "faults"):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        point = node.args[0].value
+        ctx.fire_sites.append((fi.path, node.lineno, point))
+        if ctx.fault_points and point not in ctx.fault_points:
+            out.append(Finding(
+                fi.path, node.lineno, "RD211",
+                f"fault point '{point}' is not in the "
+                f"emqx_tpu/faults.py POINTS catalog — this site can "
+                f"never fire"))
+    return out
+
+
+def finalize(ctx) -> List[Finding]:
+    out: List[Finding] = []
+    fired = {p for _path, _line, p in ctx.fire_sites}
+    for point, line in sorted(ctx.fault_points.items()):
+        if ctx.docs_robustness and \
+                f"`{point}`" not in ctx.docs_robustness:
+            out.append(Finding(
+                ctx.fault_catalog_path, line, "RD212",
+                f"fault point '{point}' is missing from the "
+                f"docs/ROBUSTNESS.md fault-point table"))
+        if ctx.tests_text and point not in ctx.tests_text:
+            out.append(Finding(
+                ctx.fault_catalog_path, line, "RD213",
+                f"fault point '{point}' is never referenced by any "
+                f"test — untested failure handling"))
+        if ctx.fire_sites and point not in fired:
+            out.append(Finding(
+                ctx.fault_catalog_path, line, "RD214",
+                f"fault point '{point}' has no faults.fire() site — "
+                f"a catalog entry that silently tests nothing"))
+    return out

@@ -1,0 +1,145 @@
+"""RD20x — metric-name registry + documentation cross-checks.
+
+Five parallel registries keep this broker observable; the counter
+registry (``emqx_tpu/metrics.py``) and the stats-gauge registry
+(``emqx_tpu/stats.py STATS_KEYS``) are the two this module guards:
+
+  RD201  a literal name passed to ``*.metrics.inc/dec`` is not in
+         the counter registry (``*_METRICS`` lists, or a
+         ``.new("...")`` registration) — ``Metrics.inc`` would
+         KeyError at runtime, but only on the first traversal of
+         that path; the gate catches it at diff time.
+  RD202  a literal counter name used in code does not appear in
+         docs/OBSERVABILITY.md — either verbatim or covered by a
+         family glob like ``packets.*``. New counters ship
+         documented or not at all.
+  RD203  a literal name is ``dec``'d but absent from
+         ``GAUGE_METRICS`` — the Prometheus exposition would emit a
+         shrinking ``counter`` and every scraper's ``rate()`` turns
+         to garbage (the audited-registry rule at metrics.py).
+  RD204  a literal ``stats.setstat`` key (or max_key) is not in
+         ``STATS_KEYS`` — the gauge would spring into existence on
+         first set, invisible to dashboards built from the registry.
+
+Only literal string arguments are judged; dynamic names
+(``f"device.{key}"`` folds, per-peer gauges) are the registries'
+documented extension points and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from analysis import FileInfo, Finding
+
+RULES = {
+    "RD201": "metric name not in the metrics registry",
+    "RD202": "metric name undocumented in docs/OBSERVABILITY.md",
+    "RD203": "dec'd metric missing from GAUGE_METRICS",
+    "RD204": "stats gauge key not in STATS_KEYS",
+}
+
+
+def _applies(path: str) -> bool:
+    return path.replace("\\", "/").startswith("emqx_tpu/")
+
+
+def _chain(node) -> Optional[str]:
+    """Dotted name of an attribute chain rooted at a Name, else
+    None (calls/subscripts in the chain give up)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _metric_receiver(func: ast.Attribute, in_metrics_cls: bool) -> bool:
+    """Is ``<recv>.inc/dec`` a Metrics call? The receiver chain must
+    end in ``metrics`` (self.metrics, node.broker.metrics, bare
+    ``metrics`` module global) — or be ``self`` inside the Metrics
+    class itself."""
+    chain = _chain(func.value)
+    if chain is None:
+        return False
+    if chain == "self":
+        return in_metrics_cls
+    return chain == "metrics" or chain.endswith(".metrics")
+
+
+def check(fi: FileInfo, ctx) -> List[Finding]:
+    if not _applies(fi.path):
+        return []
+    out: List[Finding] = []
+    tree = fi.tree
+    # class spans, to know when `self` IS a Metrics
+    metrics_cls_ranges = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Metrics":
+            metrics_cls_ranges.append(
+                (node.lineno, node.end_lineno or node.lineno))
+
+    def in_metrics(line: int) -> bool:
+        return any(a <= line <= b for a, b in metrics_cls_ranges)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        attr = node.func.attr
+        if attr in ("inc", "dec"):
+            if not node.args or not isinstance(node.args[0],
+                                               ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            if not _metric_receiver(node.func, in_metrics(node.lineno)):
+                continue
+            name = node.args[0].value
+            ctx.metric_sites.append((fi.path, node.lineno, name, attr))
+            if ctx.metric_names and name not in ctx.metric_names:
+                out.append(Finding(
+                    fi.path, node.lineno, "RD201",
+                    f"metric '{name}' is not registered (add it to "
+                    f"a *_METRICS list in emqx_tpu/metrics.py or "
+                    f"register with .new())"))
+            if ctx.docs_observability and not ctx.documented(
+                    name, ctx.docs_observability):
+                out.append(Finding(
+                    fi.path, node.lineno, "RD202",
+                    f"metric '{name}' is undocumented — add it (or "
+                    f"its family glob) to docs/OBSERVABILITY.md"))
+            if attr == "dec" and ctx.metric_names \
+                    and name not in ctx.gauge_metrics:
+                out.append(Finding(
+                    fi.path, node.lineno, "RD203",
+                    f"'{name}' is dec'd but not in GAUGE_METRICS — "
+                    f"the Prometheus exposition would emit a "
+                    f"non-monotonic counter and scraped rate() "
+                    f"turns to garbage"))
+        elif attr == "setstat" and ctx.stats_keys:
+            keys = []
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                keys.append((node.args[0].value, node.args[0]))
+            if len(node.args) > 2 and \
+                    isinstance(node.args[2], ast.Constant) and \
+                    isinstance(node.args[2].value, str) and \
+                    node.args[2].value:
+                keys.append((node.args[2].value, node.args[2]))
+            for kw in node.keywords:
+                if kw.arg == "max_key" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str) and \
+                        kw.value.value:
+                    keys.append((kw.value.value, kw.value))
+            for key, knode in keys:
+                if key not in ctx.stats_keys:
+                    out.append(Finding(
+                        fi.path, knode.lineno, "RD204",
+                        f"stats gauge '{key}' is not in "
+                        f"emqx_tpu/stats.py STATS_KEYS"))
+    return out

@@ -1,0 +1,134 @@
+"""Inline suppression engine: ``# lint: ok-<RULE> <why>``.
+
+A finding is waived when its line — or the standalone comment line
+directly above it — carries a pragma naming its rule with a reason:
+
+    self._counters[i] += n  # lint: ok-CD102 single-writer mode
+
+    # lint: ok-CD101 shutdown fallback: owning loop is gone
+    self._run_xloop_groups(pb, gids)
+
+Several rules may share one pragma (``ok-CD101,CD103 <why>``). The
+engine is itself gated:
+
+  LNT001  malformed pragma / missing reason — every waiver must say
+          WHY or it is noise that outlives its justification
+  LNT002  stale pragma: waived nothing in this run — a suppression
+          that no longer suppresses must be deleted, not trusted
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, List, Tuple
+
+from analysis import FileInfo, Finding
+
+RULES = {
+    "LNT001": "malformed lint pragma or missing reason",
+    "LNT002": "stale lint pragma (suppresses nothing)",
+}
+
+#: matches the pragma tail of a line; group 1 = everything after
+#: ``ok-`` (rule list), group 2 = the reason
+_PRAGMA = re.compile(r"#\s*lint:\s*ok-(\S+)(.*)$")
+_RULE_ID = re.compile(r"^[A-Z]{1,4}\d{3}$")
+
+
+def _parse_line(line: str):
+    """``(rules, reason)`` from a source line, or None without a
+    pragma. Malformed rule lists yield ``([], reason)``."""
+    m = _PRAGMA.search(line)
+    if m is None:
+        return None
+    rules = [r for r in m.group(1).split(",") if r]
+    if not all(_RULE_ID.match(r) for r in rules):
+        rules = []
+    return rules, m.group(2).strip()
+
+
+def _comment_lines(fi: FileInfo) -> List[int]:
+    """Line numbers of real COMMENT tokens — tokenized, so pragma
+    syntax quoted inside docstrings never registers as a waiver."""
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(fi.src).readline)
+        return [t.start[0] for t in toks
+                if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unparseable file: fall back to the lexical scan (the E999
+        # finding is already reported; waivers just can't apply)
+        return []
+
+
+def collect(fi: FileInfo) -> Dict[int, Tuple[List[str], str, int]]:
+    """Effective line -> (rules, reason, literal pragma line). A
+    pragma on a comment-only line also guards the next non-blank,
+    non-comment line."""
+    out: Dict[int, Tuple[List[str], str, int]] = {}
+    for i in _comment_lines(fi):
+        line = fi.lines[i - 1]
+        parsed = _parse_line(line)
+        if parsed is None:
+            continue
+        rules, reason = parsed
+        out[i] = (rules, reason, i)
+        if line.lstrip().startswith("#"):
+            for j in range(i + 1, len(fi.lines) + 1):
+                nxt = fi.lines[j - 1].strip()
+                if nxt and not nxt.startswith("#"):
+                    out.setdefault(j, (rules, reason, i))
+                    break
+    return out
+
+
+def apply(findings: List[Finding], by_path: Dict[str, FileInfo],
+          check_stale: bool = True):
+    """Split findings into (kept, suppressed); appends LNT001/LNT002
+    findings for bad or stale pragmas."""
+    tables: Dict[str, Dict[int, Tuple[List[str], str, int]]] = {}
+    used: Dict[Tuple[str, int], bool] = {}
+    wellformed: Dict[Tuple[str, int], bool] = {}
+    bad: List[Finding] = []
+    for path, fi in by_path.items():
+        table = collect(fi)
+        tables[path] = table
+        for line, (rules, reason, lit) in table.items():
+            if line != lit:
+                continue
+            ok = bool(rules) and len(reason) >= 3
+            wellformed[(path, lit)] = ok
+            used.setdefault((path, lit), False)
+            if not ok:
+                bad.append(Finding(
+                    path, lit, "LNT001",
+                    "pragma needs `ok-<RULE> <reason>` (a waiver "
+                    "without a stated reason is drift waiting to "
+                    "happen)"))
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        ent = tables.get(f.path, {}).get(f.line)
+        if ent is not None and f.rule in ent[0] and len(ent[1]) >= 3:
+            suppressed.append(f)
+            used[(f.path, ent[2])] = True
+        else:
+            kept.append(f)
+    kept.extend(bad)
+    if check_stale:
+        for (path, lit), was_used in sorted(used.items()):
+            if was_used or not wellformed.get((path, lit), False):
+                continue
+            rules = tables[path][lit][0]
+            kept.append(Finding(
+                path, lit, "LNT002",
+                f"stale pragma ok-{','.join(rules)}: it suppresses "
+                f"nothing — delete it or the waiver outlives the "
+                f"code it excused"))
+    return kept, suppressed
+
+
+def check(fi: FileInfo, ctx) -> List[Finding]:
+    """Pragmas are applied by :func:`apply`, not the per-file pass."""
+    return []

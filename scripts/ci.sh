@@ -6,9 +6,14 @@
 # is stdlib-built:
 #
 #   1. byte-compile everything            (syntax)
-#   2. scripts/lint.py                    (AST lint, must be clean)
-#   3. pytest                             (full suite, CPU mesh)
-#   4. scripts/cov.py over the suite      (line coverage report;
+#   2. scripts/lint.py --stats            (static-analysis gate:
+#      generic smells + concurrency-domain/lock rules + registry-
+#      drift cross-checks, docs/ANALYSIS.md; per-rule counts printed,
+#      any unwaived finding fails)
+#   3. tests/test_lint.py                 (the analyzers' own suite:
+#      every rule must catch its seeded violation)
+#   4. pytest                             (full suite, CPU mesh)
+#   5. scripts/cov.py over the suite      (line coverage report;
 #      COV=0 skips — it roughly doubles suite wall time)
 #
 # Exits nonzero on any violation.
@@ -18,8 +23,11 @@ cd "$(dirname "$0")/.."
 echo "== byte-compile =="
 python -m compileall -q emqx_tpu tests scripts bench.py __graft_entry__.py
 
-echo "== lint (scripts/lint.py) =="
-python scripts/lint.py
+echo "== static analysis (scripts/lint.py, docs/ANALYSIS.md) =="
+python scripts/lint.py --stats
+
+echo "== analyzer self-tests (tests/test_lint.py) =="
+python -m pytest tests/test_lint.py -q
 
 echo "== match-cache parity (docs/MATCH_CACHE.md) =="
 # also part of the full suite below; run first so a cache parity

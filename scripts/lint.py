@@ -1,180 +1,100 @@
 #!/usr/bin/env python
-"""Stdlib AST linter — the repo's static gate.
+"""The repo's static-analysis gate (driver for scripts/analysis/).
 
 The reference gates its tree with xref + elvis in CI
-(/root/reference/rebar.config:27-30, elvis.config:1). This image has
-no ruff/mypy/pyflakes and installs are off-limits, so the gate is
-built on ``ast``: high-signal checks only, and the tree must pass
-clean (scripts/ci.sh exits nonzero otherwise).
+(/root/reference/rebar.config:27-30). This image has no
+ruff/mypy/pyflakes and installs are off-limits, so the gate is built
+on stdlib ``ast`` — and beyond the generic smells it checks the
+invariants THIS codebase lives by: thread/loop-affinity domains,
+lock-guarded shared state, and the five parallel registries
+(metrics, stats gauges, fault points, closed-schema TOML, telemetry
+stages) that must stay in sync with docs/. Rule catalog:
+docs/ANALYSIS.md.
 
-Checks:
-  F401  module-level import never used in the file
-  F811  duplicate def/class name in one scope
-  B006  mutable default argument
-  E722  bare ``except:``
-  E711  comparison to None with ==/!=
-  F631  assert on a non-empty tuple (always true)
-  W605  invalid escape sequence in a plain string literal (compile
-        warning surfaced as an error)
-  E999  syntax error
+Usage:
+    python scripts/lint.py [paths...]        # full gate (ci.sh)
+    python scripts/lint.py --stats           # + per-rule counts
+    python scripts/lint.py --rule CD102      # one rule only
+    python scripts/lint.py --list-rules      # catalog
+
+Exit status is nonzero on any unwaived finding. Waivers are inline
+``# lint: ok-<RULE> <why>`` pragmas — and are themselves checked
+(reason required, stale pragmas flagged).
 """
 
 from __future__ import annotations
 
-import ast
 import sys
-import warnings
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-def _names_loaded(tree: ast.AST) -> set[str]:
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # a.b.c — record the root name
-            cur = node
-            while isinstance(cur, ast.Attribute):
-                cur = cur.value
-            if isinstance(cur, ast.Name):
-                used.add(cur.id)
-    # pytest fixtures are *requested* by parameter name — an import
-    # that only appears as a function argument is used
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            a = node.args
-            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
-                used.add(arg.arg)
-    # __all__ re-exports count as uses
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__" \
-                        and isinstance(node.value, (ast.List, ast.Tuple)):
-                    for elt in node.value.elts:
-                        if isinstance(elt, ast.Constant) \
-                                and isinstance(elt.value, str):
-                            used.add(elt.value)
-    return used
+import analysis  # noqa: E402  (needs the scripts/ dir on sys.path)
 
-
-def _check_imports(tree: ast.Module, path: str, errors: list) -> None:
-    used = _names_loaded(tree)
-    for node in tree.body:
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                name = a.asname or a.name.split(".")[0]
-                if name not in used and a.name != "__future__":
-                    errors.append((path, node.lineno,
-                                   f"F401 unused import '{a.name}'"))
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for a in node.names:
-                name = a.asname or a.name
-                if name != "*" and name not in used:
-                    errors.append((path, node.lineno,
-                                   f"F401 unused import '{name}'"))
-
-
-_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
-            ast.SetComp)
-
-
-def _check_defs(tree: ast.AST, path: str, errors: list) -> None:
-    class V(ast.NodeVisitor):
-        def _scope(self, body, where):
-            seen: dict[str, int] = {}
-            for node in body:
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef,
-                                     ast.ClassDef)):
-                    # decorated redefinition (property setters,
-                    # overloads, dispatch) is deliberate
-                    if node.name in seen and not node.decorator_list:
-                        errors.append((path, node.lineno,
-                                       f"F811 redefinition of "
-                                       f"'{node.name}' in {where}"))
-                    seen[node.name] = node.lineno
-
-        def visit_Module(self, node):
-            self._scope(node.body, "module")
-            self.generic_visit(node)
-
-        def visit_ClassDef(self, node):
-            self._scope(node.body, f"class {node.name}")
-            self.generic_visit(node)
-
-        def _defaults(self, node):
-            for d in list(node.args.defaults) + [
-                    d for d in node.args.kw_defaults if d is not None]:
-                if isinstance(d, _MUTABLE):
-                    errors.append((path, d.lineno,
-                                   "B006 mutable default argument"))
-
-        def visit_FunctionDef(self, node):
-            self._defaults(node)
-            self.generic_visit(node)
-
-        def visit_AsyncFunctionDef(self, node):
-            self._defaults(node)
-            self.generic_visit(node)
-
-        def visit_ExceptHandler(self, node):
-            if node.type is None:
-                errors.append((path, node.lineno, "E722 bare except"))
-            self.generic_visit(node)
-
-        def visit_Compare(self, node):
-            for op, cmp_ in zip(node.ops, node.comparators):
-                if isinstance(op, (ast.Eq, ast.NotEq)) and \
-                        isinstance(cmp_, ast.Constant) and \
-                        cmp_.value is None:
-                    errors.append((path, node.lineno,
-                                   "E711 comparison to None with ==/!="))
-            self.generic_visit(node)
-
-        def visit_Assert(self, node):
-            if isinstance(node.test, ast.Tuple) and node.test.elts:
-                errors.append((path, node.lineno,
-                               "F631 assert on tuple is always true"))
-            self.generic_visit(node)
-
-    V().visit(tree)
-
-
-def lint_file(path: Path, errors: list) -> None:
-    src = path.read_text(encoding="utf-8")
-    try:
-        with warnings.catch_warnings():
-            warnings.simplefilter("error", SyntaxWarning)
-            tree = ast.parse(src, filename=str(path))
-    except SyntaxWarning as w:
-        errors.append((str(path), getattr(w, "lineno", 0) or 0,
-                       f"W605 {w}"))
-        return
-    except SyntaxError as e:
-        errors.append((str(path), e.lineno or 0, f"E999 {e.msg}"))
-        return
-    _check_imports(tree, str(path), errors)
-    _check_defs(tree, str(path), errors)
+ROOT = Path(__file__).resolve().parents[1]
+DEFAULT_TARGETS = ["emqx_tpu", "tests", "scripts", "bench.py",
+                   "__graft_entry__.py"]
 
 
 def main(argv) -> int:
-    targets = argv or ["emqx_tpu", "tests", "scripts", "bench.py",
-                       "__graft_entry__.py"]
-    files: list[Path] = []
-    for t in targets:
+    rule = None
+    stats = False
+    targets = []
+    it = iter(argv)
+    for a in it:
+        if a == "--rule":
+            rule = next(it, None)
+            if rule is None:
+                print("--rule needs a rule id (see --list-rules)")
+                return 2
+        elif a == "--stats":
+            stats = True
+        elif a == "--list-rules":
+            for rid, desc in sorted(analysis.all_rules().items()):
+                print(f"{rid:7s} {desc}")
+            return 0
+        elif a.startswith("-"):
+            print(__doc__)
+            return 2
+        else:
+            targets.append(a)
+    rules = analysis.all_rules()
+    if rule is not None and rule not in rules:
+        print(f"unknown rule {rule!r}; see --list-rules")
+        return 2
+
+    paths = []
+    for t in targets or DEFAULT_TARGETS:
         p = Path(t)
-        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
-    errors: list = []
-    for f in files:
-        lint_file(f, errors)
-    for path, line, msg in errors:
-        print(f"{path}:{line}: {msg}")
-    print(f"lint: {len(files)} files, {len(errors)} finding(s)")
-    return 1 if errors else 0
+        paths.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    ctx = analysis.build_context(ROOT)
+    files = []
+    parse_findings = []
+    for p in paths:
+        try:
+            rel = str(p.resolve().relative_to(ROOT))
+        except ValueError:
+            rel = str(p)
+        fi, errs = analysis.parse_file(p, rel)
+        files.append(fi)
+        parse_findings.extend(errs)
+    kept, suppressed, counts = analysis.run(
+        files, ctx, parse_findings=parse_findings, rule=rule)
+    for f in sorted(kept, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render())
+    if stats:
+        print("-- per-rule findings --")
+        sup_by_rule = {}
+        for f in suppressed:
+            sup_by_rule[f.rule] = sup_by_rule.get(f.rule, 0) + 1
+        for rid in sorted(set(counts) | set(sup_by_rule)):
+            line = f"{rid:7s} {counts.get(rid, 0):4d}"
+            if sup_by_rule.get(rid):
+                line += f"   ({sup_by_rule[rid]} waived)"
+            print(line)
+    print(f"lint: {len(files)} files, {len(kept)} finding(s), "
+          f"{len(suppressed)} waived")
+    return 1 if kept else 0
 
 
 if __name__ == "__main__":

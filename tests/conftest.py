@@ -40,6 +40,13 @@ def _zone_isolation():
     zone._zones.update(saved)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests the tier-1 filter (-m 'not slow') "
+        "skips; the full ci.sh pytest run includes them")
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests with asyncio.run (no pytest-asyncio in
     this image)."""

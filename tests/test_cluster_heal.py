@@ -283,6 +283,36 @@ def test_net_drop_loss_repaired_by_anti_entropy():
         _teardown(trs, cls)
 
 
+def test_net_delay_inflates_rtt_without_loss():
+    """net.delay stalls frames to a peer, losing nothing: heartbeats
+    keep succeeding (the peer stays ``ok`` — a slow link is NOT a
+    partition) while the measured RTT inflates by ~delay_ms. This is
+    the slow-WAN shape the detector must ride out without flapping,
+    and the lag knob the repl.ship stall scenario leans on."""
+    cfg = _fast_cfg(heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    suspect_after=3)
+    nodes, trs, cls = _mk_net(2, cfg, "dly")
+    try:
+        _wait(lambda: trs[0].health_info()
+              .get("hn1", {}).get("rtt_ms") is not None,
+              msg="no heartbeat RTT before arming")
+        trs[1].fault_peers = set()   # only hn0's outbound is slow
+        trs[0].fault_peers = {"hn1"}
+        faults.set_master(True)
+        faults.arm("net.delay", times=0, delay_ms=250.0)
+        _wait(lambda: (trs[0].health_info()["hn1"]["rtt_ms"] or 0)
+              >= 200.0, msg="delay never showed up in heartbeat RTT")
+        assert trs[0].peer_state("hn1") == "ok", \
+            "a slow link must not be declared suspect/down"
+        faults.disarm("net.delay")
+        _wait(lambda: (trs[0].health_info()["hn1"]["rtt_ms"] or 1e9)
+              < 200.0, msg="RTT never recovered after disarm")
+        assert trs[0].peer_state("hn1") == "ok"
+    finally:
+        faults.clear()
+        _teardown(trs, cls)
+
+
 def test_cast_buffer_full_drop_is_counted():
     """The cast-buffer-full shed (previously a log line only) counts
     into ``forward.dropped`` so at-most-once loss is observable."""
