@@ -2001,6 +2001,77 @@ def recovery():
         return out
 
     r = asyncio.run(_run())
+
+    def _gc_window_sweep():
+        """ROADMAP item 5d: measure what group_commit_window_ms
+        actually buys. T concurrent flushers (the multi-loop shape)
+        hammer one fsync-armed WalGroup per window value; the sweep
+        records fsyncs per flush call (coalescing win) against the
+        added p50/p99 flush latency (the window's cost) — the
+        docs/DURABILITY.md recommendation table is generated from
+        exactly these columns."""
+        import tempfile
+        import threading as th
+
+        from emqx_tpu.wal import WalGroup
+
+        windows = [float(x) for x in os.environ.get(
+            "RECOVERY_GC_WINDOWS", "0,1,3,10").split(",")]
+        T = int(os.environ.get("RECOVERY_GC_THREADS", "4"))
+        flushes = int(os.environ.get("RECOVERY_GC_FLUSHES", "50"))
+        recs = int(os.environ.get("RECOVERY_GC_RECS", "32"))
+        rows = []
+        for w_ms in windows:
+            d = tempfile.mkdtemp(prefix="emqx_gc_sweep_")
+            wg = WalGroup(d, 1, shards=max(2, T), fsync=True,
+                          group_window_ms=w_ms)
+            lats: list = []
+            lk = th.Lock()
+
+            def _worker(ti):
+                mine = []
+                for i in range(flushes):
+                    for j in range(recs):
+                        wg.append(("route", f"g/{ti}/{i}/{j}",
+                                   "bench", 1), key=f"k{ti}-{j}")
+                    t0 = time.perf_counter()
+                    wg.flush()
+                    mine.append(
+                        (time.perf_counter() - t0) * 1000.0)
+                with lk:
+                    lats.extend(mine)
+
+            threads = [th.Thread(target=_worker, args=(t,))
+                       for t in range(T)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            wi = wg.info()
+            wg.close()
+            shutil.rmtree(d, ignore_errors=True)
+            lats.sort()
+            n = len(lats)
+            rows.append({
+                "window_ms": w_ms,
+                "fsyncs": wi["fsyncs"],
+                "fsyncs_per_flush": round(
+                    wi["fsyncs"] / max(n, 1), 3),
+                "group_commits": wi["group_commits"],
+                "coalesced": wi["group_coalesced"],
+                "flush_p50_ms": round(lats[n // 2], 3),
+                "flush_p99_ms": round(
+                    lats[min(n - 1, int(n * 0.99))], 3),
+                "flushes_per_s": round(n / max(wall, 1e-9)),
+                "last_commit_ms": wi["last_commit_ms"],
+            })
+        return rows
+
+    gc_sweep = None
+    if os.environ.get("RECOVERY_GC_SWEEP", "1") == "1":
+        gc_sweep = _gc_window_sweep()
     on, off = r["msgs_per_s_on"], r["msgs_per_s_off"]
     info = {"mode": "recovery", "routes": n_routes,
             "sessions": n_sessions, "fsync": use_fsync,
@@ -2035,18 +2106,26 @@ def recovery():
         "ckpt_churn": ckpt_churn,
         "ckpt_speedup": round(
             r["ckpt_full_s"] / max(r["ckpt_delta_s"], 1e-9), 2),
+        "gc_window_sweep": gc_sweep,
     })
 
 
 def _failover_probe():
-    """The BENCH_MODE=partition failover row (docs/DURABILITY.md
-    "Replicated durability"): a durable primary journals sessions +
-    retained + routes and ships the stream to a warm standby; the
-    primary is killed (kill -9 analogue: durability hooks severed,
-    transport dropped) and the standby's heartbeat detector drives
-    promotion. Measures failover time (kill → promoted), RPO in
-    records for acked traffic (must be 0), and digest-verifies the
-    promoted durable planes against the primary's pre-kill state."""
+    """The BENCH_MODE=partition failover + FAILBACK rows
+    (docs/DURABILITY.md "Replicated durability" / "Failback"): a
+    durable primary journals ``FAILOVER_SESSIONS`` persistent
+    sessions (default 5000 — a real fleet, not a toy) + retained +
+    routes and ships the stream to a warm standby; the primary is
+    killed (kill -9 analogue: durability hooks severed, transport
+    dropped) and the standby's heartbeat detector drives promotion.
+    Measures failover time (kill → promoted), RPO in records for
+    acked traffic (must be 0), and digest-verifies the promoted
+    durable planes against the primary's pre-kill state. Then the
+    primary RESTARTS from its own directory, rejoins, and the
+    promoted standby hands the (post-promotion-churned) state back:
+    ``failback_s`` = restart → standby demoted + stream resynced,
+    digest-verified against the standby's pre-failback state.
+    ``PARTITION_FAILBACK=0`` skips the second hop."""
     import shutil
     import tempfile
 
@@ -2059,12 +2138,12 @@ def _failover_probe():
     from emqx_tpu.session import Session
     from emqx_tpu.types import Message, SubOpts
 
-    n_sess = int(os.environ.get("FAILOVER_SESSIONS", "50"))
+    n_sess = int(os.environ.get("FAILOVER_SESSIONS", "5000"))
     n_ret = int(os.environ.get("FAILOVER_RETAINED", "100"))
     cfg = ClusterConfig(
         heartbeat_interval_s=0.1, heartbeat_timeout_s=0.5,
         suspect_after=1, down_after=3, ok_after=1,
-        anti_entropy_interval_s=30.0, call_timeout_s=2.0,
+        anti_entropy_interval_s=0.5, call_timeout_s=10.0,
         redial_backoff_s=0.1, redial_backoff_max_s=0.5)
 
     def _wait(pred, timeout, what):
@@ -2134,7 +2213,7 @@ def _failover_probe():
         failover_s = time.perf_counter() - t_kill
         got = durable_digest(nodes[1])
         lp = rep1.last_promotion
-        return {
+        out = {
             "failover_s": round(failover_s, 3),
             "failover_promote_s": lp["failover_s"],
             "failover_sessions": lp["sessions"],
@@ -2142,7 +2221,59 @@ def _failover_probe():
             "rpo_records": max(
                 0, acked - rep1.replicas["fb0"].applied_seq),
             "failover_digest_ok": bool(got == want),
+            "failback_s": None,
+            "failback_sessions": None,
+            "failback_digest_ok": None,
         }
+        if os.environ.get("PARTITION_FAILBACK", "1") == "1":
+            # post-promotion churn the failback must carry home
+            nodes[1].broker.publish(Message(
+                topic="fb/0/state", payload=b"post-promo", qos=1,
+                flags={"retain": True}))
+            want2 = durable_digest(nodes[1])
+            t_fb = time.perf_counter()
+            n0b = Node(name="fb0", boot_listeners=False,
+                       durability=DurabilityConfig(
+                           enabled=True,
+                           dir=os.path.join(tmp, "d0"),
+                           fsync=False, standby="fb1",
+                           wal_shards=4))
+            n0b.modules.load(RetainerModule)
+            n0b.durability.recover()
+            tr0b = SocketTransport("fb0", cookie="bench-failover",
+                                   config=cfg)
+            tr0b.serve()
+            cl0b = Cluster(n0b, transport=tr0b, config=cfg)
+            nodes.append(n0b)
+            trs.append(tr0b)
+            cls.append(cl0b)
+            cl0b.join_remote("127.0.0.1", trs[1].port)
+            _wait(lambda: not rep1.replicas["fb0"].promoted, 120,
+                  "failback demotion")
+            r0 = n0b.replication
+
+            def _resynced():
+                # tick the journal flush the started-node timer
+                # would run (records journaled by the failback apply
+                # must flush to ship)
+                n0b.durability.on_batch()
+                return (r0.state == "replicating"
+                        and r0.acked_seq >= r0.offered_seq)
+
+            _wait(_resynced, 120, "post-failback resync")
+            out["failback_s"] = round(
+                time.perf_counter() - t_fb, 3)
+            out["failback_sessions"] = len(n0b.cm._detached)
+            try:
+                _wait(lambda: durable_digest(n0b) == want2, 60,
+                      "failback digest")
+                out["failback_digest_ok"] = True
+            except RuntimeError:
+                out["failback_digest_ok"] = False
+            fb = nodes[1].replication.last_failback
+            if fb:
+                out["failback_handoff_s"] = fb.get("failback_s")
+        return out
     finally:
         for node in nodes:
             d = node.durability

@@ -243,7 +243,7 @@ class Cluster:
         self.replication = ReplicationManager(node, self)
         node.replication = self.replication
         dur = getattr(node, "durability", None)
-        if dur is not None and dur.cfg.standby:
+        if dur is not None and dur.cfg.standby_list:
             self.replication.arm_shipper(dur)
         # intercept local route mutations for replication
         self._orig_add = node.router.add_route
@@ -458,13 +458,22 @@ class Cluster:
         self._purge_node_routes(name)
         # warm-standby failover (replication.py): AFTER the purge —
         # the promotion re-installs the dead primary's durable state
-        # remapped to this node with exact refcounts
-        if self.replication is not None:
-            try:
-                self.replication.maybe_promote(name)
-            except Exception:
-                log.exception("standby promotion check for %s failed",
-                              name)
+        # remapped to this node with exact refcounts. On its own
+        # thread: nodedown is dispatched on the transport IO loop,
+        # and the promotion ARBITRATION makes synchronous calls to
+        # co-standbys that must not block that loop against itself
+        if self.replication is not None \
+                and name in self.replication.replicas:
+            def _promote_check(repl=self.replication, dead=name):
+                try:
+                    repl.maybe_promote(dead)
+                except Exception:
+                    log.exception("standby promotion check for %s "
+                                  "failed", dead)
+            t = threading.Thread(
+                target=_promote_check, daemon=True,
+                name=f"repl-promote-{self.name}")
+            t.start()
 
     # -- clientid registry + cross-node takeover (emqx_cm_registry) -------
 
@@ -483,6 +492,17 @@ class Cluster:
 
     def locate_client(self, client_id: str) -> Optional[str]:
         return self._registry.get(client_id)
+
+    @any_thread
+    def reassign_client(self, client_id: str, owner: str) -> None:
+        """Point the registry at ``owner`` on every member (the
+        replication layer's custody-chain repair: a node dropping
+        its stale copy of a session must also retract its
+        owner-authoritative registry claim, or anti-entropy
+        propagates the wrong owner forever)."""
+        with self._lock:
+            self._registry[client_id] = owner
+        self._broadcast("client_up", client_id, owner)
 
     def remote_discard(self, client_id: str, node: str) -> None:
         """Old session on another node must die (clean start)."""
@@ -809,12 +829,30 @@ class Cluster:
             log.warning("cluster auto-heal with %s failed: %s",
                         name, e)
         finally:
+            # FAILBACK (replication.py): a healed peer we promoted
+            # for gets its adopted state handed back — even when the
+            # anti-entropy half of the rejoin failed transiently
+            # (the sweep below retries it periodically regardless)
+            if self.replication is not None:
+                try:
+                    self.replication.maybe_failback(name)
+                except Exception:
+                    log.exception("failback scheduling for %s "
+                                  "failed", name)
             self._healing.discard(name)
 
     def _ae_sweep_once(self) -> None:
         """One background anti-entropy round: sync with ONE live
         peer (round-robin) — N nodes sweeping all-to-all every
-        interval would be O(N²) traffic for no extra convergence."""
+        interval would be O(N²) traffic for no extra convergence.
+        Also the failback retry tick: a promoted replica whose
+        primary is back and healthy hands its state back even if
+        every event-driven trigger was lost."""
+        if self.replication is not None:
+            try:
+                self.replication.retry_failbacks()
+            except Exception:
+                log.exception("failback retry sweep failed")
         peers = sorted(m for m in list(self.members)
                        if m != self.name
                        and self.transport.peer_state(m) == "ok")
@@ -1201,4 +1239,12 @@ class Cluster:
                                                 args[2])
         if op == "repl_bye":
             return self.replication.handle_bye(args[0], bool(args[1]))
+        if op == "repl_replica_info":
+            # promotion arbitration (replication.py): a co-standby
+            # compares warm-replica offsets before promoting
+            return self.replication.handle_replica_info(args[0])
+        if op == "repl_failback":
+            # FAILBACK: the promoted standby hands the adopted state
+            # back to this (restarted) primary
+            return self.replication.handle_failback(args[0], args[1])
         raise ValueError(f"bad rpc op: {op}")

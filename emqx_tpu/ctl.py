@@ -121,9 +121,10 @@ class Ctl:
         """One-stop durability diagnosis (docs/DURABILITY.md):
         generation, journal shards/bytes/records/degraded state, last
         fsync latency, checkpoint chain + age, the last recovery
-        summary, and the replication block (role, standby peer,
-        shipped/acked offsets, lag, last ack age; warm replicas this
-        node holds for its peers)."""
+        summary, and the replication block (role, the replication-
+        group topology with per-standby link state + shipped/acked
+        offsets, aggregate lag, ack-quorum status, last promotion/
+        failback; warm replicas this node holds for its peers)."""
         dur = self.node.durability
         repl = getattr(self.node, "replication", None)
         if dur is None:

@@ -115,6 +115,20 @@ class DurabilityConfig:
     #: "Replicated durability"): peer NODE NAME to stream the journal
     #: to over the cluster transport; "" = no replication
     standby: str = ""
+    #: replication GROUP (docs/DURABILITY.md "Replication groups"):
+    #: peer node names the journal fans out to — each holds an
+    #: independent warm replica. Mutually exclusive with the legacy
+    #: single ``standby`` (which is exactly ``standbys = [peer]``)
+    standbys: tuple = ()
+    #: group-commit ack quorum: K > 0 makes each local group commit
+    #: wait (bounded by quorum_timeout_ms, degrade-don't-wedge)
+    #: until K standbys acked the flushed range — quorum-acked
+    #: records survive the loss of any K-1 nodes. 0 = fully async
+    #: shipping (the PR 11 latency contract)
+    ack_quorum: int = 0
+    #: bounded quorum wait per group commit; a timeout degrades
+    #: (counter + repl_quorum_degraded alarm), never wedges
+    quorum_timeout_ms: float = 250.0
     #: bounded wait for the standby's ack (shutdown tail hand-off,
     #: per-ship call deadline)
     repl_ack_timeout_s: float = 5.0
@@ -154,6 +168,37 @@ class DurabilityConfig:
         if self.repl_queue_max_records <= 0:
             raise ValueError(
                 "durability.repl_queue_max_records must be > 0")
+        if not isinstance(self.standbys, (list, tuple)):
+            raise ValueError(
+                "durability.standbys must be a list of node names")
+        self.standbys = tuple(str(s) for s in self.standbys)
+        if any(not s for s in self.standbys):
+            raise ValueError(
+                "durability.standbys entries must be non-empty")
+        if len(set(self.standbys)) != len(self.standbys):
+            raise ValueError(
+                "durability.standbys must not repeat a peer")
+        if self.standby and self.standbys:
+            raise ValueError(
+                "set durability.standby OR durability.standbys, "
+                "not both (standby = exactly standbys = [peer])")
+        if self.quorum_timeout_ms <= 0:
+            raise ValueError(
+                "durability.quorum_timeout_ms must be > 0")
+        if self.ack_quorum < 0:
+            raise ValueError("durability.ack_quorum must be >= 0")
+        if self.ack_quorum > len(self.standby_list):
+            raise ValueError(
+                "durability.ack_quorum cannot exceed the number of "
+                "configured standbys")
+
+    @property
+    def standby_list(self) -> tuple:
+        """The effective replication group: ``standbys``, or the
+        legacy single ``standby`` as a one-element group."""
+        if self.standbys:
+            return tuple(self.standbys)
+        return (self.standby,) if self.standby else ()
 
 
 def journal_key(op: tuple) -> str:
@@ -426,7 +471,11 @@ class DurabilityManager:
             w.flush()
         r = self.repl
         if r is not None:
+            # quorum-aware group commit (docs/DURABILITY.md): wake
+            # the shipper, then — with ack_quorum > 0 — block
+            # bounded until the quorum acked the flushed range
             r.notify_flush()
+            r.wait_quorum()
 
     flush = on_batch
 

@@ -111,6 +111,12 @@ POINTS: Dict[str, tuple] = {
                   "call to the warm standby is dropped (the shipper "
                   "falls back to local-only + resync) or, with "
                   "stall, delayed (replication lag)"),
+    "repl.failback": ("drop",
+                      "ReplicationManager._failback — the FAILBACK "
+                      "hand-off call to the returning primary is "
+                      "dropped (the promoted standby aborts, stays "
+                      "promoted, and retries on the primary's next "
+                      "hello) or, with stall, delayed"),
     # cluster plane (cluster_net.py, docs/CLUSTER.md). Scope per
     # transport via SocketTransport.fault_peers / fault_local when
     # several nodes share one process (the chaos matrix).

@@ -194,7 +194,13 @@ FAULT_METRICS = [
 # snapshot re-syncs (first contact, gap repair, queue overflow),
 # `.dropped` = queued-but-unshipped records discarded by the bounded
 # ship queue (triggers a resync), `.promotions` = standby
-# promotions executed after a primary death
+# promotions executed after a primary death. Replication groups
+# (multi-standby fan-out + quorum): `.quorum.waits` = group commits
+# that blocked (bounded) for the ack quorum, `.quorum.timeouts` =
+# waits that hit quorum_timeout_ms and degraded, `.failbacks` =
+# completed FAILBACK hand-offs (either side), `.failback_errors` =
+# hand-off attempts aborted by a transfer failure (the standby stays
+# promoted and retries)
 DURABILITY_METRICS = [
     "wal.appends", "wal.fsyncs", "wal.fsync_errors",
     "wal.degraded.dropped", "wal.group.commits",
@@ -205,6 +211,8 @@ DURABILITY_METRICS = [
     "durability.repl.shipped", "durability.repl.acked",
     "durability.repl.ship_errors", "durability.repl.resyncs",
     "durability.repl.dropped", "durability.repl.promotions",
+    "durability.repl.quorum.waits", "durability.repl.quorum.timeouts",
+    "durability.repl.failbacks", "durability.repl.failback_errors",
 ]
 
 # cluster plane (cluster.py + cluster_net.py, docs/CLUSTER.md),

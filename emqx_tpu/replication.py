@@ -7,29 +7,42 @@ node death still loses its live persistent sessions until that disk
 comes back. This module closes the gap the reference broker never
 did (mnesia ram tables + takeover, PAPER.md L7/L8): the primary
 streams its journal records over the cluster transport to a
-designated STANDBY peer, which continuously replays them into a warm
-*detached* replica state (never into its live broker tables). When
-the heartbeat failure detector declares the primary down, the
-standby PROMOTES — resurrecting the primary's persistent sessions,
-retained messages, and routes exactly, with RPO = 0 for every record
-the primary flushed and the standby acked.
+REPLICATION GROUP of standby peers, each of which continuously
+replays them into a warm *detached* replica state (never into its
+live broker tables). When the heartbeat failure detector declares
+the primary down, one standby PROMOTES — resurrecting the primary's
+persistent sessions, retained messages, and routes exactly, with
+RPO = 0 for every record the primary flushed and the standby acked.
+
+Group shipping model (docs/DURABILITY.md "Replication groups"):
+**fan-out**, not chained — the primary keeps ONE global offered
+stream and an independent cursor per standby (``_PeerLink``). Every
+standby receives the same per-key-ordered record stream the merge
+rule already pins, so any standby's replica converges to the same
+state; a record is **quorum-acked** once ``ack_quorum`` distinct
+standbys acked an offset at or past it, and quorum-acked records
+survive the loss of any ``ack_quorum - 1`` nodes (plus the primary's
+own disk). ``ack_quorum > 0`` makes the local group commit wait —
+bounded by ``quorum_timeout_ms``, degrade-don't-wedge — for that
+watermark; ``ack_quorum = 0`` keeps shipping fully asynchronous (the
+PR 11 latency contract, pinned by test).
 
 Roles (one :class:`ReplicationManager` per clustered node plays
 both):
 
-  - **Shipper** (primary side, armed when ``[durability] standby``
-    names a peer): journal appends are offered to a bounded queue;
-    after each local group commit the shipper thread drains the
-    queue — only locally-durable records ship — and calls
-    ``repl_ship`` on the standby with a contiguous sequence range.
-    The standby's reply is the acked offset; lag is
-    ``offered − acked``. A suspect/down standby (the transport
-    fast-fails), a ship error, or a full queue drops the shipper to
-    **local-only** mode: local durability is unaffected, the
-    ``replication_lagging`` alarm raises (hysteresis on the lag
-    thresholds), and the next successful contact runs a full RESYNC
-    (``repl_hello`` with a fresh snapshot) before incremental
-    shipping resumes.
+  - **Shipper** (primary side, armed when ``[durability] standbys``
+    — or the legacy single ``standby`` — names peers): journal
+    appends are offered to a bounded queue; after each local group
+    commit the shipper thread drains the queue — only locally-
+    durable records ship — and calls ``repl_ship`` per standby with
+    a contiguous sequence range. Each standby's reply is its acked
+    offset; lag is ``offered − min(acked)``. A suspect/down standby
+    (the transport fast-fails), a ship error, or a full queue drops
+    THAT peer's link to **local-only** mode: local durability and
+    the other standbys are unaffected, the ``replication_lagging``
+    alarm raises (hysteresis on the lag thresholds), and the next
+    successful contact runs a full RESYNC (``repl_hello`` with a
+    fresh snapshot) before incremental shipping resumes.
   - **Replica** (standby side, one per primary): applies shipped
     records into staging dicts keyed exactly like recovery's
     (sessions / retained / tombstones / absolute route refcounts).
@@ -46,11 +59,42 @@ persistent sessions resurrect DETACHED (expiry evaluated against
 detach time, reconnecting clients get session-present + DUP
 redelivery); retained messages re-arm through the retainer's
 restore path. If the standby runs its own durability, a full
-checkpoint immediately journals the adopted state.
+checkpoint immediately journals the adopted state, and its OWN
+shipper full-resyncs so the adopted state reaches the surviving
+standbys too. With several standbys, promotion is ARBITRATED
+(:meth:`ReplicationManager._arbitrate`, serialized through the
+cluster locker): the reachable replica with the highest applied
+offset wins, ties break to the first node name — a dual promotion
+is only possible when the co-standbys cannot reach each other, and
+resolves on heal through the same failback hand-off.
 
-Fault point ``repl.ship`` (docs/ROBUSTNESS.md): drop discards the
+FAILBACK (docs/DURABILITY.md "Failback"): when the dead primary
+restarts, recovers from its own disk, rejoins (PR 10 heal path) and
+hellos its standby, a PROMOTED replica does not reset — it answers
+``failback_pending`` and ships the authoritative post-promotion
+state BACK (:meth:`maybe_failback` → ``repl_failback`` chunks):
+still-detached adopted sessions hand over wholesale (full-state
+overwrite of the primary's stale crash-recovered copies — no second
+session-present/DUP storm, clients were never attached here),
+sessions whose clients reconnected to the standby stay (``keep``),
+and dead ones are closed. After the primary's ack the standby drops
+the handed sessions + exactly their route refs, re-stages them as
+its warm replica (a re-failover re-promotes from there), demotes
+itself, and the primary's next hello resyncs the stream — the pair
+converges digest-byte-exact. The original dying again mid-failback
+is safe in both windows: before the apply the standby aborts and
+stays promoted; after the apply the demoted standby re-promotes
+from the re-staged replica. The ``repl.failback`` fault point
+rehearses the first window; the crash-during-failback double
+recovery test pins the duplicate-copy cleanup (a hello from the
+authoritative primary drops the standby's unregistered stale
+detached duplicates).
+
+Fault points (docs/ROBUSTNESS.md): ``repl.ship`` drop discards the
 ship call (the standby never sees it — the resync path's repair
-target), stall delays it (lag visible to the alarm).
+target), stall delays it (lag visible to the alarm);
+``repl.failback`` drops/stalls the hand-off call (the standby stays
+promoted and retries on the primary's next hello).
 """
 
 from __future__ import annotations
@@ -72,6 +116,19 @@ log = logging.getLogger("emqx_tpu.replication")
 #: ship batch bound: one repl_ship call carries at most this many
 #: records (a huge tail ships as several bounded calls)
 SHIP_BATCH_RECORDS = 2048
+#: failback hand-off chunk: sessions per repl_failback call (bounds
+#: how long one apply blocks the primary's transport IO thread —
+#: long stalls get the freshly-rejoined primary suspected)
+FAILBACK_BATCH_SESSIONS = 256
+
+
+def _sub_route(key: str, node_name: str) -> Tuple[str, object]:
+    """A subscription key's (filter, dest) route contribution on
+    ``node_name`` — the same mapping recovery's orphan pruning and
+    the broker's subscribe path use."""
+    flt, popts = T.parse(key)
+    share = popts.get("share")
+    return (flt, (share, node_name) if share else node_name)
 
 
 @shared_state(lock="lock", attrs=("sessions", "retained",
@@ -82,6 +139,13 @@ class StandbyReplica:
     def __init__(self, primary: str) -> None:
         self.primary = primary
         self.lock = threading.Lock()
+        #: serializes the replica's STATE TRANSITIONS — a hello's
+        #: accept/reset, a promotion, a failback finalize. Without
+        #: it, the restarted primary's hello can reset the replica
+        #: between the promotion's table installs and its promoted
+        #: flag, wiping the adopted bookkeeping the failback needs
+        #: (the adopted sessions would orphan on the holder)
+        self.op_lock = threading.RLock()
         #: staging dicts — the same shapes recovery stages into
         self.sessions: Dict[str, list] = {}   # cid -> [dts, state]
         self.retained: Dict[str, object] = {}
@@ -91,6 +155,12 @@ class StandbyReplica:
         self.applied_records = 0
         self.clean = False        # primary said goodbye cleanly
         self.promoted = False
+        #: the primary's full standby list (hello snapshot) — the
+        #: promotion-arbitration electorate
+        self.peers: List[str] = []
+        #: promotion bookkeeping for failback: every cid the replica
+        #: carried at promote time (the hand-back universe)
+        self.adopted_all: set = set()
         self.last_ship_ts: Optional[float] = None
 
     def reset(self, start_seq: int) -> None:
@@ -102,6 +172,7 @@ class StandbyReplica:
             self.applied_seq = start_seq - 1
             self.clean = False
             self.promoted = False
+            self.adopted_all = set()
 
     @any_thread
     def _apply_locked(self, rec: tuple) -> None:
@@ -174,16 +245,44 @@ class StandbyReplica:
                 "routes": len(self.routes),
                 "clean": self.clean,
                 "promoted": self.promoted,
+                "peers": list(self.peers),
                 "last_ship_age_s": (
                     round(time.time() - self.last_ship_ts, 1)
                     if self.last_ship_ts else None),
             }
 
 
+class _PeerLink:
+    """One standby's shipping cursor in the fan-out group: its own
+    stream offsets and health; mutated under the manager's
+    ``_q_lock`` (offsets) or the ship lock (state machine)."""
+
+    __slots__ = ("name", "state", "need_hello", "shipped_seq",
+                 "acked_seq", "acked_bytes", "last_ack_ts")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        #: "replicating" | "syncing" | "local_only"
+        self.state = "syncing"
+        self.need_hello = True
+        self.shipped_seq = 0
+        self.acked_seq = 0
+        self.acked_bytes = 0
+        self.last_ack_ts: Optional[float] = None
+
+    def info(self) -> dict:
+        return {"state": self.state,
+                "shipped_seq": self.shipped_seq,
+                "acked_seq": self.acked_seq,
+                "last_ack_age_s": (
+                    round(time.time() - self.last_ack_ts, 1)
+                    if self.last_ack_ts else None)}
+
+
 @shared_state(lock="_q_lock", attrs=("_q",))
 class ReplicationManager:
     """Per-node replication agent: the shipper half (when this node
-    is a primary with a configured standby) plus any standby replicas
+    is a primary with configured standbys) plus any standby replicas
     this node holds for its peers. Attached by ``Cluster.__init__``
     as ``node.replication``; RPC ops route here via
     ``Cluster.handle_rpc``."""
@@ -194,32 +293,40 @@ class ReplicationManager:
         self.replicas: Dict[str, StandbyReplica] = {}
         # shipper state (armed by arm_shipper)
         self.durability = None
-        self.standby: Optional[str] = None
+        self.standbys: Tuple[str, ...] = ()
+        self.peers: Dict[str, _PeerLink] = {}
+        self._ack_quorum = 0
         self._q: List[tuple] = []         # offered, not yet shipped
         self._q_lock = threading.Lock()
+        #: group-commit quorum wait: signaled whenever any standby's
+        #: acked offset advances
+        self._ack_cv = threading.Condition(self._q_lock)
         #: one ship pass at a time: the shipper thread and a
         #: shutdown's synchronous ship_sync must not interleave
-        #: batches (the replica would see a sequence regression and
+        #: batches (a replica would see a sequence regression and
         #: force a pointless resync)
         self._ship_lock = threading.Lock()
         self._flush_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self.offered_seq = 0              # last seq assigned
-        self.shipped_seq = 0              # last seq sent
-        self.acked_seq = 0                # last seq the standby acked
         self._flushed_seq = 0             # locally durable watermark
         self.offered_bytes = 0
-        self.acked_bytes = 0
         self._q_bytes = 0
-        #: "replicating" | "syncing" | "local_only"
-        self.state = "syncing"
-        self._need_hello = True
         self._lag_alarmed = False
+        self._quorum_alarmed = False
+        self._quorum_timed_out = False
+        #: failback hand-offs / promotion checks in flight (primary
+        #: names; single-flight guards)
+        self._failback_busy: set = set()
+        self._promote_busy: set = set()
+        self._fb_lock = threading.Lock()
         self.counters: Dict[str, int] = {
             "repl.shipped": 0, "repl.acked": 0, "repl.ship_errors": 0,
             "repl.resyncs": 0, "repl.dropped": 0,
             "repl.promotions": 0,
+            "repl.quorum.waits": 0, "repl.quorum.timeouts": 0,
+            "repl.failbacks": 0, "repl.failback_errors": 0,
         }
         self._last_fold: Dict[str, int] = {}
         #: thread-recorded alarm transitions, drained on the stats
@@ -230,12 +337,14 @@ class ReplicationManager:
 
     def arm_shipper(self, durability) -> None:
         """Become a replicating primary: ship the journal stream to
-        ``[durability] standby``. Called by Cluster.__init__ when the
-        config names a standby peer."""
+        every ``[durability] standbys`` peer. Called by
+        Cluster.__init__ when the config names standbys."""
         if self._thread is not None:
             return
         self.durability = durability
-        self.standby = durability.cfg.standby
+        self.standbys = tuple(durability.cfg.standby_list)
+        self.peers = {n: _PeerLink(n) for n in self.standbys}
+        self._ack_quorum = int(durability.cfg.ack_quorum)
         durability.repl = self
         self._thread = threading.Thread(
             target=self._ship_main, daemon=True,
@@ -249,14 +358,70 @@ class ReplicationManager:
             self._thread.join(timeout=5)
             self._thread = None
 
+    # -- aggregate offsets (PR 11's single-standby surface) ---------------
+
+    @property
+    def standby(self) -> Optional[str]:
+        """The first configured standby (the PR 11 single-standby
+        accessor; the full group lives in ``peers``)."""
+        return self.standbys[0] if self.standbys else None
+
+    @property
+    def acked_seq(self) -> int:
+        """Fully-replicated watermark: the highest seq EVERY standby
+        acked (the queue-trim floor and the lag baseline)."""
+        if not self.peers:
+            return 0
+        return min(p.acked_seq for p in self.peers.values())
+
+    @property
+    def shipped_seq(self) -> int:
+        if not self.peers:
+            return 0
+        return max(p.shipped_seq for p in self.peers.values())
+
+    @property
+    def last_ack_ts(self) -> Optional[float]:
+        ts = [p.last_ack_ts for p in self.peers.values()
+              if p.last_ack_ts is not None]
+        return max(ts) if ts else None
+
+    @property
+    def state(self) -> str:
+        """Aggregate link state: ``replicating`` only when every
+        standby is; ``partial`` when some are; else the worst of
+        ``local_only``/``syncing`` (single-standby groups reduce to
+        the PR 11 three-state machine exactly)."""
+        if not self.peers:
+            return "syncing"
+        sts = [p.state for p in self.peers.values()]
+        if all(s == "replicating" for s in sts):
+            return "replicating"
+        if any(s == "replicating" for s in sts):
+            return "partial"
+        if any(s == "local_only" for s in sts):
+            return "local_only"
+        return "syncing"
+
+    def quorum_acked_seq(self) -> int:
+        """Highest seq acked by at least ``ack_quorum`` standbys —
+        the quorum durability watermark (``ack_quorum = 0`` reports
+        the best single ack)."""
+        k = max(1, self._ack_quorum)
+        acks = sorted((p.acked_seq for p in self.peers.values()),
+                      reverse=True)
+        if len(acks) < k:
+            return 0
+        return acks[k - 1]
+
     # -- primary side ------------------------------------------------------
 
     @any_thread
     def offer(self, op: tuple) -> None:
         """Queue one journal record for shipping (called from
         DurabilityManager._append, any thread). Bounded: overflow
-        drops the queue whole and schedules a full resync — local
-        durability is never affected."""
+        drops the queue whole and schedules a full resync on every
+        standby — local durability is never affected."""
         with self._q_lock:
             self.offered_seq += 1
             size = _op_size(op)
@@ -266,8 +431,9 @@ class ReplicationManager:
                 self.counters["repl.dropped"] += len(self._q)
                 self._q.clear()
                 self._q_bytes = 0
-                self._need_hello = True
-                self.state = "local_only"
+                for p in self.peers.values():
+                    p.need_hello = True
+                    p.state = "local_only"
                 return
             self._q.append((self.offered_seq, size, op))
             self._q_bytes += size
@@ -281,6 +447,36 @@ class ReplicationManager:
             self._flushed_seq = self.offered_seq
         self._flush_evt.set()
 
+    @executor_thread
+    def wait_quorum(self) -> bool:
+        """Quorum-aware group commit (docs/DURABILITY.md): after the
+        local WAL group commit, block — bounded by
+        ``quorum_timeout_ms`` — until ``ack_quorum`` standbys acked
+        the flushed watermark. Returns False on timeout: the publish
+        path continues (degrade-don't-wedge), the timeout counts,
+        and the ``repl_quorum_degraded`` alarm raises until the
+        quorum catches back up. ``ack_quorum = 0`` never blocks."""
+        k = self._ack_quorum
+        if k <= 0 or self._thread is None:
+            return True
+        with self._ack_cv:
+            target = self._flushed_seq
+            if self.quorum_acked_seq() >= target:
+                self._quorum_timed_out = False
+                return True
+            self.counters["repl.quorum.waits"] += 1
+            deadline = time.monotonic() + \
+                self.durability.cfg.quorum_timeout_ms / 1000.0
+            while self.quorum_acked_seq() < target:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self.counters["repl.quorum.timeouts"] += 1
+                    self._quorum_timed_out = True
+                    return False
+                self._ack_cv.wait(left)
+            self._quorum_timed_out = False
+            return True
+
     @bg_thread
     def _ship_main(self) -> None:
         while not self._stopping:
@@ -292,75 +488,115 @@ class ReplicationManager:
             try:
                 self._ship_pass()
             except Exception:
+                if self._stopping:
+                    return  # transport torn down under the pass
                 log.exception("journal ship pass failed")
 
-    def _peer_ok(self) -> bool:
+    def _peer_ok(self, name: str) -> bool:
         tr = self.cluster.transport
-        return tr.peer_state(self.standby) == "ok" \
-            and self.standby in getattr(tr, "_peers", {self.standby})
+        return tr.peer_state(name) == "ok" \
+            and name in getattr(tr, "_peers", {name})
 
     @bg_thread
     def _ship_pass(self) -> None:
-        """Ship everything durable and pending, bounded per call.
-        Suspect-aware: a standby the failure detector holds unhealthy
-        is not dialed at all — the queue holds (bounded) and the
-        shipper stays/goes local-only until the peer recovers."""
+        """One fan-out pass: ship everything durable and pending to
+        every standby, bounded per call. Suspect-aware: a standby the
+        failure detector holds unhealthy is not dialed at all — the
+        queue holds (bounded) and THAT link stays/goes local-only
+        until its peer recovers; healthy siblings keep shipping."""
         with self._ship_lock:
-            if self.standby not in self.cluster.members \
-                    and self.state != "replicating":
-                return  # standby not joined yet
-            if not self._peer_ok():
-                if self.state == "replicating":
-                    self.state = "local_only"
-                return
-            if self._need_hello:
-                if not self._hello():
-                    return
-            while True:
-                with self._q_lock:
-                    batch = [e for e in self._q
-                             if e[0] <= self._flushed_seq]
-                    batch = batch[:SHIP_BATCH_RECORDS]
-                    if not batch:
-                        return
-                if not self._ship_batch(batch):
-                    return
+            for peer in self.peers.values():
+                try:
+                    self._ship_peer(peer)
+                except Exception:
+                    if self._stopping:
+                        return  # transport torn down under the pass
+                    log.exception("journal ship to %s failed",
+                                  peer.name)
 
     @bg_thread
-    def _hello(self) -> bool:
-        """Full resync: snapshot the primary's durable planes and
-        hand the replica a fresh baseline + the next stream seq."""
+    def _ship_peer(self, peer: _PeerLink) -> None:
+        if peer.name not in self.cluster.members \
+                and peer.state != "replicating":
+            return  # standby not joined yet
+        if not self._peer_ok(peer.name):
+            if peer.state == "replicating":
+                peer.state = "local_only"
+            return
+        if peer.need_hello:
+            if not self._hello(peer):
+                return
+        while True:
+            with self._q_lock:
+                batch = [e for e in self._q
+                         if peer.acked_seq < e[0] <= self._flushed_seq]
+                batch = batch[:SHIP_BATCH_RECORDS]
+            if not batch:
+                if peer.state == "local_only" \
+                        and peer.acked_seq >= self._flushed_seq:
+                    # the link degraded while already fully acked
+                    # (peer went suspect with nothing left to ship):
+                    # with the detector holding it healthy again and
+                    # zero lag there is no call to prove recovery
+                    # with — the stale local_only stamp would stick
+                    # forever
+                    peer.state = "replicating"
+                return
+            if not self._ship_batch(peer, batch):
+                return
+
+    @bg_thread
+    def _hello(self, peer: _PeerLink) -> bool:
+        """Full resync with one standby: snapshot the primary's
+        durable planes and hand its replica a fresh baseline + the
+        next stream seq."""
         d = self.durability
         with self._q_lock:
             # records already queued re-ship after the snapshot (they
             # are idempotent over it); the stream restarts contiguous
             start_seq = self._q[0][0] if self._q else \
                 self.offered_seq + 1
-        snapshot = _primary_snapshot(self.node, d)
+        snapshot = _primary_snapshot(self.node, d, self.standbys)
         try:
             if _faults.enabled and _faults.fire("repl.ship"):
                 raise ConnectionError("injected repl.ship drop")
-            self.cluster.transport.call(
-                self.standby, "repl_hello", self.node.name,
+            reply = self.cluster.transport.call(
+                peer.name, "repl_hello", self.node.name,
                 snapshot, start_seq)
         except (ConnectionError, OSError) as e:
             self.counters["repl.ship_errors"] += 1
-            self.state = "local_only"
+            peer.state = "local_only"
             log.warning("replication hello to %s failed: %s",
-                        self.standby, e)
+                        peer.name, e)
+            return False
+        if isinstance(reply, dict) and reply.get("failback_pending"):
+            # the standby still owns a PROMOTED incarnation of our
+            # state: hold the stream until its failback hand-off
+            # lands (handle_hello scheduled it); not an error
+            peer.state = "syncing"
             return False
         self.counters["repl.resyncs"] += 1
-        self._need_hello = False
-        self.state = "replicating"
+        peer.need_hello = False
+        peer.state = "replicating"
         with self._q_lock:
-            self.acked_seq = max(self.acked_seq, start_seq - 1)
+            # the reset DEFINES the replica's position: a stale
+            # higher ack from a previous replica incarnation must not
+            # survive (it would make every subsequent ship start past
+            # the replica's true offset — a resync→hello live-lock).
+            # The queue still holds every record past start_seq - 1:
+            # start_seq is the queue head (trimmed at the min-ack
+            # floor), or offered + 1 on an empty queue
+            peer.acked_seq = start_seq - 1
+            peer.shipped_seq = min(peer.shipped_seq, start_seq - 1)
+            self._ack_cv.notify_all()
         log.info("replication resync with %s complete (%d sessions, "
-                 "%d routes)", self.standby,
+                 "%d routes)", peer.name,
                  len(snapshot["sessions"]), len(snapshot["routes"]))
         return True
 
     @bg_thread
-    def _ship_batch(self, batch: List[tuple]) -> bool:
+    def _ship_batch(self, peer: _PeerLink,
+                    batch: List[tuple]) -> bool:
         seq0 = batch[0][0]
         records = [op for _s, _b, op in batch]
         nbytes = sum(b for _s, b, _op in batch)
@@ -368,37 +604,56 @@ class ReplicationManager:
             if _faults.enabled and _faults.fire("repl.ship"):
                 raise ConnectionError("injected repl.ship drop")
             reply = self.cluster.transport.call(
-                self.standby, "repl_ship", self.node.name, seq0,
+                peer.name, "repl_ship", self.node.name, seq0,
                 records)
         except (ConnectionError, OSError) as e:
             self.counters["repl.ship_errors"] += 1
-            self.state = "local_only"
+            peer.state = "local_only"
             log.warning("journal ship to %s failed (%s); local-only "
-                        "until the peer recovers", self.standby, e)
+                        "until the peer recovers", peer.name, e)
+            return False
+        if isinstance(reply, dict) and reply.get("failback_pending"):
+            # the standby holds a promoted incarnation of our state:
+            # park the stream until its hand-off lands
+            peer.state = "syncing"
+            peer.need_hello = True
             return False
         if isinstance(reply, dict) and reply.get("resync"):
-            self._need_hello = True
-            return self._hello()
+            peer.need_hello = True
+            return self._hello(peer)
         acked = int(reply["applied"] if isinstance(reply, dict)
                     else reply)
         with self._q_lock:
-            self.shipped_seq = max(self.shipped_seq, batch[-1][0])
-            self.acked_seq = max(self.acked_seq, acked)
-            self.acked_bytes += nbytes
-            self._q = [e for e in self._q if e[0] > self.acked_seq]
+            peer.shipped_seq = max(peer.shipped_seq, batch[-1][0])
+            peer.acked_seq = max(peer.acked_seq, acked)
+            peer.acked_bytes += nbytes
+            floor = min(p.acked_seq for p in self.peers.values())
+            self._q = [e for e in self._q if e[0] > floor]
             self._q_bytes = sum(e[1] for e in self._q)
+            self._ack_cv.notify_all()
         self.counters["repl.shipped"] += len(records)
         self.counters["repl.acked"] += len(records)
-        self.last_ack_ts = time.time()
-        self.state = "replicating"
+        peer.last_ack_ts = time.time()
+        peer.state = "replicating"
         return True
 
-    last_ack_ts: Optional[float] = None
+    @any_thread
+    def schedule_resync(self) -> None:
+        """Force a full re-snapshot to every standby (post-promotion
+        / post-failback: the adopted state must reach this node's own
+        standbys for quorum-grade survival)."""
+        if self._thread is None:
+            return
+        with self._q_lock:
+            for p in self.peers.values():
+                p.need_hello = True
+        self._flush_evt.set()
 
     @any_thread
     def ship_sync(self, timeout: float) -> bool:
         """Drain + ship the tail synchronously (graceful shutdown's
-        bounded hand-off). True when the standby acked everything."""
+        bounded hand-off). True when EVERY standby acked
+        everything."""
         if self._thread is None:
             return True
         with self._q_lock:
@@ -413,24 +668,27 @@ class ReplicationManager:
             with self._q_lock:
                 if self.acked_seq >= self.offered_seq:
                     return True
-            if self.state == "local_only":
+            if all(p.state == "local_only"
+                   for p in self.peers.values()):
                 return False
             time.sleep(0.02)
         return False
 
     def bye(self, clean: bool = False) -> None:
-        """Tell the standby this primary is departing deliberately
-        (it keeps the warm replica, stamped clean — failback-safe)."""
+        """Tell every standby this primary is departing deliberately
+        (each keeps its warm replica, stamped clean —
+        failback-safe)."""
         if self._thread is None:
             return
-        try:
-            self.cluster.transport.call(
-                self.standby, "repl_bye", self.node.name, bool(clean))
-        except (ConnectionError, OSError):
-            pass
+        for name in self.standbys:
+            try:
+                self.cluster.transport.call(
+                    name, "repl_bye", self.node.name, bool(clean))
+            except (ConnectionError, OSError):
+                pass
 
     def lag(self) -> Tuple[int, int]:
-        """(records, bytes) the standby is behind."""
+        """(records, bytes) the slowest standby is behind."""
         with self._q_lock:
             return (max(0, self.offered_seq - self.acked_seq),
                     self._q_bytes)
@@ -442,28 +700,74 @@ class ReplicationManager:
         rep = self.replicas.get(primary)
         if rep is None:
             rep = self.replicas[primary] = StandbyReplica(primary)
-        rep.reset(start_seq)
-        with rep.lock:
-            for cid, dts, sd in snapshot.get("sessions", []):
-                rep.sessions[cid] = [dts, sd]
-            for topic, msg in snapshot.get("retained", []):
-                rep.retained[topic] = msg
-            for topic, ts in snapshot.get("tombstones", []):
-                rep.tombs[topic] = float(ts)
-            for flt, dest, refs in snapshot.get("routes", []):
-                key = (flt, tuple(dest) if isinstance(dest, list)
-                       else dest)
-                rep.routes[key] = int(refs)
-            rep.last_ship_ts = time.time()
+        with rep.op_lock:
+            if rep.promoted:
+                # the primary is back but THIS replica is
+                # authoritative: hold its stream and hand the
+                # adopted state back first
+                self.maybe_failback(primary)
+                return {"failback_pending": True,
+                        "applied": rep.applied_seq}
+            self._drop_stale_duplicates(primary, snapshot)
+            rep.reset(start_seq)
+            with rep.lock:
+                rep.peers = list(snapshot.get("standbys", ()))
+                for cid, dts, sd in snapshot.get("sessions", []):
+                    rep.sessions[cid] = [dts, sd]
+                for topic, msg in snapshot.get("retained", []):
+                    rep.retained[topic] = msg
+                for topic, ts in snapshot.get("tombstones", []):
+                    rep.tombs[topic] = float(ts)
+                for flt, dest, refs in snapshot.get("routes", []):
+                    key = (flt, tuple(dest) if isinstance(dest, list)
+                           else dest)
+                    rep.routes[key] = int(refs)
+                rep.last_ship_ts = time.time()
         log.info("warm standby armed for %s (%d sessions, %d routes,"
                  " %d retained)", primary, len(rep.sessions),
                  len(rep.routes), len(rep.retained))
         return {"applied": rep.applied_seq}
 
+    def _drop_stale_duplicates(self, primary: str,
+                               snapshot: dict) -> None:
+        """A hello is the primary's claim over the cids in its
+        snapshot (it only snapshots sessions it currently holds). A
+        DETACHED local copy of such a cid that the cluster registry
+        does not place here is a crash artifact (a standby that died
+        between a failback apply and its finalize recovers the
+        handed sessions a second time) — drop it, refs and all, so
+        the double-recovery converges instead of double-owning."""
+        cm = self.node.cm
+        for ent in snapshot.get("sessions", []):
+            cid = ent[0]
+            stale = cm._detached.get(cid)
+            if stale is None:
+                continue
+            owner = self.cluster._registry.get(cid)
+            if owner is not None and owner != primary:
+                continue  # registry places it elsewhere: not ours to drop
+            cm._detached.pop(cid, None)
+            self._drop_local_session(cid, stale[0], registry=False)
+            # the registry must follow the custody: leaving OUR
+            # stale owner-authoritative claim in place would have
+            # anti-entropy re-propagate the wrong owner forever
+            self.cluster.reassign_client(cid, primary)
+            log.warning("dropped stale detached duplicate of %r "
+                        "(authoritative primary %s reclaimed it)",
+                        cid, primary)
+
     def handle_ship(self, primary: str, seq0: int, records: list):
         rep = self.replicas.get(primary)
         if rep is None:
             return {"resync": True, "applied": 0}
+        if rep.promoted:
+            # the primary is alive and shipping, but THIS replica is
+            # the authoritative incarnation (a spurious promotion
+            # under a link cut, or a restart mid-failback): park its
+            # stream and hand the state back first
+            self.maybe_failback(primary)
+            return {"failback_pending": True,
+                    "applied": rep.applied_seq}
         return rep.apply_batch(int(seq0), records)
 
     def handle_bye(self, primary: str, clean: bool):
@@ -472,24 +776,98 @@ class ReplicationManager:
             rep.clean = bool(clean)
         return None
 
+    def handle_replica_info(self, primary: str) -> dict:
+        """Promotion-arbitration probe: what this node's replica of
+        ``primary`` holds (co-standbys compare applied offsets)."""
+        rep = self.replicas.get(primary)
+        if rep is None:
+            return {"exists": False}
+        return {"exists": True, "applied_seq": rep.applied_seq,
+                "promoted": rep.promoted,
+                "records": rep.applied_records}
+
     # -- failover ----------------------------------------------------------
 
     def maybe_promote(self, dead: str) -> bool:
         """``dead`` went down (heartbeat detector). If this node is
-        its warm standby, promote the replica — runs AFTER the
-        cluster's normal nodedown purge, so the dead primary's
-        replicated route entries are already gone and re-install
-        remapped to this node."""
+        one of its warm standbys AND wins the promotion arbitration,
+        promote the replica — runs AFTER the cluster's normal
+        nodedown purge, so the dead primary's replicated route
+        entries are already gone and re-install remapped to this
+        node."""
         rep = self.replicas.get(dead)
         if rep is None or rep.promoted:
             return False
-        t0 = time.perf_counter()
+        with self._fb_lock:  # single-flight per primary
+            if dead in self._promote_busy:
+                return False
+            self._promote_busy.add(dead)
         try:
-            summary = self._promote(rep)
-        except Exception:
-            log.exception("standby promotion for %s failed", dead)
-            return False
-        rep.promoted = True
+            return self._maybe_promote_exclusive(rep)
+        finally:
+            with self._fb_lock:
+                self._promote_busy.discard(dead)
+
+    def _maybe_promote_exclusive(self, rep: StandbyReplica) -> bool:
+        dead = rep.primary
+        # serialize the promotion claim through the cluster locker
+        # (majority of live members, suspect-degraded): co-standbys
+        # race their nodedown dispatches, and unserialized crossing
+        # reads of each other's applied offsets (a late in-flight
+        # ship batch landing between the two reads) can elect two
+        # winners — or none
+        lk = getattr(self.cluster, "locker", None)
+        key = f"\x00repl-promote\x00{dead}"
+        deadline = time.monotonic() + 10.0
+        while True:
+            locked = lk.acquire(key) if lk is not None else False
+            try:
+                verdict = self._arbitrate(rep)
+                if verdict == "done":
+                    return False
+                if verdict == "win" \
+                        or time.monotonic() >= deadline:
+                    # "defer" past the deadline is the availability
+                    # fallback: a deferral is only final once a
+                    # winner is VISIBLE — if the candidates' reads
+                    # crossed and everyone deferred, somebody must
+                    # still resurrect the dead primary's sessions (a
+                    # rare dual claim resolves on heal via the
+                    # failback hand-off)
+                    return self._promote_now(rep)
+            finally:
+                if locked:
+                    lk.release(key)
+            # deferred: wait for the better replica to claim it —
+            # OUTSIDE the lock, so the winner is never blocked by a
+            # loser's polling
+            time.sleep(0.5)
+
+    def _promote_now(self, rep: StandbyReplica) -> bool:
+        dead = rep.primary
+        t0 = time.perf_counter()
+        with rep.op_lock:
+            if rep.promoted:
+                return False
+            try:
+                summary = self._promote(rep)
+            except Exception:
+                log.exception("standby promotion for %s failed",
+                              dead)
+                return False
+            # the flag lands INSIDE the transition lock: a hello
+            # arriving from the restarted primary either ran before
+            # this whole section (the promotion then adopts its
+            # fresh snapshot and fails back cleanly) or defers with
+            # failback_pending — it can never reset the replica
+            # between the table installs and this flag
+            rep.promoted = True
+        # the adopted state becomes durable + shipped off-lock (the
+        # checkpoint can be slow; hellos must not stall on it)
+        d = self.node.durability
+        if d is not None and d.wal is not None:
+            d.checkpoint_now(full=True)
+        self.schedule_resync()
         self.counters["repl.promotions"] += 1
         failover_s = time.perf_counter() - t0
         self.last_promotion = dict(summary, primary=dead,
@@ -506,6 +884,43 @@ class ReplicationManager:
         return True
 
     last_promotion: Optional[dict] = None
+    last_failback: Optional[dict] = None
+
+    def _arbitrate(self, rep: StandbyReplica) -> str:
+        """One promotion-arbitration round among the dead primary's
+        surviving standbys: the replica with the highest applied
+        offset wins, ties break to the first node name. Returns
+        ``"done"`` when a co-standby already promoted (it IS the
+        winner), ``"defer"`` when a reachable co-standby beats this
+        replica, ``"win"`` otherwise. Unreachable co-standbys are
+        ignored — availability over a perfect election: a dual
+        promotion is only possible when the standbys cannot reach
+        each other, and resolves on heal via the failback
+        hand-off."""
+        me = str(self.node.name)
+        with rep.lock:
+            peers = list(rep.peers)
+            mine = rep.applied_seq
+        verdict = "win"
+        for other in peers:
+            other = str(other)
+            if other == me or other == rep.primary:
+                continue
+            if not self._peer_ok(other):
+                continue
+            try:
+                info = self.cluster.transport.call(
+                    other, "repl_replica_info", rep.primary)
+            except (ConnectionError, OSError):
+                continue
+            if not isinstance(info, dict) or not info.get("exists"):
+                continue
+            if info.get("promoted"):
+                return "done"
+            oa = int(info.get("applied_seq", 0))
+            if oa > mine or (oa == mine and other < me):
+                verdict = "defer"
+        return verdict
 
     def _promote(self, rep: StandbyReplica) -> dict:
         node = self.node
@@ -517,6 +932,7 @@ class ReplicationManager:
             sessions = {c: list(v) for c, v in rep.sessions.items()}
             retained = dict(rep.retained)
             tombs = dict(rep.tombs)
+            rep.adopted_all = set(sessions)
         # 1. routes: the dead primary's dests remap to this node with
         # exact refcounts; other nodes' dests are live replication's
         # problem, not the replica's
@@ -580,11 +996,9 @@ class ReplicationManager:
             if self.cluster is not None:
                 self.cluster.client_up(cid)
             resurrected += 1
-        # 4. the adopted state becomes durable here too: one full
-        # checkpoint captures routes + sessions + retained at once
-        if node.durability is not None \
-                and node.durability.wal is not None:
-            node.durability.checkpoint_now(full=True)
+        # (the caller checkpoints + resyncs its own shippers after
+        # the promoted flag lands — quorum-grade: the promoted
+        # holder dying next must not lose the adopted state)
         return {"sessions": resurrected, "routes": installed,
                 "retained": len(retained)}
 
@@ -594,13 +1008,305 @@ class ReplicationManager:
         promotion-side analogue of Broker.restore_subscription."""
         self.node.broker.restore_subscription(sess, key, opts)
 
+    # -- failback ----------------------------------------------------------
+
+    @any_thread
+    def retry_failbacks(self) -> None:
+        """Failback trigger of last resort (the cluster heal
+        worker's periodic sweep): a promoted replica whose primary
+        is back, healthy, and a member again hands the state back
+        even when the original trigger — the heal rejoin or the
+        primary's hello — was lost to a transient error or a quiet
+        fully-acked stream that never makes contact."""
+        for primary, rep in list(self.replicas.items()):
+            if not rep.promoted:
+                continue
+            if primary in self.cluster.members \
+                    and self._peer_ok(primary):
+                self.maybe_failback(primary)
+
+    @any_thread
+    def maybe_failback(self, peer: str) -> None:
+        """``peer`` — a primary this node promoted for — is back
+        (auto-heal rejoin, or its hello reached handle_hello). Hand
+        the adopted state over on a background thread; idempotent
+        and single-flight per primary."""
+        rep = self.replicas.get(peer)
+        if rep is None or not rep.promoted:
+            return
+        with self._fb_lock:
+            if peer in self._failback_busy:
+                return
+            self._failback_busy.add(peer)
+        t = threading.Thread(
+            target=self._failback_main, args=(rep,), daemon=True,
+            name=f"repl-failback-{self.node.name}")
+        t.start()
+
+    @bg_thread
+    def _failback_main(self, rep: StandbyReplica) -> None:
+        try:
+            self._failback(rep)
+        except Exception:
+            self.counters["repl.failback_errors"] += 1
+            log.exception("failback to %s failed", rep.primary)
+        finally:
+            with self._fb_lock:
+                self._failback_busy.discard(rep.primary)
+
+    @bg_thread
+    def _failback(self, rep: StandbyReplica) -> None:
+        """The FAILBACK hand-off (docs/DURABILITY.md "Failback"):
+        ship the authoritative post-promotion state back to the
+        restarted primary, then demote. Nothing is removed locally
+        until the primary acked the final chunk — the original dying
+        mid-transfer leaves this node promoted and authoritative."""
+        primary = rep.primary
+        node = self.node
+        cm = node.cm
+        t0 = time.perf_counter()
+        with rep.lock:
+            universe = sorted(rep.adopted_all)
+        # classify the adopted population NOW (post-promotion churn
+        # included): still-detached sessions hand back; sessions
+        # whose clients reconnected HERE stay; the rest closed
+        handed: List[tuple] = []
+        keep: List[str] = []
+        closed: List[str] = []
+        for cid in universe:
+            ent = cm._detached.get(cid)
+            if ent is not None:
+                s, dts, _exp = ent
+                try:
+                    handed.append((cid, float(dts), s.to_wire()))
+                except Exception:
+                    keep.append(cid)  # mutating mid-walk: keep here
+            elif cid in cm._channels:
+                keep.append(cid)
+            else:
+                # adopted here once, gone now. The registry decides
+                # what to tell the primary: owned by the primary
+                # itself → ITS copy is authoritative, say nothing;
+                # owned by another VERIFIED member → it MIGRATED
+                # through a further failover chain (that owner's
+                # hand-off machinery is responsible for it) and the
+                # primary only drops its stale copy. Ownerless (or
+                # claimed by us without a copy): say NOTHING — the
+                # primary keeps its recovered copy. Telling it
+                # "closed" here once dropped the LAST copy of a
+                # quorum-acked session under a racing custody chain;
+                # a possibly-stale resurrection (it expires on its
+                # own clock) always beats data loss
+                owner = self.cluster._registry.get(cid)
+                if owner is not None and owner != primary \
+                        and owner != self.node.name:
+                    keep.append(cid)
+        # failback is HEAL traffic: it goes via call_addr like the
+        # rejoin/anti-entropy path, bypassing the suspect fast-fail
+        # gate — the primary's IO loop stalls while applying big
+        # chunks, gets transiently suspected, and a fast-fail here
+        # would abort (and restart) the hand-off forever at scale
+        tr = self.cluster.transport
+        call_addr = getattr(tr, "call_addr", None)
+        addr = getattr(tr, "_peers", {}).get(primary)
+
+        def _send(payload):
+            if _faults.enabled and _faults.fire("repl.failback"):
+                raise ConnectionError("injected repl.failback drop")
+            if call_addr is not None and addr is not None:
+                return call_addr(addr, "repl_failback",
+                                 self.node.name, payload)
+            return tr.call(primary, "repl_failback",
+                           self.node.name, payload)
+
+        try:
+            for i in range(0, max(len(handed), 1),
+                           FAILBACK_BATCH_SESSIONS):
+                chunk = handed[i:i + FAILBACK_BATCH_SESSIONS]
+                final = i + FAILBACK_BATCH_SESSIONS >= len(handed)
+                payload = {"sessions": chunk, "final": final}
+                if final:
+                    payload["keep"] = keep
+                    payload["closed"] = closed
+                _send(payload)
+        except (ConnectionError, OSError) as e:
+            self.counters["repl.failback_errors"] += 1
+            log.warning("failback to %s failed (%s); staying "
+                        "promoted", primary, e)
+            return
+        # the primary applied everything: drop the handed sessions +
+        # exactly their route refs, re-stage them as the warm replica
+        # (a re-failover re-promotes from here), demote — one
+        # transition-locked section, so a concurrent hello/promotion
+        # can never interleave with the finalize
+        with rep.op_lock:
+            restaged = []
+            for cid, dts, sd in handed:
+                ent = cm._detached.pop(cid, None)
+                if ent is None:
+                    continue
+                self._drop_local_session(cid, ent[0])
+                restaged.append((cid, dts, sd))
+            with rep.lock:
+                rep.sessions.clear()
+                rep.retained.clear()
+                rep.tombs.clear()
+                rep.routes.clear()
+                for cid, dts, sd in restaged:
+                    rep.sessions[cid] = [dts, sd]
+                    for key in sd.get("subscriptions", {}):
+                        flt, dest = _sub_route(key, primary)
+                        rep.routes[(flt, dest)] = \
+                            rep.routes.get((flt, dest), 0) + 1
+                rep.clean = False
+                rep.applied_seq = 0  # the next hello resets
+                rep.adopted_all = set()
+                # count + record BEFORE clearing promoted: an
+                # observer seeing the demotion must also see the
+                # completed hand-off
+                self.counters["repl.failbacks"] += 1
+                fb = {"primary": primary,
+                      "sessions": len(restaged),
+                      "kept": len(keep), "closed": len(closed),
+                      "failback_s":
+                          round(time.perf_counter() - t0, 4)}
+                self.last_failback = fb
+                rep.promoted = False
+        self._events.append(("deactivate", "standby_promoted",
+                             {}, ""))
+        d = node.durability
+        if d is not None and d.wal is not None:
+            d.checkpoint_now(full=True)
+        self.schedule_resync()
+        log.warning("FAILBACK to %s complete in %.1fms: %s",
+                    primary, fb["failback_s"] * 1000.0, fb)
+
+    def handle_failback(self, standby: str, payload: dict) -> dict:
+        """The returning primary's half of FAILBACK: adopt the
+        authoritative post-promotion session state back from the
+        promoted standby (chunked calls; idempotent — a timed-out
+        chunk re-applies cleanly). Stale crash-recovered local
+        copies are replaced by full-state overwrite; sessions the
+        standby kept (their clients reconnected there) or closed
+        drop their stale local copies; LIVE local sessions always
+        win."""
+        from emqx_tpu.session import Session
+
+        node = self.node
+        cm = node.cm
+        me = node.broker.node
+        d = node.durability
+        down_ts = time.time()
+        adopted = 0
+        for cid, dts, sd in payload.get("sessions", []):
+            if cid in cm._channels:
+                continue  # the client already came home live
+            stale = cm._detached.pop(cid, None)
+            if stale is not None:
+                self._drop_local_session(cid, stale[0],
+                                         registry=False)
+            try:
+                sess = Session.from_wire(sd)
+            except Exception as e:
+                log.warning("failback session %r unrecoverable: %s",
+                            cid, e)
+                continue
+            expiry = float(sd.get("expiry_interval", 0.0) or 0.0)
+            if expiry <= 0:
+                continue
+            detach = float(dts) if dts is not None else down_ts
+            if down_ts - detach >= expiry:
+                continue  # expired while failed over
+            sess.client_id = cid
+            sess.broker = node.broker
+            if d is not None:
+                sess.durable = True
+                sess._dur = d
+                d._detach_ts[cid] = detach
+            for key, opts in list(sess.subscriptions.items()):
+                try:
+                    flt, dest = _sub_route(key, me)
+                    node.router.add_route(flt, dest=dest)
+                    node.broker.restore_subscription(sess, key, opts)
+                    if d is not None:
+                        # absolute refcount record: a crash before
+                        # the failback checkpoint still recovers it
+                        d._append(("route", flt, dest,
+                                   node.router.route_refs(flt,
+                                                          dest)))
+                except Exception:
+                    log.exception("failback restore of %r for %r "
+                                  "failed", key, cid)
+            cm._detached[cid] = (sess, detach, expiry)
+            if d is not None:
+                d._append(("sess.state", cid, detach, sd))
+            if self.cluster is not None:
+                self.cluster.client_up(cid)
+            adopted += 1
+        for cid in list(payload.get("keep", ())) + \
+                list(payload.get("closed", ())):
+            stale = cm._detached.pop(cid, None)
+            if stale is not None:
+                self._drop_local_session(cid, stale[0],
+                                         registry=False)
+        if d is not None and d.wal is not None:
+            # the adopted records journaled above must become
+            # locally durable AND shippable now — nothing else runs
+            # on_batch for them (no publish traffic yet on a node
+            # that just came back)
+            d.wal.flush()
+            if d.repl is not None:
+                d.repl.notify_flush()
+        if payload.get("final"):
+            if d is not None and d.wal is not None:
+                # the heavy full checkpoint runs off the transport
+                # IO thread (heartbeats keep flowing); the journal
+                # records above already cover a crash window
+                threading.Thread(
+                    target=lambda: d.checkpoint_now(full=True),
+                    daemon=True,
+                    name=f"failback-ckpt-{node.name}").start()
+            self.schedule_resync()
+            self.last_failback = {"from": standby,
+                                  "applied": adopted,
+                                  "role": "primary"}
+            log.warning("failback from %s applied (%d sessions "
+                        "adopted)", standby, adopted)
+        return {"applied": adopted}
+
+    def _drop_local_session(self, cid: str, sess,
+                            registry: bool = True) -> None:
+        """Remove one locally-held detached session plus exactly its
+        route-ref contributions (failback hand-off finalize and
+        stale-duplicate cleanup). The caller already popped it from
+        ``cm._detached``."""
+        node = self.node
+        me = node.broker.node
+        try:
+            node.broker.detach_subscriber(sess)
+        except Exception:
+            log.exception("detaching handed session %r failed", cid)
+        for key in list(getattr(sess, "subscriptions", {})):
+            try:
+                flt, dest = _sub_route(key, me)
+                if node.router.route_refs(flt, dest) > 0:
+                    node.router.delete_route(flt, dest=dest)
+            except Exception:
+                log.exception("dropping route of %r for %r failed",
+                              key, cid)
+        d = node.durability
+        if d is not None:
+            d.session_closed(cid)
+        if registry and self.cluster is not None:
+            self.cluster.client_down(cid)
+
     # -- observability -----------------------------------------------------
 
     @owner_loop
     def fold(self, metrics, alarms, stats) -> None:
         """Stats-tick fold: counter deltas, lag gauges, and the
-        ``replication_lagging`` alarm with hysteresis. Runs on the
-        main loop."""
+        ``replication_lagging`` / ``repl_quorum_degraded`` alarms
+        with hysteresis. Runs on the main loop."""
         cur = dict(self.counters)
         for name, val in cur.items():
             delta = val - self._last_fold.get(name, 0)
@@ -621,10 +1327,11 @@ class ReplicationManager:
             lag_r, lag_b = self.lag()
             stats.setstat("durability.repl.lag_records", lag_r)
             stats.setstat("durability.repl.lag_bytes", lag_b)
-            if self.last_ack_ts is not None:
+            ack_ts = self.last_ack_ts
+            if ack_ts is not None:
                 stats.setstat(
                     "durability.repl.last_ack_age_s",
-                    int(time.time() - self.last_ack_ts))
+                    int(time.time() - ack_ts))
             cfg = self.durability.cfg
             if not self._lag_alarmed \
                     and lag_r > cfg.repl_lag_alarm_records:
@@ -634,7 +1341,7 @@ class ReplicationManager:
                     details={"lag_records": lag_r,
                              "lag_bytes": lag_b,
                              "state": self.state,
-                             "standby": self.standby},
+                             "standbys": list(self.standbys)},
                     message="journal shipping is behind the "
                             "configured lag bound; durability is "
                             "local-only beyond the acked offset")
@@ -642,6 +1349,26 @@ class ReplicationManager:
                     and lag_r <= cfg.repl_lag_clear_records:
                 self._lag_alarmed = False
                 alarms.deactivate("replication_lagging")
+            if self._ack_quorum > 0:
+                degraded = self._quorum_timed_out and \
+                    self.quorum_acked_seq() < self._flushed_seq
+                if degraded and not self._quorum_alarmed:
+                    self._quorum_alarmed = True
+                    alarms.activate(
+                        "repl_quorum_degraded",
+                        details={"ack_quorum": self._ack_quorum,
+                                 "quorum_acked_seq":
+                                     self.quorum_acked_seq(),
+                                 "flushed_seq": self._flushed_seq,
+                                 "peers": {n: p.state for n, p
+                                           in self.peers.items()}},
+                        message="group commit cannot reach its ack "
+                                "quorum inside the bounded wait; "
+                                "records are durable locally and on "
+                                "fewer than ack_quorum standbys")
+                elif not degraded and self._quorum_alarmed:
+                    self._quorum_alarmed = False
+                    alarms.deactivate("repl_quorum_degraded")
 
     def info(self) -> dict:
         out: dict = {"counters": dict(self.counters)}
@@ -650,19 +1377,29 @@ class ReplicationManager:
             out["role"] = "primary"
             out["state"] = self.state
             out["standby"] = self.standby
+            out["standbys"] = {n: p.info()
+                               for n, p in self.peers.items()}
             out["shipped_seq"] = self.shipped_seq
             out["acked_seq"] = self.acked_seq
             out["offered_seq"] = self.offered_seq
             out["lag_records"] = lag_r
             out["lag_bytes"] = lag_b
+            out["ack_quorum"] = self._ack_quorum
+            out["quorum_acked_seq"] = self.quorum_acked_seq()
+            out["quorum_degraded"] = bool(
+                self._ack_quorum > 0 and self._quorum_timed_out
+                and self.quorum_acked_seq() < self._flushed_seq)
+            ack_ts = self.last_ack_ts
             out["last_ack_age_s"] = (
-                round(time.time() - self.last_ack_ts, 1)
-                if self.last_ack_ts else None)
+                round(time.time() - ack_ts, 1)
+                if ack_ts else None)
         if self.replicas:
             out["standby_for"] = {p: r.info()
                                   for p, r in self.replicas.items()}
         if self.last_promotion is not None:
             out["last_promotion"] = self.last_promotion
+        if self.last_failback is not None:
+            out["last_failback"] = self.last_failback
         return out
 
 
@@ -679,9 +1416,11 @@ def _op_size(op: tuple) -> int:
         return 64
 
 
-def _primary_snapshot(node, durability) -> dict:
+def _primary_snapshot(node, durability, standbys=()) -> dict:
     """The resync baseline: every durable plane as transferable
-    data, same shapes the recovery checkpoint stages."""
+    data, same shapes the recovery checkpoint stages. Carries the
+    primary's standby list — the replica-side promotion-arbitration
+    electorate."""
     state = durability._snapshot_state()
     routes = []
     for flt, dests in node.router.route_table().items():
@@ -690,7 +1429,8 @@ def _primary_snapshot(node, durability) -> dict:
     return {"sessions": state["sessions"],
             "retained": state["retained"],
             "tombstones": state["tombstones"],
-            "routes": routes}
+            "routes": routes,
+            "standbys": list(standbys)}
 
 
 def durable_digest(node) -> str:

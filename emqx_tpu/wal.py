@@ -395,6 +395,10 @@ class WalGroup:
         #: leader commit passes / follower flushes satisfied by one
         self.commits = 0
         self.coalesced = 0
+        #: duration of the last leader commit pass (window sleep +
+        #: write + fsync across shards) — the group_commit_window_ms
+        #: tuning signal (BENCH_MODE=recovery sweep)
+        self.last_commit_ms = 0.0
 
     # -- shard routing -----------------------------------------------------
 
@@ -439,6 +443,7 @@ class WalGroup:
             self._leader = True
         try:
             while True:
+                t0 = time.perf_counter()
                 if self.group_window_ms > 0:
                     # the coalescing window: stragglers' appends land
                     # in the buffers this pass is about to commit
@@ -453,6 +458,8 @@ class WalGroup:
                         ok = w.flush() or ok
                 if any_pending:
                     self.commits += 1
+                    self.last_commit_ms = \
+                        (time.perf_counter() - t0) * 1000.0
                 with self._cv:
                     self._done = upto
                     self._last_ok = ok
@@ -529,6 +536,7 @@ class WalGroup:
             "last_fsync_ms": max(p["last_fsync_ms"] for p in per),
             "group_commits": self.commits,
             "group_coalesced": self.coalesced,
+            "last_commit_ms": round(self.last_commit_ms, 3),
         }
         if self.n > 1:
             out["per_shard"] = per

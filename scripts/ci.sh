@@ -119,19 +119,43 @@ echo "== replicated durability (docs/DURABILITY.md) =="
 # silent data loss at failover, fail fast
 python -m pytest tests/test_replication.py -q
 
+echo "== replication groups + failback (docs/DURABILITY.md) =="
+# the quorum-grade group story: multi-standby fan-out, the K-1 loss
+# survival sweep, bounded quorum waits (ack_quorum=0 async pin),
+# deterministic promotion arbitration, the full failover→failback→
+# re-failover cycle, crash-during-failback double recovery, and
+# promotion under the standby's own live load — a regression here
+# is quorum data loss or a split brain, fail fast
+python -m pytest tests/test_replication_group.py -q \
+    --deselect tests/test_replication_group.py::test_chaos_soak_full
+
+echo "== replication chaos-soak smoke (docs/DURABILITY.md) =="
+# the kill-anything scheduler at a fixed seed and bounded rounds:
+# the 3-node quorum group takes scripted primary kills (a full
+# failover→failback→re-failover cycle) plus randomized node/link
+# kills, asserting after every heal that no quorum-acked record is
+# lost and every plane digest converges. The driver's real run is
+# the 20+-round slow variant (SOAK_ROUNDS)
+SOAK_SEED=1337 SOAK_ROUNDS=4 python -m pytest \
+    tests/test_replication_group.py::test_chaos_soak_smoke -q
+
 echo "== recovery smoke (docs/DURABILITY.md) =="
 # the BENCH_MODE=recovery scenario end-to-end at toy scale: durable
 # QoS1 traffic, a kill -9, and a full journal-replay recovery must
-# run to completion and emit its row (numbers are not gated here —
-# the driver's real-scale run is)
+# run to completion and emit its row, incl. the group-commit window
+# sweep columns (numbers are not gated here — the driver's
+# real-scale run is)
 BENCH_MODE=recovery RECOVERY_ROUTES=1500 RECOVERY_SESSIONS=30 \
     RECOVERY_PUB_ITERS=4 RECOVERY_FSYNC=0 \
+    RECOVERY_GC_FLUSHES=10 RECOVERY_GC_RECS=8 \
     BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
     python bench.py | python -c "import json,sys; \
 rec=json.loads(sys.stdin.readlines()[-1]); \
 assert rec['metric']=='recovery_replay_s' \
     and rec['value'] is not None \
-    and rec['recovery_routes'] == 1500, rec"
+    and rec['recovery_routes'] == 1500 \
+    and rec['gc_window_sweep'] is not None \
+    and len(rec['gc_window_sweep']) == 4, rec"
 
 echo "== cluster heal matrix (docs/CLUSTER.md) =="
 # failure detector (wedged-peer detection, suspect-parks-not-purges,
@@ -145,9 +169,10 @@ echo "== partition-heal + failover smoke (docs/CLUSTER.md) =="
 # the BENCH_MODE=partition scenario end-to-end at toy scale: a
 # 3-node partition with churn on both sides must detect, heal, and
 # reconverge all plane digests with zero manual rejoin — AND the
-# warm-standby failover row must promote with RPO 0 and a
-# digest-verified byte-exact durable state (numbers are not gated
-# here — the driver's real-scale run is; the RPO/digest booleans ARE)
+# warm-standby failover + FAILBACK rows must promote with RPO 0,
+# hand the state back to the restarted primary, and digest-verify
+# byte-exactness on BOTH hops (numbers are not gated here — the
+# driver's real-scale run is; the RPO/digest booleans ARE)
 BENCH_MODE=partition PARTITION_ROUTES=300 PARTITION_SECONDS=1 \
     FAILOVER_SESSIONS=30 FAILOVER_RETAINED=60 \
     BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
@@ -158,7 +183,9 @@ assert rec['metric']=='partition_heal_converge_s' \
     and rec['partition_detect_s'] is not None \
     and rec['failover_s'] is not None \
     and rec['rpo_records'] == 0 \
-    and rec['failover_digest_ok'] is True, rec"
+    and rec['failover_digest_ok'] is True \
+    and rec['failback_s'] is not None \
+    and rec['failback_digest_ok'] is True, rec"
 
 echo "== telemetry (docs/OBSERVABILITY.md) =="
 # the publish-path telemetry suite, incl. the disabled-mode A/B
