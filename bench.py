@@ -1064,6 +1064,18 @@ def overload():
     overload_curve(emit=_emit)
 
 
+def devloss():
+    """BENCH_MODE=devloss — the device-loss recovery window: a
+    device-regime node loses its backend mid-batch, rides the exact
+    host oracle, and auto-recovers (rebuild + kernel rewarm +
+    half-open probe). Records host-fallback msgs/s, rebuild_s,
+    time-to-breaker-closed, and first-batch-after-recovery p99
+    (emqx_tpu/bench_live.py, docs/ROBUSTNESS.md "Device-loss
+    recovery")."""
+    from emqx_tpu.bench_live import devloss as _devloss
+    _devloss(emit=_emit)
+
+
 def latency():
     """BENCH_MODE=latency — the small-batch low-latency operating
     point (VERDICT r4 item 4): per-step device latency of the full
@@ -2785,6 +2797,8 @@ _MODES = {
     "flapstorm": ("flapstorm", "flapstorm_match_p99_ms", "ms"),
     "overload": ("overload", "overload_delivered_msgs_per_s",
                  "msgs/sec"),
+    "devloss": ("devloss", "devloss_host_fallback_msgs_per_s",
+                "msgs/sec"),
     "recovery": ("recovery", "recovery_replay_s", "s"),
     "partition": ("partition", "partition_heal_converge_s", "s"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
@@ -2806,6 +2820,7 @@ _MODE_WORKLOADS = {
     "live": "probe_v1",
     "flapstorm": "flapstorm_v1",
     "overload": "overload_curve_v1",
+    "devloss": "devloss_v1",
     "recovery": "durability_v1",
     "partition": "cluster_heal_v1",
 }

@@ -677,6 +677,156 @@ async def _run_overload() -> dict:
     }
 
 
+def _run_devloss() -> dict:
+    """BENCH_MODE=devloss body — the device-loss recovery window,
+    measured (docs/ROBUSTNESS.md "Device-loss recovery"): a
+    device-regime node under continuous batch traffic loses its
+    backend mid-batch (`device.lost` armed times=0), every batch
+    rides the exact host oracle, the backend returns, and the
+    recovery rebuilds HBM state + re-warms the kernels until the
+    half-open probe closes the breaker. Records the host-fallback
+    throughput during the outage, `rebuild_s`, time-to-breaker-
+    closed after the backend returns, and the p99 of the first
+    post-recovery batches (the kernel-rewarm-stayed-off-the-hot-path
+    proof). Direct ``publish_batch`` driving — per-batch latency is
+    the quantity under test, sockets would only blur it."""
+    from emqx_tpu import faults
+    from emqx_tpu.node import Node
+    from emqx_tpu.overload import DeviceBreaker, OverloadConfig
+    from emqx_tpu.ops.warmup import stamp_first_batch
+    from emqx_tpu.router import MatcherConfig
+    from emqx_tpu.types import Message
+
+    n_filters = int(os.environ.get("DEVLOSS_FILTERS", "600"))
+    n_topics = int(os.environ.get("DEVLOSS_TOPICS", "16"))
+    batch = int(os.environ.get("DEVLOSS_BATCH", "64"))
+    secs = float(os.environ.get("DEVLOSS_SECS", "2"))
+    outage = float(os.environ.get("DEVLOSS_OUTAGE_SECS", "2"))
+
+    node = Node(boot_listeners=False,
+                matcher=MatcherConfig(device_min_filters=0),
+                overload=OverloadConfig(
+                    breaker_failures=2, breaker_cooldown_s=60.0,
+                    rebuild_backoff_s=0.1, sentinel_timeout_s=1.0))
+
+    class _Sink:
+        __slots__ = ("n",)
+
+        def __init__(self):
+            self.n = 0
+
+        def deliver(self, flt, msg):
+            self.n += 1
+
+    sink = _Sink()
+    topics = [f"dv/t{i}" for i in range(n_topics)]
+    for t in topics:
+        node.broker.subscribe(sink, t)
+    pad = _Sink()
+    for i in range(n_filters):
+        node.broker.subscribe(pad, f"dvbg/{i}/x")
+    msgs = [Message(topic=topics[i % n_topics], payload=b"\x00" * 16)
+            for i in range(batch)]
+
+    def drive(seconds, latencies=None):
+        sent = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            tb = time.perf_counter()
+            node.broker.publish_batch(msgs)
+            if latencies is not None:
+                latencies.append((time.perf_counter() - tb) * 1000.0)
+            sent += batch
+        return sent / (time.perf_counter() - t0)
+
+    br = node.broker.breaker
+    rec = br.recovery
+    drive(1.0)  # compile every kernel pre-outage
+    steady_lat = []
+    steady = drive(secs, steady_lat)
+    # the outage: the backend dies mid-traffic; batches host-match
+    out_lat = []
+    faults.arm("device.lost", times=0)
+    try:
+        fallback_rate = drive(outage, out_lat)
+        rebuilding = br.state == DeviceBreaker.REBUILDING
+    finally:
+        faults.disarm("device.lost")
+    t_back = time.perf_counter()
+    # the backend is back: publish until the probe closes the breaker
+    closed = False
+    while time.perf_counter() - t_back < 60.0:
+        node.broker.publish_batch(msgs)
+        if br.state == DeviceBreaker.CLOSED:
+            closed = True
+            break
+        time.sleep(0.02)
+    time_to_closed = time.perf_counter() - t_back
+    # first post-recovery batches: the rewarm proof (no compile tail)
+    post_lat = []
+    for _ in range(20):
+        tb = time.perf_counter()
+        node.broker.publish_batch(msgs)
+        post_lat.append((time.perf_counter() - tb) * 1000.0)
+    info = {
+        "mode": "devloss", "filters": n_filters,
+        "topics": n_topics, "batch": batch,
+        "steady_msgs_per_s": round(steady, 1),
+        "steady_p99_ms": round(
+            float(np.percentile(steady_lat, 99)), 3),
+        "fallback_msgs_per_s": round(fallback_rate, 1),
+        "outage_p99_ms": round(float(np.percentile(out_lat, 99)), 3),
+        "classified_lost_during_outage": rebuilding,
+        "rebuild_s": rec.last_rebuild_s,
+        "rebuilds": rec.rebuilds,
+        "rebuild_failures": rec.rebuild_failures,
+        "time_to_closed_s": round(time_to_closed, 3),
+        "breaker_closed": closed,
+        "first_batch_ms": round(post_lat[0], 3),
+        "deliveries": sink.n,
+    }
+    stamp_first_batch(info, float(np.percentile(post_lat, 99)))
+    return info
+
+
+def devloss(emit=None) -> None:
+    """BENCH_MODE=devloss — the device-loss recovery row: host-
+    fallback msgs/s during the outage (`value`; vs_baseline = the
+    fraction of steady device throughput the oracle window retains),
+    `rebuild_s`, `time_to_closed_s` after the backend returns, and
+    `first_batch_p99_ms` (scripts/ci.sh gates a toy-scale run)."""
+    import sys
+
+    from emqx_tpu.profiling import enable_compile_cache
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    enable_compile_cache()
+    info = _run_devloss()
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    rec = {
+        "metric": "devloss_host_fallback_msgs_per_s",
+        "workload": "devloss_v1",
+        "value": info["fallback_msgs_per_s"],
+        "unit": "msgs/sec",
+        "vs_baseline": round(
+            info["fallback_msgs_per_s"]
+            / max(info["steady_msgs_per_s"], 1.0), 3),
+    }
+    for k in ("steady_msgs_per_s", "steady_p99_ms", "outage_p99_ms",
+              "classified_lost_during_outage", "rebuild_s",
+              "rebuilds", "rebuild_failures", "time_to_closed_s",
+              "breaker_closed", "first_batch_ms",
+              "first_batch_p99_ms"):
+        rec[k] = info[k]
+    if emit is not None:
+        emit(rec)
+    else:
+        print(json.dumps(rec), flush=True)
+
+
 def overload_curve(emit=None) -> None:
     """BENCH_MODE=overload — offered load vs delivered msgs/s vs shed
     fraction, one JSON row with the whole curve (scripts/ci.sh gates

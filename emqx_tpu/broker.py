@@ -30,8 +30,9 @@ import numpy as np
 
 from emqx_tpu import faults
 from emqx_tpu import topic as T
-from emqx_tpu.concurrency import (any_thread, executor_thread,
-                                  owner_loop, shared_state)
+from emqx_tpu.concurrency import (any_thread, bg_thread,
+                                  executor_thread, owner_loop,
+                                  shared_state)
 from emqx_tpu.broker_helper import FanoutManager, unpack_sids
 from emqx_tpu.hooks import Hooks
 from emqx_tpu.metrics import Metrics
@@ -105,7 +106,7 @@ class PendingBatch:
 
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
-        "host_matched", "host_inv", "span",
+        "host_matched", "host_inv", "host_only", "span",
         "plan", "plan_state", "xgroups",
         "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
@@ -130,6 +131,11 @@ class PendingBatch:
         self.host_topics: Optional[List[str]] = None
         self.host_matched = None  # host-path lazy match cache
         self.host_inv = None
+        # breaker fallback: match on the host trie ONLY — an open or
+        # rebuilding breaker means the device plane is suspect, and
+        # the oracle fallback must never re-execute against it (a
+        # LOST backend would raise out of the fallback itself)
+        self.host_only = False
         # batch dispatch plan (ops/dispatch_plan.DispatchPlan), built
         # by publish_fetch when the planner is on and the batch has no
         # capacity-overflow row; None = legacy per-delivery walk
@@ -493,7 +499,8 @@ class Broker:
             # (docs/ROBUSTNESS.md). The automaton is NOT reclaimed —
             # the probe rides it straight back
             self.metrics.inc("breaker.fallback.batches")
-            return self._begin_host(pb, topics, defer_host)
+            return self._begin_host(pb, topics, defer_host,
+                                    host_only=True)
         try:
             return self._begin_device(pb, topics, cfg)
         except Exception:
@@ -505,12 +512,18 @@ class Broker:
             br.record_failure()
             log.exception("device publish dispatch failed — "
                           "host-oracle fallback for this batch")
-            return self._begin_host(pb, topics, defer_host)
+            return self._begin_host(pb, topics, defer_host,
+                                    host_only=True)
 
     def _begin_host(self, pb: PendingBatch, topics: List[str],
-                    defer_host: bool) -> PendingBatch:
+                    defer_host: bool,
+                    host_only: bool = False) -> PendingBatch:
         """The host-path tail of ``publish_begin`` (true host regime,
-        breaker-forced fallback, or a device dispatch failure)."""
+        breaker-forced fallback, or a device dispatch failure).
+        ``host_only`` pins the batch's matching to the host trie —
+        the breaker paths use it so a suspect (or LOST) device plane
+        is never re-entered through ``match_filters``."""
+        pb.host_only = host_only
         sp = pb.span
         if sp is not None:
             sp.path = "host"
@@ -538,6 +551,7 @@ class Broker:
         sp = pb.span
         if faults.enabled:
             faults.fire("device.walk")
+            faults.fire("device.lost")
         uniq, pb.inv = dedup_topics(topics)
         pb.n_uniq = len(uniq)
         if sp is not None:
@@ -650,7 +664,8 @@ class Broker:
             t_m = sp.clock()
         uniq, inv = dedup_topics(topics)
         pb.n_uniq = len(uniq)
-        matched = self.router.match_filters(uniq)
+        matched = (self.router.match_filters_host(uniq)
+                   if pb.host_only else self.router.match_filters(uniq))
         if sp is not None:
             sp.n_uniq = pb.n_uniq
             sp.add("match", t_m)  # host regime: the actual trie walk
@@ -698,11 +713,15 @@ class Broker:
                               "fallback for this batch")
                 # convert the batch to the deferred-host shape:
                 # finish re-matches every live topic on the host trie
-                # (exact), so nothing is delivered wrong or lost
+                # (exact), so nothing is delivered wrong or lost.
+                # host_only: the device just failed mid-batch — the
+                # re-match must not ride it again (a LOST backend
+                # would raise out of the fallback itself)
                 pb.plan = None
                 pb.xgroups = None
                 pb.host_topics = [m.topic for _, m in pb.live]
                 pb.host_matched = None
+                pb.host_only = True
                 return
             br.record_success(time.perf_counter() - t0)
         finally:
@@ -724,6 +743,7 @@ class Broker:
         once, not per batch."""
         if faults.enabled:
             faults.fire("device.fetch")
+            faults.fire("device.lost")
         import jax
 
         sp = pb.span
@@ -934,6 +954,33 @@ class Broker:
                 lambda fid: self.helper.members_sorted(id_map[fid]))
         return build_plan(pb.inv, n_u, pb.ovf, pb.bovf, pb.f_ptr,
                           subs_packed, src_packed, big_map)
+
+    @bg_thread
+    def warm_device_path(self) -> int:
+        """Device-loss recovery, step 3 (devloss.py): execute the
+        real dispatch → fetch kernel chain once per observed batch
+        shape on the recovery thread, so the first post-recovery
+        publish batch pays zero compile (docs/ROBUSTNESS.md
+        "Device-loss recovery"). Drives :meth:`_begin_device` /
+        :meth:`_fetch_device` over synthetic NUL-rooted topics
+        (ops/warmup.py) that no real filter can match — nothing
+        delivers, no hooks or message metrics fire, and the fan-out
+        manager's device tables re-derive at the rebuilt epoch as a
+        side effect. Returns the number of warmed buckets."""
+        from emqx_tpu.ops.warmup import warm_plan
+
+        cfg = self.router.config
+        warmed = 0
+        for _bucket, topics in warm_plan(self._pack_budgets,
+                                         cfg.min_batch):
+            pb = PendingBatch()
+            pb.results = [0] * len(topics)
+            pb.live = [(i, Message(topic=t, payload=b""))
+                       for i, t in enumerate(topics)]
+            self._begin_device(pb, topics, cfg)
+            self._fetch_device(pb)
+            warmed += 1
+        return warmed
 
     @owner_loop
     def publish_finish(self, pb: PendingBatch) -> List[int]:
@@ -1363,7 +1410,9 @@ class Broker:
             if sp is not None:
                 t_m = sp.clock()
             uniq, pb.host_inv = dedup_topics(pb.host_topics)
-            pb.host_matched = self.router.match_filters(uniq)
+            pb.host_matched = (
+                self.router.match_filters_host(uniq) if pb.host_only
+                else self.router.match_filters(uniq))
             if sp is not None:
                 sp.n_uniq = len(uniq)
                 sp.add("match", t_m)

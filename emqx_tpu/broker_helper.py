@@ -246,6 +246,17 @@ class FanoutManager:
             "fanout.version": self._version,
         }
 
+    def invalidate_device(self) -> None:
+        """Device-loss recovery (devloss.py, docs/ROBUSTNESS.md):
+        the cached fan-out snapshots hold CSR/bitmap tables in a
+        dead backend's HBM. Drop them — the next :meth:`state` /
+        :meth:`sharded_state` call re-derives the tables from the
+        live membership ``rows`` at the rebuilt automaton's epoch.
+        Host truth (registry, rows, version) is untouched."""
+        with self._lock:
+            self._state = None
+            self._sharded = None
+
     # -- device snapshot ---------------------------------------------------
 
     def state(self, epoch: int,

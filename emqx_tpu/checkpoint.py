@@ -21,10 +21,15 @@ from __future__ import annotations
 
 import binascii
 import json
+import logging
 import os
 from typing import Optional
 
 import numpy as np
+
+from emqx_tpu import faults
+
+log = logging.getLogger("emqx_tpu.checkpoint")
 
 FORMAT = 2  # v2: compressed walk tables (wt/node2), no CSR arrays
 
@@ -203,7 +208,25 @@ def load(router, path: str, device: Optional[bool] = None) -> dict:
                 v2_states=int(dims[0]), v2_edges=int(dims[1]),
                 wt_slots=int(dims[2]), wt_take=int(dims[3]))
             dev_auto = device_view(host_auto)
-            auto = jax.device_put(dev_auto) if use_dev else dev_auto
+            auto = None
+            try:
+                if faults.enabled:
+                    faults.fire("device.lost")
+                # the straight-to-HBM placement — the same path the
+                # device-loss rebuild reuses (docs/ROBUSTNESS.md)
+                auto = jax.device_put(dev_auto) if use_dev \
+                    else dev_auto
+            except Exception:
+                # restoring onto a dead/absent backend must not kill
+                # the boot: the route log just replayed is always
+                # sufficient — degrade to re-flatten-on-first-match
+                # (at runtime the breaker + devloss recovery own the
+                # lost-backend story)
+                log.exception(
+                    "checkpoint table placement failed — restoring "
+                    "from the route log (re-flatten on first match)")
+                tables = False
+        if tables:
             # a delta-mode restorer keeps no main-table mirror — the
             # saved host arrays still install the walk tables, churn
             # then flows through the side-automaton (docs/DELTA.md)
@@ -298,8 +321,6 @@ def write_manifest(dirpath: str, manifest: dict) -> None:
     The ``checkpoint.rename`` fault point (faults.py) fires just
     before the rename — the crash window in which every new segment
     exists but the PREVIOUS generation is still authoritative."""
-    from emqx_tpu import faults
-
     tmp = os.path.join(dirpath, MANIFEST + ".tmp")
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(manifest, f)

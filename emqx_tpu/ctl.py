@@ -87,7 +87,8 @@ class Ctl:
             "partitions, fid quarantine")
         self.register_command(
             "overload", self._overload,
-            "overload level, samples, shed counters, breaker state")
+            "overload level, samples, shed counters, breaker state "
+            "incl. device-loss recovery (rebuilds, last_rebuild_s)")
         self.register_command(
             "faults", self._faults,
             "list | arm <point[:action[:times[:delay_ms]]]> | "
@@ -102,7 +103,10 @@ class Ctl:
     def _overload(self, args) -> str:
         """One-stop overload diagnosis (docs/ROBUSTNESS.md): current
         level + last sample set, the cumulative shed/heal counters,
-        and the device-path breaker state."""
+        and the device-path breaker state — with the device-loss
+        recovery fields (state incl. ``rebuilding``, classification,
+        rebuilds, rebuild_failures, last_rebuild_s) when the
+        recovery manager is attached."""
         from emqx_tpu.metrics import BREAKER_METRICS, OVERLOAD_METRICS
         ov = self.node.overload
         out = {"enabled": ov is not None}

@@ -1,0 +1,202 @@
+"""Device-loss recovery: rebuild HBM state on a fresh backend and
+auto-close the breaker (docs/ROBUSTNESS.md "Device-loss recovery").
+
+PR 8's circuit breaker survives a *failing* device step — but a LOST
+runtime (preemption, XLA crash, hung backend) left it OPEN forever:
+every half-open probe re-executed against dead buffer references and
+the broker silently host-matched until a process restart. This module
+closes that last unrecoverable domain:
+
+  1. **Classify** — a breaker trip runs a trivial *sentinel* device
+     op on a recovery thread (bounded by ``sentinel_timeout_s``; a
+     hung backend classifies the same as a dead one). Sentinel
+     answers → transient (slow batch / kernel bug): the normal
+     cooldown → half-open probe path handles it, nothing changes.
+  2. **Quarantine + rebuild** — sentinel dead → the breaker enters
+     ``REBUILDING`` (no probe can succeed against dead buffers) and
+     :meth:`Router.rebuild_device_state` reconstructs ALL
+     device-resident state from host authority: trie → fresh tables
+     straight into HBM, delta side-automaton + tombstone mask
+     re-staged, match cache cold-started under a global epoch bump.
+     The fan-out manager's device snapshots are dropped too — the
+     first post-rebuild state build re-derives them from the live
+     membership rows at the new epoch.
+  3. **Re-warm** — ``Broker.warm_device_path`` drives the real
+     dispatch/fetch seams over the observed batch shapes
+     (ops/warmup.py) so the first post-recovery batch pays zero
+     compile.
+  4. **Admit the probe** — only then does the breaker re-arm its
+     half-open window; the probe's success closes it and clears the
+     ``device_path_lost`` alarm (the *device_path_recovered* signal).
+
+Failed rebuild attempts (backend still gone, or gone AGAIN
+mid-rebuild) count ``breaker.rebuild.failures`` and retry with
+exponential backoff — publishes never wedge, they ride the exact
+host-oracle fallback for the whole (measured) window.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from emqx_tpu import faults
+from emqx_tpu.concurrency import any_thread, bg_thread, shared_state
+
+log = logging.getLogger("emqx_tpu.devloss")
+
+
+@bg_thread
+def sentinel_alive(timeout_s: float) -> bool:
+    """One trivial device op, bounded: can the backend still answer?
+    Runs the probe on a disposable daemon thread so a HUNG runtime
+    (the worst failure mode — no exception, no progress) times out
+    into the same LOST verdict a dead one raises into."""
+    out = {}
+
+    def _probe() -> None:
+        try:
+            if faults.enabled:
+                faults.fire("device.lost")
+            import jax
+            import numpy as np
+
+            x = jax.device_put(np.int32(1))
+            out["ok"] = int(x) == 1  # forces the device round trip
+        except Exception:
+            out["ok"] = False
+
+    t = threading.Thread(target=_probe, daemon=True,
+                         name="devloss-sentinel")
+    t.start()
+    t.join(timeout_s)
+    return bool(out.get("ok"))
+
+
+@shared_state(lock="_lock", attrs=("_active",))
+class DeviceRecovery:
+    """The breaker's lost-backend recovery arm (one per node, wired
+    by Node when ``[overload] breaker_rebuild``). All device work
+    happens on a dedicated daemon thread per episode — never on the
+    publish path, never on the event loop."""
+
+    def __init__(self, broker, metrics, alarms,
+                 backoff_s: float = 0.5,
+                 sentinel_timeout_s: float = 5.0) -> None:
+        self.broker = broker
+        self.metrics = metrics
+        self.alarms = alarms
+        self.backoff_s = max(0.01, float(backoff_s))
+        self.sentinel_timeout_s = max(0.1, float(sentinel_timeout_s))
+        self._lock = threading.Lock()
+        self._active = False
+        self._stop = threading.Event()
+        # episode bookkeeping (`ctl overload` breaker block)
+        self.rebuilds = 0
+        self.rebuild_failures = 0
+        self.last_rebuild_s: Optional[float] = None
+        self.last_classification: Optional[str] = None
+        self.last_error: Optional[str] = None
+
+    # -- breaker hook (any thread — fetch executor, event loop) -----------
+
+    @any_thread
+    def on_trip(self, reason: str) -> bool:
+        """A breaker trip landed: classify it on the recovery thread.
+        At most one episode runs at a time — re-trips during an
+        active episode are already being handled."""
+        with self._lock:
+            if self._active or self._stop.is_set():
+                return False
+            self._active = True
+        threading.Thread(target=self._run, args=(reason,),
+                         daemon=True, name="device-recovery").start()
+        return True
+
+    def stop(self) -> None:
+        """Node shutdown: let an in-flight episode exit at its next
+        backoff check instead of rebuilding into a dying process."""
+        self._stop.set()
+
+    # -- the recovery episode (its own daemon thread) ---------------------
+
+    @bg_thread
+    def _run(self, reason: str) -> None:
+        try:
+            self._classify_and_recover(reason)
+        except Exception:
+            log.exception("device-loss recovery episode crashed")
+        finally:
+            with self._lock:
+                self._active = False
+
+    @bg_thread
+    def _classify_and_recover(self, reason: str) -> None:
+        br = self.broker.breaker
+        if sentinel_alive(self.sentinel_timeout_s):
+            # the backend answers: a slow/failed BATCH, not a lost
+            # runtime — the breaker's cooldown → half-open probe
+            # path recovers it without a rebuild
+            self.last_classification = "transient"
+            log.info("breaker trip classified transient (%s): "
+                     "sentinel answered, cooldown probe will decide",
+                     reason)
+            return
+        self.last_classification = "lost"
+        if not br.enter_rebuilding():
+            return  # a racing probe closed the breaker meanwhile
+        if self.alarms is not None:
+            self.alarms.activate(
+                "device_path_lost",
+                details={"reason": reason,
+                         "sentinel_timeout_s": self.sentinel_timeout_s},
+                message="device backend lost: rebuilding HBM state "
+                        "from host-authoritative structures")
+        router = self.broker.router
+        router.suspend_device()
+        # the fan-out manager's device snapshots reference dead HBM;
+        # the next state() call re-derives them at the new epoch
+        self.broker.helper.invalidate_device()
+        backoff = self.backoff_s
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                info = router.rebuild_device_state()
+                self.broker.warm_device_path()
+            except Exception as e:
+                self.rebuild_failures += 1
+                self.metrics.inc("breaker.rebuild.failures")
+                self.last_error = repr(e)[:200]
+                log.warning(
+                    "device-state rebuild failed (attempt %d, "
+                    "backend still gone?): %r — retrying in %.2fs",
+                    self.rebuild_failures, e, backoff)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 30.0)
+                continue
+            self.last_rebuild_s = time.monotonic() - t0
+            self.rebuilds += 1
+            self.metrics.inc("breaker.rebuilds")
+            log.warning(
+                "device state rebuilt in %.3fs (epoch %s, %s filters"
+                ", kernels re-warmed): admitting half-open probe",
+                self.last_rebuild_s, info.get("epoch"),
+                info.get("filters"))
+            br.rebuild_complete()
+            return
+
+    def info(self) -> dict:
+        return {
+            "rebuilding": self._active
+            and self.last_classification == "lost",
+            "classification": self.last_classification,
+            "rebuilds": self.rebuilds,
+            "rebuild_failures": self.rebuild_failures,
+            "last_rebuild_s": (round(self.last_rebuild_s, 3)
+                               if self.last_rebuild_s is not None
+                               else None),
+            "last_rebuild_error": self.last_error,
+        }

@@ -76,6 +76,15 @@ POINTS: Dict[str, tuple] = {
     "device.fetch": ("raise",
                      "Broker.publish_fetch — the device→host "
                      "transfer fails/stalls (executor thread)"),
+    "device.lost": ("raise",
+                    "every device seam — Broker._begin_device "
+                    "dispatch, Broker._fetch_device transfer, the "
+                    "recovery sentinel probe, and the rebuild's "
+                    "fresh-table device placement "
+                    "(Router.rebuild_device_state). Arm times=0: "
+                    "the backend is GONE — every device call raises "
+                    "until disarmed (the fresh backend), unlike the "
+                    "times-bounded device.walk/device.fetch"),
     "executor.death": ("drop",
                        "IngressBatcher._complete — the fetch thread "
                        "pool dies out from under a batch"),

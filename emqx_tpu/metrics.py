@@ -158,10 +158,16 @@ OVERLOAD_METRICS = [
 # device steps that failed (or exceeded breaker_slow_ms), `trips` =
 # closed/half-open → open transitions, `probes` = half-open probe
 # batches admitted, `fallback.batches` = publish batches matched on
-# the exact host oracle because the breaker was open
+# the exact host oracle because the breaker was open or rebuilding.
+# Device-loss recovery (devloss.py): `rebuilds` = successful
+# device-state reconstructions after a lost-backend classification
+# (trie re-flattened straight to HBM, caches cold-started, kernels
+# re-warmed), `rebuild.failures` = rebuild attempts that failed
+# (backend still gone — retried with backoff)
 BREAKER_METRICS = [
     "breaker.failures", "breaker.trips", "breaker.probes",
     "breaker.fallback.batches",
+    "breaker.rebuilds", "breaker.rebuild.failures",
 ]
 
 # fault injection (faults.py): total armed injection points that

@@ -117,6 +117,15 @@ class Node:
                     failures=ocfg.breaker_failures,
                     cooldown_s=ocfg.breaker_cooldown_s,
                     slow_ms=ocfg.breaker_slow_ms)
+                if ocfg.breaker_rebuild:
+                    # device-loss recovery (devloss.py): classify
+                    # trips, rebuild HBM state on a lost backend,
+                    # re-warm kernels, re-arm the half-open probe
+                    from emqx_tpu.devloss import DeviceRecovery
+                    self.broker.breaker.recovery = DeviceRecovery(
+                        self.broker, self.metrics, self.alarms,
+                        backoff_s=ocfg.rebuild_backoff_s,
+                        sentinel_timeout_s=ocfg.sentinel_timeout_s)
             if self.ingress is not None:
                 self.ingress.submit_wait_timeout = \
                     ocfg.ingress_wait_timeout_s
@@ -370,6 +379,12 @@ class Node:
         for t in self._bg_tasks:
             t.cancel()
         self._bg_tasks.clear()
+        br = self.broker.breaker
+        if br is not None and br.recovery is not None:
+            # an in-flight device-state rebuild must not retry into
+            # a dying process (its thread is daemon — this just
+            # breaks the backoff loop early)
+            br.recovery.stop()
         # quiesce module background tasks (scrape sockets, timers)
         # without unloading — start() re-kicks them
         self.modules.on_loop_stop()

@@ -192,6 +192,23 @@ class DeltaAutomaton:
         return (len(self.fids) >= max_filters
                 or len(self.tombs) > max(1024, live))
 
+    def invalidate_device(self) -> None:
+        """Device-loss recovery (docs/ROBUSTNESS.md): the staged
+        device view — side walk tables, tombstone mask, cached
+        snapshot — references a dead backend's HBM. Drop it all and
+        mark dirty; the next :meth:`snapshot` re-flattens the side
+        trie and re-stages the mask on the fresh backend. Host
+        authority (trie, fids, tombs, log) is untouched."""
+        self._host_auto = None
+        self._dev_auto = None
+        self._patcher = None
+        self._flatten_dirty = bool(self.fids)
+        self._mask_dev = None
+        self._mask_cap = 0
+        self._mask_dirty = bool(self.tombs)
+        self._snap = None
+        self._snap_key = None
+
     # -- host match (oracle-fallback union) -------------------------------
 
     def host_match(self, topic: str) -> List[str]:

@@ -23,7 +23,12 @@ The asyncio build needs both built explicitly:
     ``breaker_slow_ms``) trip matching to the exact host-oracle
     fallback the overflow path already uses; after ``cooldown_s`` a
     single half-open probe batch rides the device again and either
-    closes the breaker or re-opens it.
+    closes the breaker or re-opens it. A trip whose sentinel
+    classification says the backend is LOST (not just slow) enters
+    ``REBUILDING`` instead: devloss.DeviceRecovery reconstructs all
+    device-resident state from the host-authoritative structures and
+    only then re-arms the probe window (docs/ROBUSTNESS.md
+    "Device-loss recovery").
 
 ``[overload] enabled = false`` builds none of this: every hot-path
 guard reads a ``None`` attribute and the broker is byte-for-byte the
@@ -94,6 +99,20 @@ class OverloadConfig:
     #: a successful device fetch slower than this counts as a
     #: failure (a stalled device is as bad as a dead one); 0 = off
     breaker_slow_ms: float = 0.0
+    # -- device-loss recovery (devloss.py, docs/ROBUSTNESS.md) ------------
+    #: classify breaker trips with a sentinel device op and, on a
+    #: LOST backend, rebuild all device-resident state from the
+    #: host-authoritative structures before admitting the half-open
+    #: probe; False = the pre-recovery breaker (an open breaker on a
+    #: dead backend probes forever)
+    breaker_rebuild: bool = True
+    #: initial retry backoff after a failed rebuild attempt
+    #: (exponential, capped at 30 s — the device may still be gone)
+    rebuild_backoff_s: float = 0.5
+    #: bound on the sentinel classification op: a backend that
+    #: cannot answer a trivial device op within this is LOST (a hung
+    #: runtime classifies the same as a dead one)
+    sentinel_timeout_s: float = 5.0
 
     def __post_init__(self) -> None:
         if self.interval_s <= 0:
@@ -119,17 +138,26 @@ class OverloadConfig:
             raise ValueError("overload.breaker_failures must be >= 1")
         if self.breaker_cooldown_s <= 0:
             raise ValueError("overload.breaker_cooldown_s must be > 0")
+        if self.rebuild_backoff_s <= 0:
+            raise ValueError("overload.rebuild_backoff_s must be > 0")
+        if self.sentinel_timeout_s <= 0:
+            raise ValueError("overload.sentinel_timeout_s must be > 0")
 
 
 class DeviceBreaker:
     """Circuit breaker on the device publish path (match + fan-out +
     fetch). CLOSED = device serves; OPEN = every batch takes the
     exact host-oracle path; HALF_OPEN = exactly one probe batch rides
-    the device, its outcome decides. Failure recording is
-    thread-safe — fetches run on the ingress executor."""
+    the device, its outcome decides; REBUILDING = the backend was
+    classified LOST and the recovery subsystem (devloss.py) is
+    rebuilding HBM state from the host-authoritative structures — no
+    probe is admitted until the rebuilt tables are published and the
+    kernels re-warmed (a probe against dead buffer references can
+    never succeed). Failure recording is thread-safe — fetches run
+    on the ingress executor, recovery on its own thread."""
 
-    CLOSED, HALF_OPEN, OPEN = 0, 1, 2
-    STATE_NAMES = ("closed", "half_open", "open")
+    CLOSED, HALF_OPEN, OPEN, REBUILDING = 0, 1, 2, 3
+    STATE_NAMES = ("closed", "half_open", "open", "rebuilding")
 
     def __init__(self, metrics, alarms=None, failures: int = 3,
                  cooldown_s: float = 5.0, slow_ms: float = 0.0) -> None:
@@ -143,11 +171,19 @@ class DeviceBreaker:
         self._open_until = 0.0
         self._probing = False
         self._lock = threading.Lock()
+        #: device-loss recovery manager (devloss.DeviceRecovery),
+        #: attached by Node when [overload] breaker_rebuild; None =
+        #: the pre-recovery breaker (OPEN probes forever on a dead
+        #: backend)
+        self.recovery = None
 
     def allow_device(self) -> bool:
         """May this batch use the device path? CLOSED is a lock-free
         read (the per-batch hot-path cost); OPEN returns False until
-        the cooldown elapses, then admits ONE half-open probe."""
+        the cooldown elapses, then admits ONE half-open probe;
+        REBUILDING never admits a probe — :meth:`rebuild_complete`
+        (not the cooldown clock) is what re-arms the half-open
+        window."""
         if self.state == self.CLOSED:
             return True
         with self._lock:
@@ -167,7 +203,12 @@ class DeviceBreaker:
     def record_success(self, elapsed_s: float = 0.0) -> None:
         """A device batch completed. A completion slower than
         ``slow_ms`` counts as a failure — a wedged device that
-        eventually answers must still trip the fallback."""
+        eventually answers must still trip the fallback. A success
+        arriving in OPEN or REBUILDING is a pre-trip in-flight batch
+        completing late: it must NOT close the breaker (nor preempt
+        a rebuild) — the half-open probe is the only evidence that
+        counts (the single-probe invariant, pinned by
+        tests/test_chaos.py)."""
         if self.slow_ms and elapsed_s * 1000.0 > self.slow_ms:
             self.record_failure(
                 reason=f"slow device step {elapsed_s * 1000.0:.0f}ms"
@@ -176,14 +217,20 @@ class DeviceBreaker:
         if self.state == self.CLOSED and not self.failures:
             return
         with self._lock:
+            if self.state in (self.OPEN, self.REBUILDING):
+                return
             was = self.state
             self.state = self.CLOSED
             self.failures = 0
             self._probing = False
         if was != self.CLOSED:
-            log.info("device-path breaker closed: probe succeeded")
+            log.info("device-path breaker closed: probe succeeded "
+                     "(device path recovered)")
             if self.alarms is not None:
                 self.alarms.deactivate("device_path_breaker")
+                # the device_path_lost clear IS the
+                # device_path_recovered signal (docs/OBSERVABILITY.md)
+                self.alarms.deactivate("device_path_lost")
 
     def record_failure(self, reason: str = "device step failed") -> None:
         self.metrics.inc("breaker.failures")
@@ -209,9 +256,42 @@ class DeviceBreaker:
                              "reason": reason},
                     message="device publish path tripped to "
                             "host-oracle fallback")
+            rec = self.recovery
+            if rec is not None:
+                # classify the trip off the hot path: a sentinel
+                # device op distinguishes "slow batch" (transient —
+                # the cooldown probe handles it) from "dead runtime"
+                # (enter REBUILDING and reconstruct HBM state)
+                rec.on_trip(reason)
+
+    def enter_rebuilding(self) -> bool:
+        """OPEN → REBUILDING (the recovery manager classified the
+        backend LOST). False if the breaker moved on meanwhile (a
+        racing probe closed it — nothing to rebuild)."""
+        with self._lock:
+            if self.state not in (self.OPEN, self.HALF_OPEN):
+                return False
+            self.state = self.REBUILDING
+            self._probing = False
+        log.error("device-path breaker REBUILDING: backend lost — "
+                  "reconstructing device state from host structures")
+        return True
+
+    def rebuild_complete(self) -> None:
+        """The rebuilt tables are published and the kernels warmed:
+        admit the half-open probe NOW (no cooldown wait — the probe
+        rides fresh state, not the dead buffers that tripped us)."""
+        with self._lock:
+            if self.state != self.REBUILDING:
+                return
+            self.state = self.HALF_OPEN
+            self._probing = False
+            self.failures = 0
+        log.warning("device-state rebuild complete: half-open probe "
+                    "window armed")
 
     def info(self) -> dict:
-        return {
+        out = {
             "state": self.STATE_NAMES[self.state],
             "failures": self.failures,
             "threshold": self.threshold,
@@ -219,6 +299,10 @@ class DeviceBreaker:
                 max(0.0, self._open_until - time.monotonic()), 3)
             if self.state == self.OPEN else 0.0,
         }
+        rec = self.recovery
+        if rec is not None:
+            out.update(rec.info())
+        return out
 
 
 def read_rss_mb() -> Optional[float]:

@@ -337,6 +337,13 @@ class Router:
         self._pub2: Optional[tuple] = None
         self._freeze: Optional[dict] = None
         self._rebuild_inflight = False
+        # device-loss recovery (devloss.py, docs/ROBUSTNESS.md
+        # "Device-loss recovery"): while True every match routes
+        # through the host trie — the published device snapshots
+        # reference a dead backend's HBM and must not be touched.
+        # Set by suspend_device() at lost-backend classification,
+        # cleared when rebuild_device_state() publishes fresh tables
+        self._device_suspended = False
         # automaton.delta.* / automaton.rebuild.* counters, drained by
         # the stats flush (drain_automaton_stats)
         self._delta_probes = 0
@@ -1274,6 +1281,11 @@ class Router:
         cfg = self.config
         if not cfg.use_device or not self._routes:
             return False
+        if self._device_suspended:
+            # lost backend: every published device snapshot points at
+            # dead HBM — host trie until the rebuild publishes fresh
+            # tables (devloss.py)
+            return False
         if cfg.mesh is not None:
             return True
         return len(self._filter_ids) >= cfg.device_min_filters
@@ -1318,6 +1330,168 @@ class Router:
             self._free_ids.extend(self._pending_free)
             self._pending_free.clear()
             self._bump_cache_rev()  # drained ids may recycle
+
+    # -- device-loss recovery (devloss.py, docs/ROBUSTNESS.md) ------------
+
+    def suspend_device(self) -> None:
+        """Lost-backend classification, step 0: route every match
+        through the host trie until :meth:`rebuild_device_state`
+        publishes fresh tables. One attribute write — matchers that
+        would have gathered from dead HBM buffers (publish dispatch,
+        retained replay, ``match_routes``) take the exact host path
+        instead."""
+        self._device_suspended = True
+        log.error("device matching suspended: backend lost — host "
+                  "trie serves until the rebuild publishes")
+
+    def device_suspended(self) -> bool:
+        return self._device_suspended
+
+    def match_filters_host(self, topics: Sequence[str]) -> List[List[str]]:
+        """Host-only batch match — the breaker's exact oracle
+        fallback. Unlike :meth:`match_filters` this NEVER consults
+        the device, whatever ``use_device_now()`` says: an open or
+        rebuilding breaker means the device plane is suspect, and
+        the fallback must not re-execute against it."""
+        if not topics:
+            return []
+        with self._lock:
+            return [self._host_match_locked(t) for t in topics]
+
+    def _quarantine_locked(self) -> None:
+        """Drop every published reference to the dead backend's HBM
+        state (call under the lock, device already suspended): the
+        published (main, delta) snapshots, the match caches (their
+        table gathers would read dead buffers — cold start), the
+        mesh filler fan, the delta's staged device view. The
+        host-authoritative structures — persistent trie, route
+        table, word table, filter-id assignment — are untouched:
+        they are exactly what the rebuild reads."""
+        self._published = None
+        self._pub2 = None
+        self._match_cache_obj = None
+        self._sharded_cache_obj = None
+        self._sharded_cache_meta = None
+        self._dummy_fan = None
+        if self._delta is not None:
+            self._delta.invalidate_device()
+        self._bump_cache_rev()
+
+    def rebuild_device_state(self) -> dict:
+        """Device-loss recovery (devloss.DeviceRecovery): quarantine
+        the dead published snapshot and rebuild ALL device-resident
+        state from the host-authoritative structures — the
+        persistent trie re-flattens to fresh tables placed straight
+        into HBM (the ``checkpoint.load`` path), the delta
+        side-automaton and tombstone mask re-stage against the new
+        id map, and the match cache cold-starts under a global epoch
+        bump so no stale cached row can ever serve.
+
+        Delta mode reuses the PR 7 off-lock freeze protocol: the
+        flatten runs OFF the router lock, so route ops arriving
+        mid-rebuild complete in ms (deferred into the freeze log +
+        the next delta generation) and host matches stay exact
+        throughout. Non-delta and mesh configurations rebuild under
+        the lock — route ops stall for the flatten (documented
+        degrade, docs/ROBUSTNESS.md; the mesh rebuild is best-effort
+        per-shard via the stacked flatten).
+
+        Raises when the fresh placement fails (backend still dead,
+        or died again mid-rebuild) — the recovery loop retries with
+        backoff. On success the device suspension lifts and the
+        published snapshot serves again."""
+        import time as _time
+
+        # claim the compaction slot: a background flatten may be
+        # mid-flight against the dead device — wait it out (its own
+        # error handling arms the compaction backoff)
+        deadline = _time.monotonic() + 120.0
+        while True:
+            with self._lock:
+                if not self._compacting and self._freeze is None:
+                    self._compacting = True
+                    break
+            if _time.monotonic() > deadline:
+                raise RuntimeError(
+                    "device-state rebuild: background compaction "
+                    "would not yield")
+            _time.sleep(0.01)
+        t0 = _time.perf_counter()
+        try:
+            if faults.enabled:
+                faults.fire("device.lost")
+            with self._lock:
+                offlock = (self._delta_active
+                           and self._auto is not None
+                           and not self._dirty)
+            if offlock:
+                self._rebuild_devloss_offlock()
+            else:
+                with self._lock:
+                    self._quarantine_locked()
+                    self._dirty = True
+                    self._rebuild_locked()
+                    self._device_suspended = False
+        finally:
+            self._compacting = False
+        return {"rebuild_s": _time.perf_counter() - t0,
+                "epoch": self._rebuilds,
+                "filters": len(self._filter_ids)}
+
+    def _rebuild_devloss_offlock(self) -> None:
+        """The delta-mode rebuild body: freeze + quarantine under a
+        short lock, flatten off-lock, place fresh tables, swap +
+        replay under another short lock — :meth:`_compact_offlock`'s
+        protocol with the quarantine folded into the freeze window
+        (route ops landing mid-rebuild go to the freeze log AND the
+        live delta, so the swap's ``split_after`` re-stages them
+        against the fresh id map exactly as a compaction would)."""
+        with self._lock:
+            self._quarantine_locked()
+            self._freeze = {"log": [], "adds": TrieOracle(),
+                            "add_fids": {}, "dels": set()}
+            self._rebuild_inflight = True
+            mark = self._delta.mark() if self._delta is not None else 0
+            n_pend = len(self._pending_free)
+            prev = self._auto
+            cap_s2 = nb = None
+            if prev is not None and prev.node2 is not None:
+                cap_s2 = prev.node2.shape[0] * self._grow["state"]
+                nb = prev.wt.shape[0] * self._grow["edge"]
+        try:
+            host_auto = self._flatten_main(cap_s2, nb)
+            if faults.enabled:
+                faults.fire("device.lost")
+            auto = device_view(host_auto)
+            if self.config.use_device:
+                # straight to HBM — the checkpoint.load restore path
+                auto = jax.device_put(auto)
+        except BaseException:
+            with self._lock:
+                self._unfreeze_locked()
+            raise
+        with self._lock:
+            self._install_walk_meta(host_auto)
+            self._auto = auto
+            self._patcher = None  # delta mode: no main-table mirror
+            self._auto_map = list(self._id_to_filter)
+            # recycle ONLY ids quarantined before the freeze (the
+            # compaction rule: an id freed mid-flatten waits a
+            # generation)
+            self._free_ids.extend(self._pending_free[:n_pend])
+            del self._pending_free[:n_pend]
+            self._dirty = False
+            self._grow = {"state": 1, "edge": 1}
+            self._rebuilds += 1
+            self._bump_cache_rev()
+            self._published = (auto, self._auto_map, self._rebuilds,
+                               self._cache_rev)
+            if self._delta is not None:
+                self._delta = self._delta.split_after(mark)
+            self._delta_ver += 1
+            self._unfreeze_locked()
+            self._publish_pair_locked()
+            self._device_suspended = False
 
     def match_dispatch(self, topics: Sequence[str]):
         """Dispatch-only device match: encode + enqueue the compiled

@@ -49,8 +49,9 @@ STATS_KEYS = [
     "cluster.member.state", "cluster.hb.rtt_ms",
     # overload protection (docs/ROBUSTNESS.md): monitor level (0 ok /
     # 1 warn / 2 critical) and device-path breaker state (0 closed /
-    # 1 half-open / 2 open) — surfaced by lint rule RD204: they were
-    # set dynamically and invisible to registry-built dashboards
+    # 1 half-open / 2 open / 3 rebuilding — device-loss recovery) —
+    # surfaced by lint rule RD204: they were set dynamically and
+    # invisible to registry-built dashboards
     "overload.level", "breaker.state",
     # replicated durability (docs/DURABILITY.md): journal-ship lag
     # and ack age on a replicating primary

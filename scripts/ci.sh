@@ -88,6 +88,17 @@ echo "== chaos suite (docs/ROBUSTNESS.md) =="
 # production-outage bug, fail fast
 python -m pytest tests/test_chaos.py -q
 
+echo "== device-loss recovery suite (docs/ROBUSTNESS.md) =="
+# the lost-backend rounds specifically (also part of the full suite
+# above — re-run focused so a devloss regression is named in CI):
+# lost classification -> REBUILDING -> rebuild + rewarm ->
+# auto-close with exact deliveries, double loss mid-rebuild, the
+# half-open single-probe invariant, host-only fallback, rebuild
+# under route churn vs the host oracle, live QoS1 zero-lost/dup
+python -m pytest tests/test_chaos.py -q \
+    -k "device_lost or device_loss or half_open_single_probe \
+or fallback_never or rebuild_under_route or rebuild_off"
+
 echo "== overload degradation smoke (docs/ROBUSTNESS.md) =="
 # the BENCH_MODE=overload scenario end-to-end at toy scale: the
 # stepped offered-load sweep must run to completion and emit its
@@ -99,6 +110,22 @@ BENCH_MODE=overload OVERLOAD_RATES="500,4000" OVERLOAD_STEP_SECS=1 \
 rec=json.loads(sys.stdin.readlines()[-1]); \
 assert rec['metric']=='overload_delivered_msgs_per_s' \
     and rec['value'] is not None and rec['curve'], rec"
+
+echo "== device-loss recovery smoke (docs/ROBUSTNESS.md) =="
+# the BENCH_MODE=devloss scenario end-to-end at toy scale: the
+# backend dies mid-batch, every outage batch host-matches, and the
+# breaker must auto-close onto rebuilt tables — the closed boolean
+# and the recovery fields are gated (throughput numbers are not)
+BENCH_MODE=devloss DEVLOSS_FILTERS=64 DEVLOSS_SECS=1 \
+    DEVLOSS_OUTAGE_SECS=1 DEVLOSS_BATCH=32 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='devloss_host_fallback_msgs_per_s' \
+    and rec['value'] is not None and rec['breaker_closed'] \
+    and rec['classified_lost_during_outage'] \
+    and rec['rebuilds'] >= 1 and rec['rebuild_s'] is not None \
+    and rec['first_batch_p99_ms'] is not None, rec"
 
 echo "== crash recovery (docs/DURABILITY.md) =="
 # journal framing/torn-tail/degrade semantics (per shard), the

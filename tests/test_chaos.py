@@ -673,3 +673,387 @@ def test_ctl_overload_and_faults_commands():
         ["faults", "arm", "nope"])
     assert node.ctl.run(["faults", "clear"]) == "ok"
     assert not faults.enabled
+
+
+# -- device-loss recovery (devloss.py, docs/ROBUSTNESS.md) -------------------
+#
+# The contract: a LOST backend (every device call raises/hangs, not
+# just one slow batch) is classified by the sentinel, the breaker
+# enters REBUILDING, publishes ride the exact host oracle with zero
+# lost or duplicated deliveries, all device-resident state rebuilds
+# from host authority, the kernels re-warm off the hot path, and the
+# half-open probe auto-closes the breaker — no process restart.
+
+
+def _wait_for(cond, timeout=10.0, step=0.01):
+    deadline = time.monotonic()
+    deadline += timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+def _recovery_cfg(**over):
+    kw = dict(breaker_failures=2, breaker_cooldown_s=30.0,
+              rebuild_backoff_s=0.05, sentinel_timeout_s=1.0)
+    kw.update(over)
+    return OverloadConfig(**kw)
+
+
+def test_device_lost_point_is_persistent():
+    """The device.lost contract vs the times-bounded walk/fetch
+    points: armed times=0, EVERY device call raises until disarmed
+    (the backend is gone, not glitching)."""
+    faults.arm("device.lost", times=0)
+    for _ in range(5):
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("device.lost")
+    assert faults.enabled
+    assert faults.disarm("device.lost")
+    assert faults.fire("device.lost") is False
+    # config knob validation rides along (closed schema)
+    with pytest.raises(ValueError):
+        OverloadConfig(rebuild_backoff_s=0.0)
+    with pytest.raises(ValueError):
+        OverloadConfig(sentinel_timeout_s=-1.0)
+
+
+def test_device_loss_classifies_rebuilds_and_auto_closes():
+    """The tentpole scenario at broker level: a lost backend trips
+    the breaker, the sentinel classifies LOST (not transient), the
+    breaker enters REBUILDING (cooldown_s=30 — any recovery must
+    come through the rebuild, not the cooldown probe), rebuild
+    attempts fail while the backend is still gone, and once it
+    returns the rebuilt tables + re-warmed kernels admit the probe
+    that closes the breaker. Deliveries are exact throughout."""
+    node = _device_node(overload=_recovery_cfg())
+    s = Sink()
+    node.subscribe(s, "dl/+")
+    node.subscribe(s, "dl/#")
+    br = node.broker.breaker
+    rec = br.recovery
+    assert rec is not None
+    # warm the device path so the loss is a regression, not a boot
+    assert node.broker.publish_batch(
+        [Message(topic="dl/t", payload=b"warm")]) == [2]
+    epoch_before = node.router._rebuilds
+    faults.arm("device.lost", times=0)
+    try:
+        # every batch during the outage host-matches exactly
+        for i in range(3):
+            assert node.broker.publish_batch(
+                [Message(topic="dl/t", payload=b"out%d" % i)]) == [2]
+        assert br.state in (DeviceBreaker.OPEN,
+                            DeviceBreaker.REBUILDING)
+        # classification runs off the hot path; the sentinel cannot
+        # answer -> REBUILDING, device matching suspended
+        assert _wait_for(lambda: br.state == DeviceBreaker.REBUILDING)
+        assert rec.last_classification == "lost"
+        assert node.router.device_suspended()
+        assert any(a.name == "device_path_lost"
+                   for a in node.alarms.get_alarms("activated"))
+        # rebuild attempts fail while the backend is still gone
+        assert _wait_for(lambda: rec.rebuild_failures >= 1)
+        assert node.metrics.val("breaker.rebuild.failures") >= 1
+        # publishes still serve, host-only, mid-rebuild
+        assert node.broker.publish_batch(
+            [Message(topic="dl/t", payload=b"mid")]) == [2]
+    finally:
+        faults.disarm("device.lost")
+    # the backend is back: the next attempt rebuilds + re-warms and
+    # arms the half-open window (NOT the 30s cooldown clock)
+    assert _wait_for(lambda: br.state == DeviceBreaker.HALF_OPEN)
+    assert rec.rebuilds == 1
+    assert node.metrics.val("breaker.rebuilds") == 1
+    assert rec.last_rebuild_s is not None
+    assert not node.router.device_suspended()
+    assert node.router._rebuilds > epoch_before  # fresh tables
+    # the probe batch rides the rebuilt tables and closes the breaker
+    assert node.broker.publish_batch(
+        [Message(topic="dl/t", payload=b"probe")]) == [2]
+    assert br.state == DeviceBreaker.CLOSED
+    assert not any(a.name in ("device_path_lost",
+                              "device_path_breaker")
+                   for a in node.alarms.get_alarms("activated"))
+    # zero lost, zero duplicated across the whole episode
+    assert sorted(p for _f, _t, p in s.got) == sorted(
+        2 * [b"warm", b"out0", b"out1", b"out2", b"mid", b"probe"])
+    # ctl surfaces the recovery fields
+    import json as _json
+    out = _json.loads(node.ctl.run(["overload"]))
+    assert out["breaker"]["state"] == "closed"
+    assert out["breaker"]["rebuilds"] == 1
+    assert out["breaker"]["classification"] == "lost"
+    assert out["breaker"]["last_rebuild_s"] is not None
+
+
+def test_device_loss_double_loss_mid_rebuild():
+    """The device dies AGAIN mid-recovery: after the lost
+    classification, the first attempts fail against the still-dead
+    backend; then the rebuild itself succeeds but the warmup phase
+    dies (device.fetch) — the attempt counts as failed and retries,
+    and only a fully clean rebuild+warm admits the probe."""
+    node = _device_node(overload=_recovery_cfg(breaker_failures=1))
+    s = Sink()
+    node.subscribe(s, "dd/1")
+    assert node.broker.publish_batch(
+        [Message(topic="dd/1", payload=b"warm")]) == [1]
+    br = node.broker.breaker
+    rec = br.recovery
+    faults.arm("device.lost", times=0)
+    try:
+        assert node.broker.publish_batch(
+            [Message(topic="dd/1", payload=b"out")]) == [1]
+        assert _wait_for(lambda: rec.rebuild_failures >= 1)
+        # the backend returns... but dies again during kernel warmup
+        faults.arm("device.fetch", action="raise", times=1)
+    finally:
+        faults.disarm("device.lost")
+    assert _wait_for(lambda: br.state == DeviceBreaker.HALF_OPEN)
+    assert rec.rebuild_failures >= 2  # dead-backend + mid-warm death
+    assert rec.rebuilds == 1
+    assert node.broker.publish_batch(
+        [Message(topic="dd/1", payload=b"probe")]) == [1]
+    assert br.state == DeviceBreaker.CLOSED
+    assert sorted(p for _f, _t, p in s.got) == \
+        [b"out", b"probe", b"warm"]
+
+
+def test_half_open_single_probe_invariant():
+    """Satellite pin: concurrent batches arriving during the
+    half-open window must not all ride the device — exactly ONE
+    probe is admitted; and a stale pre-trip success can neither
+    close an OPEN breaker nor preempt a rebuild."""
+    import threading
+
+    from emqx_tpu.metrics import Metrics
+    br = DeviceBreaker(Metrics(), failures=1, cooldown_s=0.05)
+    br.record_failure()
+    assert br.state == DeviceBreaker.OPEN
+    # a pre-trip in-flight batch completing late must NOT close it
+    br.record_success()
+    assert br.state == DeviceBreaker.OPEN
+    time.sleep(0.06)
+    results = []
+    barrier = threading.Barrier(8)
+
+    def probe():
+        barrier.wait()
+        results.append(br.allow_device())
+
+    ts = [threading.Thread(target=probe) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(results) == 1  # exactly one probe admitted
+    assert br.state == DeviceBreaker.HALF_OPEN
+    assert br.allow_device() is False  # probe still in flight
+    br.record_success()
+    assert br.state == DeviceBreaker.CLOSED
+    # REBUILDING admits no probe even past any cooldown, ignores
+    # stale successes, and only rebuild_complete re-arms the window
+    br2 = DeviceBreaker(Metrics(), failures=1, cooldown_s=0.01)
+    br2.record_failure()
+    assert br2.enter_rebuilding()
+    time.sleep(0.03)
+    assert br2.allow_device() is False
+    br2.record_success()
+    assert br2.state == DeviceBreaker.REBUILDING
+    br2.rebuild_complete()
+    assert br2.state == DeviceBreaker.HALF_OPEN
+    assert br2.allow_device() is True
+    br2.record_success()
+    assert br2.state == DeviceBreaker.CLOSED
+
+
+def test_breaker_fallback_never_rides_device():
+    """While the breaker is OPEN or REBUILDING the oracle fallback
+    must not re-enter the device plane through any seam — with a
+    truly lost backend the fallback itself would raise. Pin it by
+    making every router device entry explode."""
+    node = _device_node(overload=_recovery_cfg(breaker_failures=1))
+    s = Sink()
+    node.subscribe(s, "ho/1")
+    assert node.broker.publish_batch(
+        [Message(topic="ho/1", payload=b"warm")]) == [1]
+
+    def boom(*a, **k):
+        raise AssertionError("device path entered during fallback")
+
+    faults.arm("device.lost", times=0)
+    try:
+        assert node.broker.publish_batch(
+            [Message(topic="ho/1", payload=b"trip")]) == [1]
+        assert _wait_for(
+            lambda: node.broker.breaker.state
+            == DeviceBreaker.REBUILDING)
+        node.router.match_dispatch = boom
+        node.router.match_ids = boom
+        node.router._dispatch_sharded = boom
+        # breaker fallback, host regime probe, retained-style lookups
+        assert node.broker.publish_batch(
+            [Message(topic="ho/1", payload=b"fb")]) == [1]
+        assert [r.dest for r in node.router.match_routes("ho/1")] \
+            == [node.broker.node]
+    finally:
+        # restore the seams BEFORE the backend "returns": the
+        # background recovery warms through them the moment the
+        # fault disarms
+        for name in ("match_dispatch", "match_ids",
+                     "_dispatch_sharded"):
+            node.router.__dict__.pop(name, None)
+        faults.disarm("device.lost")
+    assert sorted(p for _f, _t, p in s.got) == \
+        [b"fb", b"trip", b"warm"]
+
+
+def test_rebuild_under_route_churn_parity():
+    """Route ops arriving DURING the rebuild window complete and the
+    rebuilt automaton matches the host oracle byte-exactly on the
+    churned filter set (the PR 7 freeze protocol carries them into
+    the fresh tables + next delta generation)."""
+    node = _device_node(overload=_recovery_cfg(breaker_failures=1))
+    sinks = {f"rc/{i}": Sink() for i in range(6)}
+    for flt, s in sinks.items():
+        node.subscribe(s, flt)
+    assert node.broker.publish_batch(
+        [Message(topic="rc/0", payload=b"warm")]) == [1]
+    br = node.broker.breaker
+    rec = br.recovery
+    faults.arm("device.lost", times=0)
+    late = Sink()
+    try:
+        assert node.broker.publish_batch(
+            [Message(topic="rc/0", payload=b"trip")]) == [1]
+        assert _wait_for(lambda: rec.rebuild_failures >= 1)
+        # stretch the successful attempt's flatten so churn lands in
+        # the freeze window (stall = sleep then proceed normally)
+        faults.arm("compaction.flatten", action="stall", times=1,
+                   delay_ms=300.0)
+    finally:
+        faults.disarm("device.lost")
+    # churn while the rebuild flatten runs off-lock: adds, deletes,
+    # and a brand-new wildcard — all must land in the fresh tables
+    t0 = time.monotonic()
+    node.subscribe(late, "rc/late/+")
+    node.subscribe(late, "rc/0")
+    node.broker.unsubscribe(sinks["rc/5"], "rc/5")
+    churn_s = time.monotonic() - t0
+    assert _wait_for(lambda: br.state == DeviceBreaker.HALF_OPEN,
+                     timeout=15.0)
+    assert churn_s < 5.0  # route ops did not ride the whole flatten
+    assert node.broker.publish_batch(
+        [Message(topic="rc/0", payload=b"probe")]) == [2]
+    assert br.state == DeviceBreaker.CLOSED
+    # parity: device match vs host oracle over the churned set
+    topics = [f"rc/{i}" for i in range(6)] + ["rc/late/x", "rc/none"]
+    dev = node.router.match_filters(topics)
+    host = node.router.match_filters_host(topics)
+    assert [sorted(r) for r in dev] == [sorted(r) for r in host]
+    assert sorted(dev[0]) == ["rc/0"]
+    assert dev[5] == []                     # deleted mid-rebuild
+    assert dev[6] == ["rc/late/+"]          # added mid-rebuild
+    # the mid-rebuild subscriber actually receives
+    assert node.broker.publish_batch(
+        [Message(topic="rc/late/x", payload=b"new")]) == [1]
+    assert late.got[-1][2] == b"new"
+
+
+def test_breaker_rebuild_off_is_legacy_open_forever():
+    """[overload] breaker_rebuild = false: no recovery manager — a
+    lost backend leaves the breaker cycling OPEN exactly as PR 8
+    shipped it (the pre-recovery behavior, selectable)."""
+    node = _device_node(overload=_recovery_cfg(
+        breaker_rebuild=False, breaker_failures=1,
+        breaker_cooldown_s=0.1))
+    s = Sink()
+    node.subscribe(s, "lg/1")
+    br = node.broker.breaker
+    assert br.recovery is None
+    assert node.broker.publish_batch(
+        [Message(topic="lg/1", payload=b"warm")]) == [1]
+    faults.arm("device.lost", times=0)
+    try:
+        assert node.broker.publish_batch(
+            [Message(topic="lg/1", payload=b"t")]) == [1]
+        assert br.state == DeviceBreaker.OPEN
+        time.sleep(0.12)
+        # the cooldown probe re-executes against the dead backend,
+        # fails, and re-opens — forever, by design with rebuild off
+        assert node.broker.publish_batch(
+            [Message(topic="lg/1", payload=b"p")]) == [1]
+        assert br.state == DeviceBreaker.OPEN
+        assert br.state != DeviceBreaker.REBUILDING
+    finally:
+        faults.disarm("device.lost")
+    time.sleep(0.12)
+    assert node.broker.publish_batch(
+        [Message(topic="lg/1", payload=b"ok")]) == [1]
+    assert br.state == DeviceBreaker.CLOSED
+    assert len(s.got) == 4
+
+
+async def test_device_loss_qos1_live_zero_lost_or_duplicated(tmp_path):
+    """The acceptance scenario over real sockets: kill the device
+    mid-stream under DURABLE QoS1 traffic (journal flushing from the
+    very fetch seam that is failing), keep publishing through
+    fallback -> rebuild -> close, and assert every payload was
+    delivered exactly once — zero lost, zero duplicated, no process
+    restart."""
+    from emqx_tpu.durability import DurabilityConfig
+    async with broker_node(
+            matcher=MatcherConfig(device_min_filters=0),
+            durability=DurabilityConfig(
+                enabled=True, dir=str(tmp_path / "dur"), fsync=False),
+            overload=_recovery_cfg(breaker_failures=1,
+                                   sentinel_timeout_s=0.5)) as node:
+        port = node_port(node)
+        sub = TestClient("dlsub")
+        pub = TestClient("dlpub")
+        await sub.connect(port=port)
+        await pub.connect(port=port)
+        await sub.subscribe("dl/t", qos=1)
+        br = node.broker.breaker
+        sent = []
+
+        async def send(i):
+            payload = b"m%03d" % i
+            await pub.publish("dl/t", payload=payload, qos=1)
+            sent.append(payload)
+
+        for i in range(5):          # warm device regime
+            await send(i)
+        faults.arm("device.lost", times=0)
+        try:
+            for i in range(5, 15):  # the outage window
+                await send(i)
+            assert _wait_for(
+                lambda: br.state == DeviceBreaker.REBUILDING,
+                timeout=10.0)
+            for i in range(15, 20):  # mid-rebuild traffic
+                await send(i)
+        finally:
+            faults.disarm("device.lost")
+        # keep publishing until a probe closes the breaker
+        i = 20
+        deadline = time.monotonic() + 20.0
+        while br.state != DeviceBreaker.CLOSED \
+                and time.monotonic() < deadline:
+            await send(i)
+            i += 1
+            await asyncio.sleep(0.05)
+        assert br.state == DeviceBreaker.CLOSED
+        for j in range(i, i + 3):   # post-recovery device traffic
+            await send(j)
+        got = []
+        for _ in sent:
+            got.append(bytes((await sub.recv(timeout=10.0)).payload))
+        assert sorted(got) == sorted(sent)  # exact, no loss, no dup
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.3)     # and nothing extra
+        assert node.metrics.val("breaker.rebuilds") == 1
+        await sub.close()
+        await pub.close()

@@ -306,3 +306,27 @@ def test_delta_mode_saves_routes_only_and_roundtrips(tmp_path):
         ("gone/soon", set()),
     ]:
         assert set(r2.match_filters([topic])[0]) == want, topic
+
+
+def test_restore_onto_lost_backend_degrades_to_route_log(tmp_path):
+    """Device-loss at RESTORE time (docs/ROBUSTNESS.md "Device-loss
+    recovery"): the straight-to-HBM table placement failing must not
+    kill the boot — the route log just replayed is authoritative,
+    matching re-flattens on first use (and at runtime the breaker +
+    devloss recovery own the lost-backend story)."""
+    from emqx_tpu import faults
+
+    r1 = _mk(delta=False)
+    _fill(r1)
+    path = str(tmp_path / "ckpt.npz")
+    assert checkpoint.save(r1, path)["tables"]
+
+    r2 = _mk()
+    with faults.injected("device.lost", times=1):
+        out = checkpoint.load(r2, path)
+    assert out["tables_restored"] is False   # degraded, not crashed
+    assert out["routes"] >= 6                # route log replayed
+    # the backend "returns": first match re-flattens and is exact
+    assert set(r2.match_filters(["a/b"])[0]) == {"a/b", "a/+"}
+    assert set(r2.match_filters(["x/any/depth"])[0]) == {"x/#"}
+    assert r2.stats()["rebuilds"] >= 1       # the lazy re-flatten
