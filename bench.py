@@ -1076,6 +1076,17 @@ def devloss():
     _devloss(emit=_emit)
 
 
+def drain():
+    """BENCH_MODE=drain — the zero-downtime graceful-drain operation
+    (docs/OPERATIONS.md): a 2-node cluster, DRAIN_SESSIONS detached
+    persistent sessions + DRAIN_LIVE live clients on the draining
+    node; records sessions drained/s, redirect wave p99,
+    time-to-empty, and the zero-RPO boolean (digest-verified custody
+    hand-off, exactly one holder)."""
+    from emqx_tpu.bench_live import drain as _drain
+    _drain(emit=_emit)
+
+
 def latency():
     """BENCH_MODE=latency — the small-batch low-latency operating
     point (VERDICT r4 item 4): per-step device latency of the full
@@ -2799,6 +2810,7 @@ _MODES = {
                  "msgs/sec"),
     "devloss": ("devloss", "devloss_host_fallback_msgs_per_s",
                 "msgs/sec"),
+    "drain": ("drain", "drain_time_to_empty_s", "s"),
     "recovery": ("recovery", "recovery_replay_s", "s"),
     "partition": ("partition", "partition_heal_converge_s", "s"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
@@ -2821,6 +2833,7 @@ _MODE_WORKLOADS = {
     "flapstorm": "flapstorm_v1",
     "overload": "overload_curve_v1",
     "devloss": "devloss_v1",
+    "drain": "drain_v1",
     "recovery": "durability_v1",
     "partition": "cluster_heal_v1",
 }

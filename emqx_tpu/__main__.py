@@ -98,11 +98,38 @@ def main(argv=None) -> int:
                   flush=True)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
-        for sig in (signal.SIGINT, signal.SIGTERM):
+        hold = []  # strong ref: a weakly-held drain task could be GC'd
+
+        async def _drain_then_stop():
+            # SIGTERM drain mode ([drain] on_sigterm,
+            # docs/OPERATIONS.md): redirect clients in paced waves
+            # and hand session custody over, bounded by the grace
+            # window, before the normal graceful stop
             try:
-                loop.add_signal_handler(sig, stop.set)
-            except NotImplementedError:
-                pass
+                dr = node.drain
+                if not dr.active:
+                    dr.start()
+                await dr.wait(dr.cfg.sigterm_grace_s)
+            except Exception:
+                logging.getLogger("emqx_tpu").exception(
+                    "SIGTERM drain failed; stopping anyway")
+            finally:
+                stop.set()
+
+        def _term():
+            if node.drain.cfg.on_sigterm and not node.drain.active \
+                    and not stop.is_set():
+                hold.append(loop.create_task(_drain_then_stop()))
+            else:
+                # no drain mode, a drain already running, or a
+                # SECOND SIGTERM: stop now
+                stop.set()
+
+        try:
+            loop.add_signal_handler(signal.SIGINT, stop.set)
+            loop.add_signal_handler(signal.SIGTERM, _term)
+        except NotImplementedError:
+            pass
         await stop.wait()
         await node.stop()
 

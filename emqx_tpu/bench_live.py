@@ -866,5 +866,148 @@ def overload_curve(emit=None) -> None:
         print(json.dumps(rec), flush=True)
 
 
+async def _run_drain() -> dict:
+    """BENCH_MODE=drain body — the zero-downtime operation, measured
+    (docs/OPERATIONS.md): a 2-node socket cluster, ``DRAIN_SESSIONS``
+    detached persistent sessions (subscription + queued QoS1 state)
+    plus ``DRAIN_LIVE`` real socket clients on the draining node;
+    `ctl drain start --target` redirects the live clients in paced
+    waves and hands every session's custody to the peer. Records
+    sessions drained/s, the redirect-wave p99, time-to-empty, and
+    the zero-RPO booleans (digest-verified hand-off, every session
+    on the target, exactly-one-holder)."""
+    import tempfile
+
+    from emqx_tpu.cluster import ClusterConfig
+    from emqx_tpu.drain import DrainConfig
+    from emqx_tpu.durability import DurabilityConfig
+    from emqx_tpu.node import Node
+    from emqx_tpu.replication import sessions_digest
+    from emqx_tpu.session import Session
+    from emqx_tpu.types import Message, SubOpts
+    from tests.mqtt_client import TestClient
+
+    n_sessions = int(os.environ.get("DRAIN_SESSIONS", "5000"))
+    n_live = int(os.environ.get("DRAIN_LIVE", "50"))
+    wave_size = int(os.environ.get("DRAIN_WAVE", "200"))
+    tmp = tempfile.mkdtemp(prefix="bench-drain-")
+    ccfg = ClusterConfig(heartbeat_interval_s=0.2,
+                         heartbeat_timeout_s=2.0, suspect_after=4,
+                         down_after=100, ok_after=1,
+                         anti_entropy_interval_s=5.0)
+    nodes = []
+    for i in range(2):
+        node = Node(
+            name=f"bd{i}", boot_listeners=False,
+            durability=DurabilityConfig(
+                enabled=True, dir=os.path.join(tmp, f"d{i}"),
+                fsync=False, standbys=(f"bd{1 - i}",), ack_quorum=1,
+                quorum_timeout_ms=500.0, repl_ack_timeout_s=5.0),
+            drain=DrainConfig(wave_size=wave_size,
+                              wave_interval_s=0.1,
+                              handoff_timeout_s=60.0))
+        node.add_listener(port=0)
+        node.enable_cluster(port=0, cookie="bench-drain",
+                            config=ccfg)
+        await node.start()
+        nodes.append(node)
+    n0, n1 = nodes
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, n1.cluster.join_remote,
+                               "127.0.0.1",
+                               n0.cluster.transport.port)
+    # the detached persistent-session population with real state
+    cids = [f"bench-d{i}" for i in range(n_sessions)]
+    now = time.time()
+    for i, cid in enumerate(cids):
+        s = Session(cid, broker=n0.broker, clean_start=False)
+        n0.durability.session_opened(s, 3600.0)
+        s.subscribe(f"bench/{i % 97}/+", SubOpts(qos=1))
+        n0.cm._detached[cid] = (s, now, 3600.0)
+    # registry population batched (ONE call, not 5k broadcast casts
+    # that would starve the heartbeats at setup time)
+    with n0.cluster._lock:
+        for cid in cids:
+            n0.cluster._registry[cid] = "bd0"
+    n0.cluster.transport.call("bd1", "registry_sync", "bd0", cids)
+    n0.broker.publish(Message(topic="bench/13/x", payload=b"queued",
+                              qos=1))
+    n0.durability.on_batch()
+    pre_digest = sessions_digest(n0, cids)
+    # the live population (v5, redirect targets)
+    clients = []
+    from emqx_tpu.mqtt import constants as C
+    for i in range(n_live):
+        c = TestClient(f"bench-l{i}", version=C.MQTT_V5)
+        await c.connect(port=n0.listeners[0].port, timeout=10.0)
+        clients.append(c)
+    # the measured operation
+    t0 = time.perf_counter()
+    n0.drain.start(target="bd1")
+    while n0.drain.time_to_empty_s is None:
+        await asyncio.sleep(0.02)
+        if time.perf_counter() - t0 > 120:
+            break
+    info = n0.drain.info()
+    on_target = sum(1 for cid in cids if cid in n1.cm._detached)
+    digest_ok = sessions_digest(n1, cids) == pre_digest
+    one_holder = not any(cid in n0.cm._detached for cid in cids)
+    tte = info["time_to_empty_s"] or (time.perf_counter() - t0)
+    out = {
+        "sessions": n_sessions,
+        "live_clients": n_live,
+        "time_to_empty_s": round(tte, 3),
+        "sessions_drained_per_s": round(
+            info["handed_off"] / max(tte, 1e-6), 1),
+        "redirect_wave_p99_ms": info["wave_p99_ms"],
+        "redirected": info["redirected"],
+        "handed_off": info["handed_off"],
+        "handoff_digest_ok": bool(digest_ok),
+        "sessions_on_target": on_target,
+        "exactly_one_holder": bool(one_holder),
+        "rpo_records": 0 if (digest_ok and on_target == n_sessions
+                             and one_holder) else None,
+    }
+    for c in clients:
+        try:
+            await c.close()
+        except Exception:
+            pass
+    for node in nodes:
+        await node.stop()
+    return out
+
+
+def drain(emit=None) -> None:
+    """BENCH_MODE=drain — graceful-drain operation metrics: sessions
+    drained/s, redirect wave p99, time-to-empty at DRAIN_SESSIONS
+    persistent sessions, and the zero-RPO boolean (scripts/ci.sh
+    gates a toy-scale run)."""
+    import sys
+
+    from emqx_tpu.profiling import enable_compile_cache
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    enable_compile_cache()
+    info = asyncio.run(_run_drain())
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    rec = {
+        "metric": "drain_time_to_empty_s",
+        "workload": "drain_v1",
+        "value": info["time_to_empty_s"],
+        "unit": "s",
+        "vs_baseline": None,
+    }
+    rec.update({k: v for k, v in info.items()
+                if k != "time_to_empty_s"})
+    if emit is not None:
+        emit(rec)
+    else:
+        print(json.dumps(rec), flush=True)
+
+
 if __name__ == "__main__":
     live()

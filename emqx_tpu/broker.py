@@ -74,6 +74,10 @@ class DispatchConfig:
     #: when the planner is off (there is no plan to walk).
     preserialize: bool = True
 
+    #: live-reloadable knobs (emqx_tpu/reload.py): both flags are
+    #: read per publish batch (not a dataclass field: unannotated)
+    RELOADABLE = frozenset({"planner", "preserialize"})
+
 
 class _PlanState:
     """Per-batch host routing state the planned delivery tail shares

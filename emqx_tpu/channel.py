@@ -43,6 +43,7 @@ from emqx_tpu.mqtt.packet import (Auth, Connack, Connect, Disconnect,
                                   Pingreq, Pingresp, Suback, Subscribe,
                                   Unsuback, Unsubscribe, check, to_message,
                                   from_message, will_msg)
+from emqx_tpu.cm import SessionUnavailableError
 from emqx_tpu.session import (PUBREL_MARKER, Session, SessionError)
 from emqx_tpu.types import Message, SubOpts
 from emqx_tpu.utils.base62 import encode as b62encode
@@ -151,7 +152,9 @@ class Channel:
     def _ack(self, ptype: int, pid: int, rc: int = RC.SUCCESS) -> PubAck:
         return PubAck(type=ptype, packet_id=pid, reason_code=rc)
 
-    def _connack_error(self, rc5: int) -> List[Packet]:
+    def _connack_error(self, rc5: int,
+                       props: Optional[Dict[str, Any]] = None
+                       ) -> List[Packet]:
         rc = rc5 if self.proto_ver == C.MQTT_V5 else RC.compat("connack", rc5)
         self.broker.metrics.inc("packets.connack.error")
         if rc5 in (RC.BAD_USERNAME_OR_PASSWORD, RC.NOT_AUTHORIZED):
@@ -163,6 +166,9 @@ class Channel:
         self.close_after_send = True
         self.broker.metrics.inc("packets.connack.sent")
         self.broker.metrics.inc("client.connack")
+        if props and self.proto_ver == C.MQTT_V5:
+            # e.g. Server-Reference on a draining node's 0x9C
+            return [Connack(reason_code=rc, properties=props)]
         return [Connack(reason_code=rc)]
 
     # -- inbound ----------------------------------------------------------
@@ -230,6 +236,18 @@ class Channel:
             # (docs/ROBUSTNESS.md)
             self.broker.metrics.inc("overload.shed.connect")
             return self._connack_error(RC.SERVER_BUSY)
+        dr = getattr(self.broker, "draining", None)
+        if dr is not None and dr.rejects_connects():
+            # DRAINING (docs/OPERATIONS.md): new CONNECTs go to the
+            # drain target — v5 gets 0x9C Use-Another-Server plus a
+            # Server-Reference when one is configured, v3 the
+            # server-unavailable compat code (there is no redirect
+            # on its wire)
+            self.broker.metrics.inc("drain.rejected.connects")
+            ref = dr.server_ref()
+            return self._connack_error(
+                RC.USE_ANOTHER_SERVER,
+                props={"Server-Reference": ref} if ref else None)
         # TLS-cert-derived username overrides the packet's, and feeds
         # everything downstream (clientid derivation, auth, ACLs,
         # bans) exactly as the reference's setting_peercert_infos
@@ -333,8 +351,16 @@ class Channel:
                 pkt.properties.get("Topic-Alias-Maximum", 0) or 0)
             self.client_max_packet = pkt.properties.get(
                 "Maximum-Packet-Size")
-        self.session, session_present = self.cm.open_session(
-            client_id, pkt.clean_start, self, sess_opts)
+        try:
+            self.session, session_present = self.cm.open_session(
+                client_id, pkt.clean_start, self, sess_opts)
+        except SessionUnavailableError:
+            # the registered session owner is transiently suspect
+            # (cm.py): ServerBusy — the client's retry lands after
+            # the failure detector settles the owner's fate, and the
+            # session is never silently replaced by a fresh one
+            self.broker.metrics.inc("overload.shed.connect")
+            return self._connack_error(RC.SERVER_BUSY)
         self.session.broker = self.broker
         self.session.notify = self._notify_deliver
         # egress pre-serialization hints (read off-loop by
@@ -1057,11 +1083,40 @@ class Channel:
         self.disconnect_reason = "discarded" if discard else "kicked"
         self._shutdown(rc=RC.SESSION_TAKEN_OVER)
 
+    # -- drain redirect (called by DrainManager via the CM marshal) -------
+
+    def drain_redirect(self, server_ref: Optional[str] = None) -> None:
+        """Server-initiated redirect (docs/OPERATIONS.md): v5 clients
+        get DISCONNECT 0x9C Use-Another-Server with a
+        Server-Reference; v3 clients a plain close (their protocol
+        has no server DISCONNECT) and find the peer through the
+        cluster registry on reconnect. The will is suppressed exactly
+        like the cm takeover path — custody is moving, the session is
+        not dying — and the close queues behind any batched publish
+        acks still pending, so a publisher never loses an ack it was
+        owed (the rolling-restart zero-RPO ordering)."""
+        if self.closed or self.state != CONNECTED:
+            return
+
+        def _go(_f=None) -> None:
+            if self.closed:
+                return
+            self.will = None  # custody hand-off, not session death
+            self.disconnect_reason = "drained"
+            self._shutdown(rc=RC.USE_ANOTHER_SERVER,
+                           server_ref=server_ref)
+
+        if self._pending_pubs:
+            self._pending_pubs[-1].add_done_callback(_go)
+        else:
+            _go()
+
     # -- teardown ----------------------------------------------------------
 
     def _shutdown(self, publish_will: Optional[bool] = None,
                   rc: Optional[int] = None,
-                  close_transport: bool = True) -> None:
+                  close_transport: bool = True,
+                  server_ref: Optional[str] = None) -> None:
         if self.closed:
             return
         self.closed = True
@@ -1071,10 +1126,14 @@ class Channel:
                 and self.proto_ver == C.MQTT_V5
                 and self.send_oob is not None):
             # tell the victim why before closing (e.g. DISCONNECT
-            # 0x8E session-taken-over on kick/takeover — the
-            # reference's handle_call({takeover,...}) reply path)
+            # 0x8E session-taken-over on kick/takeover, 0x9C + the
+            # Server-Reference on a drain redirect — the reference's
+            # handle_call({takeover,...}) reply path)
+            props = ({"Server-Reference": server_ref}
+                     if server_ref else {})
             try:
-                self.send_oob([Disconnect(reason_code=rc)])
+                self.send_oob([Disconnect(reason_code=rc,
+                                          properties=props)])
             except Exception:
                 pass
         if publish_will is None:
@@ -1100,7 +1159,12 @@ class Channel:
                 (dict(self.clientinfo), self.disconnect_reason or "normal"))
             flapping = getattr(self.broker, "flapping", None)
             if flapping is not None and self.zone.enable_flapping_detect:
-                flapping.disconnected(self.client_id, self.peername[0])
+                # the reason tags server-initiated disconnects (drain
+                # redirect, graceful shutdown) so flapping exempts
+                # them — an operator drain must never auto-ban a
+                # fleet (the ban replicates cluster-wide)
+                flapping.disconnected(self.client_id, self.peername[0],
+                                      reason=self.disconnect_reason)
         if self.client_id and self.session is not None:
             self.cm.connection_closed(
                 self.client_id, self, self.session, self.expiry_interval)

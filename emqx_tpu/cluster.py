@@ -99,6 +99,19 @@ class ClusterConfig:
     redial_backoff_s: float = 0.5
     redial_backoff_max_s: float = 5.0
 
+    #: live-reloadable knobs (emqx_tpu/reload.py, docs/OPERATIONS.md):
+    #: the detector loop and the call gate read these per round /
+    #: per call off the shared config object. ``detector`` decides
+    #: what gets built; ``call_timeout_s`` is captured by the
+    #: transport at construction; ``anti_entropy_interval_s`` by the
+    #: heal worker's queue timeout (not a dataclass field:
+    #: unannotated)
+    RELOADABLE = frozenset({
+        "heartbeat_interval_s", "heartbeat_timeout_s",
+        "suspect_after", "down_after", "ok_after", "auto_heal",
+        "suspect_fast_fail", "redial_backoff_s",
+        "redial_backoff_max_s"})
+
     def __post_init__(self) -> None:
         if self.heartbeat_interval_s <= 0:
             raise ValueError("cluster.heartbeat_interval_s must be > 0")
@@ -228,6 +241,13 @@ class Cluster:
         # Mnesia bag emqx_channel_registry); covers live and detached
         # sessions so cross-node takeover can find the owner
         self._registry: Dict[str, str] = {}
+        # takeover parking (docs/OPERATIONS.md): a session handed out
+        # by ``takeover_client`` whose REPLY is lost (stale link mid
+        # rolling-restart) must not evaporate — the owner parks it
+        # until the taker's client_up confirms custody; the taker's
+        # retry (paced by the ServerBusy answer) collects it.
+        # cid -> (session, parked_at); TTL-pruned, client_up-cleared
+        self._takeover_parked: Dict[str, tuple] = {}
         # distributed per-clientid lock (emqx_cm_locker / ekka_locker
         # quorum) — taken by cm around open/discard/takeover
         from emqx_tpu.cm_locker import ClusterLocker
@@ -373,9 +393,10 @@ class Cluster:
         # reap what we just proved dead, the way every other
         # ConnectionError site here does — the dead name must not
         # linger as a member/broadcast target until some later cast
-        # happens to fail. Suspect members are NOT reaped.
+        # happens to fail. Suspect members are NOT reaped, and with
+        # the detector armed the verdict is deferred to it.
         for m in unreachable:
-            self.handle_nodedown(m)
+            self._peer_call_failed(m)
 
     @any_thread
     def _set_members(self, members: List[str]) -> None:
@@ -387,6 +408,18 @@ class Cluster:
             for r in self.node.router.lookup_routes(flt):
                 if self._owned(r.dest, self.name):
                     self._broadcast("route_add", flt, r.dest)
+        # ...and this node's clientid-registry claims, batched (ONE
+        # cast per peer). The registry was the only replicated plane
+        # the join sync skipped: a freshly restarted node served
+        # reconnects with a FRESH session (session-present false,
+        # stranding the real session's queued messages on its
+        # holder) for the anti-entropy interval — the rolling-restart
+        # proof tripped exactly this window
+        with self._lock:
+            owned = [c for c, n in self._registry.items()
+                     if n == self.name]
+        if owned:
+            self._broadcast("registry_sync", self.name, owned)
         # new joiners also need our shared-group weights
         for (group, flt), members in \
                 self.node.broker.shared._subs.items():
@@ -438,6 +471,24 @@ class Cluster:
             self.members = [self.name]
         for m in ex:
             self._purge_node_routes(m)
+
+    @any_thread
+    def _peer_call_failed(self, name: str) -> None:
+        """A call/cast to a member failed with a transport error.
+        With the failure detector armed, one transient error is NOT
+        a death verdict: the failed dial already dropped the link
+        (straight to suspect) and the detector's miss counting
+        delivers the real verdict — the legacy instant
+        ``handle_nodedown`` here used to purge a LIVE peer's registry
+        entries and spuriously promote against it off one stale-link
+        error during a rolling restart (caught live by
+        tests/test_drain.py). Detector-less transports keep the
+        legacy behavior: the error IS the only failure detection."""
+        tr = self.transport
+        if getattr(tr, "_hb_enabled", False):
+            self._count("rpc.errors")
+            return
+        self.handle_nodedown(name)
 
     @any_thread
     def handle_nodedown(self, name: str) -> None:
@@ -493,6 +544,13 @@ class Cluster:
     def locate_client(self, client_id: str) -> Optional[str]:
         return self._registry.get(client_id)
 
+    def claim_parked(self, client_id: str):
+        """Collect a reply-loss-parked takeover copy locally (a
+        client dialing the parking node directly must find its
+        session, not a fresh one)."""
+        ent = self._takeover_parked.pop(client_id, None)
+        return ent[0] if ent is not None else None
+
     @any_thread
     def reassign_client(self, client_id: str, owner: str) -> None:
         """Point the registry at ``owner`` on every member (the
@@ -515,23 +573,40 @@ class Cluster:
             log.warning("remote discard of %s skipped: owner %s "
                         "suspect", client_id, node)
         except ConnectionError:
-            self.handle_nodedown(node)
+            self._peer_call_failed(node)
 
     def remote_takeover(self, client_id: str, node: str):
         """Pull the session from its current owner node
-        (emqx_cm:takeover_session RPC, src/emqx_cm.erl:263-272)."""
+        (emqx_cm:takeover_session RPC, src/emqx_cm.erl:263-272). The
+        caller's name rides along so the owner can move the route
+        contributions with the session (see _local_takeover)."""
         try:
-            return self.transport.call(node, "takeover_client", client_id)
-        except PeerUnavailableError:
-            # suspect owner: hand out a fresh session NOW instead of
-            # blocking the CONNECT into call_timeout — the same
-            # availability choice the bounded cross-loop takeover
-            # makes (overload.takeover.timeout)
+            return self.transport.call(node, "takeover_client",
+                                       client_id, self.name)
+        except PeerUnavailableError as e:
+            if e.state == "suspect":
+                # suspect ≠ dead: the registry NAMES this owner, so
+                # the session exists — let the caller (the cm chase)
+                # wait out the detector's hysteresis bounded instead
+                # of instantly minting a fresh session (a transient
+                # heartbeat blip at reconnect time used to cost the
+                # client its session — caught by the rolling-restart
+                # proof). A confirmed-down owner still degrades to a
+                # fresh session immediately.
+                return {"suspect": node}
             log.warning("remote takeover of %s skipped: owner %s "
-                        "suspect — fresh session", client_id, node)
+                        "%s — fresh session", client_id, node,
+                        e.state)
             return None
         except ConnectionError:
-            self.handle_nodedown(node)
+            self._peer_call_failed(node)
+            if getattr(self.transport, "_hb_enabled", False):
+                # the call may have EXECUTED with the reply lost (the
+                # owner parked the handed session): answer BUSY so
+                # the client's retry re-chases and collects it —
+                # returning None here minted a fresh session over a
+                # parked live one (rolling-restart proof)
+                return {"suspect": node}
             return None
         except Exception:
             # a takeover failure must degrade to a fresh session,
@@ -540,24 +615,99 @@ class Cluster:
                           client_id, node)
             return None
 
-    def _local_takeover(self, client_id: str):
+    def _local_takeover(self, client_id: str, taker=None):
         cm = self.node.cm
+        # TTL prune of the parking lot (bounded bookkeeping)
+        now = time.time()
+        for cid in [c for c, (_s, ts) in
+                    self._takeover_parked.items() if now - ts > 60.0]:
+            self._takeover_parked.pop(cid, None)
         chan = cm.lookup_channel(client_id)
+        if chan is None and self.replication is not None \
+                and self.replication.adopting(client_id):
+            # mid-hand-off adopted copy (see ReplicationManager
+            # .adopting): not serveable until the final marker lands
+            return {"suspect": self.name}
+        dr = getattr(self.node, "drain", None)
+        if chan is None and dr is not None and dr.active \
+                and dr.target is not None \
+                and (client_id in cm._detached
+                     or client_id in self._takeover_parked):
+            # custody is ALREADY moving through the drain hand-off
+            # (dual-route, digest-verified — loss-free under live
+            # traffic). A client-initiated pull racing it would rip
+            # the session out mid-transfer and drop every forward in
+            # the pull window; defer instead — the caller answers
+            # ServerBusy and the client's retry lands on the target
+            return {"suspect": self.name}
         sess = None
         if chan is not None:
             sess = cm._takeover(chan)
         elif client_id in cm._detached:
             sess, _ts, _exp = cm._detached.pop(client_id)
+        if sess is None and client_id in self._takeover_parked:
+            # a previous hand-out's reply was lost: the taker's retry
+            # collects the parked copy instead of finding nothing
+            return self._takeover_parked.pop(client_id)[0]
         cm.cancel_will(client_id)  # connection re-established elsewhere
-        if sess is not None:
-            # hand-off: drop table entries here without death-path
-            # side effects; the new node's resume() resubscribes.
-            # The broker/notify references MUST be severed: over a
-            # socket transport the session travels pickled, and a
-            # broker drags thread locks + device arrays with it
-            self.node.broker.detach_subscriber(sess)
-            sess.notify = None
-            sess.broker = None
+        if sess is None:
+            # not held here (anymore): if OUR registry already knows
+            # the new custodian — a drain hand-off or failback moved
+            # it while the caller still held a stale claim — answer
+            # with a forwarding marker so the caller chases the
+            # custody chain instead of minting a fresh session
+            # (docs/OPERATIONS.md; the rolling-restart proof tripped
+            # exactly this window)
+            with self._lock:
+                owner = self._registry.get(client_id)
+            if owner is not None and owner != self.name:
+                return {"moved": owner}
+            return None
+        if taker:
+            # move the route contributions WITH the session — BEFORE
+            # detaching its dispatch wiring: a stale self-dest here
+            # silently swallowed every locally-routed message until
+            # anti-entropy (the publish was acked with routes >= 1
+            # but the dispatch found no subscriber), and the taker's
+            # own route_add broadcast is an at-most-once cast that
+            # can park behind a suspect blip. Install the taker's
+            # dest locally NOW (idempotent; its broadcast confirms)
+            # and drop this node's refs through the replicated
+            # wrapper (the zero-edge broadcasts route_del). Ordering:
+            # with routes moved first, a local publish in the
+            # detach window routes to the taker; one landing just
+            # before still reaches the (still-wired) session object
+            # and travels with it.
+            from emqx_tpu.replication import _sub_route
+            for key in list(getattr(sess, "subscriptions", {})):
+                try:
+                    flt, dest = _sub_route(key, taker)
+                    self._apply_route("add", flt, dest)
+                    flt2, dest2 = _sub_route(key, self.name)
+                    if self.node.router.route_refs(flt2, dest2) > 0:
+                        self.node.router.delete_route(flt2,
+                                                      dest=dest2)
+                except Exception:
+                    log.exception("moving route of %r for %r failed",
+                                  key, client_id)
+        # hand-off: drop table entries here without death-path
+        # side effects; the new node's resume() resubscribes.
+        # The broker/notify references MUST be severed: over a
+        # socket transport the session travels pickled, and a
+        # broker drags thread locks + device arrays with it
+        self.node.broker.detach_subscriber(sess)
+        sess.notify = None
+        sess.broker = None
+        d = getattr(self.node, "durability", None)
+        if d is not None and getattr(sess, "durable", False):
+            # the session now lives on the taking node: a stale
+            # sess.state left in OUR journal would ship to our
+            # standbys and resurrect a zombie copy when we die
+            d.session_closed(client_id)
+        # park until the taker's client_up confirms custody: if the
+        # REPLY below is lost to a broken link, the severed session
+        # would otherwise be gone from every node
+        self._takeover_parked[client_id] = (sess, time.time())
         return sess
 
     def _purge_node_routes(self, name: str) -> None:
@@ -591,7 +741,7 @@ class Cluster:
             try:
                 self.transport.cast(m, op, *args)
             except ConnectionError:
-                self.handle_nodedown(m)
+                self._peer_call_failed(m)
 
     def _apply_route(self, op: str, flt: str, dest) -> None:
         """Idempotent remote apply — always through the ORIGINAL
@@ -622,7 +772,7 @@ class Cluster:
         try:
             self.transport.cast(node, "forward", flt, msg)
         except ConnectionError:
-            self.handle_nodedown(node)
+            self._peer_call_failed(node)
 
     def _local_shared_count(self, group: str, flt: str) -> int:
         return len(self.node.broker.shared._subs.get((group, flt), ()))
@@ -712,7 +862,9 @@ class Cluster:
             self.transport.cast(target, "forward_shared", group, flt, msg)
             return 0  # remote delivery, not counted locally
         except ConnectionError:
-            self.handle_nodedown(target)
+            # availability: re-route this delivery around the failed
+            # member either way; the death verdict is the detector's
+            self._peer_call_failed(target)
             rest = [x for x in nodes if x != target]
             return self._route_shared(group, flt, rest, msg)
 
@@ -1110,7 +1262,23 @@ class Cluster:
             b.metrics.inc("messages.received")
             # dispatch by the already-matched filter (no re-match,
             # no shared dispatch — shared goes via forward_shared)
-            return b.dispatch(flt, msg)
+            n = b.dispatch(flt, msg)
+            if not n and getattr(msg, "qos", 0) > 0 \
+                    and not msg.headers.get("fwd_bounce"):
+                # the session this forward targeted MOVED between
+                # the cast and its delivery (drain hand-off,
+                # takeover, a parked cast replayed after a heal):
+                # re-route once to the filter's CURRENT owners —
+                # scoped to this filter's routes, one bounce max, so
+                # a QoS>0 delivery survives a custody move instead
+                # of dying on the stale owner (docs/OPERATIONS.md)
+                msg.headers["fwd_bounce"] = True
+                for r in self.node.router.lookup_routes(flt):
+                    if isinstance(r.dest, tuple):
+                        continue  # shared groups pick per-dispatch
+                    if r.dest != self.name:
+                        self._forward(r.dest, flt, msg)
+            return n
         if op == "forward_shared":
             group, flt, msg = args
             return self.node.broker.shared.dispatch(group, flt, msg)
@@ -1118,6 +1286,10 @@ class Cluster:
             cid, name = args
             with self._lock:
                 self._registry[cid] = name
+            # custody confirmed elsewhere: a parked takeover copy
+            # (reply-loss insurance) is no longer needed
+            if name != self.name:
+                self._takeover_parked.pop(cid, None)
             return None
         if op == "client_down":
             cid, name = args
@@ -1125,13 +1297,22 @@ class Cluster:
                 if self._registry.get(cid) == name:
                     self._registry.pop(cid, None)
             return None
+        if op == "registry_sync":
+            # join-time batched registry push (owner-authoritative,
+            # idempotent — the per-entry analogue of client_up)
+            owner, cids = args
+            with self._lock:
+                for cid in cids:
+                    self._registry[cid] = owner
+            return None
         if op == "discard_client":
             # the REQUESTING node holds the cluster lock for this
             # clientid — re-acquiring here would deadlock on it
             return self.node.cm.discard_session(args[0],
                                                 cluster_lock=False)
         if op == "takeover_client":
-            return self._local_takeover(args[0])
+            return self._local_takeover(
+                args[0], args[1] if len(args) > 1 else None)
         if op == "lock_acquire":
             return self.locker.grant(args[0], args[1])
         if op == "lock_release":
@@ -1245,6 +1426,18 @@ class Cluster:
             return self.replication.handle_replica_info(args[0])
         if op == "repl_failback":
             # FAILBACK: the promoted standby hands the adopted state
-            # back to this (restarted) primary
+            # back to this (restarted) primary — also the receive
+            # side of a DRAIN custody hand-off (drain.py): same
+            # chunked full-state adoption, same journaling
             return self.replication.handle_failback(args[0], args[1])
+        if op == "overload_level":
+            # drain wave pacing (drain.py): the draining peer adapts
+            # its disconnect budget to THIS node's overload level
+            ov = getattr(self.node, "overload", None)
+            return int(ov.level) if ov is not None else 0
+        if op == "drain_digest":
+            # drain custody verification: digest of the named
+            # sessions as THIS node now holds them (replication.py)
+            from emqx_tpu.replication import sessions_digest
+            return sessions_digest(self.node, args[0])
         raise ValueError(f"bad rpc op: {op}")

@@ -1086,6 +1086,13 @@ class SocketTransport(Transport):
 
 #: ops that touch per-session state: must run on the node's serving
 #: loop. Everything else (membership, routes, registry, ping) is
-#: lock-guarded and runs on the IO thread.
+#: lock-guarded and runs on the IO thread. ``repl_failback`` and
+#: ``repl_hello`` belong here because both mutate ``cm._detached``
+#: (failback/drain adoption re-applies pop-then-re-add; the hello's
+#: stale-duplicate cleanup pops) — applied on the IO thread they
+#: raced a concurrent ``takeover_client`` on the serving loop, and a
+#: reconnect landing in the gap was handed a fresh session (caught
+#: live by the rolling-restart proof, tests/test_drain.py).
 _OWNER_OPS = frozenset(
-    {"forward", "forward_shared", "discard_client", "takeover_client"})
+    {"forward", "forward_shared", "discard_client", "takeover_client",
+     "repl_failback", "repl_hello"})

@@ -27,6 +27,20 @@ log = logging.getLogger("emqx_tpu.cm")
 TAKEOVER_RC = 0x8E  # session taken over
 
 
+class SessionUnavailableError(Exception):
+    """The clientid's registered session owner is SUSPECT
+    (unconfirmed by the failure detector): the session exists but
+    cannot be pulled right now. The channel answers the CONNECT with
+    ServerBusy — the client's retry lands after the detector settles
+    the owner's fate (recovered → takeover; down → fresh session) —
+    instead of silently minting a fresh session over a live one."""
+
+    def __init__(self, client_id: str, owner: str) -> None:
+        super().__init__(
+            f"session owner {owner} of {client_id!r} is suspect")
+        self.owner = owner
+
+
 class ConnectionManager:
     def __init__(self, broker=None) -> None:
         self.broker = broker
@@ -190,15 +204,73 @@ class ConnectionManager:
                 self.unregister_channel(client_id, old_chan)
                 sess = None
         elif client_id in self._detached:
+            repl = getattr(self.cluster, "replication", None) \
+                if self.cluster is not None else None
+            if repl is not None and repl.adopting(client_id):
+                # adopted by a STILL-RUNNING hand-off: this copy is
+                # an intermediate snapshot — resuming it would make
+                # the finalize skip the authoritative one (live
+                # wins) and drop its queued messages with the source
+                raise SessionUnavailableError(client_id,
+                                              self.cluster.name)
             sess, _ts, _exp = self._detached.pop(client_id)
         elif self.cluster is not None:
             # the session may live on another node: pull it over
-            # (emqx_cm:takeover_session RPC path)
+            # (emqx_cm:takeover_session RPC path). Custody may have
+            # MOVED since the registry entry we read (a drain
+            # hand-off, a failback): a holder that no longer has the
+            # session answers with a forwarding marker and the chase
+            # follows the chain — bounded by the visited set, never
+            # revisiting a node
             loc = self.cluster.locate_client(client_id)
-            if loc is not None and loc != self.cluster.name:
-                sess = self.cluster.remote_takeover(client_id, loc)
+            visited = set()
+            retries = 0
+            while loc is not None and loc not in visited:
+                if loc == self.cluster.name:
+                    ent = self._detached.pop(client_id, None)
+                    if ent is not None:
+                        sess = ent[0]
+                    else:
+                        # a takeover hand-out whose reply was lost
+                        # parked the session here (cluster.py)
+                        sess = self.cluster.claim_parked(client_id)
+                    break
+                res = self.cluster.remote_takeover(client_id, loc)
+                if isinstance(res, dict) and "suspect" in res:
+                    # the named owner is SUSPECT — unconfirmed, the
+                    # session exists. Minting a fresh session here
+                    # loses it (a transient heartbeat blip at
+                    # reconnect time — the rolling-restart proof
+                    # caught it live); blocking the serving loop is
+                    # worse. Answer the CONNECT with ServerBusy
+                    # instead: the CLIENT's retry is the pacing, and
+                    # its next attempt lands after the detector's
+                    # hysteresis has settled the owner's fate.
+                    retries += 1
+                    if retries <= 3 and self.cluster.transport \
+                            .peer_state(loc) == "ok":
+                        continue  # blip already cleared: retry now
+                    log.warning(
+                        "resume of %r deferred: owner %s is %s",
+                        client_id, loc,
+                        self.cluster.transport.peer_state(loc))
+                    raise SessionUnavailableError(client_id, loc)
+                visited.add(loc)
+                if isinstance(res, dict):
+                    loc = res.get("moved")
+                    continue
+                sess = res
                 if sess is not None:
                     sess.client_id = client_id
+                break
+            if sess is None and visited:
+                # the chase dead-ended: the client gets a fresh
+                # session (availability); noteworthy because a
+                # registry that NAMED owners but produced no session
+                # usually means a custody move raced this CONNECT
+                log.warning("takeover chase for %r ended empty "
+                            "(visited %s, last claim %r)",
+                            client_id, sorted(visited), loc)
         if sess is not None:
             self._register(client_id, channel)
             if self.broker is not None:
@@ -228,6 +300,7 @@ class ConnectionManager:
     #: deadlock both loops — the timeout breaks it with a clear error
     #: and the client retries
     XLOOP_CALL_TIMEOUT = 15.0
+
 
     def _call_channel(self, chan, fn):
         """Run ``fn()`` on the channel's owning event loop (multi-loop
@@ -325,6 +398,17 @@ class ConnectionManager:
         """Keep a persistent session around; drop a clean one."""
         self.unregister_channel(client_id, channel)
         if session is None:
+            return
+        cur = self._channels.get(client_id)
+        if cur is not None and cur is not channel \
+                and getattr(cur, "session", None) is session:
+            # the session already re-attached to a NEWER live
+            # connection (a reconnect raced this channel's teardown —
+            # e.g. a client abandoning a slow CONNECT attempt whose
+            # server side completed): detaching here would flip
+            # connected/notify off UNDER the live owner and strand
+            # every subsequent delivery in the mqueue (caught live by
+            # the rolling-restart proof, tests/test_drain.py)
             return
         if expiry_interval > 0:
             # stay subscribed: deliveries enqueue to the mqueue while

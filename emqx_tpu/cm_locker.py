@@ -117,10 +117,13 @@ class ClusterLocker:
             for m, res in self._pool.map(
                     lambda p: self._ask_peer(p, client_id, me), peers):
                 if res is ConnectionError:
-                    # unreachable peer: normal nodedown handling
-                    # shrinks the membership — the quorum is over
-                    # live members
-                    self.cluster.handle_nodedown(m)
+                    # unreachable peer: with the failure detector
+                    # armed the verdict is DEFERRED to it (a
+                    # transient call error to a LIVE member used to
+                    # shrink the membership and trigger spurious
+                    # promotions, cluster.py _peer_call_failed);
+                    # legacy transports keep the nodedown-now path
+                    self.cluster._peer_call_failed(m)
                 elif res is PeerUnavailableError:
                     # suspect ≠ dead: no vote, no nodedown, no wait
                     suspect.append(m)

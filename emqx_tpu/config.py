@@ -141,6 +141,11 @@ class NodeConfig:
     # byte-for-byte. Only takes effect on a node with a cluster
     # transport ([node] cluster_port).
     cluster: Optional[Any] = None
+    # [drain] section: graceful-drain wave pacing, default target,
+    # SIGTERM drain mode (emqx_tpu.drain.DrainConfig,
+    # docs/OPERATIONS.md). None = defaults (drain available via ctl,
+    # passive until started).
+    drain: Optional[Any] = None
 
 
 #: zone fields with a closed value set — a typo must be a startup
@@ -385,6 +390,39 @@ def _build_cluster(raw: Dict[str, Any]):
         raise ConfigError(str(e)) from e
 
 
+def _build_drain(raw: Dict[str, Any]):
+    """``[drain]`` table → :class:`~emqx_tpu.drain.DrainConfig`.
+    Closed schema like zones/matcher: a typo'd ``on_sigterm = true``
+    silently leaving SIGTERM a hard stop is the drift this rule
+    catches."""
+    import dataclasses as _dc
+
+    from emqx_tpu.drain import DrainConfig
+
+    known = {f.name for f in _dc.fields(DrainConfig)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown drain setting: drain.{key}")
+        want = DrainConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"drain.{key} must be a boolean")
+        if want == "int" and (isinstance(val, bool)
+                              or not isinstance(val, int)):
+            raise ConfigError(f"drain.{key} must be an integer")
+        if want == "float":
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise ConfigError(f"drain.{key} must be a number")
+            val = float(val)
+        if want == "str" and not isinstance(val, str):
+            raise ConfigError(f"drain.{key} must be a string")
+        kwargs[key] = val
+    try:
+        return DrainConfig(**kwargs)
+    except ValueError as e:
+        raise ConfigError(str(e)) from e
+
+
 def _build_listener(i: int, raw: Dict[str, Any]) -> ListenerConfig:
     raw = dict(raw)
     ltype = raw.pop("type", None)
@@ -524,6 +562,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         if not isinstance(craw, dict):
             raise ConfigError("cluster must be a table")
         cfg.cluster = _build_cluster(craw)
+    drraw = raw.get("drain")
+    if drraw is not None:
+        if not isinstance(drraw, dict):
+            raise ConfigError("drain must be a table")
+        cfg.drain = _build_drain(drraw)
     for name, zraw in raw.get("zones", {}).items():
         cfg.zones[name] = _build_zone(name, zraw)
     for i, lraw in enumerate(raw.get("listeners", [])):
@@ -590,7 +633,11 @@ def build_node(cfg: NodeConfig):
                 overload=cfg.overload,
                 faults_config=cfg.faults,
                 durability=cfg.durability,
+                drain=cfg.drain,
                 boot_listeners=False)
+    # the live-reload diff's baseline (emqx_tpu/reload.py): listener
+    # topology is only comparable against what the node booted from
+    node.boot_config = cfg
     for i, lc in enumerate(cfg.listeners):
         zone = cfg.zones.get(lc.zone)
         name = lc.name or f"{lc.type}:{i}"

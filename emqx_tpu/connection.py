@@ -694,8 +694,14 @@ class Listener:
         # send in a DISCONNECT before force-closing live connections
         # at stop() — Node.stop sets Server-Shutting-Down (0x8B) on a
         # durable node so clients learn to reconnect-and-resume.
-        # None = the legacy silent close
+        # None = the legacy silent close. With a drain target
+        # configured the stop is a redirect instead: 0x9C
+        # Use-Another-Server + the Server-Reference, and wills are
+        # suppressed like the cm takeover path — custody is moving,
+        # the sessions are not dying (docs/OPERATIONS.md)
         self.shutdown_rc: Optional[int] = None
+        self.shutdown_ref: Optional[str] = None
+        self.shutdown_drain = False
         self._loop_conns: List[int] = []
 
     async def _handshake(self, reader, writer):
@@ -922,11 +928,21 @@ class Listener:
     def _shutdown_conn(self, conn) -> None:
         try:
             if not conn.channel.closed:
-                conn.channel.disconnect_reason = "server_shutdown"
+                if self.shutdown_drain:
+                    # drain hand-off stop: the session's custody is
+                    # moving to the drain target — the will must not
+                    # fire (exactly the cm takeover contract)
+                    conn.channel.will = None
+                    conn.channel.disconnect_reason = "drained"
+                else:
+                    conn.channel.disconnect_reason = "server_shutdown"
                 # graceful stop: v5 clients get DISCONNECT 0x8B
-                # (Server-Shutting-Down) so they reconnect-and-resume
-                # instead of diagnosing a dead socket
-                conn.channel._shutdown(rc=self.shutdown_rc)
+                # (Server-Shutting-Down) — or 0x9C + Server-Reference
+                # when a drain target is configured — so they
+                # reconnect-and-resume instead of diagnosing a dead
+                # socket
+                conn.channel._shutdown(rc=self.shutdown_rc,
+                                       server_ref=self.shutdown_ref)
             conn._close_transport()
         except Exception:
             pass

@@ -65,8 +65,18 @@ class Ctl:
                               "list | add <kind> <value> [secs] | del <kind> <value>")
         self.register_command("checkpoint", self._checkpoint,
                               "save|load <path>")
-        self.register_command("reload", self._reload,
-                              "<config.toml> — re-publish zones")
+        self.register_command(
+            "reload", self._reload,
+            "<config.toml> — diff the running config and apply "
+            "reloadable knobs + zones atomically; boot-only edits "
+            "are rejected with a per-knob report "
+            "(docs/OPERATIONS.md)")
+        self.register_command(
+            "drain", self._drain,
+            "start [--target <peer>] [--ref <host:port>] | status | "
+            "stop — graceful node drain: redirect clients in paced "
+            "waves, hand session custody to the target "
+            "(docs/OPERATIONS.md)")
         self.register_command("trace", self._trace,
                               "list | start client|topic <v> | stop client|topic <v>")
         self.register_command("vm", self._vm,
@@ -397,17 +407,57 @@ class Ctl:
         return "usage: banned list | add <kind> <value> [secs] | del <kind> <value>"
 
     def _reload(self, args) -> str:
-        from emqx_tpu.config import reload_zones
+        """Diff-based live reload (emqx_tpu/reload.py,
+        docs/OPERATIONS.md): re-parse + validate the file in full,
+        then all-or-nothing — any boot-only edit rejects the whole
+        reload with a per-knob report; otherwise zones re-publish
+        (the legacy reload, output shape preserved) and every changed
+        reloadable knob applies atomically."""
+        from emqx_tpu.config import load_config
+        from emqx_tpu.reload import apply_reload
         if len(args) != 1:
             return "usage: reload <config.toml>"
-        info = reload_zones(args[0], node=self.node)
+        info = apply_reload(self.node, load_config(args[0]))
+        if info["rejected"]:
+            lines = ["reload rejected (boot-only changes; nothing "
+                     "applied):"]
+            for r in info["rejected"]:
+                lines.append(f"  {r['knob']}: {r['old']!r} -> "
+                             f"{r['new']!r} ({r['reason']})")
+            return "\n".join(lines)
         out = f"zones reloaded: {', '.join(info['zones']) or '(none)'}"
         if info["listeners"]:
             out += f"; listeners rebound: {', '.join(info['listeners'])}"
         if info["stale"]:
             out += (f"; stale (no longer in config, kept): "
                     f"{', '.join(info['stale'])}")
+        for a in info["applied"]:
+            out += (f"\napplied: {a['knob']} {a['old']!r} -> "
+                    f"{a['new']!r}")
         return out
+
+    def _drain(self, args) -> str:
+        """Graceful drain control (drain.py, docs/OPERATIONS.md)."""
+        dr = self.node.drain
+        if not args or args[0] == "status":
+            return json.dumps(dr.info(), indent=2)
+        if args[0] == "start":
+            target = ref = None
+            rest = list(args[1:])
+            while rest:
+                flag = rest.pop(0)
+                if flag == "--target" and rest:
+                    target = rest.pop(0)
+                elif flag == "--ref" and rest:
+                    ref = rest.pop(0)
+                else:
+                    raise ValueError(f"bad drain option: {flag}")
+            dr.start(target=target, ref=ref)
+            return json.dumps(dr.info(), indent=2)
+        if args[0] == "stop":
+            dr.stop()
+            return json.dumps(dr.info(), indent=2)
+        raise ValueError(f"bad subcommand: {args[0]}")
 
     def _checkpoint(self, args) -> str:
         from emqx_tpu import checkpoint

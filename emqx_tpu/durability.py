@@ -140,6 +140,20 @@ class DurabilityConfig:
     #: and schedules a full resync on the next standby contact
     repl_queue_max_records: int = 500_000
 
+    #: live-reloadable knobs (emqx_tpu/reload.py, docs/OPERATIONS.md):
+    #: cadences/bounds read per tick, per flush or per ship pass.
+    #: Layout (dir, wal_shards), the fsync/backoff/buffer values
+    #: baked into the Wal group at build, the shipping topology
+    #: (standby/standbys/ack_quorum, copied at arm_shipper) and
+    #: ``enabled`` itself need a restart (not a dataclass field:
+    #: unannotated)
+    RELOADABLE = frozenset({
+        "flush_interval_ms", "checkpoint_interval_s",
+        "checkpoint_min_records", "checkpoint_full_every",
+        "quorum_timeout_ms", "repl_ack_timeout_s",
+        "repl_lag_alarm_records", "repl_lag_clear_records",
+        "repl_queue_max_records"})
+
     def __post_init__(self) -> None:
         if self.flush_interval_ms <= 0:
             raise ValueError("durability.flush_interval_ms must be > 0")

@@ -163,6 +163,12 @@ class FaultsConfig:
     #: arm specs: ``point[:action[:times[:delay_ms]]]``
     arm: List[str] = dataclasses.field(default_factory=list)
 
+    #: live-reloadable knobs (emqx_tpu/reload.py): none — the section
+    #: configures the process-global registry at boot; runtime chaos
+    #: goes through ``ctl faults`` (not a dataclass field:
+    #: unannotated)
+    RELOADABLE = frozenset()
+
 
 class _Arm:
     __slots__ = ("point", "action", "times", "delay_ms", "prob",

@@ -24,6 +24,16 @@ class _Track:
     count: int = 0
 
 
+#: disconnect reasons the BROKER caused (drain redirect wave,
+#: graceful node shutdown): they say nothing about the client's
+#: stability, and counting them would let a rolling restart auto-ban
+#: a well-behaved fleet — the ban replicates cluster-wide, so the
+#: receiving peer would refuse the very reconnects the drain
+#: redirected to it (docs/OPERATIONS.md; regression-pinned by
+#: tests/test_drain.py)
+SERVER_INITIATED = frozenset({"drained", "server_shutdown"})
+
+
 class Flapping:
     def __init__(self, banned: Optional[Banned] = None,
                  config: Optional[FlappingConfig] = None,
@@ -36,7 +46,10 @@ class Flapping:
     def connected(self, clientid: str, peerhost: str = "") -> None:
         pass  # tracked on disconnect (reference counts state changes)
 
-    def disconnected(self, clientid: str, peerhost: str = "") -> None:
+    def disconnected(self, clientid: str, peerhost: str = "",
+                     reason: Optional[str] = None) -> None:
+        if reason in SERVER_INITIATED:
+            return
         now = time.time()
         t = self._tracks.get(clientid)
         if t is None or now - t.started > self.config.window:

@@ -176,6 +176,24 @@ FAULT_METRICS = [
     "faults.injected",
 ]
 
+# zero-downtime operations (drain.py + reload.py,
+# docs/OPERATIONS.md): `drain.rejected.connects` = CONNECTs refused
+# with 0x9C Use-Another-Server while DRAINING, `drain.redirects` =
+# live clients redirected by the paced waves, `drain.waves` /
+# `drain.waves.deferred` = waves executed / held because the target
+# reported critical overload, `drain.handoff.sessions` = persistent
+# sessions whose custody moved to the drain target,
+# `drain.handoff.errors` = hand-offs that failed or whose digest
+# never settled inside the bound, `config.reload.applied` /
+# `config.reload.rejected` = knobs applied by / boot-only knobs that
+# rejected a `ctl reload`
+OPS_METRICS = [
+    "drain.rejected.connects", "drain.redirects", "drain.waves",
+    "drain.waves.deferred", "drain.handoff.sessions",
+    "drain.handoff.errors",
+    "config.reload.applied", "config.reload.rejected",
+]
+
 # durability layer (wal.py + durability.py + replication.py,
 # docs/DURABILITY.md): `wal.appends` = journal records framed,
 # `wal.fsyncs` = batched write+sync cycles (one per shard per group
@@ -236,6 +254,7 @@ DURABILITY_METRICS = [
 CLUSTER_METRICS = [
     "cluster.hb.suspects", "cluster.hb.downs",
     "cluster.hb.reappears", "cluster.rpc.fastfail",
+    "cluster.rpc.errors",
     "cluster.forward.dropped", "cluster.heal.rejoins",
     "cluster.ae.sweeps", "cluster.ae.repairs",
     "cluster.locker.degraded",
@@ -246,7 +265,7 @@ ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
                + AUTOMATON_METRICS + TRANSPORT_METRICS
                + OVERLOAD_METRICS + BREAKER_METRICS + FAULT_METRICS
-               + DURABILITY_METRICS + CLUSTER_METRICS)
+               + OPS_METRICS + DURABILITY_METRICS + CLUSTER_METRICS)
 
 #: registry names that are NOT monotonic — ``Metrics.dec`` runs on
 #: them in steady state (today: the retainer's live-entry count,

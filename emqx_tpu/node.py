@@ -58,6 +58,7 @@ class Node:
                  overload: Optional[OverloadConfig] = None,
                  faults_config=None,
                  durability=None,
+                 drain=None,
                  plugin_config_dir: Optional[str] = None) -> None:
         self.name = name
         self.zone = zone or get_zone()
@@ -133,8 +134,22 @@ class Node:
             self.overload = None
         # fault injection ([faults], faults.py): arm specs applied at
         # build; no section = the module-level registry is untouched
+        # (kept for the live-reload diff, emqx_tpu/reload.py)
+        self.faults_config = faults_config
         if faults_config is not None:
             _faults.configure(faults_config)
+        # graceful drain ([drain], drain.py, docs/OPERATIONS.md):
+        # always built, passive until `ctl drain start` / SIGTERM —
+        # the channel's CONNECT gate reads broker.draining (None
+        # until a drain is active, the usual zero-cost guard)
+        from emqx_tpu.drain import NODE_RUNNING, DrainManager
+        self.node_state = NODE_RUNNING
+        self.drain = DrainManager(self, drain)
+        self.broker.draining = None
+        # the parsed boot NodeConfig when built from a file
+        # (config.build_node) — the live-reload diff's baseline for
+        # listener topology; None on programmatic nodes
+        self.boot_config = None
         # durability layer ([durability], durability.py,
         # docs/DURABILITY.md): write-ahead journal + atomic
         # checkpoints + crash recovery. enabled = false (the default)
@@ -376,6 +391,13 @@ class Node:
         self.modules.load(RetainerModule)
 
     async def stop(self) -> None:
+        from emqx_tpu.drain import NODE_STOPPING
+        self.node_state = NODE_STOPPING
+        # a still-active drain's wave task dies with the node; its
+        # CONNECT gate is moot once the listeners close
+        if self.drain.active:
+            self.drain.stop()
+            self.node_state = NODE_STOPPING
         for t in self._bg_tasks:
             t.cancel()
         self._bg_tasks.clear()
@@ -388,7 +410,19 @@ class Node:
         # quiesce module background tasks (scrape sockets, timers)
         # without unloading — start() re-kicks them
         self.modules.on_loop_stop()
-        if self.durability is not None:
+        drain_ref = self.drain.server_ref()
+        if drain_ref is not None:
+            # a drain target is configured: the stop is a REDIRECT
+            # (docs/OPERATIONS.md) — v5 clients get 0x9C
+            # Use-Another-Server + the Server-Reference instead of
+            # 0x8B, and wills are suppressed like the cm takeover
+            # path (custody moves; the sessions are not dying)
+            from emqx_tpu.mqtt import reason_codes as RC
+            for lst in self.listeners:
+                lst.shutdown_rc = RC.USE_ANOTHER_SERVER
+                lst.shutdown_ref = drain_ref
+                lst.shutdown_drain = True
+        elif self.durability is not None:
             # graceful shutdown (docs/DURABILITY.md): v5 clients get
             # DISCONNECT Server-Shutting-Down (0x8B) before their
             # sockets close, so fleets reconnect-and-resume instead
@@ -440,6 +474,10 @@ class Node:
                 log.exception("sys heartbeat failed")
 
     def _update_stats(self, stats: Stats) -> None:
+        # node lifecycle gauge (docs/OPERATIONS.md): 0 running /
+        # 1 draining / 2 stopping — the fleet dashboard's one-glance
+        # "is anything mid-maintenance" signal
+        stats.setstat("node.state", self.node_state)
         stats.setstat("connections.count", self.cm.connection_count(),
                       "connections.max")
         stats.setstat("sessions.count", self.cm.session_count(),

@@ -114,6 +114,22 @@ class OverloadConfig:
     #: runtime classifies the same as a dead one)
     sentinel_timeout_s: float = 5.0
 
+    #: live-reloadable knobs (emqx_tpu/reload.py, docs/OPERATIONS.md):
+    #: thresholds and policies read per tick / per CONNECT / per
+    #: enqueue, plus the breaker/recovery fields pushed into the live
+    #: objects by the reload appliers. ``enabled``/``breaker``/
+    #: ``breaker_rebuild`` decide what gets BUILT; ``interval_s`` is
+    #: captured by the monitor loop (not a dataclass field:
+    #: unannotated)
+    RELOADABLE = frozenset({
+        "lag_warn_ms", "lag_critical_ms", "queue_warn",
+        "queue_critical", "rss_warn_mb", "rss_critical_mb",
+        "clear_ticks", "shed_qos0", "reject_connects",
+        "critical_hiwater_div", "force_shutdown_queue_len",
+        "ingress_wait_timeout_s", "breaker_failures",
+        "breaker_cooldown_s", "breaker_slow_ms",
+        "rebuild_backoff_s", "sentinel_timeout_s"})
+
     def __post_init__(self) -> None:
         if self.interval_s <= 0:
             raise ValueError("overload.interval_s must be > 0")

@@ -316,6 +316,16 @@ class ReplicationManager:
         self._lag_alarmed = False
         self._quorum_alarmed = False
         self._quorum_timed_out = False
+        #: sessions adopted by a STILL-RUNNING hand-off (failback or
+        #: drain): cid -> (source, adopted_at). Serving one of these
+        #: to a reconnecting client mid-transfer would resume a STALE
+        #: intermediate snapshot and make the finalize skip the
+        #: authoritative copy (live-wins) — its queued messages would
+        #: drop with the source. The resume/takeover paths answer
+        #: ServerBusy until the source's final marker lands (or the
+        #: TTL expires — a source that died mid-hand-off must not
+        #: wedge its sessions behind BUSY forever).
+        self._adopting: Dict[str, tuple] = {}
         #: failback hand-offs / promotion checks in flight (primary
         #: names; single-flight guards)
         self._failback_busy: set = set()
@@ -798,6 +808,18 @@ class ReplicationManager:
         rep = self.replicas.get(dead)
         if rep is None or rep.promoted:
             return False
+        if rep.clean:
+            # the primary said a clean goodbye (graceful stop /
+            # drain): a planned departure is not a failure. Its own
+            # disk is authoritative when it returns, and a drained
+            # node's sessions were already handed off — promoting a
+            # replica whose close records may not all have shipped
+            # resurrects zombies and poisons the registry (caught
+            # live by the rolling-restart proof). A primary that
+            # comes back and resyncs clears the flag, so a LATER
+            # crash promotes normally.
+            log.info("not promoting for %s: clean departure", dead)
+            return False
         with self._fb_lock:  # single-flight per primary
             if dead in self._promote_busy:
                 return False
@@ -937,6 +959,7 @@ class ReplicationManager:
         # exact refcounts; other nodes' dests are live replication's
         # problem, not the replica's
         installed = 0
+        dj = node.durability
         for (flt, dest), refs in routes.items():
             if dest == primary:
                 dest2 = me
@@ -947,6 +970,13 @@ class ReplicationManager:
                 continue
             have = node.router.route_refs(flt, dest2)
             node.router.set_route_refs(flt, dest2, have + int(refs))
+            if dj is not None:
+                # absolute refcount record: a crash BEFORE the
+                # post-promotion checkpoint lands still recovers the
+                # adopted route (Wal.close flushes — the journal is
+                # the belt, the checkpoint the fast path)
+                dj._append(("route", flt, dest2,
+                            node.router.route_refs(flt, dest2)))
             installed += 1
             # surviving members need the adopted route (set_route_refs
             # bypasses the replicated add wrapper on purpose)
@@ -967,6 +997,15 @@ class ReplicationManager:
         for cid, (dts, sd) in sessions.items():
             if cid in node.cm._channels or cid in node.cm._detached:
                 continue  # the client already lives here — keep it
+            owner = self.cluster._registry.get(cid)
+            if owner is not None and owner != primary \
+                    and owner != me and owner in self.cluster.members:
+                # custody already MOVED off the dead primary (a drain
+                # hand-off, a takeover chain) to a live member: the
+                # replica's copy is stale — resurrecting it would
+                # double-own the session and poison the registry with
+                # this node's claim (registry-guarded promotion)
+                continue
             try:
                 sess = Session.from_wire(sd)
             except Exception as e:
@@ -993,6 +1032,11 @@ class ReplicationManager:
                     log.exception("restoring %r of %r failed",
                                   key, cid)
             node.cm._detached[cid] = (sess, detach, expiry)
+            if d is not None:
+                # journal the adopted session NOW: the promoted
+                # holder crashing before its checkpoint must still
+                # recover it (the double-recovery contract)
+                d._append(("sess.state", cid, detach, sd))
             if self.cluster is not None:
                 self.cluster.client_up(cid)
             resurrected += 1
@@ -1181,6 +1225,19 @@ class ReplicationManager:
         log.warning("FAILBACK to %s complete in %.1fms: %s",
                     primary, fb["failback_s"] * 1000.0, fb)
 
+    def adopting(self, client_id: str) -> bool:
+        """True while ``client_id`` was adopted by a hand-off whose
+        final marker has not landed (bounded by a 30 s TTL against a
+        source dying mid-transfer) — the resume/takeover paths defer
+        such sessions instead of serving a stale snapshot."""
+        ent = self._adopting.get(client_id)
+        if ent is None:
+            return False
+        if time.time() - ent[1] > 30.0:
+            self._adopting.pop(client_id, None)
+            return False
+        return True
+
     def handle_failback(self, standby: str, payload: dict) -> dict:
         """The returning primary's half of FAILBACK: adopt the
         authoritative post-promotion session state back from the
@@ -1238,6 +1295,7 @@ class ReplicationManager:
                     log.exception("failback restore of %r for %r "
                                   "failed", key, cid)
             cm._detached[cid] = (sess, detach, expiry)
+            self._adopting[cid] = (standby, time.time())
             if d is not None:
                 d._append(("sess.state", cid, detach, sd))
             if self.cluster is not None:
@@ -1258,6 +1316,11 @@ class ReplicationManager:
             if d.repl is not None:
                 d.repl.notify_flush()
         if payload.get("final"):
+            # the hand-off is complete: its adopted sessions are
+            # authoritative and serveable
+            for cid in [c for c, (src, _ts) in
+                        self._adopting.items() if src == standby]:
+                self._adopting.pop(cid, None)
             if d is not None and d.wal is not None:
                 # the heavy full checkpoint runs off the transport
                 # IO thread (heartbeats keep flowing); the journal
@@ -1433,6 +1496,52 @@ def _primary_snapshot(node, durability, standbys=()) -> dict:
             "standbys": list(standbys)}
 
 
+def _session_entry(cid: str, s) -> tuple:
+    """One session's canonical digest entry — subscriptions, unacked
+    inflight, queued mqueue payloads, QoS2 barrier, pid counter. The
+    shared vocabulary of :func:`durable_digest` and
+    :func:`sessions_digest`, so a drain hand-off and a full-node
+    digest agree on what "byte-exact" means."""
+    subs = []
+    for key, o in sorted(s.subscriptions.items()):
+        flt, popts = T.parse(key)
+        subs.append((key, int(o.qos), int(o.nl),
+                     popts.get("share", o.share)))
+    inflight = sorted(
+        (pid, (v[0] if isinstance(v[0], str)
+               else (v[0].topic, bytes(v[0].payload).hex())))
+        for pid, v in s.inflight.to_list())
+    mq = [(m.topic, bytes(m.payload).hex())
+          for _p, q in s.mqueue.snapshot() for m in q]
+    return ("sess", cid, tuple(subs), tuple(inflight), tuple(mq),
+            sorted(s.awaiting_rel), s.next_pkt_id)
+
+
+def sessions_digest(node, cids) -> str:
+    """Order-independent digest of a named session subset — live or
+    detached, missing cids contribute nothing (so both sides of a
+    custody hand-off hash exactly what they hold). The drain
+    hand-off's verification predicate (drain.py)."""
+    h = hashlib.sha1()
+    entries = []
+    for cid in cids:
+        ent = node.cm._detached.get(cid)
+        s = ent[0] if ent is not None else None
+        if s is None:
+            chan = node.cm._channels.get(cid)
+            s = getattr(chan, "session", None)
+        if s is None:
+            continue
+        try:
+            entries.append(_session_entry(cid, s))
+        except Exception:
+            log.exception("digesting session %r failed", cid)
+    for e in sorted(entries, key=repr):
+        h.update(repr(e).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
 def durable_digest(node) -> str:
     """Order-independent digest of a node's durable planes — routes
     (own-node dests normalized to ``@self`` so a primary and its
@@ -1467,20 +1576,7 @@ def durable_digest(node) -> str:
                 and getattr(s, "durable", False):
             sessions[cid] = s
     for cid, s in sessions.items():
-        subs = []
-        for key, o in sorted(s.subscriptions.items()):
-            flt, popts = T.parse(key)
-            subs.append((key, int(o.qos), int(o.nl),
-                         popts.get("share", o.share)))
-        inflight = sorted(
-            (pid, (v[0] if isinstance(v[0], str)
-                   else (v[0].topic, bytes(v[0].payload).hex())))
-            for pid, v in s.inflight.to_list())
-        mq = [(m.topic, bytes(m.payload).hex())
-              for _p, q in s.mqueue.snapshot() for m in q]
-        entries.append(("sess", cid, tuple(subs), tuple(inflight),
-                        tuple(mq), sorted(s.awaiting_rel),
-                        s.next_pkt_id))
+        entries.append(_session_entry(cid, s))
     for e in sorted(entries, key=repr):
         h.update(repr(e).encode())
         h.update(b"\x00")

@@ -137,6 +137,15 @@ class MatcherConfig:
     # (also bounds the side-automaton walk cost)
     delta_max_filters: int = 4096
 
+    #: live-reloadable knobs (emqx_tpu/reload.py,
+    #: docs/OPERATIONS.md): only fields the match/mutation paths read
+    #: at use time — everything else is kernel/table geometry copied
+    #: into built device structures at flatten time (not a dataclass
+    #: field: unannotated)
+    RELOADABLE = frozenset({
+        "delta", "delta_max_filters", "device_min_filters",
+        "patch_drain_batch", "host_reclaim_pending"})
+
 
 def topic_partition(topic: str, parts: int) -> int:
     """Match-cache partition of a concrete topic: a stable hash of

@@ -47,6 +47,10 @@ STATS_KEYS = [
     # ``cluster.member.<name>.state`` / ``.rtt_ms`` dynamically.
     "cluster.members.count",
     "cluster.member.state", "cluster.hb.rtt_ms",
+    # node lifecycle (docs/OPERATIONS.md): 0 running / 1 draining /
+    # 2 stopping — set by the drain subsystem (drain.py); a fleet
+    # dashboard's one-glance "is anything mid-maintenance" gauge
+    "node.state",
     # overload protection (docs/ROBUSTNESS.md): monitor level (0 ok /
     # 1 warn / 2 critical) and device-path breaker state (0 closed /
     # 1 half-open / 2 open / 3 rebuilding — device-loss recovery) —

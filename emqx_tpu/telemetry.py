@@ -133,6 +133,12 @@ class TelemetryConfig:
     #: (one slow batch is a blip; a streak is a regime)
     slow_alarm_after: int = 10
 
+    #: live-reloadable knobs (emqx_tpu/reload.py): read per span;
+    #: ``enabled``/``ring_size``/``slow_log_size`` shape the
+    #: histograms and the slow-record ring at build (not a dataclass
+    #: field: unannotated)
+    RELOADABLE = frozenset({"slow_threshold_ms", "slow_alarm_after"})
+
 
 class Histogram:
     """One latency family: fixed log-bucket counts + sum/count for

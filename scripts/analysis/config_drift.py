@@ -42,6 +42,7 @@ SECTIONS: Dict[str, Tuple[str, str]] = {
     "faults": ("emqx_tpu/faults.py", "FaultsConfig"),
     "durability": ("emqx_tpu/durability.py", "DurabilityConfig"),
     "cluster": ("emqx_tpu/cluster.py", "ClusterConfig"),
+    "drain": ("emqx_tpu/drain.py", "DrainConfig"),
 }
 
 #: schema fields that are runtime-only by design (config.py refuses

@@ -127,6 +127,41 @@ assert rec['metric']=='devloss_host_fallback_msgs_per_s' \
     and rec['rebuilds'] >= 1 and rec['rebuild_s'] is not None \
     and rec['first_batch_p99_ms'] is not None, rec"
 
+echo "== zero-downtime operations: drain + live reload (docs/OPERATIONS.md) =="
+# graceful drain (CONNECT gate 0x9C + Server-Reference, paced waves
+# with overload-adaptive budget, will suppression, flapping
+# exemption, v3.1.1 reconnect-via-registry, digest-verified custody
+# hand-off) and the diff-based live config reload (reloadable knobs
+# apply atomically, boot-only edits reject whole with a per-knob
+# report, classification table lint-checked against the dataclasses)
+python -m pytest tests/test_drain.py tests/test_reload.py -q \
+    --deselect tests/test_drain.py::test_rolling_restart_3node
+
+echo "== rolling-restart proof (docs/OPERATIONS.md) =="
+# the 3-node cluster restarted node-by-node under live durable QoS1
+# traffic: zero lost, zero duplicated (sorted(got) == sorted(sent)),
+# session custody exactly-one-holder, all five replicated plane
+# digests byte-equal after the last rejoin
+ROLLING_MSGS=60 python -m pytest \
+    tests/test_drain.py::test_rolling_restart_3node -q
+
+echo "== drain smoke (docs/OPERATIONS.md) =="
+# the BENCH_MODE=drain scenario end-to-end at toy scale: live
+# clients redirected, every persistent session's custody handed to
+# the peer — the zero-RPO booleans ARE gated (throughput numbers are
+# not; the driver's 5k-session run is)
+BENCH_MODE=drain DRAIN_SESSIONS=200 DRAIN_LIVE=10 DRAIN_WAVE=50 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='drain_time_to_empty_s' \
+    and rec['value'] is not None \
+    and rec['rpo_records'] == 0 \
+    and rec['handoff_digest_ok'] is True \
+    and rec['exactly_one_holder'] is True \
+    and rec['sessions_on_target'] == 200 \
+    and rec['redirected'] == 10, rec"
+
 echo "== crash recovery (docs/DURABILITY.md) =="
 # journal framing/torn-tail/degrade semantics (per shard), the
 # kill-point matrix (every armed storage fault x crash stage must
