@@ -987,6 +987,56 @@ def main():
             "expand": round(max(0.0, p50 - p50_mp), 3),
         }
 
+    # walk-cost columns (ISSUE 16): per-topic hop count under the
+    # compressed automaton — the quantity path compression shrinks.
+    # hops_for_level[L] is the walk's step bound for an L-level
+    # topic; per-topic gathers follow the kernel's own cost model
+    # (GATHERS_PER_HOP fetches per hop per active lane).
+    from emqx_tpu.ops.walk_pallas import GATHERS_PER_HOP
+    hl_ = np.asarray(host_auto.hops_for_level)
+    lv_ = np.concatenate([np.asarray(b_[1])[:u]
+                          for b_, u in zip(host_batches, uniques)])
+    lv_ = lv_[lv_ > 0]
+    steps_per_topic = hl_[np.minimum(lv_, len(hl_) - 1)]
+    walk_levels_p50 = int(np.percentile(steps_per_topic, 50))
+    gathers_per_topic = round(
+        float(steps_per_topic.mean()) * GATHERS_PER_HOP * k, 1)
+
+    # compaction A/B (ISSUE 16): re-finalize the SAME flatten with
+    # compression forced off, time the match stage on both tables,
+    # report the off-p50 and the speedup. Only on rows that ask
+    # (deep/uniform — _CONFIG_MATRIX sets BENCH_COMPRESS_AB) and only
+    # when the live tables actually compressed (wide mode).
+    compress_ab = None
+    if (os.environ.get("BENCH_COMPRESS_AB") == "1"
+            and not use_cache and int(host_auto.wt_take) > 1):
+        from emqx_tpu.ops.csr import finalize_automaton
+        off_host = finalize_automaton(host_auto, force_mode="narrow")
+        off_dev = jax.device_put(device_view(off_host))
+
+        def step_off(ids, n, sysm):
+            res = match_batch(off_dev, ids, n, sysm, k=k, m=m,
+                              pack_ids=False,
+                              **walk_params(off_host, ids.shape[1]))
+            return res.ids
+
+        def step_on(ids, n, sysm):
+            res = match_batch(auto, ids, n, sysm, k=k, m=m,
+                              pack_ids=False,
+                              **walk_params(host_auto, ids.shape[1]))
+            return res.ids
+
+        for s_ in (step_off, step_on):  # compile outside the timing
+            for b_ in step_batches:
+                jax.block_until_ready(s_(*b_))
+        off_p50, _ = _latency_pass(step_off, step_batches, iters=8)
+        on_p50, _ = _latency_pass(step_on, step_batches, iters=8)
+        compress_ab = {
+            "compress_off_p50_ms": round(off_p50, 3),
+            "compress_speedup": (round(off_p50 / on_p50, 2)
+                                 if on_p50 > 0 else None),
+        }
+
     counts = np.asarray(outs[0][0])[:uniques[0]]
     deliv = np.diff(np.asarray(outs[0][1]))[:uniques[0]]
     ovf = sum(int(np.asarray(o[2]).sum()) for o in outs)
@@ -1010,9 +1060,13 @@ def main():
         "device": str(jax.devices()[0]),
         "unique_kmsgs_per_s": round(batches_per_s * avg_unique / 1e3, 1),
         "window_mmsgs": [round(r * batch / 1e6, 2) for r in rates],
+        "walk_levels_p50": walk_levels_p50,
+        "gathers_per_topic": gathers_per_topic,
     }
     if stage_ms is not None:
         info["stage_p50_ms"] = stage_ms
+    if compress_ab is not None:
+        info.update(compress_ab)
     if use_cache:
         st1 = cache.stats()
         probed = (st1["hit"] - st0["hit"]) + (st1["miss"] - st0["miss"])
@@ -1042,9 +1096,13 @@ def main():
         "vs_baseline": round(throughput / 1_000_000, 3),
         "p50_batch_ms": round(p50, 3),
         "p99_batch_ms": round(p99, 3),
+        "walk_levels_p50": walk_levels_p50,
+        "gathers_per_topic": gathers_per_topic,
     }
     if stage_ms is not None:
         rec["stage_p50_ms"] = stage_ms
+    if compress_ab is not None:
+        rec.update(compress_ab)
     _emit(rec)
 
 
@@ -1053,6 +1111,108 @@ def live():
     the full broker stack (see emqx_tpu/bench_live.py)."""
     from emqx_tpu.bench_live import live as _live
     _live(emit=_emit)
+
+
+def deep_smoke():
+    """BENCH_MODE=deep_smoke — the path-compression CI gate
+    (ISSUE 16, scripts/ci.sh): a 16-level workload must (a) actually
+    level-compress — the walk's hop bound strictly below the raw
+    level count — and (b) hold exact host-oracle parity through the
+    compressed tables and the product fetch seam. Numbers are not
+    gated here; the compression + correctness booleans ARE."""
+    import random as _random
+
+    n_filters = int(os.environ.get("DEEP_FILTERS", "400"))
+    n_topics = int(os.environ.get("DEEP_TOPICS", "256"))
+    levels = 16
+
+    jax = _jax_with_retry()
+
+    from emqx_tpu.oracle import TrieOracle
+    from emqx_tpu.ops import native
+    from emqx_tpu.ops.csr import device_view
+    from emqx_tpu.ops.match import depth_bucket, walk_params
+    from emqx_tpu.ops.walk_pallas import (fetch_walk_result,
+                                          match_batch_auto)
+
+    rng = _random.Random(6)
+    filters = set()
+    while len(filters) < n_filters:
+        ws = ["w%d" % rng.randint(0, 3) for _ in range(levels)]
+        r = rng.random()
+        if r < 0.25:
+            ws[rng.randint(0, levels - 1)] = "+"
+        elif r < 0.4:
+            ws = ws[:rng.randint(4, levels - 1)] + ["#"]
+        filters.add("/".join(ws))
+    filters = sorted(filters)
+
+    oracle = TrieOracle()
+    use_native = native.available()
+    if use_native:
+        eng = native.NativeEngine()
+        for i, f in enumerate(filters):
+            eng.insert(f, i)
+            oracle.insert(f)
+        host_auto = eng.flatten()
+        encode = eng.encode_batch
+    else:
+        insert, flatten, encode = _python_engine()
+        for i, f in enumerate(filters):
+            insert(f, i)
+            oracle.insert(f)
+        host_auto = flatten()
+
+    hl = np.asarray(host_auto.hops_for_level)
+    deep_hops = int(hl[min(levels, len(hl) - 1)])
+    # the gate: a 16-level literal-spined trie MUST compress — the
+    # walk takes strictly fewer hops than the topic has levels
+    assert int(host_auto.wt_take) > 1, \
+        "deep workload did not take the wide (compressed) layout"
+    assert deep_hops < levels, \
+        f"no compression: {deep_hops} hops for {levels} levels"
+
+    topics = ["/".join("w%d" % rng.randint(0, 3)
+                       for _ in range(levels))
+              for _ in range(n_topics)]
+    # seed guaranteed-match probes (wildcard rows above cover misses)
+    for f in rng.sample(filters, min(32, len(filters))):
+        topics.append("/".join(
+            "w0" if w == "+" else w
+            for w in f.split("/")).replace("/#", "/w0"))
+    ids_, n_, sysm_ = encode(topics, levels)
+    ids_, n_ = depth_bucket(ids_, n_)
+    auto = jax.device_put(device_view(host_auto))
+    t0 = time.time()
+    res = match_batch_auto(auto, ids_, n_, sysm_, k=16, m=64,
+                           pack_ids=True,
+                           **walk_params(host_auto, ids_.shape[1]))
+    r_ids, r_cnt, r_ovf = fetch_walk_result(res)
+    walk_s = time.time() - t0
+    inv = {i: f for i, f in enumerate(filters)}
+    mismatch = 0
+    for i, t in enumerate(topics):
+        want = sorted(oracle.match(t))
+        if r_ovf[i]:
+            continue  # flagged rows host-resolve in the product
+        got = sorted(inv[j] for j in r_ids[i] if j >= 0)
+        if got != want:
+            mismatch += 1
+    assert mismatch == 0, f"{mismatch} topics diverged from oracle"
+
+    _emit({
+        "metric": "deep_smoke_parity",
+        "value": 1,
+        "unit": "ok",
+        "filters": len(filters),
+        "topics": len(topics),
+        "levels": levels,
+        "walk_hops_deep": deep_hops,
+        "compressed": True,
+        "parity_ok": True,
+        "native": use_native,
+        "walk_s": round(walk_s, 3),
+    })
 
 
 def overload():
@@ -2466,11 +2626,16 @@ _CONFIG_MATRIX = [
     ("literal_100k", {"BENCH_MIX": "literal", "BENCH_LEVELS": "1",
                       "BENCH_WPL": "100000"}, None, 100_000, 100_000),
     ("plus_1m", {"BENCH_MIX": "plus"}, None, 1_000_000, 200_000),
-    ("hash_1m_deep", {"BENCH_MIX": "hash", "BENCH_LEVELS": "16"},
+    # the two compaction A/B rows (ISSUE 16): the deep row is where
+    # path compression lives (16-level spines, hops ≪ levels), the
+    # uniform row is the guard against the flat-tree regression
+    ("hash_1m_deep", {"BENCH_MIX": "hash", "BENCH_LEVELS": "16",
+                      "BENCH_COMPRESS_AB": "1"},
      None, 1_000_000, 200_000),
     ("share_1m", {}, "shared", 1_000_000, 200_000),
     ("mixed_10m", {}, None, 10_000_000, 500_000),
-    ("mixed_1m_uniform", {"BENCH_TRAFFIC": "uniform"}, None,
+    ("mixed_1m_uniform",
+     {"BENCH_TRAFFIC": "uniform", "BENCH_COMPRESS_AB": "1"}, None,
      1_000_000, 100_000),
     # match-cache A/B rows (same workloads as the two rows above;
     # the cache-off rows ARE the baseline half of the pair): the
@@ -2518,8 +2683,10 @@ _HEADLINE_ROW = "mixed_1m_zipf"
 #: compressed-walk kernel + algebra-derived k) must invalidate staged
 #: rows mechanically, the way _MODE_WORKLOADS does for modes — round
 #: 4's adaptive-K change relied on a manual full re-run instead
-#: (ADVICE r4 item 1).
-_METHOD_REV = "walkv2"
+#: (ADVICE r4 item 1). Round 6: the native builder level-compresses
+#: the automaton and the TPU walk runs the VMEM-resident Pallas
+#: kernel — every row measures a different walk.
+_METHOD_REV = "walkv3_compact"
 
 
 def _row_spec(name: str, extra: dict, mode, subs_tpu) -> str:
@@ -2705,6 +2872,10 @@ def configs():
                                 "cache", "cache_slots",
                                 "cache_hit_rate",
                                 "cache_warm_hit_rate",
+                                "walk_levels_p50",
+                                "gathers_per_topic",
+                                "compress_off_p50_ms",
+                                "compress_speedup",
                                 "thr_logical_msgs_per_s", "chain"):
                         if fld in inf:
                             row[fld] = inf[fld]
@@ -2814,6 +2985,7 @@ _MODES = {
     "recovery": ("recovery", "recovery_replay_s", "s"),
     "partition": ("partition", "partition_heal_converge_s", "s"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
+    "deep_smoke": ("deep_smoke", "deep_smoke_parity", "ok"),
     "mixed": ("main", "publish_match_fanout_throughput", "msgs/sec"),
     "configs": ("configs", "publish_match_fanout_throughput",
                 "msgs/sec"),
@@ -2832,7 +3004,7 @@ _MODE_WORKLOADS = {
     "live": "probe_v1",
     "flapstorm": "flapstorm_v1",
     "overload": "overload_curve_v1",
-    "devloss": "devloss_v1",
+    "devloss": "devloss_v2_deep",  # + the deep-bucket rewarm proof
     "drain": "drain_v1",
     "recovery": "durability_v1",
     "partition": "cluster_heal_v1",

@@ -722,11 +722,21 @@ def _run_devloss() -> dict:
     topics = [f"dv/t{i}" for i in range(n_topics)]
     for t in topics:
         node.broker.subscribe(sink, t)
+    # a deep (16-level) bucket rides along: its level shape is its
+    # own compile family, and the rewarm must cover it too — the
+    # first_deep_batch_p99_ms column is that proof (ISSUE 16)
+    deep_topics = ["/".join(["dv", "deep", str(i)] + ["d"] * 13)
+                   for i in range(min(4, n_topics))]
+    for t in deep_topics:
+        node.broker.subscribe(sink, t)
     pad = _Sink()
     for i in range(n_filters):
         node.broker.subscribe(pad, f"dvbg/{i}/x")
     msgs = [Message(topic=topics[i % n_topics], payload=b"\x00" * 16)
             for i in range(batch)]
+    deep_msgs = [Message(topic=deep_topics[i % len(deep_topics)],
+                         payload=b"\x00" * 16)
+                 for i in range(batch)]
 
     def drive(seconds, latencies=None):
         sent = 0
@@ -742,6 +752,7 @@ def _run_devloss() -> dict:
     br = node.broker.breaker
     rec = br.recovery
     drive(1.0)  # compile every kernel pre-outage
+    node.broker.publish_batch(deep_msgs)  # incl. the deep bucket
     steady_lat = []
     steady = drive(secs, steady_lat)
     # the outage: the backend dies mid-traffic; batches host-match
@@ -768,6 +779,13 @@ def _run_devloss() -> dict:
         tb = time.perf_counter()
         node.broker.publish_batch(msgs)
         post_lat.append((time.perf_counter() - tb) * 1000.0)
+    # the deep bucket's own first batches: the rewarm must have
+    # compiled the 16-level shape too, off the hot path
+    post_deep_lat = []
+    for _ in range(10):
+        tb = time.perf_counter()
+        node.broker.publish_batch(deep_msgs)
+        post_deep_lat.append((time.perf_counter() - tb) * 1000.0)
     info = {
         "mode": "devloss", "filters": n_filters,
         "topics": n_topics, "batch": batch,
@@ -783,6 +801,9 @@ def _run_devloss() -> dict:
         "time_to_closed_s": round(time_to_closed, 3),
         "breaker_closed": closed,
         "first_batch_ms": round(post_lat[0], 3),
+        "first_deep_batch_ms": round(post_deep_lat[0], 3),
+        "first_deep_batch_p99_ms": round(
+            float(np.percentile(post_deep_lat, 99)), 3),
         "deliveries": sink.n,
     }
     stamp_first_batch(info, float(np.percentile(post_lat, 99)))
@@ -808,7 +829,7 @@ def devloss(emit=None) -> None:
     print(json.dumps(info), file=sys.stderr, flush=True)
     rec = {
         "metric": "devloss_host_fallback_msgs_per_s",
-        "workload": "devloss_v1",
+        "workload": "devloss_v2_deep",
         "value": info["fallback_msgs_per_s"],
         "unit": "msgs/sec",
         "vs_baseline": round(
@@ -819,7 +840,8 @@ def devloss(emit=None) -> None:
               "classified_lost_during_outage", "rebuild_s",
               "rebuilds", "rebuild_failures", "time_to_closed_s",
               "breaker_closed", "first_batch_ms",
-              "first_batch_p99_ms"):
+              "first_batch_p99_ms", "first_deep_batch_ms",
+              "first_deep_batch_p99_ms"):
         rec[k] = info[k]
     if emit is not None:
         emit(rec)

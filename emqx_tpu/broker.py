@@ -975,8 +975,9 @@ class Broker:
 
         cfg = self.router.config
         warmed = 0
-        for _bucket, topics in warm_plan(self._pack_budgets,
-                                         cfg.min_batch):
+        for _bucket, topics in warm_plan(
+                self._pack_budgets, cfg.min_batch,
+                levels=self.router.observed_levels()):
             pb = PendingBatch()
             pb.results = [0] * len(topics)
             pb.live = [(i, Message(topic=t, payload=b""))

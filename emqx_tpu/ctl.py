@@ -191,6 +191,10 @@ class Ctl:
             # cumulative lock-stall the off-lock compaction design
             # keeps near zero
             "delta": r.delta_info(),
+            # walk kernel variant (pallas | lax) + the live tables'
+            # level-compression snapshot (docs/PERF_NOTES.md
+            # "Round 6: path compression and the VMEM walk")
+            "walk": r.walk_info(),
         }
         for name, c in (("single", r._match_cache_obj),
                         ("sharded", r._sharded_cache_obj)):

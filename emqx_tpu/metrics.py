@@ -134,6 +134,13 @@ TRANSPORT_METRICS = [
 AUTOMATON_METRICS = [
     "automaton.delta.probes", "automaton.delta.filters",
     "automaton.delta.merges", "automaton.rebuild.stall_ms",
+    # level-compressed walk tables (ops/csr.py compress_automaton):
+    # `compaction.chains` = compressed edges carrying a fused
+    # single-child run, `compaction.fused_edges` = interior states
+    # those runs absorbed — table-state snapshots carried as drain
+    # deltas (GAUGE_METRICS: a rebuild may shrink them); 0/0 means
+    # the live tables walk narrow (no deep chains worth fusing)
+    "automaton.compaction.fused_edges", "automaton.compaction.chains",
 ]
 
 # overload protection + self-healing (overload.py,
@@ -276,6 +283,8 @@ ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
 #: new dec'd name here or its scraped rates turn to garbage.
 GAUGE_METRICS = frozenset({
     "retained.count",
+    "automaton.compaction.fused_edges",
+    "automaton.compaction.chains",
 })
 
 

@@ -502,6 +502,8 @@ class Node:
         auto = self.router.drain_automaton_stats()
         if any(auto.values()):
             self.metrics.fold_automaton_stats(auto)
+        stats.setstat("automaton.compaction.ratio",
+                      self.router.walk_info()["ratio"])
         stats.setstat("match.cache.entries.count",
                       self.router.cache_entries(),
                       "match.cache.entries.max")

@@ -30,6 +30,7 @@ _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
 
+_i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
@@ -48,13 +49,23 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    src = os.path.join(_SRC_DIR, "emqx_native.cpp")
+    try:
+        return (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(src))
+    except OSError:
+        return not os.path.exists(_SO)
+
+
 def load_library():
-    """The shared library, building it if needed; None on failure."""
+    """The shared library, (re)building it if missing or older than
+    the source; None on failure."""
     global _lib, _build_failed
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not os.path.exists(_SO) and not _build():
+        if _stale() and not _build() and not os.path.exists(_SO):
             _build_failed = True
             return None
         lib = C.CDLL(_SO)
@@ -99,6 +110,18 @@ def load_library():
         lib.trie_match.argtypes = [C.c_void_p, C.c_char_p, C.c_int32,
                                    _i32p, C.c_int32]
         lib.trie_match.restype = C.c_int32
+        try:
+            # level compression (absent in a pre-rebuild .so: the
+            # flatten then compresses in numpy, same result)
+            lib.csr_compress.argtypes = [
+                _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
+                C.c_int64, C.c_int32, C.c_int64, C.c_int64, C.c_int64,
+                _i32p, _i32p, _i32p, _i32p, _i32p,
+                _i32p, _i16p, _i16p, _i32p, _i64p]
+            lib.csr_compress.restype = C.c_int32
+            lib.has_csr_compress = True
+        except AttributeError:
+            lib.has_csr_compress = False
         _lib = lib
         return _lib
 
@@ -265,6 +288,13 @@ class NativeEngine:
             end_filter=end_filter, n_states=int(n_states), n_edges=E)
         if skip_hash:
             return auto
+        compressed = _compress_native(
+            self._lib, auto, state_capacity=v2_state_capacity)
+        if compressed is not None:
+            from emqx_tpu.ops.csr import attach_walk_tables
+            auto2, edges = compressed
+            return attach_walk_tables(auto2, edges,
+                                      n_buckets=n_buckets)
         return finalize_automaton(auto,
                                   state_capacity=v2_state_capacity,
                                   n_buckets=n_buckets)
@@ -394,6 +424,66 @@ class ShardedNativeEngine:
         parts = finalize_parts(autos, state_capacity=state_capacity,
                                n_buckets=n_buckets)
         return _stack_sharded(parts), parts
+
+
+def _compress_native(lib, auto, state_capacity: Optional[int] = None):
+    """Level-compress ``auto`` with the C++ chain fuser.
+
+    Returns ``(compressed_auto, V2Edges)`` byte-identical to
+    ``csr.compress_automaton`` (parity pinned field-for-field by
+    tests/test_walk_pallas.py::test_native_compress_parity)
+    or None when the numpy path should run instead: narrow-mode tries
+    (no deep chains worth fusing — the numpy narrow path is a cheap
+    renumber) or a pre-rebuild .so without the symbol."""
+    if not getattr(lib, "has_csr_compress", False):
+        return None
+    from emqx_tpu.ops.csr import (MAX_TAKE, WIDE_SLOTS, V2Edges,
+                                  capacity_for)
+
+    S = int(auto.n_states)
+    E = int(auto.n_edges)
+    R = MAX_TAKE
+    e_cap = max(E, 1)
+    e_src = np.empty(e_cap, np.int32)
+    e_word = np.empty(e_cap, np.int32)
+    e_take = np.empty(e_cap, np.int32)
+    e_child = np.empty(e_cap, np.int32)
+    e_cw = np.empty((e_cap, R - 1), np.int32)
+    node2 = np.empty((S, 4), np.int32)
+    v2_hop = np.empty(S, np.int16)
+    v2_depth = np.empty(S, np.int16)
+    hl = np.empty(S + 1, np.int32)
+    info = np.zeros(4, np.int64)
+    rc = lib.csr_compress(
+        np.ascontiguousarray(auto.row_ptr[:S + 1], np.int32),
+        np.ascontiguousarray(auto.edge_word, np.int32),
+        np.ascontiguousarray(auto.edge_child, np.int32),
+        np.ascontiguousarray(auto.plus_child[:S], np.int32),
+        np.ascontiguousarray(auto.hash_filter[:S], np.int32),
+        np.ascontiguousarray(auto.end_filter[:S], np.int32),
+        S, R, e_cap, S, S + 1,
+        e_src, e_word, e_take, e_child, e_cw.reshape(-1),
+        node2.reshape(-1), v2_hop, v2_depth, hl, info)
+    if rc != 0:
+        return None
+    S2, E2, maxdepth, mode = (int(x) for x in info)
+    if mode != 1:
+        return None
+    edges = V2Edges(src=e_src[:E2].copy(), word=e_word[:E2].copy(),
+                    take=e_take[:E2].copy(), child=e_child[:E2].copy(),
+                    cw=e_cw[:E2].copy())
+    S2_cap = capacity_for(S2, state_capacity)
+    node2_p = np.full((S2_cap, 4), -1, np.int32)
+    node2_p[:S2] = node2[:S2]
+    hop_p = np.full(S2_cap, -1, np.int16)
+    hop_p[:S2] = v2_hop[:S2]
+    depth_p = np.full(S2_cap, -1, np.int16)
+    depth_p[:S2] = v2_depth[:S2]
+    return auto._replace(
+        node2=node2_p, hops_for_level=hl[:maxdepth + 1].copy(),
+        v2_hop=hop_p, v2_depth=depth_p,
+        v2_states=S2, v2_edges=E2,
+        wt_slots=WIDE_SLOTS, wt_take=R), edges
 
 
 def _encode_batch(lib, wt, topics: Sequence[str], max_levels: int):

@@ -40,23 +40,42 @@ def warm_buckets(observed: Iterable[int], min_batch: int,
     return buckets[-max(1, cap):]
 
 
-def warm_topics(bucket: int, min_batch: int) -> List[str]:
+def warm_topics(bucket: int, min_batch: int,
+                levels: int = 4) -> List[str]:
     """A unique-topic list whose padded dispatch lands exactly in
     ``bucket``: the dispatch pads to the smallest power-of-two bucket
     ≥ the topic count (floored at ``min_batch``), so ``bucket//2 + 1``
-    topics select ``bucket`` for any bucket above the floor."""
+    topics select ``bucket`` for any bucket above the floor.
+
+    ``levels`` pins the batch's level-bucket shape: the walk slices
+    its level axis to the batch's deepest topic (``depth_bucket``)
+    and compiles per resulting depth, so the FIRST topic carries
+    exactly ``levels`` levels — one deep spine is enough to select
+    the compile family, the rest stay short."""
     n = 1 if bucket <= min_batch else bucket // 2 + 1
-    return ["\x00devloss/warm/%d/%d" % (bucket, i) for i in range(n)]
+    out = ["\x00devloss/warm/%d/%d" % (bucket, i) for i in range(n)]
+    spine = ["\x00devloss", "warm", str(bucket), "0"][:max(2, levels)]
+    spine += ["d"] * (max(2, levels) - len(spine))
+    out[0] = "/".join(spine)
+    return out
 
 
 def warm_plan(observed: Iterable[int], min_batch: int,
-              cap: int = MAX_WARM_BUCKETS
+              cap: int = MAX_WARM_BUCKETS,
+              levels: Iterable[int] = ()
               ) -> List[Tuple[int, List[str]]]:
     """``(bucket, topics)`` warm batches, smallest bucket first (the
     floor bucket compiles fastest — recovery reaches "some shape is
-    warm" as early as possible)."""
-    return [(b, warm_topics(b, min_batch))
-            for b in warm_buckets(observed, min_batch, cap)]
+    warm" as early as possible). ``levels`` is the set of observed
+    level-bucket shapes (``Router.observed_levels``) — each is its
+    own compile family, so every bucket replays every depth; the
+    compressed-walk deep buckets (16-level spines, ISSUE 16) warm
+    here exactly like the shallow ones. Empty = the historical
+    4-level shape only."""
+    lvls = sorted({int(l) for l in levels if int(l) >= 2}) or [4]
+    return [(b, warm_topics(b, min_batch, lv))
+            for b in warm_buckets(observed, min_batch, cap)
+            for lv in lvls]
 
 
 def stamp_first_batch(record: Dict[str, object],

@@ -39,7 +39,8 @@ from emqx_tpu import faults
 from emqx_tpu import topic as T
 from emqx_tpu.oracle import TrieOracle
 from emqx_tpu.ops.csr import Automaton, build_automaton, device_view
-from emqx_tpu.ops.match import depth_bucket, match_batch
+from emqx_tpu.ops.match import depth_bucket
+from emqx_tpu.ops.walk_pallas import match_batch_auto, walk_variant
 from emqx_tpu.ops.patch import AutoPatcher, PatchOverflow
 from emqx_tpu.ops.tokenize import WordTable, encode_batch
 from emqx_tpu.types import Route
@@ -266,6 +267,17 @@ class Router:
         # provably ≤1 lane, so the walk runs k=1)
         self._walk_meta = {"slots": 2, "take": 1, "hops": None,
                            "has_plus": True}
+        # level-compression facts of the LIVE tables (set alongside
+        # _walk_meta at rebuild/restore): chains = compressed edges
+        # carrying a fused run (take > 1), fused_edges = interior
+        # states those runs absorbed, ratio = permille of walk steps
+        # compression shaved off the deepest level
+        self._compaction = {"mode": "narrow", "chains": 0,
+                            "fused_edges": 0, "ratio": 0}
+        # level-bucket shapes live dispatches have compiled (lb after
+        # depth_bucket) — devloss rewarm replays exactly these so a
+        # deep post-recovery batch pays zero compile (ops/warmup.py)
+        self._seen_levels: set = set()
         self._compacting = False  # background compaction in flight
         # crashed-compaction supervision (docs/ROBUSTNESS.md): a
         # background flatten that raised arms an exponential backoff
@@ -359,7 +371,7 @@ class Router:
         self._delta_filters = 0
         self._delta_merges = 0
         self._rebuild_stall_ms = 0.0
-        self._auto_drained = (0, 0, 0, 0)
+        self._auto_drained = (0, 0, 0, 0, 0, 0)
 
     # -- engine dispatch (native C++ or pure Python) ----------------------
 
@@ -947,6 +959,24 @@ class Router:
             "hops": np.array(host_auto.hops_for_level),
             "has_plus": has_plus,
         }
+        chains = fused = 0
+        if int(host_auto.wt_take) > 1:
+            from emqx_tpu.ops.csr import WIDE_SLOT
+            for p in pool:
+                wt = np.asarray(p.wt).reshape(-1, WIDE_SLOT)
+                takes = wt[wt[:, 0] >= 0, 2]
+                chains += int((takes > 1).sum())
+                fused += int((takes - 1).sum())
+        hops = self._walk_meta["hops"]
+        levels = len(hops)
+        deepest = int(hops[-1]) if levels else 0
+        self._compaction = {
+            "mode": "wide" if int(host_auto.wt_take) > 1 else "narrow",
+            "chains": chains,
+            "fused_edges": fused,
+            "ratio": (1000 * (levels - deepest)) // levels
+            if levels else 0,
+        }
 
     def _steps_for(self, lb: int) -> int:
         """Scan-step bound for a batch sliced to ``lb`` levels — read
@@ -966,9 +996,15 @@ class Router:
     def _walk_kw(self, lb: int) -> dict:
         """Static kernel kwargs for the live tables at batch depth
         ``lb``."""
+        self._seen_levels.add(int(lb))  # GIL-atomic; rewarm reads it
         m = self._walk_meta
         return {"steps": self._steps_for(lb), "slots": m["slots"],
                 "take": m["take"]}
+
+    def observed_levels(self) -> List[int]:
+        """Level-bucket shapes live dispatches have used (each is one
+        jit compile family) — the devloss rewarm's level axis."""
+        return sorted(self._seen_levels)
 
     def _patchers_dirty(self) -> bool:
         """Any live patcher holding queued device updates?"""
@@ -1538,9 +1574,10 @@ class Router:
         with self._wt_lock:
             ids, n, sysm = self._encode(padded, cfg.max_levels)
         ids, n = depth_bucket(ids, n)
-        res = match_batch(auto, ids, n, sysm, k=self.effective_k(),
-                          m=cfg.max_matches, pack_ids=False,
-                          **self._walk_kw(ids.shape[1]))
+        res = match_batch_auto(auto, ids, n, sysm,
+                               k=self.effective_k(),
+                               m=cfg.max_matches, pack_ids=False,
+                               **self._walk_kw(ids.shape[1]))
         out_ids, out_ovf = res.ids, res.overflow
         if dsnap is not None:
             # two-probe: union the side-automaton's raw emits +
@@ -1619,10 +1656,10 @@ class Router:
             with self._wt_lock:
                 ids, n, sysm = self._encode(padded, cfg.max_levels)
             ids, n = depth_bucket(ids, n)
-            res = match_batch(auto, ids, n, sysm,
-                              k=self.effective_k(), m=cfg.max_matches,
-                              pack_ids=True,
-                              **self._walk_kw(ids.shape[1]))
+            res = match_batch_auto(auto, ids, n, sysm,
+                                   k=self.effective_k(),
+                                   m=cfg.max_matches, pack_ids=True,
+                                   **self._walk_kw(ids.shape[1]))
             miss_rows, miss_ovf = res.ids, res.overflow
             if dsnap is not None:
                 # two-probe: fold the side-automaton + tombstone mask
@@ -1780,8 +1817,10 @@ class Router:
         """Delta/rebuild counter deltas since the last drain — folded
         into Metrics by the stats flush under the ``automaton.``
         prefix (docs/OBSERVABILITY.md)."""
+        comp = self._compaction
         cur = (self._delta_probes, self._delta_filters,
-               self._delta_merges, int(self._rebuild_stall_ms))
+               self._delta_merges, int(self._rebuild_stall_ms),
+               comp["fused_edges"], comp["chains"])
         prev = self._auto_drained
         self._auto_drained = cur
         return {
@@ -1789,7 +1828,18 @@ class Router:
             "delta.filters": cur[1] - prev[1],
             "delta.merges": cur[2] - prev[2],
             "rebuild.stall_ms": cur[3] - prev[3],
+            # table-state gauges carried as deltas (GAUGE_METRICS —
+            # a rebuild may shrink them)
+            "compaction.fused_edges": cur[4] - prev[4],
+            "compaction.chains": cur[5] - prev[5],
         }
+
+    def walk_info(self) -> Dict[str, object]:
+        """Live walk-kernel facts for `ctl cache` / bench: the variant
+        dispatch would pick right now (pallas | lax) and the level-
+        compression snapshot of the live tables (mode, fused chains,
+        permille of deepest-walk steps saved)."""
+        return {"variant": walk_variant(), **self._compaction}
 
     def delta_info(self) -> Dict[str, object]:
         """Live delta-automaton state for `ctl cache` / bench

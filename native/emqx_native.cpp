@@ -378,6 +378,175 @@ int64_t trie_flatten(Trie* t, int64_t s_cap, int64_t e_cap,
 }
 
 // ---------------------------------------------------------------------------
+// Level compression (ops/csr.py compress_automaton, wide mode)
+// ---------------------------------------------------------------------------
+// Fuse chains of single-child literal levels into one multi-word edge
+// directly from the v1 CSR flatten, so deep literal spines collapse
+// from one walk hop per level to one hop per wildcard-branch point.
+// Semantics mirror the numpy compressor BIT-FOR-BIT (same hop-BFS
+// emission order, same renumbering, same narrow/wide decision) —
+// parity pinned by tests/test_native.py against compress_automaton.
+//
+// Outputs (filled only when the chosen mode is wide; the caller runs
+// the cheap numpy narrow path otherwise):
+//   e_src/e_word/e_take/e_child[e_cap], e_cw[e_cap*(max_take-1)],
+//   node2[s_cap*4], v2_hop/v2_depth[s_cap] (dense, v2 ids),
+//   hops_for_level[hl_cap].
+// out_info[4] = {S2, E2, maxdepth, mode(1=wide, 0=narrow)}.
+// Returns 0 on success, -1 when a capacity is too small.
+
+int32_t csr_compress(const int32_t* row_ptr, const int32_t* edge_word,
+                     const int32_t* edge_child,
+                     const int32_t* plus_child,
+                     const int32_t* hash_filter,
+                     const int32_t* end_filter,
+                     int64_t S, int32_t max_take,
+                     int64_t e_cap, int64_t s_cap, int64_t hl_cap,
+                     int32_t* e_src, int32_t* e_word, int32_t* e_take,
+                     int32_t* e_child, int32_t* e_cw,
+                     int32_t* node2, int16_t* v2_hop, int16_t* v2_depth,
+                     int32_t* hops_for_level, int64_t* out_info) {
+    const int32_t CHAIN_PAD = -3;  // csr.py CW_PAD
+    const int32_t R = max_take;
+
+    // depth per state (tree ⇒ unique regardless of traversal order)
+    std::vector<int32_t> depth(S, -1);
+    depth[0] = 0;
+    {
+        std::vector<int64_t> frontier{0}, nxt;
+        int32_t d = 0;
+        while (!frontier.empty()) {
+            d++;
+            nxt.clear();
+            for (int64_t s : frontier) {
+                for (int32_t e = row_ptr[s]; e < row_ptr[s + 1]; e++) {
+                    depth[edge_child[e]] = d;
+                    nxt.push_back(edge_child[e]);
+                }
+                if (plus_child[s] >= 0) {
+                    depth[plus_child[s]] = d;
+                    nxt.push_back(plus_child[s]);
+                }
+            }
+            frontier.swap(nxt);
+        }
+    }
+    int32_t maxdepth = 0;
+    if (S > 1)
+        for (int64_t s = 0; s < S; s++)
+            if (depth[s] > maxdepth) maxdepth = depth[s];
+
+    // chain interiors: exactly one literal child, no '+', no
+    // terminals (the states the walk can skip); links[s] = skippable
+    // hops below s, built deepest-first so children resolve first
+    std::vector<uint8_t> elig(S, 0);
+    for (int64_t s = 1; s < S; s++) {
+        int32_t deg = row_ptr[s + 1] - row_ptr[s];
+        elig[s] = (deg == 1 && plus_child[s] < 0 &&
+                   hash_filter[s] < 0 && end_filter[s] < 0);
+    }
+    std::vector<int32_t> links(S, 0);
+    {
+        // counting sort by depth (descending sweep)
+        std::vector<std::vector<int64_t>> by_depth(maxdepth + 1);
+        for (int64_t s = 0; s < S; s++)
+            if (elig[s]) by_depth[depth[s]].push_back(s);
+        for (int32_t d = maxdepth; d >= 1; d--)
+            for (int64_t s : by_depth[d])
+                links[s] = 1 + links[edge_child[row_ptr[s]]];
+    }
+
+    // hop-BFS over the compressed graph: materialize branch states in
+    // discovery order, emit one compressed edge per (src, literal)
+    std::vector<int16_t> hop(S, -1);
+    hop[0] = 0;
+    std::vector<int64_t> mat{0};
+    std::vector<int64_t> frontier{0}, next_lit, next_plus;
+    int64_t E2 = 0;
+    while (!frontier.empty()) {
+        next_lit.clear();
+        next_plus.clear();
+        for (int64_t s : frontier) {
+            for (int32_t e = row_ptr[s]; e < row_ptr[s + 1]; e++) {
+                if (E2 >= e_cap) return -1;
+                int64_t cur = edge_child[e];
+                int32_t j = links[cur] < R - 1 ? links[cur] : R - 1;
+                int32_t* cw = e_cw + E2 * (R - 1);
+                for (int32_t i = 0; i < R - 1; i++) cw[i] = CHAIN_PAD;
+                for (int32_t i = 0; i < j; i++) {
+                    int32_t e0 = row_ptr[cur];
+                    cw[i] = edge_word[e0];
+                    cur = edge_child[e0];
+                }
+                hop[cur] = (int16_t)(hop[s] + 1);
+                e_src[E2] = (int32_t)s;  // v1 ids; renumbered below
+                e_word[E2] = edge_word[e];
+                e_take[E2] = 1 + j;
+                e_child[E2] = (int32_t)cur;
+                E2++;
+                next_lit.push_back(cur);
+            }
+        }
+        for (int64_t s : frontier)
+            if (plus_child[s] >= 0) {
+                hop[plus_child[s]] = (int16_t)(hop[s] + 1);
+                next_plus.push_back(plus_child[s]);
+            }
+        frontier.clear();
+        frontier.insert(frontier.end(), next_lit.begin(),
+                        next_lit.end());
+        frontier.insert(frontier.end(), next_plus.begin(),
+                        next_plus.end());
+        mat.insert(mat.end(), frontier.begin(), frontier.end());
+    }
+    int64_t S2 = (int64_t)mat.size();
+    if (S2 > s_cap) return -1;
+    if (maxdepth + 1 > hl_cap) return -1;
+
+    for (int32_t d = 0; d <= maxdepth; d++) hops_for_level[d] = 0;
+    for (int64_t i = 0; i < S2; i++) {
+        int32_t d = depth[mat[i]];
+        int32_t h = hop[mat[i]] + 1;
+        if (h > hops_for_level[d]) hops_for_level[d] = h;
+    }
+    for (int32_t d = 1; d <= maxdepth; d++)
+        if (hops_for_level[d - 1] > hops_for_level[d])
+            hops_for_level[d] = hops_for_level[d - 1];
+    for (int32_t d = 0; d <= maxdepth; d++)
+        if (hops_for_level[d] < 1) hops_for_level[d] = 1;
+
+    // the same mode rule the numpy compressor applies (csr.py): wide
+    // only when compression shortens the deepest walk by ≥ 2 steps
+    // and the packed (state << 5 | level) lane word can hold the ids
+    int32_t saved = (maxdepth + 1) - hops_for_level[maxdepth];
+    int32_t mode = (saved >= 2 && S2 < ((int64_t)1 << 26) &&
+                    maxdepth <= 31) ? 1 : 0;
+    out_info[0] = S2;
+    out_info[1] = E2;
+    out_info[2] = maxdepth;
+    out_info[3] = mode;
+    if (mode == 0) return 0;  // caller runs the numpy narrow path
+
+    std::vector<int32_t> newid(S, -1);
+    for (int64_t i = 0; i < S2; i++) newid[mat[i]] = (int32_t)i;
+    for (int64_t e = 0; e < E2; e++) {
+        e_src[e] = newid[e_src[e]];
+        e_child[e] = newid[e_child[e]];
+    }
+    for (int64_t i = 0; i < S2; i++) {
+        int64_t m = mat[i];
+        int32_t pc = plus_child[m];
+        node2[i * 4 + 0] = pc >= 0 ? newid[pc] : -1;
+        node2[i * 4 + 1] = hash_filter[m];
+        node2[i * 4 + 2] = end_filter[m];
+        node2[i * 4 + 3] = -1;
+        v2_hop[i] = hop[m];
+        v2_depth[i] = (int16_t)depth[m];
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
 // Host-side oracle match (fallback path, emqx_tpu/oracle.py semantics)
 // Returns count of matched filter ids written to out (max out_cap).
 // ---------------------------------------------------------------------------

@@ -146,9 +146,19 @@ def _read(root: Path, rel: str) -> str:
         return ""
 
 
+#: ops/ functions that ARE the sanctioned device→host transfer seams
+#: (DP301): each one exists so every other kernel call can stay
+#: async — a sync inside any of these is the coalesced fetch the
+#: dispatch pipeline planned for, not a stall
+DEVICE_FETCH_SEAMS = frozenset({
+    "fetch_walk_result",  # ops/walk_pallas.py — walk parity/bench
+})
+
+
 def build_context(root: Path) -> Context:
     ctx = Context()
     ctx.root = root
+    ctx.device_whitelist = set(DEVICE_FETCH_SEAMS)
     # metrics registry: every *_METRICS list literal in metrics.py,
     # the GAUGE_METRICS set, plus .new("literal") registrations
     # anywhere in the package (retainer/monitors register at attach)

@@ -48,6 +48,29 @@ echo "== delta-automaton parity + off-lock compaction (docs/DELTA.md) =="
 # match-correctness bug, fail fast
 python -m pytest tests/test_delta.py -q
 
+echo "== compressed-walk parity (docs/PERF_NOTES.md round 6) =="
+# Pallas-vs-lax byte identity (CPU interpret mode), native-vs-numpy
+# chain-fuser parity, and the randomized compressed-walk property
+# suite (deep spines, $share, churn, devloss rebuild, checkpoint
+# round-trip) — a divergence here is a match-correctness bug in the
+# wide-table walk, fail fast
+python -m pytest tests/test_walk_pallas.py -q
+
+echo "== deep-topic compression smoke (docs/PERF_NOTES.md round 6) =="
+# the BENCH_MODE=deep_smoke gate at toy scale: a 16-level workload
+# must level-compress (walk hop bound strictly below the raw level
+# count) and hold exact host-oracle parity through the compressed
+# tables + the product fetch seam (throughput is not gated here)
+BENCH_MODE=deep_smoke DEEP_FILTERS=400 DEEP_TOPICS=256 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='deep_smoke_parity' \
+    and rec['value'] is not None \
+    and rec['compressed'] is True \
+    and rec['parity_ok'] is True \
+    and rec['walk_hops_deep'] < rec['levels'], rec"
+
 echo "== flap-storm guard (flapping.py + scenario smoke) =="
 python -m pytest tests/test_flapping.py -q
 # the BENCH_MODE=flapstorm scenario end-to-end at toy scale: a
@@ -125,7 +148,8 @@ assert rec['metric']=='devloss_host_fallback_msgs_per_s' \
     and rec['value'] is not None and rec['breaker_closed'] \
     and rec['classified_lost_during_outage'] \
     and rec['rebuilds'] >= 1 and rec['rebuild_s'] is not None \
-    and rec['first_batch_p99_ms'] is not None, rec"
+    and rec['first_batch_p99_ms'] is not None \
+    and rec['first_deep_batch_p99_ms'] is not None, rec"
 
 echo "== zero-downtime operations: drain + live reload (docs/OPERATIONS.md) =="
 # graceful drain (CONNECT gate 0x9C + Server-Reference, paced waves

@@ -1,0 +1,318 @@
+"""VMEM-resident Pallas walk + path compression (ISSUE 16).
+
+Three contracts pinned here:
+
+  1. **Pallas-vs-lax byte identity** on CPU interpret mode — same
+     ids, counts, overflow flags, bit for bit, on both table layouts
+     (narrow / wide) and both packing modes.
+  2. **Native-vs-numpy compression parity** — the C++ ``csr_compress``
+     chain fuser must reproduce ``csr.compress_automaton`` exactly
+     (same edges, same renumbering, same hop bounds, same wt).
+  3. **Compressed-walk property suite** — randomized topic/filter
+     fuzz (``+``/``#``/``$share``, deep literal spines, single-char
+     and empty levels) against the host ``TrieOracle`` across
+     add/delete churn, delta flatten, devloss rebuild and checkpoint
+     round-trip, with the router's dispatch seam forced through the
+     Pallas kernel.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.oracle import TrieOracle
+from emqx_tpu.ops.csr import (attach_walk_tables, build_automaton,
+                              compress_automaton)
+from emqx_tpu.ops.match import match_batch, walk_params
+from emqx_tpu.ops.tokenize import WordTable, encode_batch
+from emqx_tpu.ops.walk_pallas import (fetch_walk_result,
+                                      match_batch_pallas, walk_variant)
+from emqx_tpu.router import MatcherConfig, Router
+
+
+def _build(filters, mode=None):
+    trie = TrieOracle()
+    table = WordTable()
+    fids = {}
+    for f in filters:
+        trie.insert(f)
+        fids[f] = len(fids)
+        for w in T.words(f):
+            table.intern(w)
+    if mode is None:
+        auto = build_automaton(trie, fids, table)
+    else:
+        raw = build_automaton(trie, fids, table, skip_hash=True)
+        auto, edges = compress_automaton(raw, force_mode=mode)
+        auto = attach_walk_tables(auto, edges)
+    inv = {v: k for k, v in fids.items()}
+    return trie, table, auto, inv
+
+
+def _rand_word(rng):
+    return rng.choice(["a", "b", "c", "sensor", "x", "y1", "q", ""])
+
+
+def _rand_filters(rng, n, deep=True):
+    out = set()
+    while len(out) < n:
+        r = rng.random()
+        if r < 0.1:
+            out.add("$share/g/%s/%s" % (_rand_word(rng),
+                                        _rand_word(rng)))
+            continue
+        if deep and r < 0.35:
+            # deep literal spine, sometimes '#'-capped
+            depth = rng.randint(8, 16)
+            ws = ["s%d" % rng.randint(0, 2) for _ in range(depth)]
+            if rng.random() < 0.4:
+                ws[-1] = "#"
+            out.add("/".join(ws))
+            continue
+        depth = rng.randint(1, 6)
+        ws = []
+        for i in range(depth):
+            rr = rng.random()
+            if rr < 0.2:
+                ws.append("+")
+            elif rr < 0.28 and i == depth - 1:
+                ws.append("#")
+            else:
+                ws.append(_rand_word(rng))
+        out.add("/".join(ws))
+    return sorted(out)
+
+
+def _rand_topics(rng, n, L=16):
+    out = []
+    for _ in range(n):
+        if rng.random() < 0.4:
+            depth = rng.randint(8, L)
+            out.append("/".join("s%d" % rng.randint(0, 2)
+                                for _ in range(depth)))
+        else:
+            out.append("/".join(_rand_word(rng)
+                                for _ in range(rng.randint(1, 6))))
+    return out
+
+
+# -- 1. Pallas vs lax byte identity ----------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["narrow", "wide"])
+@pytest.mark.parametrize("pack_ids", [True, False])
+def test_pallas_lax_byte_identity(mode, pack_ids):
+    rng = random.Random(20160 + pack_ids)
+    filters = _rand_filters(rng, 150)
+    topics = _rand_topics(rng, 32)
+    trie, table, auto, inv = _build(filters, mode=mode)
+    ids, n, sysm = encode_batch(table, topics, 16)
+    kw = dict(k=16, m=64, pack_ids=pack_ids,
+              **walk_params(auto, ids.shape[1]))
+    ref = match_batch(auto, ids, n, sysm, **kw)
+    got = match_batch_pallas(auto, ids, n, sysm, interpret=True, **kw)
+    r_ids, r_cnt, r_ovf = fetch_walk_result(ref)
+    g_ids, g_cnt, g_ovf = fetch_walk_result(got)
+    np.testing.assert_array_equal(g_ids, r_ids)
+    np.testing.assert_array_equal(g_cnt, r_cnt)
+    np.testing.assert_array_equal(g_ovf, r_ovf)
+
+
+def test_pallas_overflow_and_sys_semantics():
+    """Edge semantics must survive the kernel port: tiny K overflow
+    flags, $SYS root masking, topics past max_levels."""
+    filters = ["#", "+/#", "$SYS/#", "a/+/c", "a/b/c", "a/b/#"]
+    trie, table, auto, inv = _build(filters, mode="narrow")
+    topics = ["a/b/c", "$SYS/broker", "a/x/c", "q",
+              "/".join(["d"] * 40)]
+    ids, n, sysm = encode_batch(table, topics, 16)
+    kw = dict(k=2, m=8, pack_ids=True, **walk_params(auto, 16))
+    ref = match_batch(auto, ids, n, sysm, **kw)
+    got = match_batch_pallas(auto, ids, n, sysm, interpret=True, **kw)
+    for a, b in zip(fetch_walk_result(got), fetch_walk_result(ref)):
+        np.testing.assert_array_equal(a, b)
+    # the >16-level topic must be flagged, not truncated
+    assert bool(fetch_walk_result(got)[2][-1])
+
+
+def test_walk_variant_dispatch(monkeypatch):
+    monkeypatch.delenv("EMQX_TPU_WALK", raising=False)
+    assert walk_variant() == "lax"  # CPU test backend
+    monkeypatch.setenv("EMQX_TPU_WALK", "pallas")
+    assert walk_variant() == "pallas"
+    monkeypatch.setenv("EMQX_TPU_WALK", "lax")
+    assert walk_variant() == "lax"
+
+
+# -- 2. native chain-fuser parity ------------------------------------------
+
+
+def test_native_compress_parity():
+    native = pytest.importorskip("emqx_tpu.ops.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(31)
+    eng = native.NativeEngine()
+    filters = _rand_filters(rng, 250)
+    for i, f in enumerate(filters):
+        eng.insert(f, i)
+    got = eng.flatten()
+    v1 = eng.flatten(skip_hash=True)
+    # the native path must have taken the C++ fuser (deep spines ⇒
+    # wide mode), and its output must be byte-identical to numpy
+    assert got.wt_take > 1
+    from emqx_tpu.ops.csr import finalize_automaton
+    want = finalize_automaton(v1)
+    for field in want._fields:
+        a, b = getattr(got, field), getattr(want, field)
+        if a is None or isinstance(a, (int, np.integer)):
+            assert a == b, field
+        else:
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.shape == b.shape and a.dtype == b.dtype, field
+            np.testing.assert_array_equal(a, b, err_msg=field)
+
+
+def test_native_compress_narrow_fallback():
+    native = pytest.importorskip("emqx_tpu.ops.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    eng = native.NativeEngine()
+    for i, f in enumerate(["a/b", "a/+", "c"]):  # shallow ⇒ narrow
+        eng.insert(f, i)
+    auto = eng.flatten()
+    assert auto.wt_take == 1
+    from emqx_tpu.ops.csr import finalize_automaton
+    want = finalize_automaton(eng.flatten(skip_hash=True))
+    np.testing.assert_array_equal(np.asarray(auto.wt),
+                                  np.asarray(want.wt))
+
+
+# -- 3. compressed-walk property suite -------------------------------------
+
+
+def _mk(**kw):
+    kw.setdefault("device_min_filters", 0)
+    kw.setdefault("min_batch", 8)
+    return Router(MatcherConfig(**kw), node="node1")
+
+
+def _assert_parity(r, oracle, topics, tag=""):
+    got = r.match_filters(topics)
+    for t, row in zip(topics, got):
+        assert sorted(row) == sorted(oracle.match(t)), (tag, t)
+
+
+@pytest.mark.parametrize("delta,match_cache", [
+    (False, False), (True, False), (False, True), (True, True)])
+def test_compressed_walk_churn_parity(delta, match_cache):
+    """Wide-table walk parity vs the oracle across add/delete churn,
+    delta-on/off × cache-on/off — the tables stay in wide
+    (chain-fused) mode throughout because of the deep spines."""
+    rng = random.Random(777)
+    r = _mk(delta=delta, match_cache=match_cache,
+            delta_max_filters=10_000)
+    oracle = TrieOracle()
+    live = {}
+    for f in _rand_filters(rng, 80):
+        r.add_route(f)
+        oracle.insert(f)
+        live[f] = True
+    probe = _rand_topics(rng, 10) + ["$share/g/a/b", "//", "s0"]
+    _assert_parity(r, oracle, probe, "warm")
+    assert r.walk_info()["mode"] == "wide"
+    assert r.walk_info()["chains"] > 0
+    for step in range(60):
+        if live and rng.random() < 0.45:
+            f = rng.choice(sorted(live))
+            r.delete_route(f)
+            oracle.delete(f)
+            del live[f]
+        else:
+            f = _rand_filters(rng, 1)[0]
+            if f not in live:
+                r.add_route(f)
+                oracle.insert(f)
+                live[f] = True
+        if step % 12 == 0:
+            _assert_parity(r, oracle, probe, f"churn@{step}")
+    r.rebuild()
+    _assert_parity(r, oracle, probe, "post-rebuild")
+
+
+def test_compressed_walk_devloss_and_checkpoint(tmp_path):
+    """Wide tables must survive the PR 14 lifecycle: devloss rebuild
+    re-fuses chains on the fresh backend, checkpoint round-trip
+    restores the compressed layout bit-compatibly."""
+    from emqx_tpu import checkpoint
+
+    rng = random.Random(99)
+    r = _mk(match_cache=False)
+    oracle = TrieOracle()
+    for f in _rand_filters(rng, 60):
+        r.add_route(f)
+        oracle.insert(f)
+    probe = _rand_topics(rng, 8)
+    _assert_parity(r, oracle, probe, "pre")
+    assert r.walk_info()["mode"] == "wide"
+    # devloss: suspend (host fallback must stay exact) then rebuild
+    r.suspend_device()
+    _assert_parity(r, oracle, probe, "suspended")
+    r.rebuild_device_state()
+    _assert_parity(r, oracle, probe, "post-devloss")
+    assert r.walk_info()["mode"] == "wide"
+    # checkpoint round-trip into a fresh router
+    path = str(tmp_path / "walk.npz")
+    checkpoint.save(r, path)
+    r2 = _mk(match_cache=False)
+    checkpoint.load(r2, path)
+    _assert_parity(r2, oracle, probe, "restored")
+    assert r2.walk_info()["mode"] == "wide"
+
+
+def test_rewarm_plan_covers_deep_buckets():
+    """Devloss rewarm must replay every observed level-bucket shape
+    (each is its own compile family): a router that served 16-level
+    traffic gets a 16-level warm spine per bucket (ISSUE 16)."""
+    from emqx_tpu.ops.warmup import warm_plan, warm_topics
+
+    r = _mk()
+    for f in ["a/b", "/".join(["s0"] * 16)]:
+        r.add_route(f)
+    r.match_filters(["a/b"])
+    r.match_filters(["/".join(["s0"] * 16)])
+    seen = r.observed_levels()
+    assert 16 in seen
+    plan = warm_plan([8, 64], 8, levels=seen)
+    # every (bucket, level) pair present; the first topic of a deep
+    # batch carries exactly the deep level count (depth_bucket keys
+    # the compile on the batch's deepest topic)
+    depths = {(b, len(topics[0].split("/"))) for b, topics in plan}
+    for b in (8, 64):
+        for lv in seen:
+            assert (b, lv) in depths
+    assert len(warm_topics(64, 8, levels=16)) == 33  # bucket select
+
+
+@pytest.mark.slow
+def test_pallas_dispatch_through_router(monkeypatch):
+    """The dispatch seam end-to-end: force the Pallas kernel (CPU ⇒
+    interpret mode) through Router.match_filters and hold oracle
+    parity, including a mid-test mutation + re-flatten."""
+    monkeypatch.setenv("EMQX_TPU_WALK", "pallas")
+    rng = random.Random(5150)
+    r = _mk(match_cache=False, active_k=8, min_batch=4)
+    oracle = TrieOracle()
+    for f in _rand_filters(rng, 40):
+        r.add_route(f)
+        oracle.insert(f)
+    probe = _rand_topics(rng, 4)
+    assert r.walk_info()["variant"] == "pallas"
+    _assert_parity(r, oracle, probe, "pallas-warm")
+    f = "mid/flight/route"
+    r.add_route(f)
+    oracle.insert(f)
+    _assert_parity(r, oracle, probe + [f.replace("+", "a")],
+                   "pallas-churn")
