@@ -32,7 +32,13 @@ loops inside the node — [node] loops, docs/DISPATCH.md "Multi-loop
 front door"; >1 shards connections over loop threads and routes the
 delivery tail through the cross-loop ring), LIVE_LOOPS_AB (0 = skip
 the loops=1 comparison pass the record's loops1_* columns come
-from; only runs when LIVE_LOOPS > 1), BENCH_PLATFORM.
+from; only runs when LIVE_LOOPS > 1), LIVE_TRACE_RATE ([tracing]
+sample_rate for the pass — default 0, tracing cold),
+LIVE_TRACE_AB (0 = skip the traced comparison pass the record's
+traced_* / trace_overhead_frac columns come from; the pass reruns
+the workload at LIVE_TRACE_AB_RATE, default 0.01 — the
+docs/OBSERVABILITY.md "Tracing" ≤3%-overhead budget's measurement),
+BENCH_PLATFORM.
 
 On a single-core host the loop threads time-share with the harness
 clients — the multi-loop row there documents ring overhead; the
@@ -212,6 +218,13 @@ async def _run() -> dict:
     planner = os.environ.get("LIVE_PLANNER", "1") != "0"
     preser = os.environ.get("LIVE_PRESER", "1") != "0"
     loops = int(os.environ.get("LIVE_LOOPS", "1"))
+    # [tracing] sample_rate for this pass; 0 leaves the node on the
+    # default (tracing cold — the disabled-mode branch only)
+    trace_rate = float(os.environ.get("LIVE_TRACE_RATE", "0"))
+    trace_cfg = None
+    if trace_rate > 0:
+        from emqx_tpu.tracing import TracingConfig
+        trace_cfg = TracingConfig(sample_rate=trace_rate)
     zone = None
     if qos:
         # QoS>0 saturation needs a wide send window: the default
@@ -224,7 +237,7 @@ async def _run() -> dict:
                         "LIVE_INFLIGHT", "8192")),
                     max_mqueue_len=50000)
     node = Node(boot_listeners=False, batch_linger_ms=1.0, zone=zone,
-                loops=loops,
+                loops=loops, tracing=trace_cfg,
                 dispatch_config=DispatchConfig(planner=planner,
                                                preserialize=preser))
     lst = node.add_listener(port=0)
@@ -359,6 +372,8 @@ async def _run() -> dict:
     for peer in subs + pubs + [p for p in (probe_sub, probe_pub)
                                if p is not None]:
         peer.close()
+    node.tracing.drain_tick()  # spans still buffered in the rings
+    trace_spans = node.tracing.spans_total
     await node.stop()
 
     out = {
@@ -395,6 +410,8 @@ async def _run() -> dict:
         if flushes else 0,
         "xloop_fraction": round(xdeliv / delivered_srv, 3)
         if delivered_srv else 0.0,
+        "trace_rate": trace_rate,
+        "trace_spans": trace_spans,
     }
     if probe_lats is not None:
         out["probe_rate"] = probe_rate
@@ -485,6 +502,26 @@ def live(emit=None) -> None:
             else:
                 os.environ["LIVE_LOOPS"] = saved_loops
         print(json.dumps(info_l1), file=sys.stderr, flush=True)
+    # tracing A/B: the same workload with [tracing] sample_rate at a
+    # production-plausible 1% vs the untraced headline — the
+    # traced_* / trace_overhead_frac columns the ≤3%-overhead budget
+    # is gated on (docs/OBSERVABILITY.md "Tracing"). Skipped when the
+    # headline pass itself ran traced (the comparison would be
+    # on-vs-on) or LIVE_TRACE_AB=0.
+    info_tr = None
+    if not info.get("trace_rate") \
+            and os.environ.get("LIVE_TRACE_AB", "1") != "0":
+        saved_tr = os.environ.get("LIVE_TRACE_RATE")
+        os.environ["LIVE_TRACE_RATE"] = os.environ.get(
+            "LIVE_TRACE_AB_RATE", "0.01")
+        try:
+            info_tr = asyncio.run(_run())
+        finally:
+            if saved_tr is None:
+                del os.environ["LIVE_TRACE_RATE"]
+            else:
+                os.environ["LIVE_TRACE_RATE"] = saved_tr
+        print(json.dumps(info_tr), file=sys.stderr, flush=True)
     rec = {
         "metric": "live_socket_throughput",
         # r5: ingest backpressure + paced service-latency probe
@@ -531,6 +568,18 @@ def live(emit=None) -> None:
             rec["preser_speedup"] = round(
                 info_q1["deliveries_per_s"]
                 / info_q1_off["deliveries_per_s"], 3)
+    if info_tr is not None:
+        rec["traced_msgs_per_s"] = round(
+            info_tr["deliveries_per_s"], 1)
+        rec["traced_p99_ms"] = round(info_tr["p99_ms"], 3)
+        rec["trace_sample_rate"] = info_tr.get("trace_rate", 0.0)
+        rec["trace_spans"] = info_tr.get("trace_spans", 0)
+        if info["deliveries_per_s"] > 0:
+            # fraction of untraced throughput the traced pass gives
+            # up (negative = noise in the traced pass's favor)
+            rec["trace_overhead_frac"] = round(
+                1.0 - info_tr["deliveries_per_s"]
+                / info["deliveries_per_s"], 3)
     if info_off is not None:
         rec["planner_off_msgs_per_s"] = round(
             info_off["deliveries_per_s"], 1)

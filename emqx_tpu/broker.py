@@ -110,7 +110,7 @@ class PendingBatch:
 
     __slots__ = (
         "done", "results", "live", "host_topics", "inv", "n_uniq",
-        "host_matched", "host_inv", "host_only", "span",
+        "host_matched", "host_inv", "host_only", "span", "tbatch",
         "plan", "plan_state", "xgroups",
         "id_map",
         "epoch", "st", "ids_dev", "ovf_dev", "pm", "pq",
@@ -130,6 +130,9 @@ class PendingBatch:
         # disabled fast path: every instrumented section below guards
         # on it with one branch and touches no clock
         self.span = None
+        # trace batch (tracing._TraceBatch | None) — set only when
+        # the batch carries sampled messages; same one-branch rule
+        self.tbatch = None
         self.results: List[int] = []
         self.live: List[Tuple[int, Message]] = []
         self.host_topics: Optional[List[str]] = None
@@ -215,6 +218,9 @@ class Broker:
         # publish-path telemetry (telemetry.Telemetry), wired by Node
         # next to router.telemetry; None = uninstrumented
         self.telemetry = None
+        # per-message span tracing (tracing.Tracing), wired by Node;
+        # None (or sample_rate = 0) = untraced, byte-identical wire
+        self.tracing = None
         # overload protection (overload.py), wired by Node when
         # [overload] enabled: the monitor (channel consults it at
         # CONNECT, sessions at QoS0 enqueue), the device-path circuit
@@ -465,6 +471,9 @@ class Broker:
         if tel is not None and tel.enabled:
             pb.span = tel.begin(len(msgs))
         sp = pb.span
+        trc = self.tracing
+        tracing_on = trc is not None and trc.active
+        tctxs = None
         pb.results = [0] * len(msgs)
         for i, msg in enumerate(msgs):
             self.metrics.inc_msg(msg)
@@ -481,10 +490,20 @@ class Broker:
             if out.flags.get("retain"):
                 self.metrics.inc("messages.retained")
             pb.live.append((i, out))
+            if tracing_on:
+                # idempotent: a context stamped at ingress submit (or
+                # carried over a cluster forward) is kept as-is
+                ctx = trc.stamp(out)
+                if ctx is not None:
+                    if tctxs is None:
+                        tctxs = []
+                    tctxs.append(ctx)
         if not pb.live:
             pb.done = True
             self._span_finish(pb)
             return pb
+        if tctxs is not None:
+            pb.tbatch = trc.batch_begin(tctxs)
         if sp is not None:
             sp.topic = pb.live[0][1].topic
         topics = [m.topic for _, m in pb.live]
@@ -664,8 +683,11 @@ class Broker:
         device threshold, device off, or empty route table). Hot
         topics dedup here too — one trie walk per unique topic."""
         sp = pb.span
+        tb = pb.tbatch
         if sp is not None:
             t_m = sp.clock()
+        elif tb is not None:
+            t_m = time.perf_counter()
         uniq, inv = dedup_topics(topics)
         pb.n_uniq = len(uniq)
         matched = (self.router.match_filters_host(uniq)
@@ -674,6 +696,8 @@ class Broker:
             sp.n_uniq = pb.n_uniq
             sp.add("match", t_m)  # host regime: the actual trie walk
             t_d = sp.clock()
+        if tb is not None:
+            self.tracing.mark_match(tb, t_m)
         for row, (i, msg) in enumerate(pb.live):
             filters = matched[inv[row]]
             if not filters:
@@ -684,11 +708,14 @@ class Broker:
             sp.add("dispatch", t_d)
 
     def _span_finish(self, pb: PendingBatch) -> None:
-        """Close a batch's telemetry span (idempotent; no-op when
-        telemetry is off)."""
+        """Close a batch's telemetry span and trace batch (idempotent;
+        no-op when both are off)."""
         if pb.span is not None:
             self.telemetry.finish(pb.span)
             pb.span = None
+        if pb.tbatch is not None:
+            self.tracing.close_batch(pb.tbatch)
+            pb.tbatch = None
 
     @executor_thread
     def publish_fetch(self, pb: PendingBatch) -> None:
@@ -896,6 +923,11 @@ class Broker:
             if sp is not None:
                 sp.fallbacks = n_fb
                 sp.add("fetch", t_f)
+            tb = pb.tbatch
+            if tb is not None:
+                # device regime: walk + fan-out + coalesced transfer,
+                # timed from batch begin (the dispatch was async)
+                self.tracing.mark_match(tb, tb.t0p)
             if self.dispatch_config.planner:
                 t_pl = sp.clock() if sp is not None else 0.0
                 pb.plan = self._build_plan(pb, subs_occ, src_occ)
@@ -908,12 +940,18 @@ class Broker:
                     # the event loop when fetch runs on the ingress
                     # executor — so the delivery tail patches bytes
                     # instead of serializing (docs/DISPATCH.md)
-                    t_s = sp.clock() if sp is not None else 0.0
+                    if sp is not None:
+                        t_s = sp.clock()
+                    else:
+                        t_s = time.perf_counter() \
+                            if tb is not None else 0.0
                     preserialize_plan(pb.plan, pb.live, pb.id_map,
                                       self._subscribers,
                                       self.helper.registry.lookup)
                     if sp is not None:
                         sp.add("serialize", t_s)
+                    if tb is not None:
+                        self.tracing.span_mark(tb, "serialize", t_s)
                 if pb.plan is not None and self.loop_group is not None:
                     # cross-loop delivery ring: partition the plan's
                     # subscriber groups by owning loop here — still
@@ -1134,8 +1172,8 @@ class Broker:
             folded = True
         if sp is not None:
             sp.add("dispatch", t_d)
-            if folded:
-                self._span_finish(pb)
+        if folded:
+            self._span_finish(pb)
 
     @owner_loop
     def _deliver_plan_group(self, pb: PendingBatch, ps: _PlanState,
@@ -1248,6 +1286,11 @@ class Broker:
             if sp is not None:
                 sp.add_ms("xloop",
                           (ps.xloop_tdone - ps.xloop_t0) * 1000.0)
+            tb = pb.tbatch
+            if tb is not None:
+                self.tracing.span_abs(
+                    tb, "xloop", ps.xloop_t0,
+                    (ps.xloop_tdone - ps.xloop_t0) * 1000.0)
         results = pb.results
         for r, (i, msg) in enumerate(pb.live):
             d = counts[r]
@@ -1411,9 +1454,12 @@ class Broker:
         batch's unique topics happens on the first chunk and is
         cached on the batch."""
         sp = pb.span
+        tb = pb.tbatch
         if pb.host_matched is None:
             if sp is not None:
                 t_m = sp.clock()
+            elif tb is not None:
+                t_m = time.perf_counter()
             uniq, pb.host_inv = dedup_topics(pb.host_topics)
             pb.host_matched = (
                 self.router.match_filters_host(uniq) if pb.host_only
@@ -1421,6 +1467,8 @@ class Broker:
             if sp is not None:
                 sp.n_uniq = len(uniq)
                 sp.add("match", t_m)
+            if tb is not None:
+                self.tracing.mark_match(tb, t_m)
         if sp is not None:
             t_d = sp.clock()
         for row in range(start, stop):
@@ -1432,8 +1480,8 @@ class Broker:
             pb.results[i] = self._route(filters, msg)
         if sp is not None:
             sp.add("dispatch", t_d)
-            if stop >= len(pb.live):
-                self._span_finish(pb)
+        if stop >= len(pb.live):
+            self._span_finish(pb)
 
     @owner_loop
     def publish_finish_chunk(self, pb: PendingBatch, start: int,
@@ -1480,8 +1528,8 @@ class Broker:
                                                msg, pb)
         if sp is not None:
             sp.add("dispatch", t_d)
-            if stop >= len(pb.live):
-                self._span_finish(pb)
+        if stop >= len(pb.live):
+            self._span_finish(pb)
 
     def _drop_no_subs(self, msg: Message) -> None:
         self.metrics.inc("messages.dropped")

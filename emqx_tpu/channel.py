@@ -863,6 +863,8 @@ class Channel:
         n_onloop = 0
         wire_ok = (self.wire_fast and not self.mountpoint
                    and not self.client_alias_max)
+        trc = self.broker.tracing
+        trace_on = trc is not None and trc.active
         for pid, item in self.session.drain_outbox():
             if pid == PUBREL_MARKER:
                 out.append(self._ack(C.PUBREL, item))
@@ -872,6 +874,12 @@ class Channel:
                 self.broker.metrics.inc("delivery.dropped")
                 self.broker.metrics.inc("delivery.dropped.expired")
                 continue
+            if trace_on and "_trace" in msg.headers:
+                # egress-flush span: stamp → this connection's flush.
+                # The context key is checked (not re-sampled) so a
+                # message traced by the PUBLISHING node — possibly
+                # across a cluster forward — closes its chain here
+                trc.flush_mark(msg.headers["_trace"], self.client_id)
             if wire_ok and pid is None:
                 data = self._wire_cached(msg)
                 if data is not None:

@@ -117,6 +117,11 @@ class NodeConfig:
     # publish log (emqx_tpu.telemetry.TelemetryConfig). None =
     # defaults (enabled).
     telemetry: Optional[Any] = None
+    # [tracing] section: sampled end-to-end message spans, slow-
+    # subscriber ranking, per-loop profiler
+    # (emqx_tpu.tracing.TracingConfig, docs/OBSERVABILITY.md
+    # "Tracing"). None = defaults (sampling off).
+    tracing: Optional[Any] = None
     # [dispatch] section: publish delivery-tail knobs
     # (emqx_tpu.broker.DispatchConfig — batch dispatch planner and
     # egress pre-serialization on/off, docs/DISPATCH.md). None =
@@ -237,6 +242,54 @@ def _build_telemetry(raw: Dict[str, Any]):
     if kwargs.get("ring_size", 1) <= 0:
         raise ConfigError("telemetry.ring_size must be > 0")
     return TelemetryConfig(**kwargs)
+
+
+def _build_tracing(raw: Dict[str, Any]):
+    """``[tracing]`` table → :class:`~emqx_tpu.tracing
+    .TracingConfig`. Closed schema like zones/matcher/telemetry: a
+    typo'd ``sample_rate`` silently tracing nothing (or everything)
+    is the drift this rule catches."""
+    import dataclasses as _dc
+
+    from emqx_tpu.tracing import TracingConfig
+
+    known = {f.name for f in _dc.fields(TracingConfig)}
+    kwargs: Dict[str, Any] = {}
+    for key, val in raw.items():
+        if key not in known:
+            raise ConfigError(f"unknown tracing setting: "
+                              f"tracing.{key}")
+        want = TracingConfig.__dataclass_fields__[key].type
+        if want == "bool" and not isinstance(val, bool):
+            raise ConfigError(f"tracing.{key} must be a boolean")
+        if want == "int" and (isinstance(val, bool)
+                              or not isinstance(val, int)):
+            raise ConfigError(f"tracing.{key} must be an integer")
+        if want == "float":
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise ConfigError(f"tracing.{key} must be a number")
+            val = float(val)
+        kwargs[key] = val
+    rate = kwargs.get("sample_rate", 0.0)
+    if not 0.0 <= rate <= 1.0:
+        raise ConfigError("tracing.sample_rate must be in [0, 1]")
+    if kwargs.get("ring_size", 1) <= 0:
+        raise ConfigError("tracing.ring_size must be > 0")
+    if kwargs.get("export_keep", 1) <= 0:
+        raise ConfigError("tracing.export_keep must be > 0")
+    if kwargs.get("slow_subs_top", 1) <= 0:
+        raise ConfigError("tracing.slow_subs_top must be > 0")
+    if kwargs.get("slow_subs_threshold_ms", 0.0) < 0:
+        raise ConfigError(
+            "tracing.slow_subs_threshold_ms must be >= 0")
+    if kwargs.get("slow_subs_expiry_s", 1.0) <= 0:
+        raise ConfigError("tracing.slow_subs_expiry_s must be > 0")
+    if kwargs.get("slow_subs_alarm_ticks", 1) < 1:
+        raise ConfigError(
+            "tracing.slow_subs_alarm_ticks must be >= 1")
+    if kwargs.get("profile_interval_ms", 1.0) <= 0:
+        raise ConfigError("tracing.profile_interval_ms must be > 0")
+    return TracingConfig(**kwargs)
 
 
 def _build_dispatch(raw: Dict[str, Any]):
@@ -537,6 +590,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         if not isinstance(traw, dict):
             raise ConfigError("telemetry must be a table")
         cfg.telemetry = _build_telemetry(traw)
+    trcraw = raw.get("tracing")
+    if trcraw is not None:
+        if not isinstance(trcraw, dict):
+            raise ConfigError("tracing must be a table")
+        cfg.tracing = _build_tracing(trcraw)
     draw = raw.get("dispatch")
     if draw is not None:
         if not isinstance(draw, dict):
@@ -626,6 +684,7 @@ def build_node(cfg: NodeConfig):
     node = Node(name=cfg.name, zone=default,
                 matcher=cfg.matcher,
                 telemetry=cfg.telemetry,
+                tracing=cfg.tracing,
                 dispatch_config=cfg.dispatch,
                 sys_interval=cfg.sys_interval,
                 load_default_modules=cfg.load_default_modules,

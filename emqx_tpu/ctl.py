@@ -8,6 +8,7 @@ is."""
 from __future__ import annotations
 
 import json
+import time
 from typing import Callable, Dict, List
 
 
@@ -78,7 +79,12 @@ class Ctl:
             "waves, hand session custody to the target "
             "(docs/OPERATIONS.md)")
         self.register_command("trace", self._trace,
-                              "list | start client|topic <v> | stop client|topic <v>")
+                              "list | start client|topic <v> | "
+                              "stop client|topic <v> | export <path>")
+        self.register_command(
+            "slow_subs", self._slow_subs,
+            "top-N slowest subscribers by moving delivery latency "
+            "(docs/OBSERVABILITY.md) | reset")
         self.register_command("vm", self._vm,
                               "host/runtime introspection (emqx_vm)")
         self.register_command(
@@ -487,4 +493,37 @@ class Ctl:
         if args[0] == "stop" and len(args) >= 3:
             kind = "clientid" if args[1] == "client" else "topic"
             return "ok" if tr.stop_trace(kind, args[2]) else "not found"
-        return "usage: trace list | start client|topic <v> | stop client|topic <v>"
+        if args[0] == "export" and len(args) >= 2:
+            # drain any spans still sitting in the per-thread rings
+            # first, so a just-published message's chain is complete
+            trc = self.node.tracing
+            trc.drain_tick(self.node.stats)
+            n = trc.export(args[1])
+            return (f"exported {n} trace events to {args[1]} "
+                    f"(Chrome trace-event JSON — chrome://tracing, "
+                    f"Perfetto)")
+        return ("usage: trace list | start client|topic <v> | "
+                "stop client|topic <v> | export <path>")
+
+    def _slow_subs(self, args) -> str:
+        trc = self.node.tracing
+        if args and args[0] == "reset":
+            trc.slow.reset()
+            return "ok"
+        # fold anything pending so the ranking reflects now
+        trc.drain_tick(self.node.stats)
+        rows = trc.slow.top()
+        if not rows:
+            return ("(none traced — slow_subs ranks sampled "
+                    "deliveries; set [tracing] sample_rate > 0)")
+        cfg = trc.config
+        lines = [f"{'clientid':<24}{'avg_ms':>10}{'max_ms':>10}"
+                 f"{'flushes':>9}{'age_s':>7}"]
+        now = time.time()
+        for cid, avg, mx, n, last in rows:
+            lines.append(f"{cid:<24}{avg:>10.2f}{mx:>10.2f}"
+                         f"{n:>9}{now - last:>7.0f}")
+        lines.append(f"threshold {cfg.slow_subs_threshold_ms:g}ms, "
+                     f"expiry {cfg.slow_subs_expiry_s:g}s, "
+                     f"tracked {len(trc.slow.clients)}")
+        return "\n".join(lines)

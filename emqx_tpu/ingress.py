@@ -148,6 +148,13 @@ class IngressBatcher:
         On a multi-loop node the future belongs to the CALLER'S loop
         (acks flush from there) while the batch always flushes on the
         home loop."""
+        trc = self.broker.tracing
+        if trc is not None and trc.active:
+            # trace-context stamp at INGRESS: the context's t0 anchors
+            # the ingress-wait span (submit → batch pickup). Stamping
+            # only mutates the message's own headers — safe from any
+            # submitting loop; idempotent for forwarded messages
+            trc.stamp(msg)
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:

@@ -267,12 +267,26 @@ CLUSTER_METRICS = [
     "cluster.locker.degraded",
 ]
 
+# sampled end-to-end tracing + slow-subscriber attribution
+# (emqx_tpu/tracing.py, docs/OBSERVABILITY.md "Tracing"), folded on
+# the stats tick: `tracing.spans` = span records drained from the
+# per-loop rings, `tracing.dropped` = spans shed because a ring was
+# full when its owner loop tried to record (the ring never blocks the
+# hot path), `slow_subs.flushes` = flush spans folded into the
+# slow-subscriber ranking, `slow_subs.breaches` = flushes whose
+# delivery latency crossed slow_subs_threshold_ms
+TRACING_METRICS = [
+    "tracing.spans", "tracing.dropped",
+    "slow_subs.flushes", "slow_subs.breaches",
+]
+
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
                + AUTOMATON_METRICS + TRANSPORT_METRICS
                + OVERLOAD_METRICS + BREAKER_METRICS + FAULT_METRICS
-               + OPS_METRICS + DURABILITY_METRICS + CLUSTER_METRICS)
+               + OPS_METRICS + DURABILITY_METRICS + CLUSTER_METRICS
+               + TRACING_METRICS)
 
 #: registry names that are NOT monotonic — ``Metrics.dec`` runs on
 #: them in steady state (today: the retainer's live-entry count,

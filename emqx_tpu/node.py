@@ -37,6 +37,7 @@ from emqx_tpu.stats import Stats
 from emqx_tpu.sys_topics import SysTopics
 from emqx_tpu.telemetry import Telemetry, TelemetryConfig
 from emqx_tpu.tracer import Tracer
+from emqx_tpu.tracing import Tracing
 from emqx_tpu.zone import Zone, get_zone
 
 log = logging.getLogger("emqx_tpu.node")
@@ -59,6 +60,7 @@ class Node:
                  faults_config=None,
                  durability=None,
                  drain=None,
+                 tracing=None,
                  plugin_config_dir: Optional[str] = None) -> None:
         self.name = name
         self.zone = zone or get_zone()
@@ -176,9 +178,17 @@ class Node:
                                    alarms=self.alarms, node=name)
         self.broker.telemetry = self.telemetry
         self.router.telemetry = self.telemetry
+        # per-message span tracing ([tracing], tracing.py): always
+        # constructed (like Telemetry) so reload/ctl can read the
+        # config; with sample_rate = 0 no seam ever stamps a context
+        # and the hot paths are byte-for-byte the untraced build
+        self.tracing = Tracing(tracing, metrics=self.metrics,
+                               alarms=self.alarms, node=name)
+        self.broker.tracing = self.tracing
         self.sys = SysTopics(self.broker, node=name, stats=self.stats,
                              interval=sys_interval,
-                             telemetry=self.telemetry)
+                             telemetry=self.telemetry,
+                             tracing=self.tracing)
         # host monitors (emqx_os_mon / emqx_vm_mon / emqx_sys_mon)
         self.os_mon = OsMon(self.alarms)
         self.vm_mon = VmMon(self.alarms, self.cm.connection_count,
@@ -344,6 +354,9 @@ class Node:
             self.metrics.enable_threadsafe()
             if self.ingress is not None:
                 self.ingress.bind_multiloop(self.loop_group)
+            # per-loop lag probes (monitors.SysMon.run): every peer
+            # loop gets a scheduling-lag gauge, not just the main loop
+            self.sys_mon.bind_loops(self.loop_group)
         for lst in self.listeners:
             lst.loop_group = self.loop_group
             await lst.start()
@@ -456,6 +469,9 @@ class Node:
             # after listeners + ingress drain: in-flight cross-loop
             # handoffs have reported back, peer loops are idle
             self.loop_group.stop()
+        # the loop profiler's sampler thread must not outlive the
+        # loops it samples (no-op unless `ctl profile loops start`)
+        self.tracing.profiler.stop()
         self._started = False
 
     async def _housekeeping(self) -> None:
@@ -555,6 +571,15 @@ class Node:
                       "publish.spans.max")
         stats.setstat("publish.slow.count", self.telemetry.slow_total,
                       "publish.slow.max")
+        # trace-span drain: swap the per-thread rings, fold flush
+        # spans into slow_subs, bump tracing.* counters + gauges —
+        # the ONE off-hot-path collection point (docs/OBSERVABILITY.md
+        # "Tracing"). Cheap no-op while nothing is sampled
+        self.tracing.drain_tick(stats)
+        # per-loop scheduling lag (monitors.SysMon probes; index 0 is
+        # the main loop, peers land as dynamic loop.<i>.lag_ms rows)
+        for i, lag in enumerate(self.sys_mon.loop_lags):
+            stats.setstat(f"loop.{i}.lag_ms", round(lag, 3))
 
     #: failure-detector state → gauge value (docs/OBSERVABILITY.md)
     _MEMBER_STATE_RANK = {"ok": 0, "suspect": 1, "down": 2}

@@ -125,11 +125,50 @@ def register_ctl(ctl) -> None:
     """``profile start <dir> | stop | kernels`` on a live node."""
     import json
 
+    def _profile_loops(args):
+        # the per-loop sampling profiler (tracing.LoopProfiler):
+        # collapsed Python stacks over the front-door loop threads,
+        # the ingress executor, and the main loop
+        trc = getattr(getattr(ctl, "node", None), "tracing", None)
+        if trc is None:
+            return "loop profiler unavailable (no node)"
+        prof = trc.profiler
+        if not args or args[0] == "show":
+            state = "running" if prof.running else "stopped"
+            head = f"loop profiler: {state}, {prof.samples} samples"
+            stacks = prof.collapsed(top=20)
+            return head + ("\n" + stacks if stacks else "")
+        if args[0] == "start":
+            if not prof.start():
+                return "loop profiler already running"
+            return (f"loop profiler sampling every "
+                    f"{prof.interval_ms:g}ms (front-door loops + "
+                    f"ingress executor + main loop)")
+        if args[0] == "stop":
+            if not prof.stop():
+                return "loop profiler not running"
+            return f"loop profiler stopped ({prof.samples} samples)"
+        if args[0] == "dump":
+            text = prof.collapsed()
+            if len(args) > 1:
+                with open(args[1], "w") as f:
+                    f.write(text + "\n")
+                return f"collapsed stacks written to {args[1]}"
+            return text or "(no samples)"
+        raise ValueError(f"bad subcommand: loops {args[0]}")
+
     def _profile(args):
         import jax
 
         if not args:
-            return f"profiling: {'on -> ' + _active['dir'] if _active['dir'] else 'off'}"
+            trc = getattr(getattr(ctl, "node", None), "tracing", None)
+            loops = ("on" if trc is not None and trc.profiler.running
+                     else "off")
+            return (f"profiling: "
+                    f"{'on -> ' + _active['dir'] if _active['dir'] else 'off'}"
+                    f" | loops: {loops}")
+        if args[0] == "loops":
+            return _profile_loops(args[1:])
         if args[0] == "start":
             if _active["dir"] is not None:
                 return f"already tracing to {_active['dir']}"
@@ -153,16 +192,25 @@ def register_ctl(ctl) -> None:
         if args[0] == "stop":
             if _active["dir"] is None:
                 return "not tracing"
-            jax.profiler.stop_trace()
             out = _active["dir"]
             _active["dir"] = None
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                # a stop whose trace jax never actually started (or
+                # that died mid-trace) must come back as operator
+                # text, not a raised traceback; the registry is
+                # already cleared so the next `start` works
+                return f"profile stop failed: {e}"
             return f"trace written to {out}"
         if args[0] == "kernels":
             return json.dumps(timer.stats(), indent=2)
         raise ValueError(f"bad subcommand: {args[0]}")
 
-    ctl.register_command("profile", _profile,
-                         "start [dir] | stop | kernels")
+    ctl.register_command(
+        "profile", _profile,
+        "start [dir] | stop | kernels | "
+        "loops start|stop|show|dump [path]")
 
 
 #: process-wide timer the router/bench feed (opt-in: spans only

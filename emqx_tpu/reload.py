@@ -50,10 +50,12 @@ def _sections() -> Dict[str, type]:
     from emqx_tpu.overload import OverloadConfig
     from emqx_tpu.router import MatcherConfig
     from emqx_tpu.telemetry import TelemetryConfig
+    from emqx_tpu.tracing import TracingConfig
 
     return {
         "matcher": MatcherConfig,
         "telemetry": TelemetryConfig,
+        "tracing": TracingConfig,
         "dispatch": DispatchConfig,
         "overload": OverloadConfig,
         "faults": FaultsConfig,
@@ -116,6 +118,7 @@ def _running_sections(node) -> Dict[str, object]:
     return {
         "matcher": node.router.config,
         "telemetry": node.telemetry.config,
+        "tracing": node.tracing.config,
         "dispatch": node.broker.dispatch_config,
         "overload": node.overload_config,
         # a durability-off node diffs against the disabled defaults:
@@ -209,6 +212,7 @@ def diff_config(node, cfg) -> List[Change]:
     # the closed-schema dataclass sections
     file_sections = {
         "matcher": cfg.matcher, "telemetry": cfg.telemetry,
+        "tracing": getattr(cfg, "tracing", None),
         "dispatch": cfg.dispatch, "overload": cfg.overload,
         "faults": cfg.faults, "durability": cfg.durability,
         "cluster": cfg.cluster, "drain": getattr(cfg, "drain", None),

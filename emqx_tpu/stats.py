@@ -65,6 +65,18 @@ STATS_KEYS = [
     # permille of deepest-level walk steps the compressed tables
     # save over one-hop-per-level (0 = narrow mode / nothing saved)
     "automaton.compaction.ratio",
+    # sampled tracing + slow-subscriber attribution (emqx_tpu/
+    # tracing.py, docs/OBSERVABILITY.md "Tracing"): span records
+    # still buffered in the per-loop rings, clientids currently in
+    # the slow_subs ranking, and the worst average delivery latency
+    # across them
+    "tracing.spans.pending",
+    "slow_subs.tracked", "slow_subs.worst_ms",
+    # per-loop event-loop scheduling lag (monitors.SysMon over the
+    # LoopGroup, docs/OBSERVABILITY.md): ``loop.0.lag_ms`` is the
+    # main loop; peer rows land as ``loop.<i>.lag_ms`` dynamically,
+    # one per front-door loop
+    "loop.0.lag_ms",
 ]
 
 

@@ -16,12 +16,13 @@ SYSDESCR = "emqx_tpu — TPU-native MQTT broker"
 class SysTopics:
     def __init__(self, broker, node: str = "emqx_tpu@127.0.0.1",
                  stats=None, interval: float = 60.0,
-                 telemetry=None) -> None:
+                 telemetry=None, tracing=None) -> None:
         self.broker = broker
         self.node = node
         self.stats = stats
         self.interval = interval
         self.telemetry = telemetry
+        self.tracing = tracing
         self.started_at = time.time()
 
     def uptime(self) -> float:
@@ -65,3 +66,12 @@ class SysTopics:
             self._pub("telemetry/slow",
                       {"count": tel.slow_total,
                        "threshold_ms": tel.config.slow_threshold_ms})
+        trc = self.tracing
+        if trc is not None and trc.config.enabled \
+                and trc.config.slow_subs_enabled:
+            # the slow-subscriber ranking, fleet-readable: same rows
+            # as `ctl slow_subs` (docs/OBSERVABILITY.md "Tracing")
+            self._pub("slow_subs", [
+                {"clientid": cid, "avg_ms": round(avg, 3),
+                 "max_ms": round(mx, 3), "count": n}
+                for cid, avg, mx, n, _last in trc.slow.top()])

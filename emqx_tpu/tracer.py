@@ -117,8 +117,14 @@ class Tracer:
 
     def trace_packet(self, direction: str, clientid: str, pkt) -> None:
         if self._traces:
-            self._log.debug("%s %s", direction, pkt,
-                            extra={"clientid": clientid})
+            # outbound PUBLISH/inbound packets that carry a topic must
+            # stamp it, or topic-filter traces miss them entirely (the
+            # filter matches on the record's `topic` extra)
+            topic = getattr(pkt, "topic", None)
+            extra = {"clientid": clientid}
+            if topic:
+                extra["topic"] = topic
+            self._log.debug("%s %s", direction, pkt, extra=extra)
 
     def trace_slow_publish(self, record: dict) -> None:
         """Tee a slow-publish telemetry record (telemetry.Telemetry)

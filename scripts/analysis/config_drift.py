@@ -37,6 +37,7 @@ RULES = {
 SECTIONS: Dict[str, Tuple[str, str]] = {
     "matcher": ("emqx_tpu/router.py", "MatcherConfig"),
     "telemetry": ("emqx_tpu/telemetry.py", "TelemetryConfig"),
+    "tracing": ("emqx_tpu/tracing.py", "TracingConfig"),
     "dispatch": ("emqx_tpu/broker.py", "DispatchConfig"),
     "overload": ("emqx_tpu/overload.py", "OverloadConfig"),
     "faults": ("emqx_tpu/faults.py", "FaultsConfig"),

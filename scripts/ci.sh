@@ -280,6 +280,23 @@ echo "== telemetry (docs/OBSERVABILITY.md) =="
 # instrumentation regression fails fast
 python -m pytest tests/test_telemetry.py -q
 
+echo "== tracing + slow_subs (docs/OBSERVABILITY.md \"Tracing\") =="
+# end-to-end message tracing: deterministic sampling, the
+# sample_rate=0 byte-identity + zero-allocation pin, ring-overflow
+# accounting, slow-subscriber ranking/expiry/alarm, cluster-forward
+# context carriage, and the loop profiler / profile-stop satellites
+python -m pytest tests/test_tracing.py -q
+
+echo "== trace-export smoke (docs/OBSERVABILITY.md) =="
+# a sampled publish through a loops=2 node (device matcher, QoS1
+# fan-out over the cross-loop ring), exported with `ctl trace
+# export`: the Chrome trace JSON must contain a complete
+# ingress→match→dispatch→publish→flush chain for a sampled trace id,
+# an xloop hop, and flush spans attributed to both subscriber
+# clientids — run focused so an export regression is named in CI
+python -m pytest \
+    tests/test_tracing.py::test_trace_chain_is_continuous_across_two_loops -q
+
 echo "== pytest =="
 if [[ "${COV:-1}" == "0" ]]; then
     python -m pytest tests -q
