@@ -1247,6 +1247,19 @@ def drain():
     _drain(emit=_emit)
 
 
+def fleet():
+    """BENCH_MODE=fleet — the connection-fleet row (ISSUE 18):
+    FLEET_CONNS real sockets (mostly-idle devices with wills,
+    persistent sessions, keepalive pings, reconnect churn) around a
+    mixed QoS0/1 + retained + shared-sub traffic core, against
+    FLEET_LOOPS event loops / FLEET_WORKERS SO_REUSEPORT processes /
+    FLEET_NODES cluster nodes. Records delivered msgs/s, delivery
+    p99, RSS per 10K conns, and the counted-blast zero-lost boolean
+    (emqx_tpu/bench_live.py; scripts/ci.sh gates a toy-scale run)."""
+    from emqx_tpu.bench_live import fleet as _fleet
+    _fleet(emit=_emit)
+
+
 def latency():
     """BENCH_MODE=latency — the small-batch low-latency operating
     point (VERDICT r4 item 4): per-step device latency of the full
@@ -2982,6 +2995,7 @@ _MODES = {
     "devloss": ("devloss", "devloss_host_fallback_msgs_per_s",
                 "msgs/sec"),
     "drain": ("drain", "drain_time_to_empty_s", "s"),
+    "fleet": ("fleet", "fleet_delivered_msgs_per_s", "msgs/sec"),
     "recovery": ("recovery", "recovery_replay_s", "s"),
     "partition": ("partition", "partition_heal_converge_s", "s"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
@@ -3006,6 +3020,7 @@ _MODE_WORKLOADS = {
     "overload": "overload_curve_v1",
     "devloss": "devloss_v2_deep",  # + the deep-bucket rewarm proof
     "drain": "drain_v1",
+    "fleet": "fleet_v1",
     "recovery": "durability_v1",
     "partition": "cluster_heal_v1",
 }

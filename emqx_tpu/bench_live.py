@@ -57,7 +57,18 @@ import numpy as np
 
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt.frame import Parser, serialize
-from emqx_tpu.mqtt.packet import Connect, PubAck, Publish, Subscribe
+from emqx_tpu.mqtt.packet import (Connect, Pingreq, PubAck, Publish,
+                                  Subscribe)
+
+
+def _bind_addr():
+    """Optional (ip, 0) source binding for outbound bench sockets.
+    Loopback connections burn one ephemeral port per (src, dst)
+    address pair (~28K), so a fleet past that size must spread its
+    SOURCE addresses — each fleet driver claims its own 127/8 ip via
+    FLEET_BIND_IP."""
+    ip = os.environ.get("FLEET_BIND_IP")
+    return (ip, 0) if ip else None
 
 
 class _Peer:
@@ -75,9 +86,11 @@ class _Peer:
 
     async def connect(self, port: int) -> None:
         self.reader, self.writer = await asyncio.open_connection(
-            "127.0.0.1", port)
+            "127.0.0.1", port, local_addr=_bind_addr())
+        # keepalive 0: a fleet-scale setup can take minutes, and the
+        # traffic core must not be expired before the window starts
         await self._send(Connect(client_id=self.cid, clean_start=True,
-                                 proto_ver=C.MQTT_V4))
+                                 keepalive=0, proto_ver=C.MQTT_V4))
         await self._read_packet()  # CONNACK
 
     async def _send(self, pkt) -> None:
@@ -1074,6 +1087,691 @@ def drain(emit=None) -> None:
     }
     rec.update({k: v for k, v in info.items()
                 if k != "time_to_empty_s"})
+    if emit is not None:
+        emit(rec)
+    else:
+        print(json.dumps(rec), flush=True)
+
+
+# -- BENCH_MODE=fleet ------------------------------------------------------
+#
+# The million-user claim, measured with real sockets (ISSUE 18): a
+# connection FLEET — mostly-idle devices with wills, persistent
+# sessions, and keepalive pings — around a mixed-traffic core
+# (QoS0/1, retained, a shared-sub group) plus a reconnect-churn pool,
+# against one node (FLEET_LOOPS event loops), an SO_REUSEPORT worker
+# pool (FLEET_WORKERS processes), or an in-process socket cluster
+# (FLEET_NODES). Reports delivered msgs/s, delivery p99, RSS per 10K
+# connections, and a counted QoS1 blast whose zero-lost boolean is
+# the CI gate. FLEET_DRIVERS > 1 shards the CLIENT side over that
+# many subprocesses too — required past ~hard_nofile/2 connections,
+# since one harness process pays 2 fds per loopback conn. Env:
+# FLEET_CONNS, FLEET_SECS, FLEET_LOOPS, FLEET_WORKERS, FLEET_NODES,
+# FLEET_DRIVERS, FLEET_SUBS, FLEET_PUBS, FLEET_CHURN, FLEET_TOPICS,
+# FLEET_PIPELINE, FLEET_BLAST, FLEET_BLAST_TIMEOUT, BENCH_PLATFORM;
+# the frame engine follows EMQX_TPU_FRAME like any broker.
+
+
+def _raise_nofile(conns: int) -> None:
+    """Lift RLIMIT_NOFILE toward what the fleet needs (2 fds per
+    loopback connection: client end + server end)."""
+    try:
+        import resource
+    except ImportError:
+        return
+    need = conns * 2 + 8192
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft >= need:
+        return
+    if hard != resource.RLIM_INFINITY and hard < need:
+        # privileged processes may lift the hard cap too (bounded by
+        # the kernel's fs.nr_open); a 100K-connection fleet needs it
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (need, need))
+            return
+        except (ValueError, OSError):
+            pass
+    new_soft = (need if hard == resource.RLIM_INFINITY
+                else min(need, hard))
+    try:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (new_soft, hard))
+    except (ValueError, OSError):
+        pass
+
+
+def _rss_mb(pid="self") -> float:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+async def _count_recv(peer: _Peer) -> None:
+    """Receive loop that counts deliveries WITHOUT latency samples
+    (for subscribers whose payloads are not timestamps: wills, the
+    counted blast)."""
+    try:
+        while True:
+            data = await peer.reader.read(65536)
+            if not data:
+                return
+            acked = False
+            for pkt in peer.parser.feed(data):
+                if isinstance(pkt, Publish):
+                    peer.received += 1
+                    if pkt.qos == 1:
+                        peer.writer.write(serialize(
+                            PubAck(type=C.PUBACK,
+                                   packet_id=pkt.packet_id),
+                            C.MQTT_V4))
+                        acked = True
+            if acked:
+                await peer.writer.drain()
+    except (asyncio.CancelledError, ConnectionResetError):
+        return
+
+
+async def _idle_connect(port: int, cid: str, clean: bool = True,
+                        will_topic: str = None, sub: str = None,
+                        sub_qos: int = 0):
+    """One fleet idler: CONNECT (keepalive 0 — no ping obligation),
+    optionally a will and one quiet subscription, then the socket
+    just sits there. No per-connection task: CONNACK (4 bytes) and
+    SUBACK (5 bytes) are fixed-size in v4, so the setup reads are
+    exact and nothing ever needs parsing again."""
+    reader, writer = await asyncio.open_connection(
+        "127.0.0.1", port, local_addr=_bind_addr())
+    kw = {}
+    if will_topic is not None:
+        kw = dict(will_flag=True, will_qos=0, will_topic=will_topic,
+                  will_payload=struct.pack("<q", 0))
+    writer.write(serialize(Connect(client_id=cid, clean_start=clean,
+                                   keepalive=0, proto_ver=C.MQTT_V4,
+                                   **kw), C.MQTT_V4))
+    await writer.drain()
+    await reader.readexactly(4)          # CONNACK
+    if sub is not None:
+        writer.write(serialize(Subscribe(
+            packet_id=1, topic_filters=[(sub, {"qos": sub_qos})]),
+            C.MQTT_V4))
+        await writer.drain()
+        await reader.readexactly(5)      # SUBACK (1 filter)
+    return reader, writer
+
+
+async def _churn_loop(ports, cid: str, stop: asyncio.Event,
+                      counter: list, wills_root: str) -> None:
+    """Reconnect churn: connect (with a will), linger briefly, drop
+    the socket WITHOUT a DISCONNECT — the will fires, the session
+    cleans up, and the fleet's accept path stays warm."""
+    k = 0
+    while not stop.is_set():
+        try:
+            r, w = await _idle_connect(
+                ports[k % len(ports)], cid,
+                will_topic=f"{wills_root}/{cid}")
+        except (OSError, asyncio.IncompleteReadError):
+            await asyncio.sleep(0.1)
+            continue
+        k += 1
+        try:
+            await asyncio.wait_for(stop.wait(), 0.05 + (k % 7) * 0.05)
+        except asyncio.TimeoutError:
+            pass
+        try:
+            w.transport.abort()          # abrupt: fires the will
+        except Exception:
+            w.close()
+        counter[0] += 1
+
+
+async def _run_fleet(ports, delivered_fn, conns_fn) -> dict:
+    conns = int(os.environ.get("FLEET_CONNS", "2000"))
+    secs = float(os.environ.get("FLEET_SECS", "5"))
+    n_topics = int(os.environ.get("FLEET_TOPICS", "32"))
+    pipeline = int(os.environ.get("FLEET_PIPELINE", "64"))
+    blast_n = int(os.environ.get("FLEET_BLAST", "2000"))
+    n_subs = int(os.environ.get(
+        "FLEET_SUBS", str(min(64, max(4, conns // 16)))))
+    n_pubs = int(os.environ.get(
+        "FLEET_PUBS", str(min(16, max(2, conns // 64)))))
+    n_churn = int(os.environ.get("FLEET_CHURN", str(conns // 20)))
+    # sharded-driver runs give each driver its own client-id prefix
+    # (same-cid sessions across drivers would take each other over)
+    # and its own will/blast namespaces so per-driver counts stay
+    # exact
+    prefix = os.environ.get("FLEET_CID_PREFIX", "fl")
+    wills_root = f"fleet/wills/{prefix}"
+    blast_topic = f"fleet/blast/{prefix}"
+
+    _raise_nofile(conns)
+    rss0 = _rss_mb()
+    topics = [f"fl/t{i}/v" for i in range(n_topics)]
+    recv_tasks = []
+
+    # traffic core: subscribers over literal/wildcard/shared shapes,
+    # mixed delivery QoS
+    subs = []
+    for i in range(n_subs):
+        s = _Peer(f"{prefix}-sub{i}")
+        await s.connect(ports[i % len(ports)])
+        if i % 8 == 0:
+            flt = f"$share/flg/fl/t{i % n_topics}/#"
+        elif i % 4 == 0:
+            flt = "fl/+/v"
+        else:
+            flt = f"fl/t{i % n_topics}/#"
+        await s.subscribe(flt, qos=1 if i % 2 else 0)
+        recv_tasks.append(asyncio.ensure_future(s.recv_loop()))
+        subs.append(s)
+
+    # wills witness + the counted-blast pair (subscribed up front so
+    # the blast needs no route churn mid-measurement)
+    will_sub = _Peer(f"{prefix}-wills")
+    await will_sub.connect(ports[0])
+    await will_sub.subscribe(f"{wills_root}/#", qos=0)
+    recv_tasks.append(asyncio.ensure_future(_count_recv(will_sub)))
+    blast_sub = _Peer(f"{prefix}-blast-sub")
+    await blast_sub.connect(ports[0])
+    await blast_sub.subscribe(blast_topic, qos=1)
+    recv_tasks.append(asyncio.ensure_future(_count_recv(blast_sub)))
+    blast_pub = _Peer(f"{prefix}-blast-pub")
+    await blast_pub.connect(ports[0])
+    recv_tasks.append(asyncio.ensure_future(blast_pub.drain_loop()))
+
+    if delivered_fn is None:
+        # sharded-driver mode: this process can't see server
+        # counters, so deliveries are counted at the client edge —
+        # stricter, if anything (only frames that made it all the
+        # way back over the wire count)
+        def delivered_fn():
+            return (sum(s.received for s in subs)
+                    + will_sub.received + blast_sub.received)
+    if conns_fn is None:
+        def conns_fn():
+            return len(idlers) + len(subs) + len(pubs) + 4
+
+    pubs = []
+    for i in range(n_pubs):
+        p = _Peer(f"{prefix}-pub{i}")
+        await p.connect(ports[i % len(ports)])
+        recv_tasks.append(asyncio.ensure_future(p.drain_loop()))
+        pubs.append(p)
+
+    # the fleet: mostly-idle device connections. 30% carry wills,
+    # 30% are persistent sessions holding a quiet QoS1 subscription,
+    # the rest are plain keepalive-0 connections.
+    n_idle = max(0, conns - n_subs - n_pubs - n_churn - 3)
+    idlers = []
+    n_wills = n_persist = 0
+    sem = asyncio.Semaphore(256)
+
+    async def _one_idler(i: int):
+        nonlocal n_wills, n_persist
+        async with sem:
+            port = ports[i % len(ports)]
+            try:
+                if i % 10 < 3:
+                    rw = await _idle_connect(
+                        port, f"{prefix}-idle{i}",
+                        will_topic=f"{wills_root}/idle{i}")
+                    n_wills += 1
+                elif i % 10 < 6:
+                    rw = await _idle_connect(
+                        port, f"{prefix}-idle{i}", clean=False,
+                        sub=f"fleet/persist/{prefix}/{i}", sub_qos=1)
+                    n_persist += 1
+                else:
+                    rw = await _idle_connect(port, f"{prefix}-idle{i}")
+            except (OSError, asyncio.IncompleteReadError) as e:
+                return e
+            idlers.append(rw)
+            return None
+
+    setup_errs = [e for e in await asyncio.gather(
+        *(_one_idler(i) for i in range(n_idle))) if e is not None]
+
+    # rotating keepalive driver: PINGREQ over a moving slice of the
+    # fleet each tick (the 2-byte PINGRESPs pool harmlessly in each
+    # idler's stream buffer — nobody reads them, nobody needs to)
+    ping_stop = asyncio.Event()
+    pinged = [0]
+
+    async def _ping_driver():
+        pos = 0
+        ping = serialize(Pingreq(), C.MQTT_V4)
+        while not ping_stop.is_set():
+            step = max(1, len(idlers) // 50) if idlers else 1
+            for _ in range(step):
+                if not idlers:
+                    break
+                _, w = idlers[pos % len(idlers)]
+                try:
+                    w.write(ping)
+                    pinged[0] += 1
+                except Exception:
+                    pass
+                pos += 1
+            try:
+                await asyncio.wait_for(ping_stop.wait(), 0.2)
+            except asyncio.TimeoutError:
+                pass
+
+    ping_task = asyncio.ensure_future(_ping_driver())
+
+    # reconnect churn
+    churn_stop = asyncio.Event()
+    churned = [0]
+    churn_tasks = [asyncio.ensure_future(
+        _churn_loop(ports, f"{prefix}-churn{i}", churn_stop, churned,
+                    wills_root))
+        for i in range(n_churn)]
+
+    # a retained drip rides along: one retained set per tick on a
+    # core topic (matches subscriber 0's filter), so the retain path
+    # is in the measured mix
+    retain_stop = asyncio.Event()
+    retain_pub = _Peer(f"{prefix}-retain")
+    await retain_pub.connect(ports[0])
+
+    async def _retain_drip():
+        j = 0
+        while not retain_stop.is_set():
+            retain_pub.writer.write(serialize(Publish(
+                topic="fl/t0/v",
+                payload=struct.pack("<q", time.perf_counter_ns()),
+                retain=True), C.MQTT_V4))
+            try:
+                await retain_pub.writer.drain()
+                await asyncio.wait_for(retain_stop.wait(), 0.1)
+            except asyncio.TimeoutError:
+                pass
+            except Exception:
+                return
+            j += 1
+
+    retain_task = asyncio.ensure_future(_retain_drip())
+
+    await asyncio.sleep(1.0)  # settle: routes, churn steady-state
+
+    # warm pass (compiles/caches outside the window)
+    warm_stop = asyncio.Event()
+    warm = [asyncio.ensure_future(p.publish_loop(
+        topics, warm_stop, pipeline, 0.0, 1 if i % 2 else 0))
+        for i, p in enumerate(pubs)]
+    await asyncio.sleep(0.5)
+    warm_stop.set()
+    await asyncio.gather(*warm, return_exceptions=True)
+    await asyncio.sleep(0.5)
+    for s in subs:
+        s.latencies.clear()
+        s.received = 0
+
+    # the timed window: mixed QoS0/QoS1 publish load (a publisher
+    # reset mid-window costs its remaining sends, not the whole run)
+    base_delivered = delivered_fn()
+    stop = asyncio.Event()
+    t0 = time.perf_counter()
+    pub_tasks = [asyncio.ensure_future(p.publish_loop(
+        topics, stop, pipeline, 0.0, 1 if i % 2 else 0))
+        for i, p in enumerate(pubs)]
+    await asyncio.sleep(secs)
+    stop.set()
+    sent = sum(r for r in
+               await asyncio.gather(*pub_tasks, return_exceptions=True)
+               if isinstance(r, int))
+    elapsed = time.perf_counter() - t0
+    await asyncio.sleep(0.5)
+    delivered = delivered_fn() - base_delivered
+    conns_now = conns_fn()
+
+    received = sum(s.received for s in subs)
+    lats = np.concatenate([np.asarray(s.latencies, np.float64)
+                           for s in subs if s.latencies]) \
+        if any(s.latencies for s in subs) else np.zeros(1)
+    rss1 = _rss_mb()
+
+    # counted QoS1 blast: every delivery individually owed, so
+    # expected == received is a hard zero-lost check, not a rate
+    churn_stop.set()     # quiesce churn first: no takeover noise
+    await asyncio.gather(*churn_tasks, return_exceptions=True)
+    # let the window's delivery backlog drain before counting: on an
+    # oversubscribed host the standing queue can be tens of seconds
+    # deep, and the blast must not race it
+    prev = delivered_fn()
+    quiet_deadline = time.perf_counter() + 60.0
+    while time.perf_counter() < quiet_deadline:
+        await asyncio.sleep(0.5)
+        cur = delivered_fn()
+        if cur == prev:
+            break
+        prev = cur
+    base_blast = blast_sub.received
+    for i in range(blast_n):
+        blast_pub.writer.write(serialize(Publish(
+            topic=blast_topic, payload=struct.pack("<q", i),
+            qos=1, packet_id=i % 0xFFFF + 1), C.MQTT_V4))
+        if (i + 1) % 128 == 0:
+            await blast_pub.writer.drain()
+            await asyncio.sleep(0)
+    await blast_pub.writer.drain()
+    deadline = time.perf_counter() + float(
+        os.environ.get("FLEET_BLAST_TIMEOUT", "60"))
+    while (blast_sub.received - base_blast) < blast_n \
+            and time.perf_counter() < deadline:
+        await asyncio.sleep(0.05)
+    blast_got = blast_sub.received - base_blast
+
+    ping_stop.set()
+    retain_stop.set()
+    await asyncio.gather(ping_task, retain_task,
+                         return_exceptions=True)
+    for t in recv_tasks:
+        t.cancel()
+    for peer in subs + pubs + [will_sub, blast_sub, blast_pub,
+                               retain_pub]:
+        peer.close()
+    for _, w in idlers:
+        try:
+            w.close()
+        except Exception:
+            pass
+    await asyncio.sleep(0)
+
+    return {
+        "conns_target": conns,
+        "conns_live": conns_now,
+        "idlers": len(idlers),
+        "idler_connect_errors": len(setup_errs),
+        "idlers_with_wills": n_wills,
+        "persistent_sessions": n_persist,
+        "keepalive_pings": pinged[0],
+        "churn_conns": n_churn,
+        "churn_reconnects": churned[0],
+        "wills_fired": will_sub.received,
+        "subs": n_subs, "pubs": n_pubs,
+        "sent": sent,
+        "delivered": delivered,
+        "received_client": received,
+        "elapsed_s": round(elapsed, 3),
+        "delivered_per_s": round(delivered / elapsed, 1),
+        "p50_ms": float(np.percentile(lats, 50)),
+        "p99_ms": float(np.percentile(lats, 99)),
+        "blast_expected": blast_n,
+        "blast_received": blast_got,
+        "blast_lost": blast_n - blast_got,
+        "rss_mb": round(rss1, 1),
+        "rss_setup_mb": round(rss0, 1),
+        "rss_per_10k_conns_mb": round(
+            (rss1 - rss0) / max(1, conns) * 10000, 1),
+    }
+
+
+async def _run_fleet_inproc() -> dict:
+    """One process: FLEET_NODES in-process nodes (socket cluster when
+    >1), each with FLEET_LOOPS front-door event loops."""
+    from emqx_tpu.node import Node
+    from emqx_tpu.zone import Zone
+
+    loops = int(os.environ.get("FLEET_LOOPS", "1"))
+    nnodes = int(os.environ.get("FLEET_NODES", "1"))
+    zone = Zone(name="default", max_inflight=8192,
+                max_mqueue_len=50000)
+    nodes = []
+    for i in range(nnodes):
+        node = Node(name=f"fleet{i}", boot_listeners=False,
+                    loops=loops, zone=zone, batch_linger_ms=1.0)
+        node.add_listener(port=0)
+        if nnodes > 1:
+            node.enable_cluster(port=0, cookie="bench-fleet")
+        await node.start()
+        nodes.append(node)
+    if nnodes > 1:
+        loop = asyncio.get_running_loop()
+        for node in nodes[1:]:
+            await loop.run_in_executor(
+                None, node.cluster.join_remote, "127.0.0.1",
+                nodes[0].cluster.transport.port)
+        await asyncio.sleep(0.5)
+    ports = [n.listeners[0].port for n in nodes]
+    try:
+        res = await _run_fleet(
+            ports,
+            delivered_fn=lambda: sum(
+                n.metrics.val("messages.delivered") for n in nodes),
+            conns_fn=lambda: sum(
+                n.cm.connection_count() for n in nodes))
+        res["loops"] = loops
+        res["nodes"] = nnodes
+        res["workers"] = 1
+        res["rss_includes_harness"] = True
+        for key in ("frame.native.frames", "frame.fallback",
+                    "frame.oversize", "messages.retained"):
+            res[key.replace(".", "_")] = sum(
+                n.metrics.val(key) for n in nodes)
+        res["frame_mode"] = nodes[0].listeners[0].frame
+    finally:
+        for node in nodes:
+            await node.stop()
+    return res
+
+
+def _run_fleet_workers(n_workers: int) -> dict:
+    """FLEET_WORKERS SO_REUSEPORT worker PROCESSES share one port;
+    worker RSS is pure server-side (the harness lives elsewhere)."""
+    from emqx_tpu.workers import WorkerPool
+
+    plat = os.environ.get("BENCH_PLATFORM") or "cpu"
+    with WorkerPool(n_workers, port=0, platform=plat) as pool:
+        res = asyncio.run(_run_fleet(
+            [pool.port],
+            delivered_fn=lambda: sum(d for _, d in pool.stats()),
+            conns_fn=lambda: sum(c for c, _ in pool.stats())))
+        worker_rss = sum(_rss_mb(p.pid) for p in pool.procs)
+    res["loops"] = 1
+    res["nodes"] = 1
+    res["workers"] = n_workers
+    res["rss_includes_harness"] = False
+    res["rss_mb"] = round(worker_rss, 1)
+    res["rss_per_10k_conns_mb"] = round(
+        worker_rss / max(1, res["conns_target"]) * 10000, 1)
+    res["frame_mode"] = os.environ.get("EMQX_TPU_FRAME", "py")
+    return res
+
+
+def _fleet_driver_main() -> None:
+    """Entry point for one FLEET_DRIVERS subprocess (re-exec'd by
+    ``_run_fleet_sharded``): drive this process's slice of the fleet
+    against the ports in FLEET_DRIVER_PORTS and report the row JSON
+    on stdout. The per-process RLIMIT_NOFILE hard cap is why this
+    exists — one harness process tops out near hard_cap/2 loopback
+    connections, so a 100K fleet is driven by a pool of these."""
+    ports = [int(p) for p in
+             os.environ["FLEET_DRIVER_PORTS"].split(",")]
+    info = asyncio.run(_run_fleet(ports, None, None))
+    info["driver_rss_mb"] = round(_rss_mb(), 1)
+    print(json.dumps(info), flush=True)
+
+
+def _merge_driver_rows(rows: list) -> dict:
+    """Sum the additive columns across driver rows. Percentiles are
+    merged conservatively — the max across drivers — because raw
+    latency samples don't cross the process boundary."""
+    out = dict(rows[0])
+    out.pop("rss_setup_mb", None)
+    for k in ("conns_target", "conns_live", "idlers",
+              "idler_connect_errors", "idlers_with_wills",
+              "persistent_sessions", "keepalive_pings", "churn_conns",
+              "churn_reconnects", "wills_fired", "subs", "pubs",
+              "sent", "delivered", "received_client",
+              "blast_expected", "blast_received", "blast_lost",
+              "driver_rss_mb"):
+        out[k] = sum(r.get(k, 0) for r in rows)
+    out["elapsed_s"] = max(r["elapsed_s"] for r in rows)
+    out["delivered_per_s"] = round(
+        sum(r["delivered"] / r["elapsed_s"] for r in rows), 1)
+    out["p50_ms"] = max(r["p50_ms"] for r in rows)
+    out["p99_ms"] = max(r["p99_ms"] for r in rows)
+    return out
+
+
+async def _spawn_drivers(n_drivers: int, ports, conns: int) -> list:
+    """Launch the driver pool (each with a distinct cid prefix and a
+    proportional slice of every population knob) and collect one row
+    dict per driver."""
+    import sys
+
+    blast = int(os.environ.get("FLEET_BLAST", "2000"))
+    churn = int(os.environ.get("FLEET_CHURN", str(conns // 20)))
+    subs = int(os.environ.get(
+        "FLEET_SUBS", str(min(64, max(4, conns // 16)))))
+    pubs = int(os.environ.get(
+        "FLEET_PUBS", str(min(16, max(2, conns // 64)))))
+    procs = []
+    for d in range(n_drivers):
+        env = dict(os.environ)
+        env.update({
+            "FLEET_DRIVER_PORTS": ",".join(str(p) for p in ports),
+            "FLEET_CID_PREFIX": f"fd{d}",
+            # one 127/8 source ip per driver: past ~28K conns the
+            # shared (src, dst) ephemeral-port space runs dry
+            "FLEET_BIND_IP": f"127.0.0.{d % 250 + 2}",
+            "FLEET_CONNS": str(conns // n_drivers),
+            "FLEET_BLAST": str(max(1, blast // n_drivers)),
+            "FLEET_CHURN": str(max(1, churn // n_drivers)),
+            "FLEET_SUBS": str(max(2, subs // n_drivers)),
+            "FLEET_PUBS": str(max(1, pubs // n_drivers)),
+        })
+        procs.append(await asyncio.create_subprocess_exec(
+            sys.executable, "-c",
+            "from emqx_tpu.bench_live import _fleet_driver_main; "
+            "_fleet_driver_main()",
+            stdout=asyncio.subprocess.PIPE, env=env))
+    outs = await asyncio.gather(*(p.communicate() for p in procs))
+    rows = []
+    for (stdout, _), p in zip(outs, procs):
+        if p.returncode == 0 and stdout.strip():
+            rows.append(json.loads(
+                stdout.decode().splitlines()[-1]))
+    if not rows:
+        raise RuntimeError("every fleet driver failed")
+    return rows
+
+
+async def _run_fleet_sharded(n_drivers: int) -> dict:
+    """FLEET_DRIVERS client subprocesses against either an in-proc
+    node (FLEET_LOOPS event loops) or an SO_REUSEPORT worker pool
+    (FLEET_WORKERS > 1). Server and harness never share a process,
+    so ``rss_mb`` is pure server-side — and no single process has to
+    hold the whole fleet's fds, which is what makes a 100K run fit
+    under an unraisable RLIMIT_NOFILE hard cap (use enough workers
+    AND drivers that each side's per-process share stays under it)."""
+    conns = int(os.environ.get("FLEET_CONNS", "2000"))
+    loops = int(os.environ.get("FLEET_LOOPS", "1"))
+    n_workers = int(os.environ.get("FLEET_WORKERS", "1"))
+    if n_workers > 1:
+        from emqx_tpu.workers import WorkerPool
+
+        plat = os.environ.get("BENCH_PLATFORM") or "cpu"
+        with WorkerPool(n_workers, port=0, platform=plat) as pool:
+            d0 = sum(d for _, d in pool.stats())
+            rows = await _spawn_drivers(n_drivers, [pool.port], conns)
+            server_delivered = sum(d for _, d in pool.stats()) - d0
+            server_rss = sum(_rss_mb(p.pid) for p in pool.procs)
+        res = _merge_driver_rows(rows)
+        res["loops"] = 1
+        res["nodes"] = 1
+        res["workers"] = n_workers
+        res["frame_mode"] = os.environ.get("EMQX_TPU_FRAME", "py")
+    else:
+        from emqx_tpu.node import Node
+        from emqx_tpu.zone import Zone
+
+        zone = Zone(name="default", max_inflight=8192,
+                    max_mqueue_len=50000)
+        node = Node(name="fleet0", boot_listeners=False, loops=loops,
+                    zone=zone, batch_linger_ms=1.0)
+        node.add_listener(port=0)
+        await node.start()
+        try:
+            d0 = node.metrics.val("messages.delivered")
+            rows = await _spawn_drivers(
+                n_drivers, [node.listeners[0].port], conns)
+            server_delivered = node.metrics.val(
+                "messages.delivered") - d0
+            server_rss = _rss_mb()
+            res = _merge_driver_rows(rows)
+            for key in ("frame.native.frames", "frame.fallback",
+                        "frame.oversize", "messages.retained"):
+                res[key.replace(".", "_")] = node.metrics.val(key)
+            res["frame_mode"] = node.listeners[0].frame
+        finally:
+            await node.stop()
+        res["loops"] = loops
+        res["nodes"] = 1
+        res["workers"] = 1
+    # client-edge vs server-side delivery accounting, both reported:
+    # drivers count what arrived over the wire, the server counts
+    # what it dispatched
+    res["server_delivered_total"] = server_delivered
+    res["drivers"] = n_drivers
+    res["rss_mb"] = round(server_rss, 1)
+    res["rss_includes_harness"] = False
+    res["rss_per_10k_conns_mb"] = round(
+        server_rss / max(1, res["conns_live"]) * 10000, 1)
+    return res
+
+
+def fleet(emit=None) -> None:
+    """BENCH_MODE=fleet — the connection-fleet row: delivered msgs/s
+    + delivery p99 + RSS per 10K conns at FLEET_CONNS real sockets
+    with wills, persistent sessions, churn, and mixed traffic, plus
+    the counted-blast zero-lost boolean (scripts/ci.sh gates a
+    toy-scale run)."""
+    import sys
+
+    from emqx_tpu.profiling import enable_compile_cache
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    enable_compile_cache()
+    n_workers = int(os.environ.get("FLEET_WORKERS", "1"))
+    n_drivers = int(os.environ.get("FLEET_DRIVERS", "1"))
+    if n_drivers > 1:
+        info = asyncio.run(_run_fleet_sharded(n_drivers))
+    elif n_workers > 1:
+        info = _run_fleet_workers(n_workers)
+    else:
+        info = asyncio.run(_run_fleet_inproc())
+    print(json.dumps(info), file=sys.stderr, flush=True)
+    rec = {
+        "metric": "fleet_delivered_msgs_per_s",
+        "workload": "fleet_v1",
+        "value": info["delivered_per_s"],
+        "unit": "msgs/sec",
+        # the million-user yardstick: live connections vs 1M
+        "vs_baseline": round(info["conns_live"] / 1_000_000, 4),
+    }
+    for k in ("conns_target", "conns_live", "idlers",
+              "idlers_with_wills", "persistent_sessions",
+              "churn_reconnects", "wills_fired", "p50_ms", "p99_ms",
+              "blast_expected", "blast_received", "blast_lost",
+              "rss_mb", "rss_per_10k_conns_mb",
+              "rss_includes_harness", "loops", "workers", "nodes",
+              "drivers", "driver_rss_mb", "server_delivered_total",
+              "frame_mode"):
+        if k in info:
+            rec[k] = info[k]
+    for k in ("frame_native_frames", "frame_fallback",
+              "messages_retained"):
+        if k in info:
+            rec[k] = info[k]
     if emit is not None:
         emit(rec)
     else:

@@ -98,6 +98,10 @@ class NodeConfig:
     # door"): shard accepted connections over this many event loops
     # inside the node. 1 = today's single-loop behavior, exactly.
     loops: int = 1
+    # MQTT frame parser engine: "py" (pure-Python Parser) or "native"
+    # (C++ incremental parser, falls back to "py" when the shared
+    # library lacks the symbols). Boot-only.
+    frame: str = "py"
     zones: Dict[str, Zone] = dataclasses.field(default_factory=dict)
     listeners: List[ListenerConfig] = dataclasses.field(
         default_factory=list)
@@ -566,7 +570,7 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
     node = raw.get("node", {})
     for key in node:
         if key not in ("name", "sys_interval", "cookie", "cluster_port",
-                       "load_default_modules", "loops"):
+                       "load_default_modules", "loops", "frame"):
             raise ConfigError(f"unknown node setting: node.{key}")
     cfg.name = node.get("name", cfg.name)
     cfg.sys_interval = float(node.get("sys_interval", cfg.sys_interval))
@@ -580,6 +584,11 @@ def parse_config(raw: Dict[str, Any]) -> NodeConfig:
         raise ConfigError(
             f"node.loops must be an integer >= 1, got {loops!r}")
     cfg.loops = loops
+    frame = node.get("frame", "py")
+    if frame not in ("py", "native"):
+        raise ConfigError(
+            f'node.frame must be "py" or "native", got {frame!r}')
+    cfg.frame = frame
     mraw = raw.get("matcher")
     if mraw is not None:
         if not isinstance(mraw, dict):
@@ -689,6 +698,7 @@ def build_node(cfg: NodeConfig):
                 sys_interval=cfg.sys_interval,
                 load_default_modules=cfg.load_default_modules,
                 loops=cfg.loops,
+                frame=cfg.frame,
                 overload=cfg.overload,
                 faults_config=cfg.faults,
                 durability=cfg.durability,

@@ -24,7 +24,9 @@ from emqx_tpu import faults
 from emqx_tpu.channel import Channel
 from emqx_tpu.gc import GcPolicy
 from emqx_tpu.limiter import TokenBucket
-from emqx_tpu.mqtt.frame import FrameError, FrameTooLarge, Parser, serialize
+from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqtt.frame import (FrameError, FrameTooLarge, NativeParser,
+                                 make_parser, resolve_frame_mode, serialize)
 from emqx_tpu.mqtt.packet import Publish
 from emqx_tpu.zone import Zone, get_zone
 
@@ -50,7 +52,8 @@ class Connection:
                  writer: asyncio.StreamWriter,
                  broker, cm, zone: Optional[Zone] = None,
                  listener: str = "tcp:default",
-                 peername=None, peer_cert_as_username=None) -> None:
+                 peername=None, peer_cert_as_username=None,
+                 frame: str = "py") -> None:
         self.reader = reader
         self.writer = writer
         self.zone = zone or get_zone()
@@ -72,8 +75,16 @@ class Connection:
         self.channel.on_deliver = self._schedule_flush
         self.channel.send_oob = self._send_packets
         self.channel.wire_fast = True  # shared-frame QoS0 broadcast
-        self.parser = Parser(max_size=self.zone.max_packet_size)
+        # [node] frame / EMQX_TPU_FRAME dispatch seam: "native" gets
+        # the stateful C parser handle when the .so exports it, and
+        # degrades to the Python parser otherwise (counted — a fleet
+        # silently running the slow path must show in the metrics)
+        self.parser = make_parser(max_size=self.zone.max_packet_size,
+                                  mode=frame)
         self.broker = broker
+        if frame == "native" and \
+                not isinstance(self.parser, NativeParser):
+            broker.metrics.inc("frame.fallback")
         self.recv_bytes = 0
         self.send_bytes = 0
         self.recv_pkts = 0
@@ -466,13 +477,30 @@ class Connection:
         """Inbound framing seam: bytes → MQTT packets, or ``None`` to
         finish the connection (framing violation)."""
         try:
-            return self.parser.feed(data)
-        except FrameTooLarge:
-            self.broker.metrics.inc("delivery.dropped.too_large")
+            pkts = self.parser.feed(data)
+        except FrameTooLarge as e:
+            # rejected at header-decode time, BEFORE the body buffers
+            # (both parsers): a 256MB-claiming header costs its
+            # header bytes, not its claimed size. v5 clients learn
+            # why (DISCONNECT 0x95 Packet Too Large) before the close
+            log.debug("oversized frame from %s: %s",
+                      self.channel.peername, e)
+            m = self.broker.metrics
+            m.inc("delivery.dropped.too_large")
+            m.inc("frame.oversize")
+            if not self.channel.closed:
+                self.channel.disconnect_reason = "frame_too_large"
+                self.channel._shutdown(rc=RC.PACKET_TOO_LARGE,
+                                       close_transport=False)
             return None
         except FrameError as e:
             log.debug("frame error from %s: %s", self.channel.peername, e)
             return None
+        nf = getattr(self.parser, "native_frames", 0)
+        if nf:
+            self.broker.metrics.inc("frame.native.frames", nf)
+            self.parser.native_frames = 0
+        return pkts
 
     async def _process(self, pkt) -> bool:
         """Run one parsed packet through the channel; ``False`` ends
@@ -645,7 +673,8 @@ class Listener:
                  proxy_protocol_timeout: float = 3.0,
                  access_rules=None,
                  max_conn_rate: float = 0.0,
-                 peer_cert_as_username=None) -> None:
+                 peer_cert_as_username=None,
+                 frame: str = "py") -> None:
         self.broker = broker
         self.cm = cm
         self.host = host
@@ -653,6 +682,10 @@ class Listener:
         self.zone = zone or get_zone()
         self.name = name
         self.max_connections = max_connections
+        # parser variant for accepted connections ([node] frame;
+        # EMQX_TPU_FRAME overrides — resolved here so a bare Listener
+        # under the env knob behaves like a configured node)
+        self.frame = resolve_frame_mode(frame)
         # PROXY protocol v1/v2 (reference: esockd proxy_protocol,
         # etc/emqx.conf listener.tcp.*.proxy_protocol): a fronting LB
         # prepends the REAL client address; the broker must see it
@@ -753,7 +786,8 @@ class Listener:
                 reader, writer, self.broker, self.cm,
                 zone=self.zone, listener=self.name,
                 peername=peername,
-                peer_cert_as_username=self.peer_cert_as_username)
+                peer_cert_as_username=self.peer_cert_as_username,
+                frame=self.frame)
             self._conns.add(conn)
             self._handshaking.discard(raw_writer)
             await conn.run()

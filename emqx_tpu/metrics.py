@@ -280,13 +280,25 @@ TRACING_METRICS = [
     "slow_subs.flushes", "slow_subs.breaches",
 ]
 
+# MQTT frame-parser engine (emqx_tpu/mqtt/frame.py NativeParser,
+# docs/PERF_NOTES.md "Round 7"): `frame.native.frames` = MQTT frames
+# decoded through the C++ incremental parser, `frame.fallback` =
+# connections that asked for frame="native" but got the Python parser
+# (shared library missing or built without the parser symbols),
+# `frame.oversize` = frames rejected at header-decode time for
+# exceeding the zone's max_packet_size (both engines; counted before
+# the body is ever buffered)
+FRAME_METRICS = [
+    "frame.native.frames", "frame.fallback", "frame.oversize",
+]
+
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
                + AUTOMATON_METRICS + TRANSPORT_METRICS
                + OVERLOAD_METRICS + BREAKER_METRICS + FAULT_METRICS
                + OPS_METRICS + DURABILITY_METRICS + CLUSTER_METRICS
-               + TRACING_METRICS)
+               + TRACING_METRICS + FRAME_METRICS)
 
 #: registry names that are NOT monotonic — ``Metrics.dec`` runs on
 #: them in steady state (today: the retainer's live-entry count,

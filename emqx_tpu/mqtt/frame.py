@@ -222,6 +222,10 @@ class Parser:
         self.strict = strict
         self._buf = bytearray()
 
+    def pending(self) -> int:
+        """Bytes buffered awaiting the rest of a partial frame."""
+        return len(self._buf)
+
     # below this buffer size the ctypes call overhead exceeds the C
     # scanner's parse savings (measured: single small frames parse
     # ~2x faster in pure Python; bulk pipelined reads ~15% faster
@@ -235,14 +239,24 @@ class Parser:
             if scan is not False:
                 return self._feed_native(scan)
         out = []
-        while True:
-            pkt, consumed = self._try_parse()
-            if pkt is None:
-                return out
-            del self._buf[:consumed]
-            out.append(pkt)
-            if isinstance(pkt, Connect):
-                self.version = pkt.proto_ver
+        # moving offset + ONE compaction at the end: B packets in a
+        # read cost O(buflen), not O(B·buflen) of per-packet del-shift.
+        # On a body-parse error `pos` still points at the failing
+        # frame's first byte, so the finally keeps it buffered —
+        # raise-before-consume, same as always.
+        pos = 0
+        try:
+            while True:
+                pkt, consumed = self._try_parse(pos)
+                if pkt is None:
+                    return out
+                pos += consumed
+                out.append(pkt)
+                if isinstance(pkt, Connect):
+                    self.version = pkt.proto_ver
+        finally:
+            if pos:
+                del self._buf[:pos]
 
     def _feed_native(self, scan) -> List[Packet]:
         """Framing through the C scanner; PUBLISH frames build from
@@ -304,15 +318,15 @@ class Parser:
             if nf == 0 or not self._buf:
                 return out
 
-    def _try_parse(self) -> Tuple[Optional[Packet], int]:
+    def _try_parse(self, pos: int = 0) -> Tuple[Optional[Packet], int]:
         buf = self._buf
-        if len(buf) < 2:
+        if len(buf) - pos < 2:
             return None, 0
         # remaining length varint (1..4 bytes after the header byte)
-        rl, mult, i = 0, 1, 1
+        rl, mult, i = 0, 1, pos + 1
         while True:
             if i >= len(buf):
-                if i > 4:
+                if i - pos > 4:
                     raise FrameError("malformed_variable_byte_integer")
                 return None, 0
             byte = buf[i]
@@ -320,19 +334,23 @@ class Parser:
             i += 1
             if not byte & 0x80:
                 break
-            if i > 4:
+            if i - pos > 4:
                 raise FrameError("malformed_variable_byte_integer")
             mult *= 128
+        hlen = i - pos
         # v5 Maximum-Packet-Size covers the WHOLE packet, fixed
-        # header included (i = header + varint bytes already read)
-        if i + rl > self.max_size:
-            raise FrameTooLarge(f"frame_too_large: {i + rl}")
+        # header included (hlen = header + varint bytes already read)
+        if hlen + rl > self.max_size:
+            raise FrameTooLarge(f"frame_too_large: {hlen + rl}")
         if len(buf) < i + rl:
             return None, 0
-        header = buf[0]
-        body = bytes(buf[i:i + rl])
+        header = buf[pos]
+        # memoryview slice → ONE copy of the body (a bare bytearray
+        # slice would copy twice: bytearray copy, then bytes copy)
+        with memoryview(buf) as view:
+            body = bytes(view[i:i + rl])
         pkt = self._parse_packet(header, body)
-        return pkt, i + rl
+        return pkt, hlen + rl
 
     def _parse_packet(self, header: int, b: bytes) -> Packet:
         ptype = header >> 4
@@ -505,6 +523,135 @@ class Parser:
             will_retain=will_retain, will_topic=will_topic,
             will_payload=will_payload, will_props=will_props,
             username=username, password=password, properties=props)
+
+
+class NativeParser(Parser):
+    """:class:`Parser` backed by the stateful per-connection C handle
+    (``mqtt_parser_new/feed/consume`` in native/emqx_native.cpp).
+
+    The retained partial-frame remainder lives C-side; each feed
+    ships only the new bytes across the ctypes boundary and gets back
+    frame descriptors (the mqtt_scan 7-int rows) over the handle's
+    buffer, which PUBLISH topic/payload slice zero-copy through a
+    memoryview. Only packet bodies are decoded in Python — by exactly
+    the same ``_parse_packet`` code the pure parser runs, so parity
+    is structural for everything but the framing itself (which the
+    differential fuzz suite pins byte-for-byte).
+
+    Construct via :func:`make_parser` — raises when the library or
+    the symbols are unavailable."""
+
+    def __init__(self, version: int = C.MQTT_V4,
+                 max_size: int = C.MAX_PACKET_SIZE,
+                 strict: bool = True) -> None:
+        super().__init__(version=version, max_size=max_size,
+                         strict=strict)
+        from emqx_tpu.ops import native as _nat
+
+        self._h = _nat.FrameHandle(max_size)
+        #: frames framed natively since the last harvest — the
+        #: connection folds this into the frame.native.frames counter
+        self.native_frames = 0
+
+    def pending(self) -> int:
+        """Bytes buffered C-side (the Python parser's len(_buf))."""
+        return self._h.pending()
+
+    def feed(self, data) -> List[Packet]:
+        out: List[Packet] = []
+        h = self._h
+        chunk = data
+        while True:
+            nf = h.feed(chunk)
+            chunk = b""
+            state = h.state
+            err, err_size = int(state[4]), int(state[1])
+            consumed = 0
+            view = h.view() if nf else None
+            try:
+                for k in range(nf):
+                    row = h.out[k * 7:k * 7 + 7]
+                    (header, boff, blen, toff, tlen, pid, pp) = row
+                    ptype = header >> 4
+                    if toff >= 0 and ptype == C.PUBLISH:
+                        qos = (header >> 1) & 0x03
+                        if qos > 0 and self.strict and pid == 0:
+                            raise FrameError("bad_packet_id")
+                        try:
+                            topic = bytes(
+                                view[toff:toff + tlen]).decode("utf-8")
+                        except UnicodeDecodeError as e:
+                            raise FrameError(
+                                "utf8_string_invalid") from e
+                        props: Dict[str, Any] = {}
+                        if self.version == C.MQTT_V5:
+                            body = bytes(view[boff:boff + blen])
+                            props, j = _parse_props(body, pp - boff)
+                            payload = body[j:]
+                        else:
+                            payload = bytes(view[pp:boff + blen])
+                        pkt = Publish(
+                            dup=bool(header & 0x08), qos=qos,
+                            retain=bool(header & 0x01), topic=topic,
+                            packet_id=pid if qos > 0 else None,
+                            properties=props, payload=payload)
+                    else:
+                        body = bytes(view[boff:boff + blen])
+                        pkt = self._parse_packet(header, body)
+                    out.append(pkt)
+                    if isinstance(pkt, Connect):
+                        self.version = pkt.proto_ver
+                    consumed = boff + blen
+            except Exception:
+                # raise-before-consume: the failed frame (and
+                # everything after it) stays buffered, exactly like
+                # the Python loop
+                if view is not None:
+                    view.release()
+                h.consume(consumed)
+                self.native_frames += nf
+                raise
+            if view is not None:
+                view.release()
+            h.consume(consumed)
+            self.native_frames += nf
+            if nf >= h.cap:
+                # descriptor array full — more complete frames may
+                # remain buffered; rescan without new bytes
+                continue
+            if err == -1:
+                raise FrameError("malformed_variable_byte_integer")
+            if err == -2:
+                raise FrameTooLarge(f"frame_too_large: {err_size}")
+            return out
+
+
+def resolve_frame_mode(configured: str = "py") -> str:
+    """The effective parser variant: ``EMQX_TPU_FRAME=py|native``
+    overrides the ``[node] frame`` config knob."""
+    import os
+
+    env = os.environ.get("EMQX_TPU_FRAME")
+    return env if env in ("py", "native") else configured
+
+
+def make_parser(version: int = C.MQTT_V4,
+                max_size: int = C.MAX_PACKET_SIZE,
+                strict: bool = True,
+                mode: str = "py") -> Parser:
+    """Parser factory behind the ``[node] frame`` dispatch seam.
+
+    ``mode="native"`` returns a :class:`NativeParser` when the shared
+    library exports the handle symbols, else falls back to the Python
+    :class:`Parser` (the caller detects the downgrade via isinstance
+    and counts ``frame.fallback``)."""
+    if mode == "native":
+        try:
+            return NativeParser(version=version, max_size=max_size,
+                                strict=strict)
+        except Exception:
+            pass
+    return Parser(version=version, max_size=max_size, strict=strict)
 
 
 # -- serializer ------------------------------------------------------------

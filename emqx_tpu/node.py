@@ -56,6 +56,7 @@ class Node:
                  batch_size: int = 256,
                  batch_linger_ms: float = 0.0,
                  loops: int = 1,
+                 frame: str = "py",
                  overload: Optional[OverloadConfig] = None,
                  faults_config=None,
                  durability=None,
@@ -78,6 +79,15 @@ class Node:
             self.loop_group = LoopGroup(loops)
         else:
             self.loop_group = None
+        # [node] frame: wire-framing parser variant for every
+        # listener this node boots ("py" | "native",
+        # docs/PERF_NOTES.md "Native front door"). Stored as
+        # CONFIGURED (reload diffs file vs config); the EMQX_TPU_FRAME
+        # env override resolves at listener construction.
+        if frame not in ("py", "native"):
+            raise ValueError(f'frame must be "py" or "native", '
+                             f"got {frame!r}")
+        self.frame = frame
         # kernel services (emqx_kernel_sup)
         self.hooks = Hooks()
         self.metrics = Metrics()
@@ -251,7 +261,8 @@ class Node:
                        proxy_protocol=proxy_protocol,
                        proxy_protocol_timeout=proxy_protocol_timeout,
                        access_rules=access_rules,
-                       max_conn_rate=max_conn_rate)
+                       max_conn_rate=max_conn_rate,
+                       frame=self.frame)
         self.listeners.append(lst)
         return lst
 
@@ -263,7 +274,8 @@ class Node:
         lst = WsListener(self.broker, self.cm, host=host, port=port,
                          path=path, zone=zone or self.zone, name=name,
                          ssl_context=ssl_context,
-                         max_connections=max_connections)
+                         max_connections=max_connections,
+                         frame=self.frame)
         self.listeners.append(lst)
         return lst
 
@@ -293,7 +305,8 @@ class Node:
                 psk_identity_hint=opts.psk_identity_hint,
                 psk_ciphers=opts.ciphers or "PSK",
                 access_rules=access_rules,
-                max_conn_rate=max_conn_rate)
+                max_conn_rate=max_conn_rate,
+                frame=self.frame)
             self.listeners.append(lst)
             return lst
         ctx = make_server_context(opts)
@@ -303,7 +316,8 @@ class Node:
                        max_connections=max_connections,
                        access_rules=access_rules,
                        max_conn_rate=max_conn_rate,
-                       peer_cert_as_username=peer_cert_as_username)
+                       peer_cert_as_username=peer_cert_as_username,
+                       frame=self.frame)
         self.listeners.append(lst)
         return lst
 

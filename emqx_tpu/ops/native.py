@@ -111,6 +111,23 @@ def load_library():
                                    _i32p, C.c_int32]
         lib.trie_match.restype = C.c_int32
         try:
+            # stateful per-connection frame parser (absent in a
+            # pre-rebuild .so: connections fall back to the Python
+            # parser and count frame.fallback)
+            lib.mqtt_parser_new.argtypes = [C.c_int64]
+            lib.mqtt_parser_new.restype = C.c_void_p
+            lib.mqtt_parser_free.argtypes = [C.c_void_p]
+            lib.mqtt_parser_pending.argtypes = [C.c_void_p]
+            lib.mqtt_parser_pending.restype = C.c_int64
+            lib.mqtt_parser_feed.argtypes = [
+                C.c_void_p, C.c_char_p, C.c_int64, C.c_int32,
+                C.POINTER(C.c_int32), C.POINTER(C.c_int64)]
+            lib.mqtt_parser_feed.restype = C.c_int32
+            lib.mqtt_parser_consume.argtypes = [C.c_void_p, C.c_int64]
+            lib.has_mqtt_parser = True
+        except AttributeError:
+            lib.has_mqtt_parser = False
+        try:
             # level compression (absent in a pre-rebuild .so: the
             # flatten then compresses in numpy, same result)
             lib.csr_compress.argtypes = [
@@ -163,6 +180,77 @@ def mqtt_scan(buf, max_size: int):
     if rc < 0:
         return [], 0, int(state[0]), int(rc), int(state[1])
     return out[: rc * 7], rc, int(state[0]), 0, 0
+
+
+def has_frame_parser() -> bool:
+    """True when the .so exports the stateful per-connection parser
+    (the ``[node] frame = "native"`` path's availability probe)."""
+    lib = load_library()
+    return bool(lib is not None and lib.has_mqtt_parser)
+
+
+# zero-copy read view over the handle's C-side buffer (released by
+# the caller before the next feed/consume — the vector may realloc)
+_view_from_memory = C.pythonapi.PyMemoryView_FromMemory
+_view_from_memory.restype = C.py_object
+_view_from_memory.argtypes = [C.c_void_p, C.c_ssize_t, C.c_int]
+_PyBUF_READ = 0x100
+
+
+class FrameHandle:
+    """Raw ctypes surface of one per-connection C parser handle.
+
+    Owns the retained partial-frame remainder C-side, so each socket
+    read ships only its NEW bytes across the FFI boundary (the
+    stateless :func:`mqtt_scan` seam re-marshalled the whole
+    accumulation buffer per read — measured slower than Python).
+    Packet-body semantics stay in :class:`emqx_tpu.mqtt.frame.
+    NativeParser`, which drives this handle."""
+
+    __slots__ = ("_lib", "_h", "out", "state", "cap")
+
+    def __init__(self, max_size: int) -> None:
+        lib = load_library()
+        if lib is None or not lib.has_mqtt_parser:
+            raise RuntimeError("native frame parser unavailable")
+        self._lib = lib
+        self.cap = _SCAN_CAP
+        self.out = (C.c_int32 * (_SCAN_CAP * 7))()
+        self.state = (C.c_int64 * 5)()
+        self._h = lib.mqtt_parser_new(max_size)
+
+    def close(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.mqtt_parser_free(h)
+
+    __del__ = close
+
+    def feed(self, data) -> int:
+        """Append ``data``, scan, fill ``self.out``/``self.state``;
+        returns the complete-frame count (never negative — scan
+        errors ride ``state[4]`` after their preceding frames)."""
+        if isinstance(data, bytearray):
+            cbuf = (C.c_char * len(data)).from_buffer(data) \
+                if data else b""
+        elif isinstance(data, bytes):
+            cbuf = data
+        else:
+            cbuf = bytes(data)
+        return self._lib.mqtt_parser_feed(
+            self._h, cbuf, len(data), self.cap, self.out, self.state)
+
+    def view(self):
+        """Zero-copy read-only memoryview of the buffered bytes."""
+        return _view_from_memory(self.state[2], self.state[3],
+                                 _PyBUF_READ)
+
+    def consume(self, n: int) -> None:
+        self._lib.mqtt_parser_consume(self._h, n)
+
+    def pending(self) -> int:
+        """Bytes currently retained (partial-frame remainder)."""
+        return int(self._lib.mqtt_parser_pending(self._h))
 
 
 class NativeEngine:

@@ -69,7 +69,7 @@ def _sections() -> Dict[str, type]:
 #: tuple in config.parse_config, not a dataclass)
 NODE_RELOADABLE = frozenset({"sys_interval"})
 NODE_KEYS = ("name", "sys_interval", "cookie", "cluster_port",
-             "load_default_modules", "loops")
+             "load_default_modules", "loops", "frame")
 
 
 def classification() -> Dict[str, Dict[str, str]]:
@@ -188,6 +188,9 @@ def diff_config(node, cfg) -> List[Change]:
         "sys_interval": node.sys.interval,
         "loops": node.loop_group.n if node.loop_group is not None
         else 1,
+        # configured value, not the resolved parser class: an
+        # EMQX_TPU_FRAME env override must not read as config drift
+        "frame": node.frame,
         "load_default_modules": node._load_default_modules,
     }
     ccfg = node._cluster_cfg
@@ -195,7 +198,7 @@ def diff_config(node, cfg) -> List[Change]:
         live_node["cluster_port"] = None  # rebinds are topology
         live_node["cookie"] = ccfg[2]
     file_node = {"name": cfg.name, "sys_interval": cfg.sys_interval,
-                 "loops": cfg.loops,
+                 "loops": cfg.loops, "frame": cfg.frame,
                  "load_default_modules": cfg.load_default_modules}
     if cfg.cookie is not None and "cookie" in live_node:
         file_node["cookie"] = cfg.cookie

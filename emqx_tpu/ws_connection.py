@@ -206,10 +206,11 @@ class WsConnection(Connection):
                  writer: asyncio.StreamWriter,
                  broker, cm, zone: Optional[Zone] = None,
                  listener: str = "ws:default", peername=None,
-                 peer_cert_as_username=None) -> None:
+                 peer_cert_as_username=None, frame: str = "py") -> None:
         super().__init__(reader, writer, broker, cm, zone=zone,
                          listener=listener, peername=peername,
-                         peer_cert_as_username=peer_cert_as_username)
+                         peer_cert_as_username=peer_cert_as_username,
+                         frame=frame)
         # one WS message may batch MULTIPLE MQTT packets (MQTT 5 §6.0),
         # so the reassembly bound is a multiple of the per-packet limit
         # (which the MQTT parser itself enforces), not the limit + slack
@@ -299,10 +300,10 @@ class WsListener(Listener):
                  port: int = 8083, path: str = "/mqtt",
                  zone: Optional[Zone] = None, name: str = "ws:default",
                  max_connections: int = 1024000,
-                 ssl_context=None) -> None:
+                 ssl_context=None, frame: str = "py") -> None:
         super().__init__(broker, cm, host=host, port=port, zone=zone,
                          name=name, max_connections=max_connections,
-                         ssl_context=ssl_context)
+                         ssl_context=ssl_context, frame=frame)
         self.path = path
 
     async def _handshake(self, reader, writer) -> bool:

@@ -297,6 +297,41 @@ echo "== trace-export smoke (docs/OBSERVABILITY.md) =="
 python -m pytest \
     tests/test_tracing.py::test_trace_chain_is_continuous_across_two_loops -q
 
+echo "== native frame-parser parity (docs/PERF_NOTES.md round 7) =="
+# differential fuzz of the C++ incremental parser vs the Python
+# parser vs the independent test codec (parsed packets, error
+# classes, buffered remainders, resume at every byte split), the
+# read-path allocation-count pins, and the server-level engine-knob
+# suite (counters, env override, fallback, oversize 0x95) — a
+# divergence here is a wire-corruption bug, fail fast
+python -m pytest tests/test_frame_fuzz.py tests/test_frame_zerocopy.py \
+    tests/test_frame_native.py -q
+
+echo "== multi-loop parity under the native frame engine =="
+# the full front-door loops parity suite re-run with
+# EMQX_TPU_FRAME=native: the engine must be invisible to every
+# cross-loop delivery/takeover invariant (skips cleanly if the
+# native library is not built — make_parser falls back to Python)
+EMQX_TPU_FRAME=native python -m pytest tests/test_frontdoor_loops.py -q
+
+echo "== fleet smoke (docs/PERF_NOTES.md round 7) =="
+# the BENCH_MODE=fleet scenario end-to-end at toy scale: real
+# sockets with wills, persistent sessions, shared subs, keepalive
+# and reconnect churn over a loops=2 native-frame node. The counted
+# QoS1 blast IS gated (zero lost deliveries), as are the engine
+# counters: native frames flowed and nothing fell back (throughput
+# numbers are not gated — the driver's 100K run is)
+BENCH_MODE=fleet FLEET_CONNS=500 FLEET_LOOPS=2 FLEET_SECS=2 \
+    EMQX_TPU_FRAME=native \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='fleet_delivered_msgs_per_s' \
+    and rec['value'] is not None \
+    and rec['blast_lost'] == 0 \
+    and rec['frame_native_frames'] > 0 \
+    and rec['frame_fallback'] == 0, rec"
+
 echo "== pytest =="
 if [[ "${COV:-1}" == "0" ]]; then
     python -m pytest tests -q

@@ -24,8 +24,15 @@ On the single-core dev host the workers/loops time-share one CPU with
 the load generator — the curve there measures sharding overhead, not
 scaling headroom; run on a many-core host for the real curve.
 
-Usage: python scripts/frontdoor_curve.py [--loops] [counts...]
-       (default counts: 1 2 4)
+A ``--frame py|native`` flag selects the MQTT frame-parser engine
+(docs/PERF_NOTES.md "Round 7") for every worker/loop — loops mode
+passes it to the Node, process mode exports ``EMQX_TPU_FRAME`` so the
+inherited-env workers pick it up. Each JSON row records the engine it
+ran with plus server-side RSS per connection, so py-vs-native rows
+are directly comparable on both axes (throughput AND memory).
+
+Usage: python scripts/frontdoor_curve.py [--loops] [--frame py|native]
+       [counts...]   (default counts: 1 2 4)
 """
 
 import asyncio
@@ -45,6 +52,18 @@ PUBS = int(os.environ.get("CURVE_PUBS", "8"))
 TOPICS = int(os.environ.get("CURVE_TOPICS", "8"))
 SECS = float(os.environ.get("CURVE_SECS", "6"))
 PIPELINE = int(os.environ.get("CURVE_PIPELINE", "32"))
+
+
+def _rss_mb(pid="self") -> float:
+    """VmRSS of ``pid`` in MB (0.0 if unreadable)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024, 1)
+    except OSError:
+        pass
+    return 0.0
 
 
 async def _run_load(port: int, delivered_fn, conns_fn):
@@ -127,18 +146,29 @@ async def _run_load(port: int, delivered_fn, conns_fn):
     }
 
 
-def _run_process_mode(n: int) -> dict:
+def _run_process_mode(n: int, frame: str) -> dict:
+    # workers inherit the environment, so the engine knob travels as
+    # EMQX_TPU_FRAME (same override the ops docs document)
+    os.environ["EMQX_TPU_FRAME"] = frame
+    rss = [0.0]
     with WorkerPool(n, port=0, platform="cpu") as pool:
         res = asyncio.run(_run_load(
             pool.port,
             delivered_fn=lambda: sum(d for _, d in pool.stats()),
             conns_fn=lambda: [c for c, _ in pool.stats()]))
+        # server-side only: worker processes, not the load harness
+        rss[0] = round(sum(_rss_mb(p.pid) for p in pool.procs), 1)
     res["workers"] = n
     res["mode"] = "process"
+    res["frame"] = frame
+    res["rss_mb"] = rss[0]
+    nconns = max(1, sum(res["conns_per_worker"]))
+    res["rss_per_conn_kb"] = round(rss[0] * 1024 / nconns, 1)
+    res["rss_includes_harness"] = False
     return res
 
 
-def _run_loops_mode(n: int) -> dict:
+def _run_loops_mode(n: int, frame: str) -> dict:
     async def _go():
         from emqx_tpu.node import Node
         from emqx_tpu.router import MatcherConfig
@@ -150,7 +180,7 @@ def _run_loops_mode(n: int) -> dict:
         matcher = (None if os.environ.get("CURVE_HOST") == "1"
                    else MatcherConfig(device_min_filters=0))
         node = Node(boot_listeners=False, loops=n, matcher=matcher,
-                    batch_linger_ms=1.0)
+                    batch_linger_ms=1.0, frame=frame)
         lst = node.add_listener(port=0)
         await node.start()
         try:
@@ -171,6 +201,10 @@ def _run_loops_mode(n: int) -> dict:
             res["xloop_fraction"] = round(
                 res["xloop_deliveries"]
                 / max(1, node.metrics.val("messages.delivered")), 3)
+            res["frame"] = lst.frame  # resolved (env may override)
+            res["frame_native_frames"] = node.metrics.val(
+                "frame.native.frames")
+            res["frame_fallback"] = node.metrics.val("frame.fallback")
         finally:
             await node.stop()
         return res
@@ -178,6 +212,11 @@ def _run_loops_mode(n: int) -> dict:
     res = asyncio.run(_go())
     res["loops"] = n
     res["mode"] = "loops"
+    res["rss_mb"] = _rss_mb()
+    nconns = max(1, sum(res["conns_per_worker"]))
+    res["rss_per_conn_kb"] = round(res["rss_mb"] * 1024 / nconns, 1)
+    # single process: the load harness shares the RSS number
+    res["rss_includes_harness"] = True
     return res
 
 
@@ -187,17 +226,25 @@ def main():
     if "--loops" in args:
         args.remove("--loops")
         mode = "loops"
+    frame = "py"
+    if "--frame" in args:
+        i = args.index("--frame")
+        frame = args[i + 1]
+        del args[i:i + 2]
+    if frame not in ("py", "native"):
+        sys.exit(f'--frame must be "py" or "native", got {frame!r}')
     counts = [int(a) for a in args] or [1, 2, 4]
     runner = _run_loops_mode if mode == "loops" else _run_process_mode
     rows = []
     for n in counts:
-        res = runner(n)
+        res = runner(n, frame)
         rows.append(res)
         print(json.dumps(res), flush=True)
     base = rows[0]["delivered_per_s"] or 1
     key = "loops" if mode == "loops" else "workers"
     print(json.dumps({
         "mode": mode,
+        "frame": frame,
         "curve": {r[key]: round(r["delivered_per_s"] / base, 2)
                   for r in rows},
         "host_cores": os.cpu_count(),

@@ -325,3 +325,203 @@ def test_native_scanner_parity_with_python_parser():
                 F._scan = saved
                 os.environ.pop("EMQX_TPU_NATIVE_FRAME", None)
             assert got_py == got_nat, (ver, chunk)
+
+
+# -- 3-way differential: NativeParser vs Parser vs the indie codec ---------
+#
+# Three independent implementations of the same wire format: the C++
+# incremental parser (native/emqx_native.cpp through NativeParser),
+# the pure-Python Parser, and tests/indie_mqtt.py (a from-scratch
+# codec with its own reading of the spec). A mirrored misreading
+# between the two in-tree engines fails against indie; a native-port
+# bug fails against Python. Compared: parsed packets on valid
+# streams, error CLASS + message + retained-buffer length on
+# malformed input, and resume behavior at EVERY byte split.
+
+from emqx_tpu.mqtt.frame import NativeParser
+from emqx_tpu.ops import native as _nat
+
+needs_native_parser = pytest.mark.skipif(
+    not _nat.has_frame_parser(),
+    reason="native frame parser not built")
+
+
+def _feed_outcome(parser, chunks):
+    """(\"ok\", packets) or (error class name, message, pending bytes)
+    — the full observable surface of a feed sequence."""
+    got = []
+    try:
+        for c in chunks:
+            got.extend(parser.feed(c))
+    except (FrameError, FrameTooLarge) as e:
+        return (type(e).__name__, str(e), parser.pending())
+    return ("ok", got)
+
+
+def _pending(parser):
+    return parser.pending()
+
+
+@needs_native_parser
+@pytest.mark.parametrize("version", [4, 5])
+def test_differential_indie_built_stream(version):
+    """Client→server stream built by the INDIE codec: both in-tree
+    parsers must agree with each other AND with indie's intent."""
+    from tests import indie_mqtt as im
+
+    rng = random.Random(505 + version)
+    parts = [im.build_connect("diff", version=version)]
+    intents = []  # (topic, payload, qos, pkt_id) per PUBLISH, in order
+    for i in range(120):
+        r = rng.random()
+        if r < 0.5:
+            qos = rng.choice([0, 0, 1, 2])
+            topic = f"d/{i}/{rng.choice(_TOPIC_WORDS)}"
+            payload = rng.randbytes(rng.randrange(96))
+            pid = i + 1 if qos else None
+            parts.append(im.build_publish(
+                topic, payload, qos=qos, pkt_id=pid, version=version,
+                retain=bool(rng.random() < 0.2)))
+            intents.append((topic, payload, qos, pid))
+        elif r < 0.7:
+            parts.append(im.build_subscribe(
+                i + 1, [(f"d/{i}/+", rng.randint(0, 2))],
+                version=version))
+        elif r < 0.8:
+            parts.append(im.build_puback_like(
+                C.PUBACK, i + 1, version=version))
+        elif r < 0.9:
+            parts.append(im.build_pingreq())
+        else:
+            parts.append(im.build_unsubscribe(
+                i + 1, [f"d/{i}/#"], version=version))
+    stream = b"".join(parts)
+
+    for chunk in (1, 3, 17, 256, len(stream)):
+        py = Parser()
+        nat = NativeParser()
+        chunks = [stream[o:o + chunk]
+                  for o in range(0, len(stream), chunk)]
+        op, on = _feed_outcome(py, chunks), _feed_outcome(nat, chunks)
+        assert op == on, (version, chunk)
+        assert op[0] == "ok"
+        pubs = [p for p in op[1] if isinstance(p, Publish)]
+        got_intents = [(p.topic, p.payload, p.qos, p.packet_id)
+                       for p in pubs]
+        assert got_intents == intents, (version, chunk)
+
+
+@needs_native_parser
+def test_differential_resume_at_every_byte_split():
+    """One stream, split at EVERY byte boundary into two feeds: both
+    parsers must return the whole-feed reference packet list from
+    every resume point."""
+    rng = random.Random(808)
+    pkts = []
+    for i in range(12):
+        pkts.append(gen_packet(rng, C.MQTT_V4))
+    pkts = [p for p in pkts if not isinstance(p, (Connect, Auth))]
+    pkts.append(Publish(topic="r/s", qos=1, packet_id=7,
+                        payload=b"tail" * 20))
+    stream = b"".join(serialize(p, C.MQTT_V4) for p in pkts)
+    ref = Parser(version=C.MQTT_V4).feed(stream)
+    assert len(ref) == len(pkts)
+    for i in range(len(stream) + 1):
+        py = Parser(version=C.MQTT_V4)
+        nat = NativeParser(version=C.MQTT_V4)
+        gp = py.feed(stream[:i]) + py.feed(stream[i:])
+        gn = nat.feed(stream[:i]) + nat.feed(stream[i:])
+        assert gp == ref, i
+        assert gn == ref, i
+        assert _pending(py) == _pending(nat) == 0, i
+
+
+@needs_native_parser
+@pytest.mark.parametrize("version", VERSIONS)
+def test_differential_error_classes_on_malformed(version):
+    """Corrupted streams: both engines must agree on the FULL
+    outcome — packets when clean, else error class, error message,
+    and how many bytes stay buffered (raise-before-consume)."""
+    rng = random.Random(31991 + version)
+    for trial in range(600):
+        good = [gen_packet(rng, version) for _ in range(2)]
+        good = [p for p in good
+                if not isinstance(p, (Connect, Auth))]
+        victim = gen_packet(rng, version)
+        if isinstance(victim, (Connect, Auth)):
+            victim = Publish(topic="v/t", payload=b"x")
+        data = bytearray(serialize(victim, version))
+        mode = rng.random()
+        if mode < 0.4 and data:
+            for _ in range(rng.randint(1, 4)):
+                k = rng.randrange(len(data))
+                data[k] ^= rng.randint(1, 255)
+        elif mode < 0.7:
+            data = data[:rng.randrange(max(1, len(data)))]
+        else:
+            data += rng.randbytes(rng.randint(1, 16))
+        blob = (b"".join(serialize(p, version) for p in good)
+                + bytes(data))
+        py = Parser(version=version, max_size=1 << 20)
+        nat = NativeParser(version=version, max_size=1 << 20)
+        op = _feed_outcome(py, [blob])
+        on = _feed_outcome(nat, [blob])
+        if op[0] == "ok":
+            assert on == op, (trial, op, on)
+        else:
+            # class + message must match; buffered remainder too
+            assert on[0] == op[0], (trial, op, on)
+            assert on[1] == op[1], (trial, op, on)
+            assert on[2] == op[2], (trial, op, on)
+
+
+@needs_native_parser
+def test_differential_server_to_client_against_indie():
+    """Server→client frames serialized by the repo: both in-tree
+    parsers and the indie decoder must extract the same fields."""
+    from tests import indie_mqtt as im
+
+    rng = random.Random(2718)
+    for version in (C.MQTT_V4, C.MQTT_V5):
+        pkts = []
+        for _ in range(60):
+            p = gen_packet(rng, version)
+            if isinstance(p, (Connect, Subscribe, Unsubscribe,
+                              Pingreq)):
+                continue
+            if isinstance(p, Auth) and version != C.MQTT_V5:
+                continue
+            pkts.append(p)
+        blob = b"".join(serialize(p, version) for p in pkts)
+        got_py = Parser(version=version).feed(blob)
+        got_nat = NativeParser(version=version).feed(blob)
+        assert got_py == got_nat
+        # indie's framing + decode over the same bytes
+        iv = 5 if version == C.MQTT_V5 else 4
+        off, got_indie = 0, []
+        while off < len(blob):
+            ptype, flags = blob[off] >> 4, blob[off] & 0x0F
+            rl, noff = im.dec_varint(blob, off + 1)
+            body = blob[noff:noff + rl]
+            got_indie.append(im.decode(ptype, flags, body, iv))
+            off = noff + rl
+        assert len(got_indie) == len(got_py)
+        for mine, theirs in zip(got_py, got_indie):
+            if isinstance(mine, Publish):
+                assert (mine.topic, mine.payload, mine.qos,
+                        mine.retain) == (theirs.topic, theirs.payload,
+                                         theirs.qos, theirs.retain)
+                if mine.qos:
+                    assert mine.packet_id == theirs.pkt_id
+            elif isinstance(mine, Connack):
+                assert (mine.session_present, mine.reason_code) == \
+                    (theirs.session_present, theirs.rc)
+            elif isinstance(mine, PubAck):
+                assert mine.packet_id == theirs.pkt_id
+                if version == C.MQTT_V5:
+                    assert mine.reason_code == theirs.rc
+            elif isinstance(mine, (Suback, Unsuback)):
+                assert mine.packet_id == theirs.pkt_id
+                assert list(mine.reason_codes) == theirs.rcs
+            elif isinstance(mine, Disconnect) and version == C.MQTT_V5:
+                assert mine.reason_code == theirs.rc

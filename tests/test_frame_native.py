@@ -1,0 +1,180 @@
+"""Server-level tests for the selectable frame engine (PR 18).
+
+``[node] frame = "native"`` routes every connection's framing through
+the C++ incremental parser (native/emqx_native.cpp ``mqtt_parser_*``)
+behind the same ``Parser.feed`` contract. These tests drive a real
+broker through the independent client: the engine knob must be
+invisible on the wire, visible only in the ``frame.*`` counters —
+plus the oversize rejection path, which must answer a v5 client with
+DISCONNECT 0x95 (Packet too large) before closing.
+"""
+
+import asyncio
+
+import pytest
+
+from tests import indie_mqtt as im
+from tests.helpers import broker_node, node_port
+
+from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.mqtt.frame import NativeParser, make_parser, resolve_frame_mode
+from emqx_tpu.ops import native as nat
+
+needs_native = pytest.mark.skipif(
+    not nat.has_frame_parser(),
+    reason="native frame parser not built")
+
+
+def _giant_header(claimed: int = 0x0FFFFFFF) -> bytes:
+    """A PUBLISH fixed header claiming ``claimed`` bytes of body."""
+    out = bytearray([0x30])
+    n = claimed
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | 0x80 if n else b)
+        if not n:
+            return bytes(out)
+
+
+@needs_native
+async def test_native_mode_roundtrip_and_counters():
+    async with broker_node(frame="native") as n:
+        port = node_port(n)
+        sub = im.IndieClient("nf-sub")
+        await sub.connect(port=port)
+        await sub.subscribe(("t/#", 1))
+        pub = im.IndieClient("nf-pub")
+        await pub.connect(port=port)
+        await pub.publish("t/a", b"zero", qos=0)
+        assert await pub.publish("t/b", b"one" * 400, qos=1) == 0
+        got = {}
+        for _ in range(2):
+            p = await sub.recv()
+            got[p.topic] = p.payload
+        assert got == {"t/a": b"zero", "t/b": b"one" * 400}
+        m = n.broker.metrics
+        assert m.val("frame.native.frames") > 0
+        assert m.val("frame.fallback") == 0
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+async def test_fallback_counter_when_parser_unavailable(monkeypatch):
+    """frame="native" with no usable .so must serve traffic through
+    the Python parser and count the downgrade."""
+    monkeypatch.setattr(
+        "emqx_tpu.mqtt.frame.NativeParser.__init__",
+        lambda self, **kw: (_ for _ in ()).throw(
+            RuntimeError("native frame parser unavailable")))
+    async with broker_node(frame="native") as n:
+        port = node_port(n)
+        c = im.IndieClient("nf-fb")
+        await c.connect(port=port)
+        await c.subscribe("t/#")
+        await c.publish("t/x", b"hi")
+        p = await c.recv()
+        assert p.payload == b"hi"
+        m = n.broker.metrics
+        assert m.val("frame.fallback") >= 1
+        assert m.val("frame.native.frames") == 0
+        await c.disconnect()
+
+
+async def test_env_var_overrides_configured_mode(monkeypatch):
+    """EMQX_TPU_FRAME=py beats frame="native" at listener build; the
+    node keeps the CONFIGURED value (reload diff must stay clean)."""
+    monkeypatch.setenv("EMQX_TPU_FRAME", "py")
+    async with broker_node(frame="native") as n:
+        assert n.frame == "native"
+        assert n.listeners[0].frame == "py"
+        port = node_port(n)
+        c = im.IndieClient("nf-env")
+        await c.connect(port=port)
+        await c.publish("t/x", b"ok")
+        assert n.broker.metrics.val("frame.native.frames") == 0
+        await c.disconnect()
+
+
+def test_resolve_frame_mode_ignores_junk_env(monkeypatch):
+    monkeypatch.setenv("EMQX_TPU_FRAME", "turbo")
+    assert resolve_frame_mode("py") == "py"
+    assert resolve_frame_mode("native") == "native"
+    monkeypatch.setenv("EMQX_TPU_FRAME", "native")
+    assert resolve_frame_mode("py") == "native"
+
+
+def test_make_parser_falls_back_cleanly(monkeypatch):
+    monkeypatch.setattr(
+        "emqx_tpu.mqtt.frame.NativeParser.__init__",
+        lambda self, **kw: (_ for _ in ()).throw(RuntimeError("no lib")))
+    p = make_parser(mode="native")
+    assert not isinstance(p, NativeParser)
+
+
+@pytest.mark.parametrize("frame_mode", ["py", "native"])
+async def test_oversize_header_gets_v5_disconnect_0x95(frame_mode):
+    if frame_mode == "native" and not nat.has_frame_parser():
+        pytest.skip("native frame parser not built")
+    async with broker_node(frame=frame_mode) as n:
+        port = node_port(n)
+        c = im.IndieClient("nf-big", version=5)
+        await c.connect(port=port)
+        c.writer.write(_giant_header())
+        await c.writer.drain()
+        p = await asyncio.wait_for(c.acks.get(), 5)
+        assert p is not None and p.ptype == im.DISCONNECT
+        assert p.rc == RC.PACKET_TOO_LARGE
+        # ... and the transport actually closes after the DISCONNECT
+        assert await asyncio.wait_for(c.acks.get(), 5) is None
+        m = n.broker.metrics
+        assert m.val("frame.oversize") == 1
+        assert m.val("delivery.dropped.too_large") == 1
+
+
+@pytest.mark.parametrize("frame_mode", ["py", "native"])
+async def test_oversize_header_v4_just_closes(frame_mode):
+    """Pre-v5 there is no server DISCONNECT: the connection closes
+    with nothing extra on the wire."""
+    if frame_mode == "native" and not nat.has_frame_parser():
+        pytest.skip("native frame parser not built")
+    async with broker_node(frame=frame_mode) as n:
+        port = node_port(n)
+        c = im.IndieClient("nf-big4", version=4)
+        await c.connect(port=port)
+        c.writer.write(_giant_header())
+        await c.writer.drain()
+        assert await asyncio.wait_for(c.acks.get(), 5) is None  # EOF
+        assert n.broker.metrics.val("frame.oversize") == 1
+
+
+@needs_native
+async def test_native_mode_over_websocket():
+    """WsConnection shares Connection._decode, so the native engine
+    must cover the WS transport with zero extra wiring."""
+    from emqx_tpu.node import Node
+    from emqx_tpu.mqtt.packet import Publish, Suback, Subscribe
+    from tests.test_ws import WsTestClient
+
+    n = Node(boot_listeners=False, frame="native")
+    n.add_ws_listener(port=0)
+    await n.start()
+    try:
+        port = n.listeners[0].port
+        sub, pub = WsTestClient("nfw-sub"), WsTestClient("nfw-pub")
+        ack = await sub.connect(port)
+        assert ack.reason_code == 0
+        await pub.connect(port)
+        await sub.send_mqtt(Subscribe(
+            packet_id=1, topic_filters=[("w/#", {"qos": 0})]))
+        sa = await asyncio.wait_for(sub.acks.get(), 5.0)
+        assert isinstance(sa, Suback)
+        await pub.send_mqtt(Publish(topic="w/1", payload=b"via-ws"))
+        msg = await asyncio.wait_for(sub.inbox.get(), 5.0)
+        assert msg.payload == b"via-ws"
+        assert n.metrics.val("frame.native.frames") > 0
+        assert n.metrics.val("frame.fallback") == 0
+        await sub.close()
+        await pub.close()
+    finally:
+        await n.stop()

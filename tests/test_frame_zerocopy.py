@@ -1,0 +1,138 @@
+"""Read-path allocation regression tests (PR 18 "Native front door").
+
+The pure-Python parser used to `del self._buf[:consumed]` once per
+packet — B packets in one read shifted the remaining buffer B times,
+O(B·buflen) for a pipelined read. It now parses at a moving offset
+and compacts ONCE per feed. These tests pin that with an instrumented
+bytearray (counting bytes shifted by compaction and bytes
+materialized by slicing), so a regression to per-packet deletes or
+double-copy slicing fails loudly rather than showing up as a
+mysterious throughput cliff under pipelined load.
+
+Also here: the oversize guard. A fixed header *claiming* 256 MB must
+be rejected from the 5 header bytes alone — neither parser may
+buffer toward the announced length (that's a remote-controlled
+allocation primitive at fleet scale).
+"""
+
+import tracemalloc
+
+import pytest
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import (FrameTooLarge, Parser, make_parser,
+                                 serialize)
+from emqx_tpu.mqtt.packet import Pingreq, Publish
+
+from emqx_tpu.ops import native as nat
+
+
+class CountingBuf(bytearray):
+    """bytearray that counts compaction-shifted and slice-copied
+    bytes (int indexing is free; slices and del-slices are the
+    O(n) operations the zero-copy rewrite bounds)."""
+
+    shifted = 0   # bytes moved left by `del buf[:k]`
+    sliced = 0    # bytes materialized by `buf[i:j]`
+
+    def __delitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, _ = key.indices(len(self))
+            CountingBuf.shifted += len(self) - stop
+        super().__delitem__(key)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, _ = key.indices(len(self))
+            CountingBuf.sliced += stop - start
+        return super().__getitem__(key)
+
+    @classmethod
+    def reset(cls):
+        cls.shifted = cls.sliced = 0
+
+
+def _py_parser(**kw) -> Parser:
+    """A Parser pinned to the pure-Python path (no C scanner) with an
+    instrumented buffer."""
+    p = Parser(**kw)
+    p._NATIVE_MIN = 1 << 60        # instance override: never go native
+    p._buf = CountingBuf()
+    CountingBuf.reset()
+    return p
+
+
+def test_pipelined_read_compacts_once():
+    """B packets in one read: one compaction of O(buflen), not B
+    del-shifts of O(B·buflen)."""
+    B = 200
+    blob = serialize(Pingreq(), C.MQTT_V4) * B
+    p = _py_parser()
+    out = p.feed(blob)
+    assert len(out) == B
+    # the single end-of-feed compaction consumes the whole buffer, so
+    # zero bytes remain to shift; per-packet deletes would have
+    # shifted ~B²/2 · framelen bytes
+    assert CountingBuf.shifted == 0, CountingBuf.shifted
+    assert len(p._buf) == 0
+
+
+def test_pipelined_read_with_trailing_partial():
+    """Same, with a partial frame behind the batch: the one
+    compaction shifts only the partial tail."""
+    B = 100
+    blob = serialize(Pingreq(), C.MQTT_V4) * B + b"\x30"  # partial PUBLISH
+    p = _py_parser()
+    out = p.feed(blob)
+    assert len(out) == B
+    assert CountingBuf.shifted == 1  # just the orphan header byte
+    assert len(p._buf) == 1
+
+
+def test_large_publish_across_reads_costs_o_len():
+    """A PUBLISH spanning N reads: total slice+shift work is O(len),
+    not O(N·len) — the body is materialized exactly once, when
+    complete."""
+    payload = b"x" * (512 * 1024)
+    frame = serialize(Publish(topic="t", payload=payload), C.MQTT_V4)
+    p = _py_parser()
+    chunk = 32 * 1024
+    out = []
+    for off in range(0, len(frame), chunk):
+        out.extend(p.feed(frame[off:off + chunk]))
+    assert len(out) == 1 and out[0].payload == payload
+    total = CountingBuf.shifted + CountingBuf.sliced
+    # one body materialization + one (empty) compaction; O(N·len)
+    # would be ~16 frames' worth (= len(frame) * nchunks / 2) here
+    assert total <= 2 * len(frame), (total, len(frame))
+
+
+def test_python_parser_rejects_claimed_giant_header():
+    """5 header bytes claiming 256 MB raise at header-decode time;
+    nothing is buffered toward the claim."""
+    p = Parser(max_size=1024 * 1024)
+    header = bytes([0x30]) + b"\xff\xff\xff\x7f"  # RL = 268435455
+    tracemalloc.start()
+    with pytest.raises(FrameTooLarge):
+        p.feed(header)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < 1024 * 1024, peak
+    # raise-before-consume: the poisonous frame stays buffered (the
+    # connection is closing anyway), but it's 5 bytes, not 256 MB
+    assert len(p._buf) == len(header)
+
+
+@pytest.mark.skipif(not nat.has_frame_parser(),
+                    reason="native frame parser not built")
+def test_native_parser_rejects_claimed_giant_header():
+    p = make_parser(max_size=1024 * 1024, mode="native")
+    assert type(p).__name__ == "NativeParser"
+    header = bytes([0x30]) + b"\xff\xff\xff\x7f"
+    tracemalloc.start()
+    with pytest.raises(FrameTooLarge):
+        p.feed(header)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < 1024 * 1024, peak
+    assert p.pending() == len(header)

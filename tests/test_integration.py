@@ -349,7 +349,15 @@ async def test_near_limit_payloads_through_batched_pipeline():
         for _ in range(3):
             m = await asyncio.wait_for(sub.recv(), 20)
             assert len(m.payload) == 900_000
-        with pytest.raises(asyncio.TimeoutError):
-            await pub.publish("big/over", bytes(1_100_000), qos=1,
-                              timeout=3)
+        # the oversized frame draws an explicit v5 DISCONNECT 0x95
+        # (Packet too large) before the close — rejected at
+        # header-decode time, never delivered
+        from emqx_tpu.mqtt import reason_codes as RC
+        from emqx_tpu.mqtt.packet import Disconnect, Publish
+        await pub.send(Publish(topic="big/over",
+                               payload=bytes(1_100_000), qos=1,
+                               packet_id=99))
+        d = await asyncio.wait_for(pub.acks.get(), 10)
+        assert isinstance(d, Disconnect), d
+        assert d.reason_code == RC.PACKET_TOO_LARGE
         await sub.disconnect()

@@ -213,7 +213,7 @@ def test_classification_covers_every_knob():
             f"{reloadable - fields}")
     assert set(table["node"]) == {
         "name", "sys_interval", "cookie", "cluster_port",
-        "load_default_modules", "loops"}
+        "load_default_modules", "loops", "frame"}
 
 
 def test_classification_matches_operations_doc():
