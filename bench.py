@@ -1215,6 +1215,192 @@ def deep_smoke():
     })
 
 
+def retained():
+    """BENCH_MODE=retained — subscribe-time retained replay
+    (ISSUE 19, docs/DISPATCH.md "Retained replay"). Two phases:
+
+    (a) index A/B: BENCH_SUBS retained NAMES in the RetainIndex,
+        mixed literal/wildcard SUBSCRIBE bursts matched through the
+        batched ``[F, L] × [cap, L]`` device kernel
+        (ops/retained_match.py, device_threshold=0) vs the per-filter
+        host scan. The host path IS ``T.match`` over every live name,
+        so device==host on the shared burst is the exact-oracle
+        parity gate. Host subs/s is measured on a small filter
+        subset (RETAINED_HOST_FILTERS) — at 1M names one host filter
+        costs seconds, and per-filter cost is the comparable number.
+
+    (b) wire smoke: a live loopback node replays RETAINED_WIRE_TOPICS
+        retained messages to RETAINED_WIRE_SUBS simultaneous wildcard
+        subscribers through the planner-egress path — every owed
+        frame must arrive (zero lost replays), ``retained.replay``
+        must count ≤1 batch per SUBSCRIBE, and
+        ``delivery.serialize.onloop`` must stay 0 (scripts/ci.sh
+        gates these booleans at toy scale).
+    """
+    import asyncio
+    import random as _random
+
+    _jax_with_retry()
+
+    from emqx_tpu.modules.retainer import RetainIndex
+    from emqx_tpu.ops.walk_pallas import walk_variant
+
+    n_names = int(os.environ.get("BENCH_SUBS") or "1000000")
+    burst = int(os.environ.get("RETAINED_BURST", "64"))
+    n_bursts = int(os.environ.get("RETAINED_BURSTS", "8"))
+    host_f = int(os.environ.get("RETAINED_HOST_FILTERS", "4"))
+    rng = _random.Random(19)
+
+    t0 = time.time()
+    idx = RetainIndex()
+    names = [f"s{i % 499}/g{(i // 499) % 97}/d{i}/state"
+             for i in range(n_names)]
+    for t in names:
+        idx.add(t)
+    build_s = time.time() - t0
+
+    def mk_burst(k):
+        flts = []
+        for _ in range(k):
+            ws = names[rng.randrange(n_names)].split("/")
+            r = rng.random()
+            if r < 0.5:
+                pass  # literal: exact store probe shape
+            elif r < 0.8:
+                ws[rng.randrange(len(ws))] = "+"
+            else:
+                ws = ws[:rng.randint(1, len(ws) - 1)] + ["#"]
+            flts.append("/".join(ws))
+        return flts
+
+    bursts = [mk_burst(burst) for _ in range(n_bursts)]
+    # warm pass: compiles for the (padded-F, cap) shape land here
+    idx.match_many(bursts[0], device_threshold=0)
+    t0 = time.time()
+    dev_hits = [idx.match_many(b, device_threshold=0)
+                for b in bursts]
+    dev_s = time.time() - t0
+    dev_rate = (n_bursts * burst) / dev_s if dev_s else 0.0
+    matched = sum(len(h) for hs in dev_hits for h in hs)
+
+    # host half of the A/B + the exact-oracle parity gate: the same
+    # filters through the T.match scan must produce the same sets
+    probe = bursts[0][:host_f]
+    t0 = time.time()
+    host_hits = idx.match_many(probe,
+                               device_threshold=n_names + 1)
+    host_s = time.time() - t0
+    host_rate = len(probe) / host_s if host_s else 0.0
+    parity_n = len(probe)
+    for flt, want in zip(probe, host_hits):
+        got = dev_hits[0][bursts[0].index(flt)]
+        assert sorted(got) == sorted(want), \
+            f"device/host divergence on {flt!r}"
+    if n_names <= 20_000:
+        # toy scale: full-burst parity is cheap — gate ALL of it
+        for b, hs in zip(bursts, dev_hits):
+            oracle = idx.match_many(b, device_threshold=n_names + 1)
+            assert [sorted(h) for h in hs] \
+                == [sorted(h) for h in oracle], "burst parity"
+            parity_n += len(b)
+
+    wire = asyncio.run(_retained_wire_smoke())
+    assert wire["wire_received"] == wire["wire_expected"], \
+        f"lost replays: {wire}"
+    assert wire["wire_onloop"] == 0, wire
+    assert wire["wire_batches"] <= wire["wire_subs"], wire
+
+    _emit({
+        "metric": "retained_subs_per_s",
+        "value": round(dev_rate, 1),
+        "unit": "subs/sec",
+        "workload": "retained_v1",
+        "names": n_names,
+        "burst": burst,
+        "bursts": n_bursts,
+        "build_s": round(build_s, 3),
+        "matched": matched,
+        "host_subs_per_s": round(host_rate, 2),
+        "speedup_vs_host": (round(dev_rate / host_rate, 2)
+                            if host_rate else None),
+        "parity_ok": True,
+        "parity_filters": parity_n,
+        "walk": walk_variant(),
+        **wire,
+    })
+
+
+async def _retained_wire_smoke() -> dict:
+    """Phase (b) of BENCH_MODE=retained: live loopback replay with
+    the delivery contract pinned (fixed toy scale — it checks
+    booleans, not throughput)."""
+    import asyncio
+
+    from emqx_tpu.bench_live import _Peer, _count_recv
+    from emqx_tpu.modules.retainer import RetainerModule
+    from emqx_tpu.mqtt import constants as C
+    from emqx_tpu.mqtt.frame import serialize
+    from emqx_tpu.mqtt.packet import Publish, Subscribe
+    from emqx_tpu.node import Node
+
+    n_topics = int(os.environ.get("RETAINED_WIRE_TOPICS", "64"))
+    n_subs = int(os.environ.get("RETAINED_WIRE_SUBS", "8"))
+    node = Node(boot_listeners=False)
+    node.modules.load(RetainerModule)
+    lst = node.add_listener(port=0)
+    await node.start()
+    try:
+        node.modules._loaded["retainer"].index_device_threshold = 0
+        pub = _Peer("retw-pub")
+        await pub.connect(lst.port)
+        for i in range(n_topics):
+            pub.writer.write(serialize(Publish(
+                topic=f"rw/{i}/s", payload=b"r%d" % i, retain=True),
+                C.MQTT_V4))
+        await pub.writer.drain()
+        deadline = time.time() + 10.0
+        while node.metrics.val("retained.count") < n_topics \
+                and time.time() < deadline:
+            await asyncio.sleep(0.02)
+        onloop0 = node.metrics.val("delivery.serialize.onloop")
+        subs = [_Peer(f"retw-s{i}") for i in range(n_subs)]
+        for i, s in enumerate(subs):
+            await s.connect(lst.port)
+        tasks = []
+        for s in subs:
+            # SUBSCRIBE without awaiting the SUBACK: replayed frames
+            # can land in the same read as the ack, and the counting
+            # loop must see every one of them
+            s.writer.write(serialize(Subscribe(
+                packet_id=1,
+                topic_filters=[("rw/#", {"qos": 0})]), C.MQTT_V4))
+            tasks.append(asyncio.ensure_future(_count_recv(s)))
+        for s in subs:
+            await s.writer.drain()
+        expected = n_topics * n_subs
+        deadline = time.time() + 30.0
+        while sum(s.received for s in subs) < expected \
+                and time.time() < deadline:
+            await asyncio.sleep(0.02)
+        for t in tasks:
+            t.cancel()
+        for s in subs + [pub]:
+            s.close()
+        return {
+            "wire_topics": n_topics,
+            "wire_subs": n_subs,
+            "wire_expected": expected,
+            "wire_received": sum(s.received for s in subs),
+            "wire_onloop":
+                node.metrics.val("delivery.serialize.onloop")
+                - onloop0,
+            "wire_batches":
+                node.metrics.val("retained.replay.batches"),
+        }
+    finally:
+        await node.stop()
+
+
 def overload():
     """BENCH_MODE=overload — the saturation degradation curve
     (offered load vs delivered msgs/s vs shed fraction) through a
@@ -2664,6 +2850,11 @@ _CONFIG_MATRIX = [
     # latency with the tunnel RTT amortized over a compiled chain
     ("latency_8k", {"BENCH_BATCH": "8192", "BENCH_CHAIN": "32"},
      "latency", 1_000_000, 100_000),
+    # subscribe-time retained replay (ISSUE 19): 1M retained names,
+    # mixed literal/wildcard bursts, batched-device vs host-scan A/B
+    # + the wire-replay contract booleans (zero lost, onloop 0)
+    ("retained_1m", {"RETAINED_BURST": "64", "RETAINED_BURSTS": "8"},
+     "retained", 1_000_000, 100_000),
     # live row pinned to the CPU backend: it measures the HOST wire
     # path (socket→deliver, host-regime filters — no device work at
     # these counts), and in the round-4 TPU run a half-wedged tunnel
@@ -3000,6 +3191,7 @@ _MODES = {
     "partition": ("partition", "partition_heal_converge_s", "s"),
     "sharded": ("sharded", "sharded_publish_throughput", "msgs/sec"),
     "deep_smoke": ("deep_smoke", "deep_smoke_parity", "ok"),
+    "retained": ("retained", "retained_subs_per_s", "subs/sec"),
     "mixed": ("main", "publish_match_fanout_throughput", "msgs/sec"),
     "configs": ("configs", "publish_match_fanout_throughput",
                 "msgs/sec"),
@@ -3023,6 +3215,7 @@ _MODE_WORKLOADS = {
     "fleet": "fleet_v1",
     "recovery": "durability_v1",
     "partition": "cluster_heal_v1",
+    "retained": "retained_v1",
 }
 
 

@@ -1466,6 +1466,54 @@ async def _run_fleet(ports, delivered_fn, conns_fn) -> dict:
         await asyncio.sleep(0.05)
     blast_got = blast_sub.received - base_blast
 
+    # reconnect-storm retained replay (docs/DISPATCH.md "Retained
+    # replay"): seed FLEET_RETAINED retained topics, then
+    # FLEET_RETAINED_CONNS fresh connections subscribe the covering
+    # wildcard at once — each is owed exactly the full set, so
+    # expected == received is the zero-lost-replay check and the
+    # elapsed window is the storm's replay rate. Exercises the
+    # batched subscribe-time match + planner-egress replay end to
+    # end (requires the server to run the retainer module — the
+    # in-process/worker fleet servers load it).
+    ret_n = int(os.environ.get("FLEET_RETAINED", "64"))
+    ret_conns = int(os.environ.get("FLEET_RETAINED_CONNS", "32"))
+    ret_expected = ret_got = 0
+    ret_elapsed = 0.0
+    if ret_n and ret_conns:
+        ret_root = f"fleet/ret/{prefix}"
+        for i in range(ret_n):
+            retain_pub.writer.write(serialize(Publish(
+                topic=f"{ret_root}/{i}/s", payload=b"r",
+                retain=True), C.MQTT_V4))
+        await retain_pub.writer.drain()
+        await asyncio.sleep(0.5)  # stores land before the storm
+        storm = [_Peer(f"{prefix}-ret{i}") for i in range(ret_conns)]
+        await asyncio.gather(*(p.connect(ports[i % len(ports)])
+                               for i, p in enumerate(storm)))
+        storm_tasks = []
+        t0r = time.perf_counter()
+        for p in storm:
+            # SUBSCRIBE without awaiting the SUBACK: replayed frames
+            # can share a read with the ack and every one must count
+            p.writer.write(serialize(Subscribe(
+                packet_id=1,
+                topic_filters=[(f"{ret_root}/#", {"qos": 0})]),
+                C.MQTT_V4))
+            storm_tasks.append(asyncio.ensure_future(_count_recv(p)))
+        await asyncio.gather(*(p.writer.drain() for p in storm))
+        ret_expected = ret_n * ret_conns
+        ret_deadline = time.perf_counter() + float(
+            os.environ.get("FLEET_RETAINED_TIMEOUT", "30"))
+        while sum(p.received for p in storm) < ret_expected \
+                and time.perf_counter() < ret_deadline:
+            await asyncio.sleep(0.05)
+        ret_elapsed = time.perf_counter() - t0r
+        ret_got = sum(p.received for p in storm)
+        for t in storm_tasks:
+            t.cancel()
+        for p in storm:
+            p.close()
+
     ping_stop.set()
     retain_stop.set()
     await asyncio.gather(ping_task, retain_task,
@@ -1504,6 +1552,14 @@ async def _run_fleet(ports, delivered_fn, conns_fn) -> dict:
         "blast_expected": blast_n,
         "blast_received": blast_got,
         "blast_lost": blast_n - blast_got,
+        "retained_storm_conns": ret_conns,
+        "retained_storm_topics": ret_n,
+        "retained_storm_expected": ret_expected,
+        "retained_storm_replayed": ret_got,
+        "retained_storm_lost": ret_expected - ret_got,
+        "retained_storm_s": round(ret_elapsed, 3),
+        "retained_storm_replays_per_s": round(
+            ret_got / ret_elapsed, 1) if ret_elapsed else 0.0,
         "rss_mb": round(rss1, 1),
         "rss_setup_mb": round(rss0, 1),
         "rss_per_10k_conns_mb": round(
@@ -1522,9 +1578,14 @@ async def _run_fleet_inproc() -> dict:
     zone = Zone(name="default", max_inflight=8192,
                 max_mqueue_len=50000)
     nodes = []
+    from emqx_tpu.modules.retainer import RetainerModule
+
     for i in range(nnodes):
         node = Node(name=f"fleet{i}", boot_listeners=False,
                     loops=loops, zone=zone, batch_linger_ms=1.0)
+        # the reconnect-storm retained-replay column needs the
+        # retainer serving replays
+        node.modules.load(RetainerModule)
         node.add_listener(port=0)
         if nnodes > 1:
             node.enable_cluster(port=0, cookie="bench-fleet")
@@ -1609,8 +1670,14 @@ def _merge_driver_rows(rows: list) -> dict:
               "churn_reconnects", "wills_fired", "subs", "pubs",
               "sent", "delivered", "received_client",
               "blast_expected", "blast_received", "blast_lost",
+              "retained_storm_conns", "retained_storm_expected",
+              "retained_storm_replayed", "retained_storm_lost",
               "driver_rss_mb"):
         out[k] = sum(r.get(k, 0) for r in rows)
+    out["retained_storm_s"] = max(
+        r.get("retained_storm_s", 0.0) for r in rows)
+    out["retained_storm_replays_per_s"] = round(sum(
+        r.get("retained_storm_replays_per_s", 0.0) for r in rows), 1)
     out["elapsed_s"] = max(r["elapsed_s"] for r in rows)
     out["delivered_per_s"] = round(
         sum(r["delivered"] / r["elapsed_s"] for r in rows), 1)
@@ -1762,6 +1829,10 @@ def fleet(emit=None) -> None:
               "idlers_with_wills", "persistent_sessions",
               "churn_reconnects", "wills_fired", "p50_ms", "p99_ms",
               "blast_expected", "blast_received", "blast_lost",
+              "retained_storm_conns", "retained_storm_topics",
+              "retained_storm_expected", "retained_storm_replayed",
+              "retained_storm_lost", "retained_storm_s",
+              "retained_storm_replays_per_s",
               "rss_mb", "rss_per_10k_conns_mb",
               "rss_includes_harness", "loops", "workers", "nodes",
               "drivers", "driver_rss_mb", "server_delivered_total",

@@ -429,6 +429,26 @@ class Broker:
             return 0
         return self.publish_batch([msg])[0]
 
+    def publish_will(self, msg: Message) -> None:
+        """Will dispatch (channel teardown, delayed-will expiry,
+        clean-start fires): funnel through the ingress accumulator
+        whenever one is taking submissions — INCLUDING on the home
+        loop, unlike :meth:`publish`, which only funnels peer-loop
+        callers. Nobody awaits a will's delivery count, so the
+        fire-and-forget submit is free, and a mass-disconnect wave
+        (loop death, drain, fleet churn) coalesces its wills into the
+        accumulator's normal device batches instead of N one-message
+        ``publish_batch`` calls — each a full match/fan-out/fetch
+        round-trip. Falls back to :meth:`publish` when no accumulator
+        loop is running (sync drivers, shutdown tail)."""
+        ing = self.ingress
+        if ing is not None:
+            if ing.submit(msg, want_result=False) is not None:
+                self.metrics.inc("wills.batched")
+                return
+        self.metrics.inc("wills.direct")
+        self.publish(msg)
+
     def publish_batch(self, msgs: Sequence[Message]) -> List[int]:
         """Batch publish — the TPU hot path, synchronously.
 

@@ -1158,7 +1158,11 @@ class Channel:
                     self.client_id, self.will,
                     min(delay, self.expiry_interval))
             else:
-                self.broker.publish(self.will)
+                # device-path will dispatch (docs/DISPATCH.md "Will
+                # batching"): a teardown wave's wills coalesce into
+                # the ingress accumulator's normal device batches
+                pw = getattr(self.broker, "publish_will", None)
+                (pw or self.broker.publish)(self.will)
             self.will = None
         if was_connected:
             self.broker.metrics.inc("client.disconnected")

@@ -121,7 +121,11 @@ class ConnectionManager:
                 return  # re-established: will is void
             ent = self._pending_wills.pop(client_id, None)
         if ent is not None and self.broker is not None:
-            self.broker.publish(ent[1])
+            # batched will dispatch: a fleet's worth of delay timers
+            # expiring together (mass disconnect + equal Will-Delay)
+            # coalesces through the ingress accumulator
+            pw = getattr(self.broker, "publish_will", None)
+            (pw or self.broker.publish)(ent[1])
 
     def cancel_will(self, client_id: str, fire: bool = False) -> None:
         """Drop a pending will; ``fire=True`` publishes it instead
@@ -134,7 +138,8 @@ class ConnectionManager:
         if handle is not None:
             handle.cancel()
         if fire and self.broker is not None:
-            self.broker.publish(msg)
+            pw = getattr(self.broker, "publish_will", None)
+            (pw or self.broker.publish)(msg)
 
     # -- session lifecycle (emqx_cm:open_session) -------------------------
 

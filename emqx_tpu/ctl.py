@@ -113,6 +113,11 @@ class Ctl:
             "durability", self._durability,
             "journal/checkpoint/recovery state | checkpoint — "
             "commit a generation now")
+        self.register_command(
+            "retained", self._retained,
+            "retained store/index state: store/deep/tombstone "
+            "counts, device epoch + dirty rows, fallback/breaker "
+            "state, replay batch counters (docs/OBSERVABILITY.md)")
         from emqx_tpu.profiling import register_ctl
         register_ctl(self)
 
@@ -212,6 +217,21 @@ class Ctl:
             out["state"] = ("disabled" if not r.config.match_cache
                             or r.config.match_cache_slots <= 0
                             else "cold (no device match yet)")
+        return json.dumps(out, indent=2)
+
+    def _retained(self, args) -> str:
+        """One-stop retained-path diagnosis (docs/OBSERVABILITY.md
+        "Retained replay"): the store/replay counters (entries,
+        tombstones, dropped/expired, replay batches + last batch
+        size) and the reverse index's device state (live/deep rows,
+        capacity, epoch, dirty-row backlog, breaker/suspension
+        fallback, walk variant)."""
+        mod = self.node.modules._loaded.get("retainer") \
+            if hasattr(self.node, "modules") else None
+        if mod is None:
+            return "retainer module not loaded"
+        out = mod.replay_info()
+        out["index"] = mod._index.device_info()
         return json.dumps(out, indent=2)
 
     def _telemetry(self, args) -> str:

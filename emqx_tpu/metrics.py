@@ -53,6 +53,13 @@ MESSAGE_METRICS = [
     "messages.forward", "messages.retained", "messages.redispatched",
     "messages.delayed", "messages.delivered", "messages.acked",
 ]
+# will dispatch (Broker.publish_will, docs/DISPATCH.md "Will
+# batching"): wills funneled through the ingress accumulator — a
+# mass-disconnect wave coalesces into device batches — vs published
+# directly (no accumulator running: sync drivers, shutdown tail)
+WILL_METRICS = [
+    "wills.batched", "wills.direct",
+]
 DELIVERY_METRICS = [
     "delivery.dropped", "delivery.dropped.no_local",
     "delivery.dropped.too_large", "delivery.dropped.qos0_msg",
@@ -293,6 +300,7 @@ FRAME_METRICS = [
 ]
 
 ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS
+               + WILL_METRICS
                + DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS
                + AUTH_ACL_METRICS + DEVICE_METRICS + CACHE_METRICS
                + AUTOMATON_METRICS + TRANSPORT_METRICS

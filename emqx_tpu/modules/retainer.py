@@ -26,7 +26,8 @@ with a counter, like the plugin's ``max_retained_messages``) and
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -53,19 +54,28 @@ class RetainIndex:
     name in a single data-parallel device pass instead of N Python
     ``T.match`` calls.
 
-    One filter needs no automaton walk at all: per level the filter
+    A filter needs no automaton walk at all: per level the filter
     word either equals the topic word or is ``+``, with a ``#``
     suffix relaxing the depth check and the ``$``-root rule masking
     system topics — a pure elementwise program over ``[cap, L]``
     (zero gathers, HBM-bandwidth bound; an earlier automaton-based
-    variant spent its time in per-level gather chains).
+    variant spent its time in per-level gather chains). Since PR 19
+    the kernel is batched on the filter side too
+    (ops/retained_match.py): :meth:`match_many` encodes a whole
+    subscribe burst as ``[F, L]`` and matches every filter against
+    every stored name in ONE dispatch; :meth:`match` is the F=1
+    special case of the same path.
 
     Rows are slot-allocated (free list); a deleted row gets
     ``n_words = 0``, which matches nothing. Names deeper than ``L``
     levels live in a host-matched side set, the same overflow
     contract as the publish path. Below ``device_threshold`` live
     rows (or on any device failure) matching falls back to the host
-    scan.
+    scan. With a router attached (:meth:`attach_router`) the index
+    rides device-loss recovery: a suspended device plane forces the
+    host scan and drops the cached matrix (its HBM references may be
+    dead), and suspension lifting (``rebuild_complete``) forgives the
+    failure breaker — a fresh backend deserves a clean slate.
     """
 
     L = 16
@@ -89,11 +99,31 @@ class RetainIndex:
         self._dev = None  # (epoch, cap, ids, n, sys) device cache
         self._dirty: set = set()  # rows mutated since _dev was built
         self._device_broken = 0  # consecutive failures; >=3 disables
+        self._router = None  # devloss riding (attach_router)
+        self._suspended_seen = False
+        self._last_batch = 0  # filters in the last device dispatch
+        # store mutations run on the broker's home loop but subscribe
+        # bursts match from every front-door loop; the lock covers
+        # the matrix + device-cache critical sections (uncontended on
+        # a single-loop node)
+        self._lock = threading.RLock()
 
     def __len__(self) -> int:
         return len(self._row_of) + len(self._deep)
 
+    def attach_router(self, router) -> None:
+        """Arm device-loss riding (docs/ROBUSTNESS.md): the index
+        holds its own device references outside
+        ``Router.rebuild_device_state()``, so instead of being
+        rebuilt it watches the router's suspension flag — see the
+        class docstring."""
+        self._router = router
+
     def add(self, topic: str) -> None:
+        with self._lock:
+            self._add_locked(topic)
+
+    def _add_locked(self, topic: str) -> None:
         if topic in self._row_of or topic in self._deep:
             return  # overwrite of the same name: index unchanged
         ws = topic.split("/")
@@ -114,6 +144,10 @@ class RetainIndex:
         self._touch(row)
 
     def remove(self, topic: str) -> None:
+        with self._lock:
+            self._remove_locked(topic)
+
+    def _remove_locked(self, topic: str) -> None:
         if topic in self._deep:
             self._deep.discard(topic)
             return
@@ -139,7 +173,9 @@ class RetainIndex:
         self._maybe_compact(backstop=True)
 
     def clear(self) -> None:
+        router = self._router
         self.__init__()
+        self._router = router
 
     def _touch(self, row: int) -> None:
         self._epoch += 1
@@ -198,11 +234,14 @@ class RetainIndex:
             await asyncio.sleep(0)
             if self._epoch != start_epoch:
                 return False
-        self._ids = new_ids
-        self._table = table
-        self._dev = None
-        self._dirty.clear()
-        self._epoch += 1
+        with self._lock:
+            if self._epoch != start_epoch:
+                return False
+            self._ids = new_ids
+            self._table = table
+            self._dev = None
+            self._dirty.clear()
+            self._epoch += 1
         return True
 
     def _grow(self) -> None:
@@ -219,45 +258,116 @@ class RetainIndex:
 
     def match(self, flt: str, device_threshold: int = 4096) -> List[str]:
         """All stored names matching ``flt`` (exact oracle parity)."""
-        deep_hits = [t for t in self._deep if T.match(t, flt)]
-        if (len(self._row_of) < device_threshold
-                or self._device_broken >= 3):
-            return [t for t in self._row_of
-                    if T.match(t, flt)] + deep_hits
-        try:
-            out = self._match_device(flt) + deep_hits
-            self._device_broken = 0
-            return out
-        except Exception:
-            # circuit breaker: a host with a permanently failing
-            # backend must not pay a failed dispatch + a stack trace
-            # on EVERY wildcard subscribe
-            self._device_broken += 1
-            if self._device_broken >= 3:
-                log.exception(
-                    "retain index device match failed %d times; "
-                    "host scan from now on", self._device_broken)
-            else:
-                log.warning("retain index device match failed; "
-                            "host fallback (%d/3)", self._device_broken)
-            return [t for t in self._row_of
-                    if T.match(t, flt)] + deep_hits
+        return self.match_many([flt], device_threshold)[0]
 
-    def _match_device(self, flt: str) -> List[str]:
+    def match_many(self, filters: Sequence[str],
+                   device_threshold: int = 4096) -> List[List[str]]:
+        """Batched match: every filter of a subscribe burst against
+        every stored name in ONE device dispatch (``[F, L] ×
+        [cap, L]`` elementwise kernel, ops/retained_match.py).
+        Returns per-filter hit lists aligned with ``filters``, exact
+        host-oracle (``T.match``) parity — including the ``$``-root
+        mask, ``#`` depth relax and the deep (> L levels) host side
+        set, which is scanned per filter either way."""
+        if not filters:
+            return []
+        deep = self._deep
+        deep_hits = ([[t for t in deep if T.match(t, f)]
+                      for f in filters] if deep
+                     else [[] for _ in filters])
+        with self._lock:
+            if (len(self._row_of) < device_threshold
+                    or not self._device_ok()):
+                return [self._host_scan(f, dh)
+                        for f, dh in zip(filters, deep_hits)]
+            try:
+                hits = self._match_device_many(filters)
+                self._device_broken = 0
+                return [h + dh for h, dh in zip(hits, deep_hits)]
+            except Exception:
+                # circuit breaker: a host with a permanently failing
+                # backend must not pay a failed dispatch + a stack
+                # trace on EVERY wildcard subscribe
+                self._device_broken += 1
+                if self._device_broken >= 3:
+                    log.exception(
+                        "retain index device match failed %d times; "
+                        "host scan from now on", self._device_broken)
+                else:
+                    log.warning(
+                        "retain index device match failed; "
+                        "host fallback (%d/3)", self._device_broken)
+                return [self._host_scan(f, dh)
+                        for f, dh in zip(filters, deep_hits)]
+
+    def _host_scan(self, flt: str, deep_hits: List[str]) -> List[str]:
+        return [t for t in self._row_of if T.match(t, flt)] + deep_hits
+
+    def _device_ok(self) -> bool:
+        """Device-path gate: the failure breaker, plus devloss riding
+        when a router is attached — suspended means the device plane
+        is mid-recovery (the cached matrix may reference a LOST
+        backend: drop it, host-scan, and don't let the doomed
+        dispatch burn breaker strikes); the suspension lifting means
+        ``rebuild_complete`` ran, so the breaker resets."""
+        r = self._router
+        if r is not None:
+            try:
+                suspended = bool(r.device_suspended())
+            except Exception:
+                suspended = False
+            if suspended:
+                self._dev = None
+                self._dirty.clear()
+                self._suspended_seen = True
+                return False
+            if self._suspended_seen:
+                self._suspended_seen = False
+                self._device_broken = 0
+        return self._device_broken < 3
+
+    def _match_device_many(self, filters: Sequence[str]
+                           ) -> List[List[str]]:
         import jax.numpy as jnp
 
-        ws = flt.split("/")
-        has_hash = ws[-1] == "#"
-        if has_hash:
-            ws = ws[:-1]
-        if len(ws) > self.L:
-            return []  # deeper than any indexed name can be
-        fw = np.full((self.L,), self._pad, dtype=np.int32)
-        for j, w in enumerate(ws):
-            # lookup, NOT intern: an unseen filter word (UNKNOWN=-1)
-            # matches no stored id >= 0 — identical result, and
-            # subscribe traffic can't grow the table
-            fw[j] = _PLUS_ID if w == "+" else self._table.lookup(w)
+        from emqx_tpu.ops.retained_match import match_names_auto
+
+        F = len(filters)
+        # pad the burst to a power of two so compile count stays
+        # logarithmic in burst size (capacity is already pow-2);
+        # padding rows (fn=0, no '#') match nothing
+        Fp = max(1, 1 << (F - 1).bit_length()) if F > 1 else 1
+        fw = np.full((Fp, self.L), self._pad, dtype=np.int32)
+        fn = np.zeros(Fp, dtype=np.int32)
+        hh = np.zeros(Fp, dtype=bool)
+        for i, flt in enumerate(filters):
+            ws = flt.split("/")
+            if ws[-1] == "#":
+                hh[i] = True
+                ws = ws[:-1]
+            if len(ws) > self.L:
+                # deeper than any indexed name can be: leave the row
+                # a no-match (the deep side set covers such names)
+                hh[i] = False
+                continue
+            fn[i] = len(ws)
+            for j, w in enumerate(ws):
+                # lookup, NOT intern: an unseen filter word
+                # (UNKNOWN=-1) matches no stored id >= 0 — identical
+                # result, and subscribe traffic can't grow the table
+                fw[i, j] = _PLUS_ID if w == "+" else self._table.lookup(w)
+        dev = self._device_arrays()
+        ok = np.asarray(match_names_auto(
+            jnp.asarray(fw), jnp.asarray(fn), jnp.asarray(hh),
+            dev[2], dev[3], dev[4]))
+        self._last_batch = F
+        rt = self._row_topic
+        return [[rt[row] for row in np.nonzero(ok[i])[0]
+                 if rt[row] is not None] for i in range(F)]
+
+    def _device_arrays(self):
+        import jax.numpy as jnp
+
         dev = self._dev
         if dev is None or dev[0] != self._epoch or dev[1] != self._cap:
             if (dev is not None and dev[1] == self._cap
@@ -274,54 +384,33 @@ class RetainIndex:
                        jnp.asarray(self._n), jnp.asarray(self._sys))
             self._dev = dev
             self._dirty.clear()
-        ok = np.asarray(_match_names_call(
-            jnp.asarray(fw), np.int32(len(ws)), bool(has_hash),
-            dev[2], dev[3], dev[4]))
-        return [self._row_topic[row] for row in np.nonzero(ok)[0]
-                if self._row_topic[row] is not None]
+        return dev
 
+    def device_info(self) -> dict:
+        """Diagnostic snapshot for ``ctl retained``
+        (docs/OPERATIONS.md): live/deep row counts, device-cache
+        state, breaker/suspension state and the last batch size."""
+        from emqx_tpu.ops.walk_pallas import walk_variant
 
-def _match_names(fw, fn, has_hash, topic_ids, n_words, sys_mask):
-    """One filter vs every stored name, elementwise (jitted below).
-
-    ``fw`` [L] filter word ids (``_PLUS_ID`` for ``+``, PAD beyond
-    ``fn``); ``fn`` word count excluding a trailing ``#``. Semantics
-    = emqx_topic:match/2: per-level equality with ``+`` wildcards; a
-    ``#`` suffix matches the parent itself and anything deeper
-    (src/emqx_topic.erl:64-87); root wildcards never match
-    ``$``-topics (src/emqx_trie.erl:162-163). Dead rows have
-    ``n_words == 0`` and too-deep names ``n_words < 0`` — both
-    excluded by the ``n > 0`` live gate (empty filters don't
-    validate, so ``fn == 0`` only happens for the bare ``#``)."""
-    import jax.numpy as jnp
-
-    L = topic_ids.shape[1]
-    lvl = jnp.arange(L, dtype=jnp.int32)[None, :]
-    word_ok = (topic_ids == fw[None, :]) | (fw[None, :] == _PLUS_ID)
-    prefix_ok = jnp.all(word_ok | (lvl >= fn), axis=1)
-    exact = prefix_ok & (n_words == fn)
-    deeper = has_hash & prefix_ok & (n_words >= fn)
-    ok = (exact | deeper) & (n_words > 0)
-    root_wild = (fw[0] == _PLUS_ID) | (has_hash & (fn == 0))
-    return ok & ~(sys_mask & root_wild)
-
-
-# jit once; shapes vary only with the index capacity (power-of-two
-# growth) so compile count stays logarithmic in store size
-def _jit_match_names():
-    import jax
-
-    return jax.jit(_match_names, static_argnums=(2,))
-
-
-_match_names_jitted = None
-
-
-def _match_names_call(*args):
-    global _match_names_jitted
-    if _match_names_jitted is None:
-        _match_names_jitted = _jit_match_names()
-    return _match_names_jitted(*args)
+        r = self._router
+        suspended = False
+        if r is not None:
+            try:
+                suspended = bool(r.device_suspended())
+            except Exception:
+                pass
+        return {
+            "rows": len(self._row_of),
+            "deep": len(self._deep),
+            "cap": self._cap,
+            "epoch": self._epoch,
+            "cached": self._dev is not None,
+            "dirty_rows": len(self._dirty),
+            "device_broken": self._device_broken,
+            "suspended": suspended,
+            "last_batch": self._last_batch,
+            "walk": walk_variant(),
+        }
 
 
 class RetainerModule(Module):
@@ -341,9 +430,22 @@ class RetainerModule(Module):
         self._restoring = False
         self.max_retained = 0
         self.max_payload = 0
+        # replay accumulator (PR 19): per-event-loop pending
+        # (session, filter, subopts) triples; the first append on a
+        # loop schedules a same-tick drain, so every session.subscribed
+        # firing queued behind one SUBACK burst lands in ONE batched
+        # index match + ONE delivery plan — the subscribe-side mirror
+        # of IngressBatcher's zero-linger coalescing
+        self._pending: Dict[object, list] = {}
+        self._replay_last_batch = 0
+        self._gc_tick = 0
         # cluster seam: Cluster sets node.retain_replicate so stores/
         # deletes broadcast (the reference plugin replicates via
         # Mnesia); applied remotely through apply_remote (no re-fan)
+
+    #: stats ticks between expired-entry sweeps — the stats tick runs
+    #: on every $SYS heartbeat, far more often than eviction needs
+    _GC_EVERY = 6
 
     def load(self, env: dict) -> None:
         self.max_retained = int(env.get("max_retained", 1_000_000))
@@ -355,10 +457,33 @@ class RetainerModule(Module):
         self._kick_on_loop()
         self.node.metrics.new("retained.count")
         self.node.metrics.new("retained.dropped")
+        self.node.metrics.new("retained.expired")
+        self.node.metrics.new("retained.replay.batches")
+        self.node.metrics.new("retained.replay.messages")
+        router = getattr(self.node, "router", None)
+        if router is None:
+            router = getattr(getattr(self.node, "broker", None),
+                             "router", None)
+        if router is not None:
+            # devloss riding: a suspended device plane host-scans and
+            # the breaker resets on rebuild_complete
+            self._index.attach_router(router)
+        stats = getattr(self.node, "stats", None)
+        if stats is not None:
+            # expired-retained GC on the stats tick (low frequency):
+            # entries past Message-Expiry must leave the store/index
+            # even when nothing ever subscribes to them again
+            stats.register_update(self._on_stats_tick)
         self.node.hooks.add("message.publish", self.on_publish,
                             priority=50)
         self.node.hooks.add("session.subscribed", self.on_subscribed,
                             priority=50)
+
+    def _on_stats_tick(self, stats) -> None:
+        self._gc_tick += 1
+        if self._gc_tick >= self._GC_EVERY:
+            self._gc_tick = 0
+            self.sweep_expired()
 
     def on_loop_start(self) -> None:
         import asyncio
@@ -392,6 +517,7 @@ class RetainerModule(Module):
         self.on_loop_stop()
         self.node.hooks.delete("message.publish", self.on_publish)
         self.node.hooks.delete("session.subscribed", self.on_subscribed)
+        self._pending.clear()
         self._store.clear()
         self._index.clear()
 
@@ -522,11 +648,13 @@ class RetainerModule(Module):
 
     def sweep_expired(self) -> int:
         """Drop expired entries (lazy pruning otherwise happens only
-        on a matching subscribe)."""
+        on a matching subscribe — the stats-tick GC and the periodic
+        sweep both land here)."""
         dead = [t for t, m in self._store.items() if m.is_expired()]
         for t in dead:
             self._pop(t)
             self.node.metrics.dec("retained.count")
+            self.node.metrics.inc("retained.expired")
         self._sweep_tombstones()
         return len(dead)
 
@@ -563,6 +691,11 @@ class RetainerModule(Module):
 
     def on_subscribed(self, clientinfo: dict, flt: str,
                       subopts: dict) -> None:
+        """Hook entry: Retain-Handling/shared-sub gating happens here
+        at submit time (both are per-subscription properties, fully
+        known now); the matched set, expiry eviction and the delivery
+        plan are deferred one event-loop tick so a SUBSCRIBE burst
+        coalesces into one batched replay (:meth:`_replay_flush`)."""
         if flt.startswith(("$share/", "$queue/")):
             return  # never to shared subscriptions
         rh = subopts.get("rh", 0)
@@ -573,26 +706,189 @@ class RetainerModule(Module):
         session = getattr(chan, "session", None)
         if session is None or not self._store:
             return
-        if not T.wildcard(flt):
-            # exact filter: one dict probe, not a store scan
-            matches = [flt] if flt in self._store else []
-        else:
-            matches = self._index.match(
-                flt, device_threshold=self.index_device_threshold)
-        for topic in matches:
-            msg = self._store.get(topic)
-            if msg is None:
-                continue
-            if msg.is_expired():
-                self._pop(topic)
-                self.node.metrics.dec("retained.count")
-                continue
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            # loop-less (library/sync) callers keep the synchronous
+            # semantics: a one-item burst, flushed inline
+            self._replay_flush([(session, flt, subopts)])
+            return
+        # the hook fires on the subscribing channel's owner loop and
+        # delivery targets that same loop's session, so pending lists
+        # are per-loop: append + drain never cross threads
+        pend = self._pending.get(loop)
+        if pend is None:
+            self._pending[loop] = pend = []
+        pend.append((session, flt, subopts))
+        if len(pend) == 1:
+            # first item this tick: drain at the end of the current
+            # loop iteration — every hook firing queued behind the
+            # same SUBSCRIBE burst lands in THIS batch (zero-linger
+            # coalescing, like IngressBatcher.submit)
+            loop.call_soon(self._replay_kick, loop)
+
+    def _replay_kick(self, loop) -> None:
+        items = self._pending.pop(loop, None)
+        if items:
+            try:
+                self._replay_flush(items)
+            except Exception:
+                log.exception("retained replay flush failed")
+
+    def _replay_flush(self, items: list) -> None:
+        """One subscribe burst → one batched index match → one
+        subscriber-grouped delivery plan.
+
+        The publish path's full PR 3/5 treatment applied to replay
+        (docs/DISPATCH.md "Retained replay"): unique wildcard filters
+        match in ONE device dispatch (RetainIndex.match_many), every
+        stored topic materializes ONE shared out-copy per burst
+        (retain flag kept per MQTT-3.3.1-8, expiry filtered here in
+        the plan stage with lazy eviction), the (session, filter,
+        row) triples group by subscriber through
+        ops/dispatch_plan.DispatchPlan, wire frames pre-build through
+        preserialize_plan (retain-set and RAP variants are serialize
+        classes there), and each session takes its whole group in one
+        ``deliver_many`` = one notify wakeup per connection per
+        burst. ``dispatch.planner=false`` restores the legacy
+        per-delivery walk byte-for-byte."""
+        store = self._store
+        if not store:
+            return
+        metrics = self.node.metrics
+        # unique filters across the burst; wildcards batch through
+        # the index, exact filters stay a dict probe
+        flt_list: List[str] = []
+        fidx: Dict[str, int] = {}
+        for _sess, flt, _opts in items:
+            if flt not in fidx:
+                fidx[flt] = len(flt_list)
+                flt_list.append(flt)
+        wild = [f for f in flt_list if T.wildcard(f)]
+        hits: Dict[str, List[str]] = {}
+        if wild:
+            hits.update(zip(wild, self._index.match_many(
+                wild, device_threshold=self.index_device_threshold)))
+        for f in flt_list:
+            if f not in hits:
+                hits[f] = [f] if f in store else []
+        # burst-local message rows: ONE copy per stored topic however
+        # many sessions/filters matched it, so wire caches and the
+        # pre-serialized frames are shared across the whole burst
+        row_of: Dict[str, int] = {}
+        rows: List[Message] = []
+
+        def row_for(topic: str) -> int:
+            r = row_of.get(topic)
+            if r is not None:
+                return r
+            msg = store.get(topic)
+            if msg is None or msg.is_expired():
+                if msg is not None:
+                    self._pop(topic)
+                    metrics.dec("retained.count")
+                    metrics.inc("retained.expired")
+                row_of[topic] = -1
+                return -1
             out = msg.copy()
             # retained-delivery keeps retain=1 (MQTT-3.3.1-8); the
             # 'retained' header tells the session's RAP logic this
             # flag is not subject to clearing
             out.set_header("retained", True)
-            session.deliver(flt, out)
+            row_of[topic] = r = len(rows)
+            rows.append(out)
+            return r
+
+        sess_of: Dict[int, int] = {}
+        sessions: List[object] = []
+        sids: List[int] = []
+        fids: List[int] = []
+        rids: List[int] = []
+        opts_of: Dict[tuple, object] = {}
+        for sess, flt, _opts in items:
+            topics = hits.get(flt, ())
+            if not topics:
+                continue
+            key = id(sess)
+            sid = sess_of.get(key)
+            if sid is None:
+                sid = sess_of[key] = len(sessions)
+                sessions.append(sess)
+            fid = fidx[flt]
+            subs = getattr(sess, "subscriptions", None)
+            # the REAL SubOpts object (the hook hands a plain dict):
+            # deliver_many and preserialize_plan key serialize
+            # classes off its qos/rap/share/subid fields
+            opts_of[(sid, fid)] = subs.get(flt) if subs else None
+            for t in topics:
+                r = row_for(t)
+                if r >= 0:
+                    sids.append(sid)
+                    fids.append(fid)
+                    rids.append(r)
+        if not sids:
+            return
+        metrics.inc("retained.replay.batches")
+        metrics.inc("retained.replay.messages", len(sids))
+        self._replay_last_batch = len(sids)
+        cfg = getattr(getattr(self.node, "broker", None),
+                      "dispatch_config", None)
+        if cfg is None or not cfg.planner:
+            # legacy per-delivery path (dispatch.planner=false),
+            # byte-for-byte the pre-batching replay loop
+            for k in range(len(sids)):
+                sessions[sids[k]].deliver(
+                    flt_list[fids[k]], rows[rids[k]])
+            return
+        from emqx_tpu.ops.dispatch_plan import (DispatchPlan,
+                                                preserialize_plan)
+
+        plan = DispatchPlan(np.asarray(sids, np.int64),
+                            np.asarray(fids, np.int64),
+                            np.asarray(rids, np.int64))
+        if cfg.preserialize:
+            subscribers: Dict[str, dict] = {}
+            for (sid, fid), opts in opts_of.items():
+                if opts is not None:
+                    subscribers.setdefault(
+                        flt_list[fid], {})[sessions[sid]] = opts
+            preserialize_plan(plan, list(enumerate(rows)), flt_list,
+                              subscribers, lambda sid: sessions[sid])
+        g_ptr = plan.g_ptr
+        for g in range(plan.n_groups):
+            sid = plan.g_sids[g]
+            sess = sessions[sid]
+            group = []
+            for k in range(g_ptr[g], g_ptr[g + 1]):
+                fid = plan.fids[k]
+                group.append((flt_list[fid], rows[plan.rows[k]],
+                              opts_of.get((sid, fid)), False))
+            dm = getattr(sess, "deliver_many", None)
+            if dm is not None:
+                dm(group)
+            else:
+                # plain subscriber objects (tests, adapters) without
+                # the batched protocol
+                for gflt, gmsg, _o, _f in group:
+                    sess.deliver(gflt, gmsg)
+
+    def replay_info(self) -> dict:
+        """``ctl retained`` snapshot: store/replay-side counters to
+        pair with ``RetainIndex.device_info``."""
+        m = self.node.metrics
+        return {
+            "store": len(self._store),
+            "tombstones": len(self._tombstones),
+            "dropped": m.val("retained.dropped"),
+            "expired": m.val("retained.expired"),
+            "replay_batches": m.val("retained.replay.batches"),
+            "replay_messages": m.val("retained.replay.messages"),
+            "replay_last_batch": self._replay_last_batch,
+        }
 
     def info(self) -> dict:
         return {"retained": len(self._store)}

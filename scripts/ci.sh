@@ -329,8 +329,37 @@ rec=json.loads(sys.stdin.readlines()[-1]); \
 assert rec['metric']=='fleet_delivered_msgs_per_s' \
     and rec['value'] is not None \
     and rec['blast_lost'] == 0 \
+    and rec['retained_storm_lost'] == 0 \
+    and rec['retained_storm_replayed'] > 0 \
     and rec['frame_native_frames'] > 0 \
     and rec['frame_fallback'] == 0, rec"
+
+echo "== retained replay parity (docs/DISPATCH.md \"Retained replay\") =="
+# batched subscribe-time matching vs the T.match host oracle (lax AND
+# forced-Pallas interpret), planner on/off + loops=1/2 replay wire
+# parity, the ≤1-wakeup / onloop==0 delivery contract, will batching,
+# devloss riding — a divergence here is a delivery-correctness bug,
+# fail before the long run
+python -m pytest tests/test_retained_replay.py -q
+
+echo "== retained replay smoke (docs/PERF_NOTES.md round 8) =="
+# the BENCH_MODE=retained scenario at toy scale: batched-device vs
+# host-scan parity over every burst (parity_ok), and the live wire
+# phase — every owed replay arrived (zero lost), serialization stayed
+# off-loop, and the storm coalesced into ≤1 replay batch per
+# subscriber (throughput numbers are not gated — the driver's 1M-name
+# run is)
+BENCH_MODE=retained BENCH_SUBS=4000 RETAINED_BURST=24 \
+    RETAINED_BURSTS=3 \
+    BENCH_PLATFORM=cpu BENCH_NO_FALLBACK=1 BENCH_NO_STAGE=1 \
+    python bench.py | python -c "import json,sys; \
+rec=json.loads(sys.stdin.readlines()[-1]); \
+assert rec['metric']=='retained_subs_per_s' \
+    and rec['value'] is not None \
+    and rec['parity_ok'] is True \
+    and rec['wire_received'] == rec['wire_expected'] \
+    and rec['wire_onloop'] == 0 \
+    and rec['wire_batches'] <= rec['wire_subs'], rec"
 
 echo "== pytest =="
 if [[ "${COV:-1}" == "0" ]]; then

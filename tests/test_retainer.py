@@ -350,6 +350,9 @@ async def test_retainer_wildcard_lookup_via_device_index():
         n.cm._channels["ridx"] = chan
         ret.on_subscribed({"clientid": "ridx"}, "home/+/temp",
                           {"qos": 0})
+        # replay batches through the accumulator: delivery lands at
+        # the end of the current loop tick (PR 19)
+        await asyncio.sleep(0)
         assert [f for f, _ in sess.got] == ["home/+/temp"] * 2
         assert sorted(m.topic for _, m in sess.got) == [
             "home/k/temp", "home/l/temp"]
